@@ -1,0 +1,386 @@
+"""Autograd: tape-based reverse-mode AD over pure JAX ops.
+
+Parity target: [U:python/mxnet/autograd.py] + the C++ tape in
+[U:src/imperative/imperative.cc] (``RecordOp``/``Backward``).  The reference
+records an nnvm graph and symbolically differentiates it; here each recorded
+node captures the ``jax.vjp`` of the executed pure function, so backward is a
+reverse walk calling stored vjp closures — residuals live on device exactly
+like the reference's saved forward buffers.
+
+Scopes (``record``, ``pause``, ``train_mode``, ``predict_mode``) and the
+``backward``/``grad``/``Function`` APIs match the reference.  Differences:
+``create_graph=True`` (grad-of-grad through the tape) is not supported — use
+:func:`incubator_mxnet_tpu.grad_fn` (functional ``jax.grad``) for higher-order
+derivatives, which the reference cannot express at all for jitted graphs.
+"""
+from __future__ import annotations
+
+import contextlib
+import itertools
+import threading
+
+import jax
+
+__all__ = [
+    "record",
+    "pause",
+    "train_mode",
+    "predict_mode",
+    "is_recording",
+    "is_training",
+    "set_recording",
+    "set_training",
+    "mark_variables",
+    "backward",
+    "grad",
+    "Function",
+]
+
+_tls = threading.local()
+
+
+def _state():
+    if not hasattr(_tls, "recording"):
+        _tls.recording = False
+        _tls.training = False
+    return _tls
+
+
+def is_recording():
+    return _state().recording
+
+
+def is_training():
+    return _state().training
+
+
+def set_recording(is_record):
+    s = _state()
+    prev, s.recording = s.recording, is_record
+    return prev
+
+
+def set_training(train_mode_):
+    s = _state()
+    prev, s.training = s.training, train_mode_
+    return prev
+
+
+@contextlib.contextmanager
+def _scope(recording, training):
+    s = _state()
+    prev_r, prev_t = s.recording, s.training
+    if recording is not None:
+        s.recording = recording
+    if training is not None:
+        s.training = training
+    try:
+        yield
+    finally:
+        s.recording, s.training = prev_r, prev_t
+
+
+def record(train_mode=True):
+    """Scope in which executed ops are recorded for ``backward``."""
+    return _scope(True, train_mode)
+
+
+def pause(train_mode=False):
+    """Scope in which recording is suspended (e.g. metric computation)."""
+    return _scope(False, train_mode)
+
+
+def train_mode():
+    return _scope(None, True)
+
+
+def predict_mode():
+    return _scope(None, False)
+
+
+# ---------------------------------------------------------------------------
+# Tape
+# ---------------------------------------------------------------------------
+
+_node_counter = itertools.count()
+
+
+class _Node:
+    """One recorded op: holds the vjp closure and provenance of its inputs."""
+
+    __slots__ = ("oid", "vjp_fn", "in_prov", "n_out", "name", "_avals")
+
+    def __init__(self, vjp_fn, in_prov, n_out, name=""):
+        self.oid = next(_node_counter)
+        self.vjp_fn = vjp_fn
+        self.in_prov = in_prov  # list of (_Node|NDArray-leaf|None, out_index)
+        self.n_out = n_out
+        self.name = name
+
+
+def record_op(fn, raw_inputs, input_arrays, kwargs, name=""):
+    """Execute ``fn`` under vjp and record a tape node.
+
+    ``raw_inputs`` are the jax arrays; ``input_arrays`` the owning NDArrays
+    (for provenance).  Returns the tuple of raw outputs and the node (or
+    ``None, None`` if no input participates in the graph).
+    """
+    needs = [(_provenance(a) is not None) for a in input_arrays]
+    if not any(needs):
+        return None, None
+
+    def pure(*diff_args):
+        it = iter(diff_args)
+        full = [next(it) if n else r for n, r in zip(needs, raw_inputs)]
+        out = fn(*full, **kwargs)
+        return out if isinstance(out, tuple) else (out,)
+
+    diff_in = [r for n, r in zip(needs, raw_inputs) if n]
+    outs, vjp_fn = jax.vjp(pure, *diff_in)
+    prov = [_provenance(a) for a, n in zip(input_arrays, needs) if n]
+    node = _Node(vjp_fn, prov, len(outs), name=name)
+    node._avals = [jax.ShapeDtypeStruct(o.shape, o.dtype) for o in outs]
+    return outs, node
+
+
+def _provenance(arr):
+    """Return the tape attachment of an NDArray, or None."""
+    if arr is None:
+        return None
+    prov = getattr(arr, "_prov", None)
+    return prov  # ('leaf', arr) or (node, out_index) or None
+
+
+# ---------------------------------------------------------------------------
+# Backward pass
+# ---------------------------------------------------------------------------
+
+
+def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
+    """Reverse walk from ``heads``, accumulating into leaf ``.grad`` buffers.
+
+    Parity: ``mx.autograd.backward`` / ``Imperative::Backward``
+    ([U:src/imperative/imperative.cc]).
+    """
+    import numpy as _np
+    from .ndarray import NDArray
+
+    if isinstance(heads, NDArray):
+        heads = [heads]
+    if head_grads is None:
+        head_grads = [None] * len(heads)
+    elif isinstance(head_grads, NDArray):
+        head_grads = [head_grads]
+    if len(heads) != len(head_grads):
+        raise ValueError("heads and head_grads length mismatch")
+
+    # Seed output gradients keyed by (node oid, out_index) / leaf id.
+    node_grads: dict[int, list] = {}
+    leaf_grads: dict[int, object] = {}
+    nodes: dict[int, _Node] = {}
+    leaves: dict[int, object] = {}
+
+    def seed(prov, g):
+        if prov is None:
+            return
+        tag, payload = prov
+        if tag == "leaf":
+            leaf = payload
+            lid = id(leaf)
+            leaves[lid] = leaf
+            leaf_grads[lid] = g if lid not in leaf_grads else leaf_grads[lid] + g
+        else:
+            node, idx = tag, payload
+            nid = node.oid
+            nodes[nid] = node
+            slots = node_grads.setdefault(nid, [None] * node.n_out)
+            slots[idx] = g if slots[idx] is None else slots[idx] + g
+
+    import jax.numpy as jnp
+
+    for h, hg in zip(heads, head_grads):
+        prov = _provenance(h)
+        if prov is None:
+            raise ValueError(
+                "cannot differentiate a head that is not part of the recorded "
+                "graph; call .attach_grad() and compute inside autograd.record()"
+            )
+        if hg is None:
+            g = jnp.ones_like(h._data)
+        else:
+            g = hg._data if isinstance(hg, NDArray) else jnp.asarray(hg)
+        seed(prov, g)
+
+    # Process nodes in reverse creation order; creation order is a valid
+    # topological order because inputs exist before outputs.  New nodes may
+    # be discovered while walking, so use a max-heap keyed on creation id.
+    import heapq
+
+    heap = [-nid for nid in nodes]
+    heapq.heapify(heap)
+    while heap:
+        nid = -heapq.heappop(heap)
+        node = nodes[nid]
+        slots = node_grads.pop(nid, None)
+        if slots is None:
+            continue
+        outs = tuple(
+            s if s is not None else None for s in slots
+        )
+        # vjp requires cotangents for every output; fill missing with zeros.
+        # We need output shapes — recover from the vjp closure by probing is
+        # costly, so require all-or-zero: replace None with 0-strength via
+        # zeros_like of the known slot when possible.
+        if any(s is None for s in outs):
+            # Build zeros from recorded output avals stored on the vjp fn.
+            filled = []
+            for s, aval in zip(outs, _out_avals(node)):
+                filled.append(s if s is not None else jnp.zeros(aval.shape, aval.dtype))
+            outs = tuple(filled)
+        in_gs = node.vjp_fn(outs)
+        for prov, g in zip(node.in_prov, in_gs):
+            if prov is None or g is None:
+                continue
+            tag, payload = prov
+            if tag == "leaf":
+                lid = id(payload)
+                leaves[lid] = payload
+                leaf_grads[lid] = g if lid not in leaf_grads else leaf_grads[lid] + g
+            else:
+                pnode, idx = tag, payload
+                pid = pnode.oid
+                if pid not in nodes:
+                    nodes[pid] = pnode
+                    heapq.heappush(heap, -pid)
+                slots2 = node_grads.setdefault(pid, [None] * pnode.n_out)
+                slots2[idx] = g if slots2[idx] is None else slots2[idx] + g
+        if not retain_graph:
+            node.vjp_fn = None  # free residuals eagerly
+
+    # Write into leaf .grad respecting grad_req.
+    for lid, leaf in leaves.items():
+        g = leaf_grads.get(lid)
+        if g is None:
+            continue
+        req = getattr(leaf, "_grad_req", "write")
+        if req == "null":
+            continue
+        if leaf._grad is None:
+            continue
+        if req == "add":
+            leaf._grad._data = leaf._grad._data + g
+        else:  # write
+            leaf._grad._data = g.astype(leaf._grad._data.dtype) if g.dtype != leaf._grad._data.dtype else g
+    _np  # silence linters
+
+
+def _out_avals(node):
+    """Shape/dtype of a node's outputs, recovered from the vjp closure."""
+    # jax.vjp closures don't expose avals publicly; we stash them at record
+    # time instead (set in record_op via attribute).
+    avals = getattr(node, "_avals", None)
+    if avals is None:
+        raise RuntimeError("internal: missing output avals for partial cotangents")
+    return avals
+
+
+def grad(heads, variables, head_grads=None, retain_graph=None, create_graph=False, train_mode=True):
+    """Return gradients of ``heads`` w.r.t. ``variables`` without touching
+    ``.grad`` buffers.  Parity: ``mx.autograd.grad``."""
+    from .ndarray import NDArray
+
+    if create_graph:
+        raise NotImplementedError(
+            "create_graph=True is not supported by the tape; use jax.grad via "
+            "incubator_mxnet_tpu.grad_fn for higher-order derivatives"
+        )
+    if isinstance(variables, NDArray):
+        variables = [variables]
+        single = True
+    else:
+        single = False
+    # Temporarily swap grads into fresh buffers.
+    from .ndarray import zeros
+
+    saved = [(v._grad, v._grad_req) for v in variables]
+    for v in variables:
+        prov = _provenance(v)
+        if prov is None or prov[0] != "leaf":
+            raise ValueError(
+                "variables passed to autograd.grad must have been marked with "
+                "attach_grad()/mark_variables() (parity with the reference: "
+                "gradients are only tracked for marked leaves)"
+            )
+        v._grad = zeros(v.shape, dtype=v.dtype, ctx=v.ctx)
+        v._grad_req = "write"
+    try:
+        backward(heads, head_grads, retain_graph=bool(retain_graph), train_mode=train_mode)
+        out = [v._grad for v in variables]
+    finally:
+        for v, (g, req) in zip(variables, saved):
+            v._grad, v._grad_req = g, req
+    return out[0] if single else out
+
+
+def mark_variables(variables, gradients, grad_reqs="write"):
+    """Legacy API: associate grad buffers with variables (parity:
+    ``mx.autograd.mark_variables``)."""
+    if isinstance(grad_reqs, str):
+        grad_reqs = [grad_reqs] * len(variables)
+    for v, g, req in zip(variables, gradients, grad_reqs):
+        v._grad = g
+        v._grad_req = req
+        v._prov = ("leaf", v)
+
+
+class Function:
+    """Customizable differentiable function (parity:
+    ``mx.autograd.Function``, [U:python/mxnet/autograd.py]).
+
+    Subclass and implement ``forward(self, *inputs)`` and
+    ``backward(self, *output_grads)`` operating on NDArrays; inside both,
+    recording is paused.
+    """
+
+    def __init__(self):
+        self._saved = None
+
+    def save_for_backward(self, *args):
+        self._saved = args
+
+    @property
+    def saved_tensors(self):
+        return self._saved
+
+    def forward(self, *inputs):
+        raise NotImplementedError
+
+    def backward(self, *output_grads):
+        raise NotImplementedError
+
+    def __call__(self, *inputs):
+        from .ndarray import NDArray, array
+
+        with pause():
+            outputs = self.forward(*inputs)
+        single = not isinstance(outputs, (tuple, list))
+        outs = (outputs,) if single else tuple(outputs)
+        if is_recording() and any(_provenance(x) is not None for x in inputs):
+            func = self
+            import jax.numpy as jnp
+
+            def vjp_fn(cotangents):
+                with pause():
+                    gs = func.backward(*[NDArray(c) for c in cotangents])
+                if not isinstance(gs, (tuple, list)):
+                    gs = (gs,)
+                return tuple(g._data if isinstance(g, NDArray) else jnp.asarray(g) for g in gs)
+
+            # one provenance slot per ORIGINAL input — backward() pairs each
+            # custom-backward gradient positionally and skips None slots
+            prov = [_provenance(x) for x in inputs]
+            node = _Node(vjp_fn, prov, len(outs), name=type(self).__name__)
+            node._avals = [jax.ShapeDtypeStruct(o.shape, o.dtype) for o in outs]
+            for i, o in enumerate(outs):
+                o._prov = (node, i)
+        return outs[0] if single else list(outs)

@@ -1,0 +1,64 @@
+"""Shared base utilities for the TPU-native MXNet-style framework.
+
+Parity target: the dtype/ctypes plumbing in [U:python/mxnet/base.py] — but there
+is no C ABI here: JAX/XLA is the backend, so "base" reduces to dtype tables,
+error types, and small helpers.  (Reference mount was empty this round; citations
+use the [U:path] convention from SURVEY.md.)
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+__all__ = [
+    "MXNetError",
+    "DeferredInitializationError",
+    "numeric_types",
+    "integer_types",
+    "string_types",
+    "_as_np_dtype",
+    "_DTYPE_ALIASES",
+]
+
+
+class MXNetError(RuntimeError):
+    """Framework-level error (parity: MXNetError in [U:python/mxnet/base.py])."""
+
+
+class DeferredInitializationError(MXNetError):
+    """Raised when a Parameter's value is accessed before shape inference
+    completed (parity: [U:python/mxnet/gluon/parameter.py])."""
+
+
+numeric_types = (float, int, _np.generic)
+integer_types = (int, _np.integer)
+string_types = (str,)
+
+# MXNet's public dtype vocabulary mapped onto numpy/JAX dtypes.  bfloat16 is
+# first-class on TPU (the reference's float16 role is mostly played by bf16).
+_DTYPE_ALIASES = {
+    "float32": _np.dtype("float32"),
+    "float64": _np.dtype("float64"),
+    "float16": _np.dtype("float16"),
+    "uint8": _np.dtype("uint8"),
+    "int8": _np.dtype("int8"),
+    "int32": _np.dtype("int32"),
+    "int64": _np.dtype("int64"),
+    "bool": _np.dtype("bool"),
+}
+
+
+def _as_np_dtype(dtype):
+    """Normalize a user-provided dtype (str | np.dtype | type) to np.dtype.
+
+    ``bfloat16`` is passed through as the ml_dtypes/JAX extended dtype.
+    """
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        if dtype == "bfloat16":
+            import ml_dtypes
+
+            return _np.dtype(ml_dtypes.bfloat16)
+        if dtype in _DTYPE_ALIASES:
+            return _DTYPE_ALIASES[dtype]
+    return _np.dtype(dtype)

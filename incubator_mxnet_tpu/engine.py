@@ -1,0 +1,67 @@
+"""Engine facade.
+
+Parity target: [U:src/engine/] + [U:python/mxnet/engine.py].  The reference's
+ThreadedEnginePerDevice (async dataflow scheduler over per-device worker
+threads and CUDA streams) is played here by XLA/PJRT's async dispatch: every
+op returns a future-backed ``jax.Array`` and XLA orders execution by data
+dependence, which is exactly the engine's var-version dependency rule.  What
+remains of the engine API:
+
+* ``waitall`` — fence (``Engine::WaitForAll``)
+* ``bulk(size)`` — op-bulking hint; XLA fusion subsumes it, kept as a no-op
+  scope for script compat
+* naive/sync mode — ``set_engine_type('NaiveEngine')`` maps to
+  ``jax.disable_jit`` + eager blocking, the reference's ``MXNET_ENGINE_TYPE``
+  debug bisection knob
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+
+__all__ = ["waitall", "bulk", "set_bulk_size", "engine_type", "set_engine_type"]
+
+_engine_type = os.environ.get("MXNET_ENGINE_TYPE", "ThreadedEnginePerDevice")
+_bulk_size = int(os.environ.get("MXNET_EXEC_BULK_EXEC_MAX_NODE_TRAIN", 15))
+
+
+def waitall():
+    from .ndarray.ndarray import waitall as _w
+
+    _w()
+
+
+@contextlib.contextmanager
+def bulk(size):
+    """Bulk-execution scope (parity: ``mx.engine.bulk``).  XLA fuses traced
+    regions automatically; this scope is retained for API compatibility."""
+    global _bulk_size
+    prev, _bulk_size = _bulk_size, size
+    try:
+        yield
+    finally:
+        _bulk_size = prev
+
+
+def set_bulk_size(size):
+    global _bulk_size
+    prev, _bulk_size = _bulk_size, size
+    return prev
+
+
+def engine_type():
+    return _engine_type
+
+
+def set_engine_type(name):
+    """'NaiveEngine' => synchronous, jit-free debug mode."""
+    global _engine_type
+    import jax
+
+    prev = _engine_type
+    _engine_type = name
+    if name == "NaiveEngine":
+        jax.config.update("jax_disable_jit", True)
+    else:
+        jax.config.update("jax_disable_jit", False)
+    return prev

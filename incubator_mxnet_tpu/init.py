@@ -1,0 +1,4 @@
+"""``mx.init`` alias module (parity: ``mxnet.init`` re-exporting
+``mxnet.initializer``)."""
+from .initializer import *  # noqa: F401,F403
+from .initializer import __all__  # noqa: F401

@@ -1,0 +1,291 @@
+"""Weight initializers (parity: [U:python/mxnet/initializer.py]).
+
+Same registry + ``InitDesc``-pattern-matching design as the reference: an
+Initializer is called with a descriptor (carrying the parameter name and
+attrs) and fills the NDArray; name suffixes (``_weight``, ``_bias``,
+``_gamma``, ``_beta``, ``_mean``, ``_var``) route to the right rule.
+"""
+from __future__ import annotations
+
+import json
+import math
+
+import numpy as _np
+
+from . import random as _random
+from .ndarray.ndarray import NDArray
+
+__all__ = [
+    "InitDesc",
+    "Initializer",
+    "Zero",
+    "One",
+    "Constant",
+    "Uniform",
+    "Normal",
+    "Orthogonal",
+    "Xavier",
+    "MSRAPrelu",
+    "Bilinear",
+    "LSTMBias",
+    "Load",
+    "Mixed",
+    "register",
+    "create",
+]
+
+_REGISTRY = {}
+
+
+def register(klass):
+    _REGISTRY[klass.__name__.lower()] = klass
+    return klass
+
+
+def create(initializer, **kwargs):
+    if initializer is None:
+        return None
+    if isinstance(initializer, Initializer):
+        return initializer
+    if isinstance(initializer, str):
+        return _REGISTRY[initializer.lower()](**kwargs)
+    raise TypeError(initializer)
+
+
+class InitDesc(str):
+    """Parameter descriptor: a str (the name) with optional attrs
+    (parity: ``mx.init.InitDesc``)."""
+
+    def __new__(cls, name, attrs=None, global_init=None):
+        obj = super().__new__(cls, name)
+        obj.attrs = attrs or {}
+        obj.global_init = global_init
+        return obj
+
+
+class Initializer:
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def dumps(self):
+        return json.dumps([self.__class__.__name__.lower(), self._kwargs])
+
+    def __call__(self, desc, arr):
+        if not isinstance(desc, InitDesc):
+            desc = InitDesc(desc)
+        init = desc.attrs.get("__init__", "")
+        if init:
+            create(json.loads(init)[0], **json.loads(init)[1])._init_weight(desc, arr)
+            return
+        name = str(desc)
+        if name.endswith("weight"):
+            self._init_weight(desc, arr)
+        elif name.endswith("bias"):
+            self._init_bias(desc, arr)
+        elif name.endswith("gamma"):
+            self._init_gamma(desc, arr)
+        elif name.endswith("beta"):
+            self._init_beta(desc, arr)
+        elif name.endswith("running_mean") or name.endswith("moving_mean") or name.endswith("mean"):
+            self._init_zero(desc, arr)
+        elif name.endswith("running_var") or name.endswith("moving_var") or name.endswith("var"):
+            self._init_one(desc, arr)
+        else:
+            self._init_default(desc, arr)
+
+    # element rules -----------------------------------------------------
+    def _init_zero(self, _, arr):
+        arr[:] = 0.0
+
+    def _init_one(self, _, arr):
+        arr[:] = 1.0
+
+    def _init_bias(self, _, arr):
+        arr[:] = 0.0
+
+    def _init_gamma(self, _, arr):
+        arr[:] = 1.0
+
+    def _init_beta(self, _, arr):
+        arr[:] = 0.0
+
+    def _init_weight(self, desc, arr):
+        raise NotImplementedError
+
+    def _init_default(self, desc, arr):
+        self._init_weight(desc, arr)
+
+    def __repr__(self):
+        return f"{self.__class__.__name__}({self._kwargs})"
+
+
+@register
+class Zero(Initializer):
+    def _init_weight(self, _, arr):
+        arr[:] = 0.0
+
+
+@register
+class One(Initializer):
+    def _init_weight(self, _, arr):
+        arr[:] = 1.0
+
+
+@register
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        super().__init__(value=value)
+        self.value = value
+
+    def _init_weight(self, _, arr):
+        arr[:] = self.value
+
+
+@register
+class Uniform(Initializer):
+    def __init__(self, scale=0.07):
+        super().__init__(scale=scale)
+        self.scale = scale
+
+    def _init_weight(self, _, arr):
+        arr[:] = _random.uniform(-self.scale, self.scale, arr.shape, dtype="float32").astype(arr.dtype)
+
+
+@register
+class Normal(Initializer):
+    def __init__(self, sigma=0.01):
+        super().__init__(sigma=sigma)
+        self.sigma = sigma
+
+    def _init_weight(self, _, arr):
+        arr[:] = _random.normal(0, self.sigma, arr.shape, dtype="float32").astype(arr.dtype)
+
+
+@register
+class Orthogonal(Initializer):
+    def __init__(self, scale=1.414, rand_type="uniform"):
+        super().__init__(scale=scale, rand_type=rand_type)
+        self.scale = scale
+        self.rand_type = rand_type
+
+    def _init_weight(self, _, arr):
+        rows = arr.shape[0]
+        cols = int(_np.prod(arr.shape[1:])) if len(arr.shape) > 1 else 1
+        if self.rand_type == "uniform":
+            tmp = _np.random.uniform(-1.0, 1.0, (rows, cols))
+        else:
+            tmp = _np.random.normal(0.0, 1.0, (rows, cols))
+        u, _, v = _np.linalg.svd(tmp, full_matrices=False)
+        q = u if u.shape == tmp.shape else v
+        arr[:] = _np.asarray(self.scale * q.reshape(arr.shape), dtype="float32")
+
+
+@register
+class Xavier(Initializer):
+    """Parity: ``mx.init.Xavier`` (gaussian/uniform, avg/in/out factor)."""
+
+    def __init__(self, rnd_type="uniform", factor_type="avg", magnitude=3):
+        super().__init__(rnd_type=rnd_type, factor_type=factor_type, magnitude=magnitude)
+        self.rnd_type = rnd_type
+        self.factor_type = factor_type
+        self.magnitude = float(magnitude)
+
+    def _init_weight(self, desc, arr):
+        shape = arr.shape
+        hw_scale = 1.0
+        if len(shape) < 2:
+            raise ValueError(f"Xavier requires ndim>=2, got {shape} for {desc}")
+        if len(shape) > 2:
+            hw_scale = float(_np.prod(shape[2:]))
+        fan_in, fan_out = shape[1] * hw_scale, shape[0] * hw_scale
+        if self.factor_type == "avg":
+            factor = (fan_in + fan_out) / 2.0
+        elif self.factor_type == "in":
+            factor = fan_in
+        elif self.factor_type == "out":
+            factor = fan_out
+        else:
+            raise ValueError(self.factor_type)
+        scale = math.sqrt(self.magnitude / factor)
+        if self.rnd_type == "uniform":
+            arr[:] = _random.uniform(-scale, scale, shape, dtype="float32").astype(arr.dtype)
+        else:
+            arr[:] = _random.normal(0, scale, shape, dtype="float32").astype(arr.dtype)
+
+
+@register
+class MSRAPrelu(Xavier):
+    def __init__(self, factor_type="avg", slope=0.25):
+        magnitude = 2.0 / (1 + slope ** 2)
+        super().__init__("gaussian", factor_type, magnitude)
+        self._kwargs = {"factor_type": factor_type, "slope": slope}
+
+
+@register
+class Bilinear(Initializer):
+    def _init_weight(self, _, arr):
+        weight = _np.zeros(arr.shape, dtype="float32")
+        shape = arr.shape
+        f = _np.ceil(shape[3] / 2.0)
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        for i in range(int(_np.prod(shape))):
+            x = i % shape[3]
+            y = (i // shape[3]) % shape[2]
+            weight.flat[i] = (1 - abs(x / f - c)) * (1 - abs(y / f - c))
+        arr[:] = weight
+
+
+@register
+class LSTMBias(Initializer):
+    """Forget-gate bias = forget_bias, others 0 (parity: mx.init.LSTMBias)."""
+
+    def __init__(self, forget_bias=1.0):
+        super().__init__(forget_bias=forget_bias)
+        self.forget_bias = forget_bias
+
+    def _init_weight(self, desc, arr):
+        b = _np.zeros(arr.shape, dtype="float32")
+        num_hidden = arr.shape[0] // 4
+        b[num_hidden : 2 * num_hidden] = self.forget_bias
+        arr[:] = b
+
+    _init_default = _init_weight
+    _init_bias = _init_weight
+
+
+@register
+class Load:
+    """Initialize from a dict of arrays (parity: ``mx.init.Load``)."""
+
+    def __init__(self, param, default_init=None, verbose=False):
+        self.param = param
+        self.default_init = default_init
+        self.verbose = verbose
+
+    def __call__(self, name, arr):
+        name = str(name)
+        for key in (name, "arg:" + name, "aux:" + name):
+            if key in self.param:
+                src = self.param[key]
+                arr[:] = src if not isinstance(src, NDArray) else src
+                return
+        if self.default_init is None:
+            raise ValueError(f"no init for {name}")
+        self.default_init(InitDesc(name), arr)
+
+
+@register
+class Mixed:
+    """Pattern -> initializer routing (parity: ``mx.init.Mixed``)."""
+
+    def __init__(self, patterns, initializers):
+        import re
+
+        self.map = [(re.compile(p), i) for p, i in zip(patterns, initializers)]
+
+    def __call__(self, name, arr):
+        for prog, init in self.map:
+            if prog.match(str(name)):
+                init(InitDesc(str(name)), arr)
+                return
+        raise ValueError(f"parameter {name} did not match any pattern")

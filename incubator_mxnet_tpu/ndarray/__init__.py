@@ -1,0 +1,88 @@
+"""``mx.nd`` namespace.
+
+Parity target: [U:python/mxnet/ndarray/] — the reference auto-generates
+Python wrappers from the C op registry at import time
+([U:python/mxnet/ndarray/register.py]); here wrappers are synthesized on
+attribute access (PEP 562) from the pure-function registry, so every
+registered op is reachable as ``nd.<opname>`` with NDArray in / NDArray out
+and an optional ``out=`` argument.
+"""
+from __future__ import annotations
+
+from .ndarray import (
+    NDArray,
+    array,
+    zeros,
+    ones,
+    full,
+    empty,
+    arange,
+    invoke,
+    waitall,
+)
+from .utils import save, load
+from ..ops import registry as _registry
+from . import random  # noqa: F401
+
+__all__ = [
+    "NDArray",
+    "array",
+    "zeros",
+    "ones",
+    "full",
+    "empty",
+    "arange",
+    "invoke",
+    "waitall",
+    "save",
+    "load",
+    "random",
+]
+
+_WRAPPER_CACHE = {}
+
+
+def _make_wrapper(op):
+    def wrapper(*args, out=None, **kwargs):
+        res = invoke(op.fn, args, kwargs, name=op.name)
+        if out is not None:
+            if isinstance(res, list):
+                raise ValueError("out= unsupported for multi-output ops")
+            out._data = res._data
+            out._version += 1
+            return out
+        return res
+
+    wrapper.__name__ = op.name
+    wrapper.__qualname__ = f"nd.{op.name}"
+    wrapper.__doc__ = op.doc
+    return wrapper
+
+
+def __getattr__(name):
+    if name.startswith("__"):
+        raise AttributeError(name)
+    if name in _WRAPPER_CACHE:
+        return _WRAPPER_CACHE[name]
+    # legacy `nd.random_uniform` style names
+    if name.startswith("random_"):
+        fn = getattr(random, name[len("random_"):], None)
+        if fn is not None:
+            _WRAPPER_CACHE[name] = fn
+            return fn
+    if name.startswith("sample_"):
+        fn = getattr(random, name[len("sample_"):], None)
+        if fn is not None:
+            _WRAPPER_CACHE[name] = fn
+            return fn
+    try:
+        op = _registry.get_op(name)
+    except KeyError:
+        raise AttributeError(f"module 'nd' has no operator {name!r}") from None
+    w = _make_wrapper(op)
+    _WRAPPER_CACHE[name] = w
+    return w
+
+
+def __dir__():
+    return sorted(set(list(globals()) + _registry.list_ops()))

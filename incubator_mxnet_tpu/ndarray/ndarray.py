@@ -1,0 +1,586 @@
+"""NDArray: imperative, mutable-feeling n-d array over immutable jax.Array.
+
+Parity target: [U:src/ndarray/ndarray.cc] + [U:python/mxnet/ndarray/ndarray.py].
+The reference NDArray is a ref-counted buffer plus an engine variable whose
+version queue orders async reads/writes; XLA/PJRT already executes
+asynchronously and hands back futures, so here:
+
+* async semantics — every op returns immediately with a jax.Array future;
+  ``wait_to_read`` maps to ``block_until_ready`` (the reference's
+  ``Engine::WaitForVar``).
+* mutation — ``a[:] = x``, ``a += b`` swap the underlying buffer and bump a
+  version counter (the engine-var version analog).  Functionally pure
+  underneath, imperative on the surface.
+* autograd — arrays carry tape provenance (``_prov``); see autograd.py.
+* context — a logical mx Context label with best-effort physical placement
+  (committed ``device_put`` when the target jax device differs).
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+import jax
+import jax.numpy as jnp
+
+from ..base import _as_np_dtype
+from ..context import Context, current_context, cpu
+from .. import autograd
+from ..ops.registry import get_op
+
+__all__ = ["NDArray", "array", "zeros", "ones", "full", "arange", "empty", "invoke", "waitall"]
+
+
+def _is_tracer(x):
+    return isinstance(x, jax.core.Tracer)
+
+
+def _place(data, ctx):
+    """Commit ``data`` to ``ctx``'s jax device when they differ (no-op for
+    tracers and already-resident arrays)."""
+    if ctx is None or _is_tracer(data):
+        return data
+    dev = ctx.jax_device()
+    try:
+        cur = list(data.devices())[0] if hasattr(data, "devices") else None
+    except Exception:
+        cur = None
+    if cur is not None and cur != dev:
+        return jax.device_put(data, dev)
+    return data
+
+
+class NDArray:
+    """An n-dimensional array on a device context."""
+
+    __slots__ = ("_data", "_ctx", "_grad", "_grad_req", "_prov", "_version", "__weakref__")
+
+    # make NDArray win over numpy in mixed operator expressions
+    __array_priority__ = 1000.0
+
+    def __init__(self, data, ctx=None, dtype=None):
+        if isinstance(data, NDArray):
+            data = data._data
+        if not isinstance(data, (jax.Array, jax.core.Tracer)):
+            data = jnp.asarray(data, dtype=_as_np_dtype(dtype))
+        elif dtype is not None and data.dtype != _as_np_dtype(dtype):
+            data = data.astype(_as_np_dtype(dtype))
+        self._data = _place(data, ctx)
+        self._ctx = ctx if ctx is not None else current_context()
+        self._grad = None
+        self._grad_req = "null"
+        self._prov = None
+        self._version = 0
+
+    # ------------------------------------------------------------------
+    # basic properties
+    # ------------------------------------------------------------------
+    @property
+    def shape(self):
+        return tuple(self._data.shape)
+
+    @property
+    def dtype(self):
+        return self._data.dtype
+
+    @property
+    def size(self):
+        s = 1
+        for d in self._data.shape:
+            s *= d
+        return s
+
+    @property
+    def ndim(self):
+        return self._data.ndim
+
+    @property
+    def context(self):
+        return self._ctx
+
+    ctx = context
+    device = context
+
+    @property
+    def T(self):
+        return self.transpose()
+
+    @property
+    def grad(self):
+        return self._grad
+
+    @property
+    def stype(self):
+        return "default"
+
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of unsized object")
+        return self.shape[0]
+
+    def __repr__(self):
+        if _is_tracer(self._data):
+            return f"<NDArray traced {self.shape} @{self._ctx}>"
+        return f"\n{_np.asarray(self._data)}\n<NDArray {'x'.join(map(str, self.shape))} @{self._ctx}>"
+
+    # ------------------------------------------------------------------
+    # synchronization (engine parity)
+    # ------------------------------------------------------------------
+    def wait_to_read(self):
+        """Block until the value is materialized (parity:
+        ``Engine::WaitForVar`` via [U:src/ndarray/ndarray.cc])."""
+        if not _is_tracer(self._data):
+            self._data.block_until_ready()
+        return self
+
+    wait_to_write = wait_to_read
+
+    # ------------------------------------------------------------------
+    # host transfer
+    # ------------------------------------------------------------------
+    def asnumpy(self):
+        return _np.asarray(self._data)
+
+    def asscalar(self):
+        if self.size != 1:
+            raise ValueError("The current array is not a scalar")
+        return self.asnumpy().reshape(()).item()
+
+    def item(self):
+        return self.asscalar()
+
+    def tolist(self):
+        return self.asnumpy().tolist()
+
+    def __array__(self, dtype=None):
+        a = self.asnumpy()
+        return a.astype(dtype) if dtype is not None else a
+
+    def __bool__(self):
+        if self.size != 1:
+            raise ValueError("The truth value of an NDArray with multiple elements is ambiguous")
+        return bool(self.asscalar())
+
+    def __float__(self):
+        return float(self.asscalar())
+
+    def __int__(self):
+        return int(self.asscalar())
+
+    def __index__(self):
+        return int(self.asscalar())
+
+    # ------------------------------------------------------------------
+    # conversion / placement
+    # ------------------------------------------------------------------
+    def astype(self, dtype, copy=True):
+        dtype = _as_np_dtype(dtype)
+        if not copy and self.dtype == dtype:
+            return self
+        return _op("cast", self, dtype=dtype)
+
+    def copyto(self, other):
+        """Copy into another NDArray or to a Context (parity: ``CopyFromTo``)."""
+        if isinstance(other, Context):
+            return NDArray(self._data, ctx=other)
+        if isinstance(other, NDArray):
+            if other.shape != self.shape:
+                raise ValueError(f"shape mismatch {self.shape} vs {other.shape}")
+            other._data = _place(self._data.astype(other.dtype), other._ctx)
+            other._version += 1
+            return other
+        raise TypeError(f"cannot copy to {type(other)}")
+
+    def copy(self):
+        return NDArray(self._data, ctx=self._ctx)
+
+    def as_in_context(self, ctx):
+        if ctx == self._ctx:
+            return self
+        return NDArray(self._data, ctx=ctx)
+
+    as_in_ctx = as_in_context
+    to_device = as_in_context
+
+    def detach(self):
+        out = NDArray(self._data, ctx=self._ctx)
+        return out
+
+    # ------------------------------------------------------------------
+    # autograd
+    # ------------------------------------------------------------------
+    def attach_grad(self, grad_req="write", stype=None):
+        """Allocate a gradient buffer and mark this array as a tape leaf
+        (parity: [U:python/mxnet/ndarray/ndarray.py] attach_grad)."""
+        self._grad = zeros(self.shape, dtype=self.dtype, ctx=self._ctx)
+        self._grad_req = grad_req
+        self._prov = ("leaf", self)
+        return self
+
+    def zero_grad(self):
+        if self._grad is not None:
+            self._grad._data = jnp.zeros_like(self._grad._data)
+
+    def backward(self, out_grad=None, retain_graph=False, train_mode=True):
+        autograd.backward([self], [out_grad], retain_graph=retain_graph, train_mode=train_mode)
+
+    # ------------------------------------------------------------------
+    # indexing
+    # ------------------------------------------------------------------
+    def __getitem__(self, key):
+        key = _convert_key(key)
+        return invoke(lambda d, _key=key: d[_key], (self,), {}, name="getitem")
+
+    def __setitem__(self, key, value):
+        key = _convert_key(key)
+        if isinstance(value, NDArray):
+            value = value._data
+        if key == slice(None):
+            new = jnp.broadcast_to(jnp.asarray(value, dtype=self.dtype), self.shape)
+            self._data = new if _is_tracer(new) else _place(new, self._ctx)
+        else:
+            self._data = self._data.at[key].set(value)
+        self._version += 1
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    # ------------------------------------------------------------------
+    # arithmetic
+    # ------------------------------------------------------------------
+    def __add__(self, other):
+        return _op("broadcast_add", self, other)
+
+    def __radd__(self, other):
+        return _op("broadcast_add", other, self)
+
+    def __sub__(self, other):
+        return _op("broadcast_sub", self, other)
+
+    def __rsub__(self, other):
+        return _op("broadcast_sub", other, self)
+
+    def __mul__(self, other):
+        return _op("broadcast_mul", self, other)
+
+    def __rmul__(self, other):
+        return _op("broadcast_mul", other, self)
+
+    def __truediv__(self, other):
+        return _op("broadcast_div", self, other)
+
+    def __rtruediv__(self, other):
+        return _op("broadcast_div", other, self)
+
+    def __mod__(self, other):
+        return _op("broadcast_mod", self, other)
+
+    def __rmod__(self, other):
+        return _op("broadcast_mod", other, self)
+
+    def __pow__(self, other):
+        return _op("broadcast_power", self, other)
+
+    def __rpow__(self, other):
+        return _op("broadcast_power", other, self)
+
+    def __neg__(self):
+        return _op("negative", self)
+
+    def __abs__(self):
+        return _op("abs", self)
+
+    def __matmul__(self, other):
+        return _op("matmul", self, other)
+
+    def __eq__(self, other):
+        if other is None:
+            return False
+        return _op("broadcast_equal", self, other)
+
+    def __ne__(self, other):
+        if other is None:
+            return True
+        return _op("broadcast_not_equal", self, other)
+
+    def __gt__(self, other):
+        return _op("broadcast_greater", self, other)
+
+    def __ge__(self, other):
+        return _op("broadcast_greater_equal", self, other)
+
+    def __lt__(self, other):
+        return _op("broadcast_lesser", self, other)
+
+    def __le__(self, other):
+        return _op("broadcast_lesser_equal", self, other)
+
+    def __hash__(self):
+        return id(self)
+
+    # in-place (buffer swap + version bump)
+    def _inplace(self, opname, other):
+        new = _op(opname, self, other)
+        self._data = new._data
+        self._prov = new._prov
+        self._version += 1
+        return self
+
+    def __iadd__(self, other):
+        return self._inplace("broadcast_add", other)
+
+    def __isub__(self, other):
+        return self._inplace("broadcast_sub", other)
+
+    def __imul__(self, other):
+        return self._inplace("broadcast_mul", other)
+
+    def __itruediv__(self, other):
+        return self._inplace("broadcast_div", other)
+
+    # ------------------------------------------------------------------
+    # shape ops (delegate to registered ops so autograd works)
+    # ------------------------------------------------------------------
+    def reshape(self, *shape, **kwargs):
+        if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+            shape = tuple(shape[0])
+        return _op("reshape", self, shape=shape, reverse=kwargs.get("reverse", False))
+
+    def reshape_like(self, other):
+        return _op("reshape_like", self, other)
+
+    def flatten(self):
+        return _op("flatten", self)
+
+    def transpose(self, axes=None):
+        return _op("transpose", self, axes=axes)
+
+    def swapaxes(self, dim1, dim2):
+        return _op("swapaxes", self, dim1=dim1, dim2=dim2)
+
+    def expand_dims(self, axis):
+        return _op("expand_dims", self, axis=axis)
+
+    def squeeze(self, axis=None):
+        return _op("squeeze", self, axis=axis)
+
+    def broadcast_to(self, shape):
+        return _op("broadcast_to", self, shape=shape)
+
+    def broadcast_like(self, other):
+        return _op("broadcast_like", self, other)
+
+    def tile(self, reps):
+        return _op("tile", self, reps=reps)
+
+    def repeat(self, repeats, axis=None):
+        return _op("repeat", self, repeats=repeats, axis=axis)
+
+    def flip(self, axis):
+        return _op("flip", self, axis=axis)
+
+    def split(self, num_outputs, axis=1, squeeze_axis=False):
+        return _op("split", self, num_outputs=num_outputs, axis=axis, squeeze_axis=squeeze_axis)
+
+    def slice(self, begin, end, step=None):
+        return _op("slice", self, begin=begin, end=end, step=step)
+
+    def slice_axis(self, axis, begin, end):
+        return _op("slice_axis", self, axis=axis, begin=begin, end=end)
+
+    def take(self, indices, axis=0, mode="clip"):
+        return _op("take", self, indices, axis=axis, mode=mode)
+
+    def one_hot(self, depth, on_value=1.0, off_value=0.0, dtype="float32"):
+        return _op("one_hot", self, depth=depth, on_value=on_value, off_value=off_value, dtype=dtype)
+
+    def pick(self, index, axis=-1, keepdims=False, mode="clip"):
+        return _op("pick", self, index, axis=axis, keepdims=keepdims, mode=mode)
+
+    def clip(self, a_min=None, a_max=None):
+        return _op("clip", self, a_min=a_min, a_max=a_max)
+
+    def abs(self):
+        return _op("abs", self)
+
+    def sign(self):
+        return _op("sign", self)
+
+    def sqrt(self):
+        return _op("sqrt", self)
+
+    def square(self):
+        return _op("square", self)
+
+    def exp(self):
+        return _op("exp", self)
+
+    def log(self):
+        return _op("log", self)
+
+    def relu(self):
+        return _op("relu", self)
+
+    def sigmoid(self):
+        return _op("sigmoid", self)
+
+    def tanh(self):
+        return _op("tanh", self)
+
+    def softmax(self, axis=-1, temperature=None):
+        return _op("softmax", self, axis=axis, temperature=temperature)
+
+    def log_softmax(self, axis=-1):
+        return _op("log_softmax", self, axis=axis)
+
+    def dot(self, other, transpose_a=False, transpose_b=False):
+        return _op("dot", self, other, transpose_a=transpose_a, transpose_b=transpose_b)
+
+    def zeros_like(self):
+        return _op("zeros_like", self)
+
+    def ones_like(self):
+        return _op("ones_like", self)
+
+    def as_np_ndarray(self):
+        return self
+
+    def as_nd_ndarray(self):
+        return self
+
+    def tostype(self, stype):
+        if stype != "default":
+            raise NotImplementedError(
+                "sparse storage types are represented densely on TPU; see docs/sparse.md"
+            )
+        return self
+
+    # reductions -------------------------------------------------------
+    def sum(self, axis=None, keepdims=False, **kw):
+        return _op("sum", self, axis=axis, keepdims=keepdims)
+
+    def mean(self, axis=None, keepdims=False, **kw):
+        return _op("mean", self, axis=axis, keepdims=keepdims)
+
+    def prod(self, axis=None, keepdims=False, **kw):
+        return _op("prod", self, axis=axis, keepdims=keepdims)
+
+    def max(self, axis=None, keepdims=False, **kw):
+        return _op("max", self, axis=axis, keepdims=keepdims)
+
+    def min(self, axis=None, keepdims=False, **kw):
+        return _op("min", self, axis=axis, keepdims=keepdims)
+
+    def argmax(self, axis=None, keepdims=False):
+        return _op("argmax", self, axis=axis, keepdims=keepdims)
+
+    def argmin(self, axis=None, keepdims=False):
+        return _op("argmin", self, axis=axis, keepdims=keepdims)
+
+    def norm(self, ord=2, axis=None, keepdims=False):
+        return _op("norm", self, ord=ord, axis=axis, keepdims=keepdims)
+
+    def topk(self, axis=-1, k=1, ret_typ="indices", is_ascend=False, dtype="float32"):
+        return _op("topk", self, axis=axis, k=k, ret_typ=ret_typ, is_ascend=is_ascend, dtype=dtype)
+
+    def sort(self, axis=-1, is_ascend=True):
+        return _op("sort", self, axis=axis, is_ascend=is_ascend)
+
+    def argsort(self, axis=-1, is_ascend=True, dtype="float32"):
+        return _op("argsort", self, axis=axis, is_ascend=is_ascend, dtype=dtype)
+
+
+# ---------------------------------------------------------------------------
+# op invocation
+# ---------------------------------------------------------------------------
+
+
+def _convert_key(key):
+    if isinstance(key, NDArray):
+        return key._data
+    if isinstance(key, tuple):
+        return tuple(k._data if isinstance(k, NDArray) else k for k in key)
+    return key
+
+
+def invoke(fn, arrays, kwargs, name="", ctx=None):
+    """Execute a pure function over NDArray/scalar inputs, wrapping outputs
+    and recording on the autograd tape when active.
+
+    This is the single dispatch point every operator call funnels through —
+    the analog of ``MXImperativeInvokeEx → Imperative::Invoke``
+    ([U:src/c_api/c_api_ndarray.cc], [U:src/imperative/imperative.cc]).
+    """
+    raw = [a._data if isinstance(a, NDArray) else a for a in arrays]
+    if ctx is None:
+        for a in arrays:
+            if isinstance(a, NDArray):
+                ctx = a._ctx
+                break
+        else:
+            ctx = current_context()
+    if autograd.is_recording():
+        outs, node = autograd.record_op(fn, raw, arrays, kwargs, name=name)
+        if node is not None:
+            results = [NDArray(o, ctx=ctx) for o in outs]
+            for i, r in enumerate(results):
+                r._prov = (node, i)
+            return results[0] if len(results) == 1 else results
+    out = fn(*raw, **kwargs)
+    if isinstance(out, tuple):
+        return [NDArray(o, ctx=ctx) for o in out]
+    return NDArray(out, ctx=ctx)
+
+
+def _op(name, *arrays, **kwargs):
+    op = get_op(name)
+    return invoke(op.fn, arrays, kwargs, name=name)
+
+
+# ---------------------------------------------------------------------------
+# creation
+# ---------------------------------------------------------------------------
+
+
+def array(source_array, ctx=None, dtype=None):
+    """Create an NDArray from any array-like (parity: ``mx.nd.array``)."""
+    if isinstance(source_array, NDArray):
+        return NDArray(source_array._data, ctx=ctx, dtype=dtype)
+    if dtype is None and not hasattr(source_array, "dtype"):
+        dtype = "float32"
+    return NDArray(jnp.asarray(source_array, dtype=_as_np_dtype(dtype)), ctx=ctx)
+
+
+def zeros(shape, ctx=None, dtype="float32", **kwargs):
+    if isinstance(shape, int):
+        shape = (shape,)
+    return NDArray(jnp.zeros(shape, dtype=_as_np_dtype(dtype)), ctx=ctx)
+
+
+def ones(shape, ctx=None, dtype="float32", **kwargs):
+    if isinstance(shape, int):
+        shape = (shape,)
+    return NDArray(jnp.ones(shape, dtype=_as_np_dtype(dtype)), ctx=ctx)
+
+
+def full(shape, val, ctx=None, dtype="float32", **kwargs):
+    if isinstance(shape, int):
+        shape = (shape,)
+    return NDArray(jnp.full(shape, val, dtype=_as_np_dtype(dtype)), ctx=ctx)
+
+
+def empty(shape, ctx=None, dtype="float32"):
+    return zeros(shape, ctx=ctx, dtype=dtype)
+
+
+def arange(start, stop=None, step=1.0, repeat=1, ctx=None, dtype="float32"):
+    data = jnp.arange(start, stop, step, dtype=_as_np_dtype(dtype))
+    if repeat != 1:
+        data = jnp.repeat(data, repeat)
+    return NDArray(data, ctx=ctx)
+
+
+def waitall():
+    """Parity: ``mx.nd.waitall`` / ``Engine::WaitForAll``.  XLA tracks its own
+    queue; effectively a fence via blocking on a trivial computation."""
+    (jax.device_put(0.0) + 0).block_until_ready()

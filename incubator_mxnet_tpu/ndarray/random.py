@@ -1,0 +1,30 @@
+"""``mx.nd.random`` — sampling ops returning NDArrays.
+
+Parity: [U:python/mxnet/ndarray/random.py]; implementation is the shared
+threaded-key machinery in :mod:`incubator_mxnet_tpu.random`.
+"""
+from ..random import (  # noqa: F401
+    uniform,
+    normal,
+    randn,
+    randint,
+    multinomial,
+    shuffle,
+    gamma,
+    exponential,
+    poisson,
+    seed,
+)
+
+__all__ = [
+    "uniform",
+    "normal",
+    "randn",
+    "randint",
+    "multinomial",
+    "shuffle",
+    "gamma",
+    "exponential",
+    "poisson",
+    "seed",
+]

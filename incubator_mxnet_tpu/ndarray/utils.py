@@ -1,0 +1,39 @@
+"""NDArray save/load.
+
+Parity target: the dmlc binary blob in [U:src/ndarray/ndarray.cc]
+(``MXNDArraySave/Load``, ``.params`` files).  Divergence (documented): the
+container is NumPy ``.npz`` with a name-mangling convention instead of the
+dmlc stream format — same API, portable, and readable by plain numpy.  Keys
+saved as ``idx:<n>`` encode the reference's "list without names" mode.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from .ndarray import NDArray, array
+
+__all__ = ["save", "load"]
+
+
+def save(fname, data):
+    """Save a list or str-keyed dict of NDArrays (parity: ``mx.nd.save``)."""
+    if isinstance(data, NDArray):
+        data = [data]
+    if isinstance(data, (list, tuple)):
+        payload = {f"idx:{i}": _np.asarray(v.asnumpy()) for i, v in enumerate(data)}
+    elif isinstance(data, dict):
+        payload = {k: _np.asarray(v.asnumpy()) for k, v in data.items()}
+    else:
+        raise TypeError(f"cannot save {type(data)}")
+    with open(fname, "wb") as f:
+        _np.savez(f, **payload)
+
+
+def load(fname):
+    """Load NDArrays saved by :func:`save` (parity: ``mx.nd.load``)."""
+    with _np.load(fname, allow_pickle=False) as z:
+        keys = list(z.keys())
+        if keys and all(k.startswith("idx:") for k in keys):
+            keys.sort(key=lambda k: int(k.split(":", 1)[1]))
+            return [array(z[k]) for k in keys]
+        return {k: array(z[k]) for k in keys}

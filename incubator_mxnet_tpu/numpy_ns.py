@@ -1,0 +1,116 @@
+"""``mx.np`` — NumPy-compatible operator namespace.
+
+Parity target: [U:src/operator/numpy/] + [U:python/mxnet/numpy/] (~50k LoC of
+C++ kernels in the reference).  Here it is a thin adapter over ``jax.numpy``,
+which already implements NumPy broadcasting/dtype-promotion on TPU — the
+whole subsystem collapses to NDArray<->jax.Array marshalling plus autograd
+tape recording via the same ``invoke`` dispatch the nd namespace uses.
+"""
+from __future__ import annotations
+
+import numpy as _onp
+import jax.numpy as jnp
+
+from .ndarray.ndarray import NDArray, invoke
+from . import random as _random
+
+pi = _onp.pi
+e = _onp.e
+inf = _onp.inf
+nan = _onp.nan
+newaxis = None
+ndarray = NDArray
+
+float32 = _onp.float32
+float64 = _onp.float64
+float16 = _onp.float16
+int32 = _onp.int32
+int64 = _onp.int64
+int8 = _onp.int8
+uint8 = _onp.uint8
+bool_ = _onp.bool_
+
+
+class _RandomNS:
+    uniform = staticmethod(_random.uniform)
+    normal = staticmethod(_random.normal)
+    randint = staticmethod(_random.randint)
+    randn = staticmethod(_random.randn)
+    shuffle = staticmethod(_random.shuffle)
+    seed = staticmethod(_random.seed)
+
+    def rand(self, *shape):
+        return _random.uniform(0, 1, shape or (1,))
+
+
+random = _RandomNS()
+
+
+def array(obj, dtype=None, ctx=None):
+    from .ndarray.ndarray import array as _arr
+
+    return _arr(obj, ctx=ctx, dtype=dtype)
+
+
+def _raw(x):
+    return x._data if isinstance(x, NDArray) else x
+
+
+_WRAPPED = {}
+
+
+def _wrap_jnp(name, fn):
+    def wrapper(*args, **kwargs):
+        # Common case: leading positional array args -> autograd-aware invoke.
+        if args and isinstance(args[0], (list, tuple)) and any(isinstance(a, NDArray) for a in args[0]):
+            seq = args[0]
+            rest = args[1:]
+
+            def seqfn(*arrs, _fn=fn, _n=len(seq), _rest=rest, **kw):
+                return _fn(list(arrs[:_n]), *_rest, **kw)
+
+            return invoke(seqfn, tuple(seq), kwargs, name=name)
+        arr_prefix = []
+        i = 0
+        for a in args:
+            if isinstance(a, NDArray):
+                arr_prefix.append(a)
+                i += 1
+            else:
+                break
+        if arr_prefix and not any(isinstance(a, NDArray) for a in args[i:]) and not any(
+            isinstance(v, NDArray) for v in kwargs.values()
+        ):
+            rest = args[i:]
+
+            def posfn(*arrs, _fn=fn, _rest=rest, **kw):
+                return _fn(*arrs, *_rest, **kw)
+
+            return invoke(posfn, tuple(arr_prefix), kwargs, name=name)
+        # Fallback: no recording, raw conversion everywhere.
+        conv_args = [_raw(a) if not isinstance(a, (list, tuple)) else [_raw(x) for x in a] for a in args]
+        conv_kwargs = {k: _raw(v) for k, v in kwargs.items()}
+        out = fn(*conv_args, **conv_kwargs)
+        if isinstance(out, tuple):
+            return tuple(NDArray(o) if hasattr(o, "shape") else o for o in out)
+        return NDArray(out) if hasattr(out, "shape") else out
+
+    wrapper.__name__ = name
+    return wrapper
+
+
+def __getattr__(name):
+    if name in _WRAPPED:
+        return _WRAPPED[name]
+    fn = getattr(jnp, name, None)
+    if fn is None or not callable(fn):
+        if fn is not None:
+            return fn
+        raise AttributeError(f"mx.np has no attribute {name!r}")
+    w = _wrap_jnp(name, fn)
+    _WRAPPED[name] = w
+    return w
+
+
+def __dir__():
+    return sorted(set(list(globals()) + dir(jnp)))

@@ -1,0 +1,14 @@
+"""Operator library (TPU-native equivalent of [U:src/operator/]).
+
+The reference registers ~1000 C++/CUDA kernels behind the NNVM registry; here
+every operator is a *pure function on jax.Arrays* registered in
+:mod:`.registry`.  XLA plays the role of mshadow/cuDNN/oneDNN: lowering,
+fusion, tiling onto the MXU.  Custom Pallas kernels slot in as just another
+registered function.
+"""
+from . import registry
+from .registry import register, get_op, list_ops, Op
+from . import tensor  # noqa: F401  (registers tensor ops)
+from . import nn  # noqa: F401  (registers NN ops)
+
+__all__ = ["register", "get_op", "list_ops", "Op", "registry", "tensor", "nn"]

@@ -1,0 +1,524 @@
+"""Neural-network operators — TPU-native equivalent of [U:src/operator/nn/]
+(convolution, fully_connected, pooling, batch_norm, layer_norm, activation,
+softmax, dropout, embedding, upsampling) and the cuDNN/oneDNN dispatch layers
+([U:src/operator/nn/cudnn/], [U:src/operator/nn/mkldnn/]).
+
+On TPU the vendor-library role is played by XLA itself: ``lax.conv_general_
+dilated`` / ``dot_general`` lower onto the MXU with autotuned tiling, and
+elementwise epilogues fuse into the matmul — there is no algo-selection cache
+to manage.  MXNet calling conventions (NCHW layout, OIHW weights, param
+names) are preserved.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..base import _as_np_dtype
+from .registry import register, alias
+
+
+# ---------------------------------------------------------------------------
+# FullyConnected
+# ---------------------------------------------------------------------------
+
+
+@register("FullyConnected")
+def fully_connected(data, weight, bias=None, num_hidden=0, no_bias=False, flatten=True):
+    """Parity: [U:src/operator/nn/fully_connected.cc].  weight is
+    (num_hidden, in_units) like the reference; lowered to one MXU matmul."""
+    if flatten and data.ndim > 2:
+        data = data.reshape(data.shape[0], -1)
+    out = jnp.matmul(data, weight.T)
+    if bias is not None and not no_bias:
+        out = out + bias
+    return out
+
+
+alias("fully_connected", "FullyConnected")
+
+
+# ---------------------------------------------------------------------------
+# Convolution / Deconvolution
+# ---------------------------------------------------------------------------
+
+_CONV_DIMS = {1: ("NCW", "OIW", "NCW"), 2: ("NCHW", "OIHW", "NCHW"), 3: ("NCDHW", "OIDHW", "NCDHW")}
+
+
+def _tuplize(v, n):
+    if v is None:
+        return (1,) * n
+    if isinstance(v, int):
+        return (v,) * n
+    v = tuple(v)
+    return v if len(v) == n else v + (v[-1],) * (n - len(v))
+
+
+@register("Convolution")
+def convolution(
+    data,
+    weight,
+    bias=None,
+    kernel=(1, 1),
+    stride=None,
+    dilate=None,
+    pad=None,
+    num_filter=0,
+    num_group=1,
+    no_bias=False,
+    layout=None,
+):
+    """Parity: [U:src/operator/nn/convolution.cc].  NCHW/OIHW convention kept;
+    XLA:TPU relayouts internally for the MXU so no NHWC rewrite is needed at
+    the API level."""
+    n = len(kernel)
+    stride = _tuplize(stride, n)
+    dilate = _tuplize(dilate, n)
+    pad = _tuplize(pad if pad is not None else 0, n)
+    dn = lax.conv_dimension_numbers(data.shape, weight.shape, _CONV_DIMS[n])
+    out = lax.conv_general_dilated(
+        data,
+        weight,
+        window_strides=stride,
+        padding=[(p, p) for p in pad],
+        rhs_dilation=dilate,
+        dimension_numbers=dn,
+        feature_group_count=num_group,
+        preferred_element_type=jnp.float32 if data.dtype == jnp.bfloat16 else None,
+    )
+    if out.dtype != data.dtype:
+        out = out.astype(data.dtype)
+    if bias is not None and not no_bias:
+        out = out + bias.reshape((1, -1) + (1,) * n)
+    return out
+
+
+@register("Deconvolution")
+def deconvolution(
+    data,
+    weight,
+    bias=None,
+    kernel=(1, 1),
+    stride=None,
+    dilate=None,
+    pad=None,
+    adj=None,
+    num_filter=0,
+    num_group=1,
+    no_bias=True,
+    target_shape=None,
+):
+    """Parity: [U:src/operator/nn/deconvolution.cc] — transposed conv as the
+    gradient of Convolution (weight stored (in, out/g, kH, kW) like MXNet)."""
+    n = len(kernel)
+    stride = _tuplize(stride, n)
+    pad = _tuplize(pad if pad is not None else 0, n)
+    adj = _tuplize(adj if adj is not None else 0, n)
+    # lax.conv_transpose with IOHW-equivalent spec: weight (I, O/g, *K)
+    dn = _CONV_DIMS[n]
+    out = lax.conv_transpose(
+        data,
+        weight,
+        strides=stride,
+        padding=[(p, p - a) for p, a in zip(pad, adj)] if any(adj) else [(p, p) for p in pad],
+        dimension_numbers=(dn[0], "IO" + dn[1][2:], dn[2]),
+        transpose_kernel=True,
+    )
+    if bias is not None and not no_bias:
+        out = out + bias.reshape((1, -1) + (1,) * n)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Pooling
+# ---------------------------------------------------------------------------
+
+
+@register("Pooling")
+def pooling(
+    data,
+    kernel=(2, 2),
+    pool_type="max",
+    global_pool=False,
+    stride=None,
+    pad=None,
+    pooling_convention="valid",
+    count_include_pad=True,
+    layout=None,
+):
+    """Parity: [U:src/operator/nn/pooling.cc] via ``lax.reduce_window``."""
+    n = data.ndim - 2
+    if global_pool:
+        kernel = data.shape[2:]
+        stride = (1,) * n
+        pad = (0,) * n
+    else:
+        kernel = _tuplize(kernel, n)
+        stride = _tuplize(stride if stride is not None else kernel, n)
+        pad = _tuplize(pad if pad is not None else 0, n)
+    window = (1, 1) + tuple(kernel)
+    strides = (1, 1) + tuple(stride)
+    padding = ((0, 0), (0, 0)) + tuple((p, p) for p in pad)
+    if pooling_convention == "full" and not global_pool:
+        # ceil-mode: extend upper padding so the last window fits
+        ext = []
+        for i, (k, s, p) in enumerate(zip(kernel, stride, pad)):
+            size = data.shape[2 + i]
+            out_full = -(-(size + 2 * p - k) // s) + 1  # ceil
+            needed = (out_full - 1) * s + k - size - p
+            ext.append((p, max(p, needed)))
+        padding = ((0, 0), (0, 0)) + tuple(ext)
+    if pool_type == "max":
+        init = -jnp.inf if jnp.issubdtype(data.dtype, jnp.floating) else jnp.iinfo(data.dtype).min
+        return lax.reduce_window(data, init, lax.max, window, strides, padding)
+    if pool_type in ("avg", "sum"):
+        summed = lax.reduce_window(data, 0.0, lax.add, window, strides, padding)
+        if pool_type == "sum":
+            return summed
+        if count_include_pad:
+            denom = 1
+            for k in kernel:
+                denom *= k
+            return summed / denom
+        ones = jnp.ones_like(data)
+        counts = lax.reduce_window(ones, 0.0, lax.add, window, strides, padding)
+        return summed / counts
+    if pool_type == "lp":
+        p2 = lax.reduce_window(jnp.square(data), 0.0, lax.add, window, strides, padding)
+        return jnp.sqrt(p2)
+    raise ValueError(pool_type)
+
+
+# ---------------------------------------------------------------------------
+# Normalization
+# ---------------------------------------------------------------------------
+
+
+@register("BatchNorm")
+def batch_norm(
+    data,
+    gamma,
+    beta,
+    moving_mean,
+    moving_var,
+    eps=1e-5,
+    momentum=0.9,
+    fix_gamma=True,
+    use_global_stats=False,
+    output_mean_var=False,
+    axis=1,
+):
+    """Parity: [U:src/operator/nn/batch_norm.cc].
+
+    Functional contract: returns ``(out, batch_mean, batch_var)`` — the layer
+    (gluon.nn.BatchNorm) owns the running-stat mutation, because aux-state
+    mutation inside the op would break purity.  When ``use_global_stats`` the
+    moving stats are used and returned unchanged.
+    """
+    ax = axis % data.ndim
+    reduce_axes = tuple(i for i in range(data.ndim) if i != ax)
+    bshape = tuple(data.shape[ax] if i == ax else 1 for i in range(data.ndim))
+    if use_global_stats:
+        mean, var = moving_mean, moving_var
+    else:
+        mean = jnp.mean(data, axis=reduce_axes)
+        var = jnp.var(data, axis=reduce_axes)
+    g = jnp.ones_like(gamma) if fix_gamma else gamma
+    inv = lax.rsqrt(var + eps)
+    out = (data - mean.reshape(bshape)) * (g * inv).reshape(bshape) + beta.reshape(bshape)
+    return out, mean, var
+
+
+@register("LayerNorm")
+def layer_norm(data, gamma, beta, axis=-1, eps=1e-5):
+    """Parity: [U:src/operator/nn/layer_norm.cc]."""
+    mean = jnp.mean(data, axis=axis, keepdims=True)
+    var = jnp.var(data, axis=axis, keepdims=True)
+    out = (data - mean) * lax.rsqrt(var + eps)
+    ax = axis % data.ndim
+    bshape = tuple(data.shape[ax] if i == ax else 1 for i in range(data.ndim))
+    return out * gamma.reshape(bshape) + beta.reshape(bshape)
+
+
+@register("GroupNorm")
+def group_norm(data, gamma, beta, num_groups=1, eps=1e-5):
+    n, c = data.shape[0], data.shape[1]
+    rest = data.shape[2:]
+    x = data.reshape((n, num_groups, c // num_groups) + rest)
+    axes = tuple(range(2, x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    x = (x - mean) * lax.rsqrt(var + eps)
+    x = x.reshape(data.shape)
+    bshape = (1, c) + (1,) * len(rest)
+    return x * gamma.reshape(bshape) + beta.reshape(bshape)
+
+
+@register("InstanceNorm")
+def instance_norm(data, gamma, beta, eps=1e-3):
+    axes = tuple(range(2, data.ndim))
+    mean = jnp.mean(data, axis=axes, keepdims=True)
+    var = jnp.var(data, axis=axes, keepdims=True)
+    x = (data - mean) * lax.rsqrt(var + eps)
+    bshape = (1, data.shape[1]) + (1,) * (data.ndim - 2)
+    return x * gamma.reshape(bshape) + beta.reshape(bshape)
+
+
+@register("RMSNorm")
+def rms_norm(data, gamma, axis=-1, eps=1e-6):
+    """TPU-era extension (not in reference): RMSNorm for LLM blocks."""
+    ms = jnp.mean(jnp.square(data.astype(jnp.float32)), axis=axis, keepdims=True)
+    out = data * lax.rsqrt(ms + eps).astype(data.dtype)
+    return out * gamma
+
+
+# ---------------------------------------------------------------------------
+# Activations / softmax
+# ---------------------------------------------------------------------------
+
+_ACTS = {
+    "relu": lambda x: jnp.maximum(x, 0),
+    "sigmoid": jax.nn.sigmoid,
+    "tanh": jnp.tanh,
+    "softrelu": jax.nn.softplus,
+    "softsign": lambda x: x / (1 + jnp.abs(x)),
+    "gelu": jax.nn.gelu,
+    "silu": jax.nn.silu,
+    "swish": jax.nn.silu,
+    "erf": jax.scipy.special.erf,
+}
+
+
+@register("Activation")
+def activation(data, act_type="relu"):
+    """Parity: [U:src/operator/nn/activation.cc]."""
+    return _ACTS[act_type](data)
+
+
+@register("LeakyReLU")
+def leaky_relu(data, gamma=None, act_type="leaky", slope=0.25, lower_bound=0.125, upper_bound=0.334):
+    """Parity: [U:src/operator/leaky_relu.cc] (leaky/prelu/elu/selu/gelu/rrelu)."""
+    if act_type == "leaky":
+        return jnp.where(data > 0, data, slope * data)
+    if act_type == "prelu":
+        g = gamma
+        if g.ndim < data.ndim and g.ndim == 1:
+            g = g.reshape((1, -1) + (1,) * (data.ndim - 2))
+        return jnp.where(data > 0, data, g * data)
+    if act_type == "elu":
+        return jnp.where(data > 0, data, slope * (jnp.exp(data) - 1))
+    if act_type == "selu":
+        lam, a = 1.0507009873554805, 1.6732632423543772
+        return lam * jnp.where(data > 0, data, a * (jnp.exp(data) - 1))
+    if act_type == "gelu":
+        return jax.nn.gelu(data, approximate=False)
+    if act_type == "rrelu":
+        mid = (lower_bound + upper_bound) / 2
+        return jnp.where(data > 0, data, mid * data)
+    raise ValueError(act_type)
+
+
+@register("softmax")
+def softmax(data, axis=-1, temperature=None, length=None):
+    """Parity: [U:src/operator/nn/softmax.cc] (with optional temperature and
+    length masking)."""
+    x = data
+    if temperature is not None and temperature != 1.0:
+        x = x / temperature
+    if length is not None:
+        ax = axis % x.ndim
+        idx = jnp.arange(x.shape[ax])
+        idx = idx.reshape((-1,) + (1,) * (x.ndim - 1 - ax))
+        mask = idx < jnp.expand_dims(length, tuple(range(len(length.shape), x.ndim - 1)) if False else -1).reshape(
+            length.shape + (1,) * (x.ndim - length.ndim)
+        )
+        x = jnp.where(mask, x, -jnp.inf)
+        out = jax.nn.softmax(x, axis=axis)
+        return jnp.where(mask, out, 0.0)
+    return jax.nn.softmax(x, axis=axis)
+
+
+@register("log_softmax")
+def log_softmax(data, axis=-1, temperature=None):
+    x = data if temperature in (None, 1.0) else data / temperature
+    return jax.nn.log_softmax(x, axis=axis)
+
+
+@register("softmin")
+def softmin(data, axis=-1):
+    return jax.nn.softmax(-data, axis=axis)
+
+
+@register("softmax_cross_entropy")
+def softmax_cross_entropy(data, label):
+    """Parity: [U:src/operator/loss_binary_op.cc] — summed CE with integer labels."""
+    logp = jax.nn.log_softmax(data, axis=-1)
+    nll = -jnp.take_along_axis(logp, label.astype(jnp.int32).reshape(-1, 1), axis=-1)
+    return jnp.sum(nll)
+
+
+def _softmax_output_fwd(data, label, grad_scale, ignore_label, use_ignore, multi_output, normalization):
+    ax = 1 if multi_output else -1
+    return jax.nn.softmax(data, axis=ax)
+
+
+@jax.custom_vjp
+def _softmax_output(data, label, grad_scale, ignore_label, use_ignore, multi_output, normalization):
+    return _softmax_output_fwd(data, label, grad_scale, ignore_label, use_ignore, multi_output, normalization)
+
+
+def _so_fwd(data, label, grad_scale, ignore_label, use_ignore, multi_output, normalization):
+    out = _softmax_output_fwd(data, label, grad_scale, ignore_label, use_ignore, multi_output, normalization)
+    return out, (out, label, grad_scale, ignore_label, use_ignore, multi_output, normalization)
+
+
+def _so_bwd(res, g):
+    out, label, grad_scale, ignore_label, use_ignore, multi_output, normalization = res
+    ax = 1 if multi_output else -1
+    nclass = out.shape[ax]
+    lab = label.astype(jnp.int32)
+    oh = jax.nn.one_hot(lab, nclass, axis=ax)
+    grad = (out - oh) * grad_scale
+    if use_ignore:
+        keep = (lab != int(ignore_label)).astype(out.dtype)
+        grad = grad * jnp.expand_dims(keep, ax)
+    if normalization == "batch":
+        grad = grad / out.shape[0]
+    elif normalization == "valid" and use_ignore:
+        keep = (lab != int(ignore_label)).astype(out.dtype)
+        grad = grad / jnp.maximum(jnp.sum(keep), 1.0)
+    return (grad, None, None, None, None, None, None)
+
+
+_softmax_output.defvjp(_so_fwd, _so_bwd)
+
+
+@register("SoftmaxOutput")
+def softmax_output(
+    data,
+    label,
+    grad_scale=1.0,
+    ignore_label=-1.0,
+    use_ignore=False,
+    multi_output=False,
+    normalization="null",
+    **kw,
+):
+    """Legacy Module-API loss head (parity: [U:src/operator/softmax_output.cc]):
+    forward = softmax, backward = scaled (p - onehot)."""
+    return _softmax_output(data, label, grad_scale, ignore_label, use_ignore, multi_output, normalization)
+
+
+alias("Softmax", "SoftmaxOutput")
+
+
+@register("LinearRegressionOutput")
+def linear_regression_output(data, label, grad_scale=1.0):
+    @jax.custom_vjp
+    def f(d, l):
+        return d
+
+    def fwd(d, l):
+        return d, (d, l)
+
+    def bwd(res, g):
+        d, l = res
+        return ((d - l) * grad_scale / d.shape[0] * 0 + (d - l) * grad_scale, None)
+
+    f.defvjp(fwd, bwd)
+    return f(data, label.reshape(data.shape))
+
+
+@register("MakeLoss")
+def make_loss(data, grad_scale=1.0, normalization="null", valid_thresh=0.0):
+    return data * 1.0
+
+
+# ---------------------------------------------------------------------------
+# Dropout / Embedding / UpSampling
+# ---------------------------------------------------------------------------
+
+
+@register("Dropout")
+def dropout(data, p=0.5, mode="training", axes=(), key=None, training=None):
+    """Parity: [U:src/operator/nn/dropout.cc].  The PRNG key is threaded from
+    mx.random (trace-safe under jit); ``mode='always'`` applies at inference.
+    When ``training`` is not given it follows ``autograd.is_training()``,
+    matching the reference's is_train dispatch."""
+    if training is None:
+        from .. import autograd
+
+        training = autograd.is_training()
+    if not training and mode != "always":
+        return data
+    if p <= 0:
+        return data
+    if key is None:
+        from ..random import get_key
+
+        key = get_key()
+    shape = list(data.shape)
+    if axes:
+        for ax in axes:
+            shape[ax] = 1
+    keep = 1.0 - p
+    mask = jax.random.bernoulli(key, keep, tuple(shape)).astype(data.dtype)
+    return data * mask / keep
+
+
+@register("Embedding")
+def embedding(data, weight, input_dim=0, output_dim=0, dtype="float32", sparse_grad=False):
+    """Parity: [U:src/operator/tensor/indexing_op.cc] Embedding — a gather
+    from the weight table; XLA lowers to dynamic-gather on TPU."""
+    idx = data.astype(jnp.int32)
+    return jnp.take(weight, idx, axis=0)
+
+
+@register("UpSampling")
+def upsampling(data, scale=2, sample_type="nearest", num_args=1):
+    """Parity: [U:src/operator/nn/upsampling.cc] (nearest / bilinear)."""
+    n, c, h, w = data.shape
+    method = "nearest" if sample_type == "nearest" else "linear"
+    return jax.image.resize(data, (n, c, h * scale, w * scale), method=method)
+
+
+@register("SequenceMask")
+def sequence_mask(data, sequence_length=None, use_sequence_length=False, value=0.0, axis=0):
+    """Parity: [U:src/operator/sequence_mask.cc] — mask positions beyond each
+    sequence's length along the time axis."""
+    if not use_sequence_length or sequence_length is None:
+        return data
+    t = data.shape[axis]
+    idx = jnp.arange(t)
+    idx = idx.reshape((-1,) + (1,) * (data.ndim - 1 - axis)) if axis == 0 else idx
+    if axis == 0:
+        mask = idx < sequence_length.reshape((1, -1) + (1,) * (data.ndim - 2))
+    else:
+        mask = idx.reshape((1, -1) + (1,) * (data.ndim - 2)) < sequence_length.reshape(
+            (-1, 1) + (1,) * (data.ndim - 2)
+        )
+    return jnp.where(mask, data, value)
+
+
+@register("SequenceLast")
+def sequence_last(data, sequence_length=None, use_sequence_length=False, axis=0):
+    if not use_sequence_length or sequence_length is None:
+        idx = [slice(None)] * data.ndim
+        idx[axis] = -1
+        return data[tuple(idx)]
+    last = (sequence_length.astype(jnp.int32) - 1)
+    if axis == 0:
+        return data[last, jnp.arange(data.shape[1])]
+    return data[jnp.arange(data.shape[0]), last]
+
+
+@register("SequenceReverse")
+def sequence_reverse(data, sequence_length=None, use_sequence_length=False, axis=0):
+    if not use_sequence_length or sequence_length is None:
+        return jnp.flip(data, axis=axis)
+    t = data.shape[axis]
+    idx = jnp.arange(t).reshape(-1, 1)
+    lens = sequence_length.astype(jnp.int32).reshape(1, -1)
+    rev = jnp.where(idx < lens, lens - 1 - idx, idx)
+    return jnp.take_along_axis(data, rev.reshape(t, -1, *([1] * (data.ndim - 2))).astype(jnp.int32), axis=0)

@@ -1,0 +1,165 @@
+"""Fused optimizer update kernels (parity: [U:src/operator/optimizer_op.cc] —
+``sgd_update``, ``sgd_mom_update``, ``adam_update``, ``ftrl_update``,
+``lamb_*``, multi-precision variants).
+
+Each update is one jitted pure function; hyperparameters are passed as
+0-d arrays so lr schedules don't trigger retraces.  ``clip`` uses +inf as
+the no-clip sentinel to keep one compiled graph.  Multi-precision (bf16
+weights + fp32 master copy) mirrors the reference's mp_* variants.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _prep(grad, rescale, clip, wd, weight):
+    g = grad.astype(jnp.float32) * rescale
+    g = jnp.clip(g, -clip, clip)
+    return g + wd * weight.astype(jnp.float32)
+
+
+@jax.jit
+def sgd_update(weight, grad, lr, wd, rescale, clip):
+    g = _prep(grad, rescale, clip, wd, weight)
+    return (weight.astype(jnp.float32) - lr * g).astype(weight.dtype)
+
+
+@jax.jit
+def sgd_mom_update(weight, grad, mom, lr, wd, rescale, clip, momentum):
+    g = _prep(grad, rescale, clip, wd, weight)
+    new_mom = momentum * mom - lr * g
+    return (weight.astype(jnp.float32) + new_mom).astype(weight.dtype), new_mom
+
+
+@jax.jit
+def nag_mom_update(weight, grad, mom, lr, wd, rescale, clip, momentum):
+    g = _prep(grad, rescale, clip, wd, weight)
+    new_mom = momentum * mom + g
+    update = momentum * new_mom + g
+    return (weight.astype(jnp.float32) - lr * update).astype(weight.dtype), new_mom
+
+
+@jax.jit
+def adam_update(weight, grad, mean, var, lr, wd, rescale, clip, beta1, beta2, eps, t):
+    g = _prep(grad, rescale, clip, wd, weight)
+    new_mean = beta1 * mean + (1 - beta1) * g
+    new_var = beta2 * var + (1 - beta2) * jnp.square(g)
+    coef1 = 1 - beta1 ** t
+    coef2 = 1 - beta2 ** t
+    lr_t = lr * jnp.sqrt(coef2) / coef1
+    upd = lr_t * new_mean / (jnp.sqrt(new_var) + eps)
+    return (weight.astype(jnp.float32) - upd).astype(weight.dtype), new_mean, new_var
+
+
+@jax.jit
+def adamw_update(weight, grad, mean, var, lr, wd, eta, rescale, clip, beta1, beta2, eps, t):
+    w32 = weight.astype(jnp.float32)
+    g = jnp.clip(grad.astype(jnp.float32) * rescale, -clip, clip)
+    new_mean = beta1 * mean + (1 - beta1) * g
+    new_var = beta2 * var + (1 - beta2) * jnp.square(g)
+    coef1 = 1 - beta1 ** t
+    coef2 = 1 - beta2 ** t
+    upd = (new_mean / coef1) / (jnp.sqrt(new_var / coef2) + eps) + wd * w32
+    return (w32 - eta * lr * upd).astype(weight.dtype), new_mean, new_var
+
+
+@jax.jit
+def rmsprop_update(weight, grad, n, lr, wd, rescale, clip, rho, eps):
+    g = _prep(grad, rescale, clip, wd, weight)
+    new_n = rho * n + (1 - rho) * jnp.square(g)
+    upd = lr * g / jnp.sqrt(new_n + eps)
+    return (weight.astype(jnp.float32) - upd).astype(weight.dtype), new_n
+
+
+@jax.jit
+def rmspropalex_update(weight, grad, n, g_avg, delta, lr, wd, rescale, clip, rho, momentum, eps):
+    g = _prep(grad, rescale, clip, wd, weight)
+    new_n = rho * n + (1 - rho) * jnp.square(g)
+    new_g = rho * g_avg + (1 - rho) * g
+    new_delta = momentum * delta - lr * g / jnp.sqrt(new_n - jnp.square(new_g) + eps)
+    return (weight.astype(jnp.float32) + new_delta).astype(weight.dtype), new_n, new_g, new_delta
+
+
+@jax.jit
+def adagrad_update(weight, grad, history, lr, wd, rescale, clip, eps):
+    g = _prep(grad, rescale, clip, wd, weight)
+    new_hist = history + jnp.square(g)
+    upd = lr * g / (jnp.sqrt(new_hist) + eps)
+    return (weight.astype(jnp.float32) - upd).astype(weight.dtype), new_hist
+
+
+@jax.jit
+def adadelta_update(weight, grad, acc_g, acc_delta, wd, rescale, clip, rho, eps):
+    g = _prep(grad, rescale, clip, wd, weight)
+    new_acc_g = rho * acc_g + (1 - rho) * jnp.square(g)
+    delta = jnp.sqrt(acc_delta + eps) / jnp.sqrt(new_acc_g + eps) * g
+    new_acc_delta = rho * acc_delta + (1 - rho) * jnp.square(delta)
+    return (weight.astype(jnp.float32) - delta).astype(weight.dtype), new_acc_g, new_acc_delta
+
+
+@jax.jit
+def ftrl_update(weight, grad, z, n, lr, wd, rescale, clip, lamda1, beta):
+    g = jnp.clip(grad.astype(jnp.float32) * rescale, -clip, clip)
+    w32 = weight.astype(jnp.float32)
+    new_n = n + jnp.square(g)
+    sigma = (jnp.sqrt(new_n) - jnp.sqrt(n)) / lr
+    new_z = z + g - sigma * w32
+    new_w = jnp.where(
+        jnp.abs(new_z) <= lamda1,
+        0.0,
+        -(new_z - jnp.sign(new_z) * lamda1) / ((beta + jnp.sqrt(new_n)) / lr + wd),
+    )
+    return new_w.astype(weight.dtype), new_z, new_n
+
+
+@jax.jit
+def signum_update(weight, grad, mom, lr, wd, rescale, clip, momentum, wd_lh):
+    g = jnp.clip(grad.astype(jnp.float32) * rescale, -clip, clip)
+    w32 = weight.astype(jnp.float32)
+    new_mom = momentum * mom - (1 - momentum) * (g + wd * w32)
+    new_w = (1 - lr * wd_lh) * w32 + lr * jnp.sign(new_mom)
+    return new_w.astype(weight.dtype), new_mom
+
+
+@jax.jit
+def lamb_update_phase1(weight, grad, mean, var, wd, rescale, clip, beta1, beta2, eps, t, bias_correction):
+    g = jnp.clip(grad.astype(jnp.float32) * rescale, -clip, clip)
+    w32 = weight.astype(jnp.float32)
+    new_mean = beta1 * mean + (1 - beta1) * g
+    new_var = beta2 * var + (1 - beta2) * jnp.square(g)
+    mean_hat = jnp.where(bias_correction, new_mean / (1 - beta1 ** t), new_mean)
+    var_hat = jnp.where(bias_correction, new_var / (1 - beta2 ** t), new_var)
+    r = mean_hat / (jnp.sqrt(var_hat) + eps) + wd * w32
+    return r, new_mean, new_var
+
+
+@jax.jit
+def lamb_update_phase2(weight, r, lr, lower_bound, upper_bound):
+    w32 = weight.astype(jnp.float32)
+    w_norm = jnp.linalg.norm(w32)
+    r_norm = jnp.linalg.norm(r)
+    ratio = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+    ratio = jnp.clip(ratio, lower_bound, upper_bound)
+    return (w32 - lr * ratio * r).astype(weight.dtype)
+
+
+# -- multi-precision (fp32 master weights for bf16/fp16 params) -------------
+
+
+@jax.jit
+def mp_sgd_mom_update(weight, grad, mom, weight32, lr, wd, rescale, clip, momentum):
+    g = jnp.clip(grad.astype(jnp.float32) * rescale, -clip, clip) + wd * weight32
+    new_mom = momentum * mom - lr * g
+    new_w32 = weight32 + new_mom
+    return new_w32.astype(weight.dtype), new_mom, new_w32
+
+
+@jax.jit
+def mp_adam_update(weight, grad, mean, var, weight32, lr, wd, rescale, clip, beta1, beta2, eps, t):
+    g = jnp.clip(grad.astype(jnp.float32) * rescale, -clip, clip) + wd * weight32
+    new_mean = beta1 * mean + (1 - beta1) * g
+    new_var = beta2 * var + (1 - beta2) * jnp.square(g)
+    lr_t = lr * jnp.sqrt(1 - beta2 ** t) / (1 - beta1 ** t)
+    new_w32 = weight32 - lr_t * new_mean / (jnp.sqrt(new_var) + eps)
+    return new_w32.astype(weight.dtype), new_mean, new_var, new_w32

@@ -1,0 +1,86 @@
+"""Operator registry — TPU-native replacement for the NNVM op registry.
+
+Parity target: ``NNVM_REGISTER_OP`` + ``FCompute`` dispatch
+([U:src/operator/], [U:include/mxnet/op_attr_types.h]).  Differences by
+design:
+
+* An op is a **pure function** ``fn(*jax_arrays, **static_kwargs)`` returning
+  a jax.Array or tuple thereof.  No FInferShape/FInferType tables are needed —
+  ``jax.eval_shape`` performs shape/dtype inference on the same function that
+  computes (used by Symbol.infer_shape and deferred Parameter init).
+* No FGradient registration — gradients come from ``jax.vjp`` of the same
+  pure function (the autograd tape calls it), so every op is differentiable
+  for free unless marked ``differentiable=False``.
+* CPU/GPU/TPU kernel variants collapse into one definition; XLA specializes
+  per backend.
+"""
+from __future__ import annotations
+
+import functools
+
+__all__ = ["Op", "register", "get_op", "list_ops", "alias"]
+
+_REGISTRY: dict[str, "Op"] = {}
+
+
+class Op:
+    """A registered operator."""
+
+    __slots__ = ("name", "fn", "differentiable", "wrap_ndarray", "doc")
+
+    def __init__(self, name, fn, differentiable=True, wrap_ndarray=True):
+        self.name = name
+        self.fn = fn
+        self.differentiable = differentiable
+        self.wrap_ndarray = wrap_ndarray
+        self.doc = fn.__doc__
+
+    def __call__(self, *args, **kwargs):
+        return self.fn(*args, **kwargs)
+
+    def __repr__(self):
+        return f"<Op {self.name}>"
+
+
+def register(name=None, differentiable=True, wrap_ndarray=True):
+    """Decorator registering a pure function as a framework operator."""
+
+    def deco(fn):
+        opname = name or fn.__name__
+        if opname in _REGISTRY:
+            raise ValueError(f"op {opname!r} already registered")
+        _REGISTRY[opname] = Op(opname, fn, differentiable, wrap_ndarray)
+        return fn
+
+    return deco
+
+
+def alias(new_name, existing):
+    """Register an alias for an existing op (MXNet has many, e.g.
+    ``elemwise_add`` vs ``broadcast_add`` vs ``__add__``)."""
+    op = get_op(existing)
+    if new_name in _REGISTRY:
+        raise ValueError(f"op {new_name!r} already registered")
+    _REGISTRY[new_name] = Op(new_name, op.fn, op.differentiable, op.wrap_ndarray)
+
+
+def get_op(name):
+    try:
+        return _REGISTRY[name]
+    except KeyError:
+        raise KeyError(f"operator {name!r} is not registered") from None
+
+
+def list_ops():
+    return sorted(_REGISTRY)
+
+
+@functools.lru_cache(maxsize=None)
+def _jitted(name):
+    """Return a jit-compiled version of a registered op (used by hot paths
+    like fused optimizer updates; everyday eager dispatch stays un-jitted and
+    relies on XLA's per-primitive caching)."""
+    import jax
+
+    op = get_op(name)
+    return jax.jit(op.fn, static_argnames=())

@@ -1,0 +1,618 @@
+"""Optimizer registry and implementations.
+
+Parity target: [U:python/mxnet/optimizer/optimizer.py] (registry, lr/wd
+mults, num_update bookkeeping, multi_precision master weights) backed by the
+fused jitted kernels in ops/optimizer_ops.py (the reference's fused C++/CUDA
+update ops, [U:src/operator/optimizer_op.cc]).
+
+States are NDArrays; updates swap buffers in place (engine-var style), so
+``trainer.step`` behaves exactly like the reference.  The fully-jitted
+training path (gluon.contrib / parallel.data_parallel) instead calls the
+pure kernels directly inside one compiled step.
+"""
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+import numpy as _np
+
+from ..ndarray.ndarray import NDArray, zeros
+from ..ops import optimizer_ops as K
+
+__all__ = [
+    "Optimizer", "SGD", "NAG", "Adam", "AdamW", "AdaGrad", "AdaDelta",
+    "RMSProp", "Ftrl", "Signum", "LAMB", "Updater", "get_updater", "create", "register",
+]
+
+_REGISTRY = {}
+
+_INF = float("inf")
+
+
+def register(klass):
+    _REGISTRY[klass.__name__.lower()] = klass
+    return klass
+
+
+def create(name, **kwargs):
+    if isinstance(name, Optimizer):
+        return name
+    return _REGISTRY[name.lower()](**kwargs)
+
+
+def _f32(x):
+    return jnp.float32(x)
+
+
+class Optimizer:
+    """Base optimizer (parity: ``mx.optimizer.Optimizer``)."""
+
+    def __init__(
+        self,
+        rescale_grad=1.0,
+        param_idx2name=None,
+        wd=0.0,
+        clip_gradient=None,
+        learning_rate=0.01,
+        lr_scheduler=None,
+        begin_num_update=0,
+        multi_precision=False,
+        param_dict=None,
+        **kwargs,
+    ):
+        self.rescale_grad = rescale_grad
+        self.lr = learning_rate
+        self.lr_scheduler = lr_scheduler
+        if lr_scheduler is not None:
+            self.lr_scheduler.base_lr = learning_rate
+        self.wd = wd
+        self.clip_gradient = clip_gradient if clip_gradient is not None else _INF
+        self.begin_num_update = begin_num_update
+        self.num_update = begin_num_update
+        self._index_update_count = {}
+        self.multi_precision = multi_precision
+        self.idx2name = dict(param_idx2name or {})
+        self.param_dict = param_dict or {}
+        self.lr_mult = {}
+        self.wd_mult = {}
+
+    # -- lr/wd plumbing (parity with reference semantics) ---------------
+    def set_learning_rate(self, lr):
+        if self.lr_scheduler is not None:
+            raise UserWarning("LRScheduler of the optimizer has already been defined.")
+        self.lr = lr
+
+    @property
+    def learning_rate(self):
+        if self.lr_scheduler is not None:
+            return self.lr_scheduler(self.num_update)
+        return self.lr
+
+    def set_lr_mult(self, args_lr_mult):
+        self.lr_mult = dict(args_lr_mult)
+
+    def set_wd_mult(self, args_wd_mult):
+        self.wd_mult = {}
+        for n in self.idx2name.values():
+            if not (n.endswith("_weight") or n.endswith("_gamma")):
+                self.wd_mult[n] = 0.0
+        self.wd_mult.update(args_wd_mult)
+
+    def _update_count(self, index):
+        if index not in self._index_update_count:
+            self._index_update_count[index] = self.begin_num_update
+        self._index_update_count[index] += 1
+        self.num_update = max(self._index_update_count[index], self.num_update)
+
+    def _get_lr(self, index):
+        lr = self.lr_scheduler(self.num_update) if self.lr_scheduler is not None else self.lr
+        param = self.param_dict.get(index)
+        if param is not None:
+            lr *= getattr(param, "lr_mult", 1.0)
+        elif index in self.lr_mult:
+            lr *= self.lr_mult[index]
+        elif index in self.idx2name:
+            lr *= self.lr_mult.get(self.idx2name[index], 1.0)
+        return lr
+
+    def _get_wd(self, index):
+        wd = self.wd
+        param = self.param_dict.get(index)
+        if param is not None:
+            wd *= getattr(param, "wd_mult", 1.0)
+        elif index in self.wd_mult:
+            wd *= self.wd_mult[index]
+        elif index in self.idx2name:
+            wd *= self.wd_mult.get(self.idx2name[index], 1.0)
+        return wd
+
+    # -- state ----------------------------------------------------------
+    def create_state(self, index, weight):
+        return None
+
+    def _use_mp(self, weight):
+        return self.multi_precision and weight.dtype in (jnp.float16, jnp.bfloat16)
+
+    def create_state_multi_precision(self, index, weight):
+        if self._use_mp(weight):
+            w32 = NDArray(weight._data.astype(jnp.float32), ctx=weight.ctx)
+            return (self.create_state(index, NDArray(w32._data)), w32)
+        return self.create_state(index, weight)
+
+    def update(self, index, weight, grad, state):
+        raise NotImplementedError
+
+    def update_multi_precision(self, index, weight, grad, state):
+        if self._use_mp(weight):
+            self._update_mp(index, weight, grad, state)
+        else:
+            self.update(index, weight, grad, state)
+
+    def _update_mp(self, index, weight, grad, state):
+        inner_state, w32 = state
+        self.update(index, w32, grad, inner_state)
+        weight._data = w32._data.astype(weight.dtype)
+        weight._version += 1
+
+    # serialization (sent to dist kvstore servers in the reference) -----
+    def __getstate__(self):
+        return self.__dict__.copy()
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+
+
+def _swap(arr, new_data):
+    arr._data = new_data
+    arr._version += 1
+
+
+@register
+class SGD(Optimizer):
+    """SGD with momentum + optional multi-precision
+    (parity: sgd_update/sgd_mom_update/mp_sgd_mom_update)."""
+
+    def __init__(self, momentum=0.0, lazy_update=False, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return zeros(weight.shape, dtype="float32", ctx=weight.ctx)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        if state is None:
+            _swap(
+                weight,
+                K.sgd_update(
+                    weight._data, grad._data, _f32(lr), _f32(wd), _f32(self.rescale_grad), _f32(self.clip_gradient)
+                ),
+            )
+        else:
+            new_w, new_mom = K.sgd_mom_update(
+                weight._data,
+                grad._data,
+                state._data,
+                _f32(lr),
+                _f32(wd),
+                _f32(self.rescale_grad),
+                _f32(self.clip_gradient),
+                _f32(self.momentum),
+            )
+            _swap(weight, new_w)
+            _swap(state, new_mom)
+
+    def update_multi_precision(self, index, weight, grad, state):
+        if self._use_mp(weight) and self.momentum != 0.0:
+            mom, w32 = state
+            self._update_count(index)
+            lr, wd = self._get_lr(index), self._get_wd(index)
+            new_w, new_mom, new_w32 = K.mp_sgd_mom_update(
+                weight._data,
+                grad._data,
+                mom._data,
+                w32._data,
+                _f32(lr),
+                _f32(wd),
+                _f32(self.rescale_grad),
+                _f32(self.clip_gradient),
+                _f32(self.momentum),
+            )
+            _swap(weight, new_w)
+            _swap(mom, new_mom)
+            _swap(w32, new_w32)
+        else:
+            super().update_multi_precision(index, weight, grad, state)
+
+
+@register
+class NAG(Optimizer):
+    def __init__(self, momentum=0.0, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return zeros(weight.shape, dtype="float32", ctx=weight.ctx)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        if state is None:
+            _swap(
+                weight,
+                K.sgd_update(
+                    weight._data, grad._data, _f32(lr), _f32(wd), _f32(self.rescale_grad), _f32(self.clip_gradient)
+                ),
+            )
+            return
+        new_w, new_mom = K.nag_mom_update(
+            weight._data,
+            grad._data,
+            state._data,
+            _f32(lr),
+            _f32(wd),
+            _f32(self.rescale_grad),
+            _f32(self.clip_gradient),
+            _f32(self.momentum),
+        )
+        _swap(weight, new_w)
+        _swap(state, new_mom)
+
+
+@register
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def create_state(self, index, weight):
+        return (
+            zeros(weight.shape, dtype="float32", ctx=weight.ctx),
+            zeros(weight.shape, dtype="float32", ctx=weight.ctx),
+        )
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        t = self._index_update_count[index]
+        mean, var = state
+        new_w, new_mean, new_var = K.adam_update(
+            weight._data,
+            grad._data,
+            mean._data,
+            var._data,
+            _f32(lr),
+            _f32(wd),
+            _f32(self.rescale_grad),
+            _f32(self.clip_gradient),
+            _f32(self.beta1),
+            _f32(self.beta2),
+            _f32(self.epsilon),
+            _f32(t),
+        )
+        _swap(weight, new_w)
+        _swap(mean, new_mean)
+        _swap(var, new_var)
+
+    def _update_mp(self, index, weight, grad, state):
+        (mean, var), w32 = state[0], state[1]
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        t = self._index_update_count[index]
+        new_w, new_mean, new_var, new_w32 = K.mp_adam_update(
+            weight._data,
+            grad._data,
+            mean._data,
+            var._data,
+            w32._data,
+            _f32(lr),
+            _f32(wd),
+            _f32(self.rescale_grad),
+            _f32(self.clip_gradient),
+            _f32(self.beta1),
+            _f32(self.beta2),
+            _f32(self.epsilon),
+            _f32(t),
+        )
+        _swap(weight, new_w)
+        _swap(mean, new_mean)
+        _swap(var, new_var)
+        _swap(w32, new_w32)
+
+
+@register
+class AdamW(Adam):
+    """Decoupled weight decay (not in the 1.x core op set; provided for the
+    BERT workload — GluonNLP ships it as a contrib optimizer)."""
+
+    def __init__(self, eta=1.0, **kwargs):
+        super().__init__(**kwargs)
+        self.eta = eta
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        t = self._index_update_count[index]
+        mean, var = state
+        new_w, new_mean, new_var = K.adamw_update(
+            weight._data,
+            grad._data,
+            mean._data,
+            var._data,
+            _f32(lr),
+            _f32(wd),
+            _f32(self.eta),
+            _f32(self.rescale_grad),
+            _f32(self.clip_gradient),
+            _f32(self.beta1),
+            _f32(self.beta2),
+            _f32(self.epsilon),
+            _f32(t),
+        )
+        _swap(weight, new_w)
+        _swap(mean, new_mean)
+        _swap(var, new_var)
+
+
+@register
+class AdaGrad(Optimizer):
+    def __init__(self, eps=1e-7, **kwargs):
+        super().__init__(**kwargs)
+        self.float_stable_eps = eps
+
+    def create_state(self, index, weight):
+        return zeros(weight.shape, dtype="float32", ctx=weight.ctx)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        new_w, new_hist = K.adagrad_update(
+            weight._data,
+            grad._data,
+            state._data,
+            _f32(lr),
+            _f32(wd),
+            _f32(self.rescale_grad),
+            _f32(self.clip_gradient),
+            _f32(self.float_stable_eps),
+        )
+        _swap(weight, new_w)
+        _swap(state, new_hist)
+
+
+@register
+class AdaDelta(Optimizer):
+    def __init__(self, rho=0.90, epsilon=1e-5, **kwargs):
+        super().__init__(**kwargs)
+        self.rho, self.epsilon = rho, epsilon
+
+    def create_state(self, index, weight):
+        return (
+            zeros(weight.shape, dtype="float32", ctx=weight.ctx),
+            zeros(weight.shape, dtype="float32", ctx=weight.ctx),
+        )
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        wd = self._get_wd(index)
+        acc_g, acc_d = state
+        new_w, new_g, new_d = K.adadelta_update(
+            weight._data,
+            grad._data,
+            acc_g._data,
+            acc_d._data,
+            _f32(wd),
+            _f32(self.rescale_grad),
+            _f32(self.clip_gradient),
+            _f32(self.rho),
+            _f32(self.epsilon),
+        )
+        _swap(weight, new_w)
+        _swap(acc_g, new_g)
+        _swap(acc_d, new_d)
+
+
+@register
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate=0.001, rho=0.9, momentum=0.9, epsilon=1e-8, centered=False, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.rho, self.momentum, self.epsilon, self.centered = rho, momentum, epsilon, centered
+
+    def create_state(self, index, weight):
+        if self.centered:
+            return tuple(zeros(weight.shape, dtype="float32", ctx=weight.ctx) for _ in range(3))
+        return zeros(weight.shape, dtype="float32", ctx=weight.ctx)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        if self.centered:
+            n, g_avg, delta = state
+            new_w, new_n, new_g, new_d = K.rmspropalex_update(
+                weight._data,
+                grad._data,
+                n._data,
+                g_avg._data,
+                delta._data,
+                _f32(lr),
+                _f32(wd),
+                _f32(self.rescale_grad),
+                _f32(self.clip_gradient),
+                _f32(self.rho),
+                _f32(self.momentum),
+                _f32(self.epsilon),
+            )
+            _swap(weight, new_w)
+            _swap(n, new_n)
+            _swap(g_avg, new_g)
+            _swap(delta, new_d)
+        else:
+            new_w, new_n = K.rmsprop_update(
+                weight._data,
+                grad._data,
+                state._data,
+                _f32(lr),
+                _f32(wd),
+                _f32(self.rescale_grad),
+                _f32(self.clip_gradient),
+                _f32(self.rho),
+                _f32(self.epsilon),
+            )
+            _swap(weight, new_w)
+            _swap(state, new_n)
+
+
+@register
+class Ftrl(Optimizer):
+    def __init__(self, lamda1=0.01, learning_rate=0.1, beta=1, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.lamda1, self.beta = lamda1, beta
+
+    def create_state(self, index, weight):
+        return (
+            zeros(weight.shape, dtype="float32", ctx=weight.ctx),
+            zeros(weight.shape, dtype="float32", ctx=weight.ctx),
+        )
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        z, n = state
+        new_w, new_z, new_n = K.ftrl_update(
+            weight._data,
+            grad._data,
+            z._data,
+            n._data,
+            _f32(lr),
+            _f32(wd),
+            _f32(self.rescale_grad),
+            _f32(self.clip_gradient),
+            _f32(self.lamda1),
+            _f32(self.beta),
+        )
+        _swap(weight, new_w)
+        _swap(z, new_z)
+        _swap(n, new_n)
+
+
+@register
+class Signum(Optimizer):
+    def __init__(self, learning_rate=0.01, momentum=0.9, wd_lh=0.0, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum, self.wd_lh = momentum, wd_lh
+
+    def create_state(self, index, weight):
+        return zeros(weight.shape, dtype="float32", ctx=weight.ctx)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        new_w, new_mom = K.signum_update(
+            weight._data,
+            grad._data,
+            state._data,
+            _f32(lr),
+            _f32(wd),
+            _f32(self.rescale_grad),
+            _f32(self.clip_gradient),
+            _f32(self.momentum),
+            _f32(self.wd_lh),
+        )
+        _swap(weight, new_w)
+        _swap(state, new_mom)
+
+
+@register
+class LAMB(Optimizer):
+    """Layer-wise adaptive moments for large-batch BERT (parity:
+    lamb_update_phase1/2 in [U:src/operator/optimizer_op.cc])."""
+
+    def __init__(
+        self,
+        learning_rate=0.001,
+        beta1=0.9,
+        beta2=0.999,
+        epsilon=1e-6,
+        lower_bound=None,
+        upper_bound=None,
+        bias_correction=True,
+        **kwargs,
+    ):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self.lower_bound = lower_bound if lower_bound is not None else 0.0
+        self.upper_bound = upper_bound if upper_bound is not None else _INF
+        self.bias_correction = bias_correction
+
+    def create_state(self, index, weight):
+        return (
+            zeros(weight.shape, dtype="float32", ctx=weight.ctx),
+            zeros(weight.shape, dtype="float32", ctx=weight.ctx),
+        )
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        t = self._index_update_count[index]
+        mean, var = state
+        r, new_mean, new_var = K.lamb_update_phase1(
+            weight._data,
+            grad._data,
+            mean._data,
+            var._data,
+            _f32(wd),
+            _f32(self.rescale_grad),
+            _f32(self.clip_gradient),
+            _f32(self.beta1),
+            _f32(self.beta2),
+            _f32(self.epsilon),
+            _f32(t),
+            jnp.bool_(self.bias_correction),
+        )
+        new_w = K.lamb_update_phase2(weight._data, r, _f32(lr), _f32(self.lower_bound), _f32(self.upper_bound))
+        _swap(weight, new_w)
+        _swap(mean, new_mean)
+        _swap(var, new_var)
+
+
+class Updater:
+    """KVStore-side updater closure (parity: ``mx.optimizer.get_updater`` /
+    the serialized optimizer shipped to dist servers)."""
+
+    def __init__(self, optimizer):
+        self.optimizer = optimizer
+        self.states = {}
+        self.states_synced = {}
+
+    def __call__(self, index, grad, weight):
+        if index not in self.states:
+            self.states[index] = self.optimizer.create_state_multi_precision(index, weight)
+        self.optimizer.update_multi_precision(index, weight, grad, self.states[index])
+
+    def get_states(self, dump_optimizer=False):
+        import pickle
+
+        return pickle.dumps((self.states, self.optimizer) if dump_optimizer else self.states)
+
+    def set_states(self, states):
+        import pickle
+
+        obj = pickle.loads(states)
+        if isinstance(obj, tuple):
+            self.states, self.optimizer = obj
+        else:
+            self.states = obj
+
+
+def get_updater(optimizer):
+    return Updater(optimizer)
+
+
+math  # keep import
+_np  # keep import

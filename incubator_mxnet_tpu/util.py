@@ -1,0 +1,60 @@
+"""Misc util shims (parity: [U:python/mxnet/util.py]).
+
+The reference toggles legacy-vs-numpy shape/array semantics process-wide
+(``np_shape``/``np_array``); this framework is numpy-semantics natively (jax
+is), so the toggles are tracked flags that always behave as enabled for
+computation — kept so reference scripts calling ``mx.npx.set_np()`` etc.
+run unchanged.
+"""
+from __future__ import annotations
+
+import contextlib
+import functools
+
+_np_shape = True
+_np_array = True
+
+
+def is_np_shape():
+    return _np_shape
+
+
+def is_np_array():
+    return _np_array
+
+
+@contextlib.contextmanager
+def np_shape(active=True):
+    global _np_shape
+    prev, _np_shape = _np_shape, active
+    try:
+        yield
+    finally:
+        _np_shape = prev
+
+
+@contextlib.contextmanager
+def np_array(active=True):
+    global _np_array
+    prev, _np_array = _np_array, active
+    try:
+        yield
+    finally:
+        _np_array = prev
+
+
+def set_np(shape=True, array=True):
+    global _np_shape, _np_array
+    _np_shape, _np_array = shape, array
+
+
+def reset_np():
+    set_np(True, True)
+
+
+def use_np(func):
+    @functools.wraps(func)
+    def wrapper(*a, **k):
+        return func(*a, **k)
+
+    return wrapper

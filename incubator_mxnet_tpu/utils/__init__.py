@@ -1,0 +1,2 @@
+"""Utility subpackage: test_utils (the testing backbone), config/env map."""
+from . import test_utils  # noqa: F401

@@ -1,0 +1,206 @@
+"""Testing backbone (parity: [U:python/mxnet/test_utils.py]).
+
+Ported idioms (SURVEY.md §4): dtype-aware ``assert_almost_equal``;
+``check_numeric_gradient`` finite-difference autograd validation;
+``check_consistency`` cross-context/dtype comparison with CPU as oracle
+(the reference's main correctness oracle for device backends — here
+cpu-jax vs tpu); ``default_context`` honoring ``MXNET_TEST_DEFAULT_CTX``;
+``rand_ndarray``; the ``with_seed`` rotating-seed decorator lives in
+tests/common.py like the reference.
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as _np
+
+from .. import context as _context
+from ..ndarray.ndarray import NDArray, array
+from .. import random as _random
+
+__all__ = [
+    "default_context",
+    "set_default_context",
+    "assert_almost_equal",
+    "almost_equal",
+    "same",
+    "rand_ndarray",
+    "rand_shape_2d",
+    "rand_shape_3d",
+    "rand_shape_nd",
+    "check_numeric_gradient",
+    "check_consistency",
+    "simple_forward",
+    "default_rtols",
+]
+
+_default_ctx = None
+
+
+def default_context():
+    global _default_ctx
+    if _default_ctx is None:
+        env = os.environ.get("MXNET_TEST_DEFAULT_CTX", "")
+        if env:
+            name, _, idx = env.partition("(")
+            idx = int(idx.rstrip(")") or 0)
+            _default_ctx = _context.Context(name, idx)
+        else:
+            _default_ctx = _context.cpu()
+    return _default_ctx
+
+
+def set_default_context(ctx):
+    global _default_ctx
+    _default_ctx = ctx
+
+
+def default_rtols(dtype):
+    d = _np.dtype(dtype) if not isinstance(dtype, str) else dtype
+    name = str(d)
+    if "float16" in name or "bfloat16" in name:
+        return 1e-2, 1e-2
+    if "float32" in name:
+        return 1e-4, 1e-5
+    if "float64" in name:
+        return 1e-6, 1e-8
+    return 0.0, 0.0
+
+
+def _to_np(a):
+    if isinstance(a, NDArray):
+        return a.asnumpy()
+    return _np.asarray(a)
+
+
+def same(a, b):
+    return _np.array_equal(_to_np(a), _to_np(b))
+
+
+def almost_equal(a, b, rtol=None, atol=None):
+    a, b = _to_np(a), _to_np(b)
+    if rtol is None or atol is None:
+        r, t = default_rtols(a.dtype)
+        rtol = rtol if rtol is not None else r
+        atol = atol if atol is not None else t
+    return _np.allclose(a.astype(_np.float64), b.astype(_np.float64), rtol=rtol, atol=atol, equal_nan=True)
+
+
+def assert_almost_equal(a, b, rtol=None, atol=None, names=("a", "b")):
+    a_np, b_np = _to_np(a), _to_np(b)
+    if rtol is None or atol is None:
+        r, t = default_rtols(a_np.dtype)
+        rtol = rtol if rtol is not None else r
+        atol = atol if atol is not None else t
+    if a_np.shape != b_np.shape:
+        raise AssertionError(f"shape mismatch: {names[0]}{a_np.shape} vs {names[1]}{b_np.shape}")
+    if not _np.allclose(a_np.astype(_np.float64), b_np.astype(_np.float64), rtol=rtol, atol=atol, equal_nan=True):
+        diff = _np.abs(a_np.astype(_np.float64) - b_np.astype(_np.float64))
+        rel = diff / (_np.abs(b_np.astype(_np.float64)) + atol)
+        raise AssertionError(
+            f"{names[0]} and {names[1]} differ: max abs {diff.max():g}, max rel {rel.max():g} "
+            f"(rtol={rtol}, atol={atol})\n{names[0]}={a_np}\n{names[1]}={b_np}"
+        )
+
+
+def rand_ndarray(shape, stype="default", density=None, dtype="float32", ctx=None):
+    if stype != "default":
+        raise NotImplementedError("sparse rand_ndarray: dense-on-TPU design, see docs/sparse.md")
+    return _random.uniform(-1.0, 1.0, shape, dtype="float32", ctx=ctx or default_context()).astype(dtype)
+
+
+def rand_shape_2d(dim0=10, dim1=10):
+    return (_np.random.randint(1, dim0 + 1), _np.random.randint(1, dim1 + 1))
+
+
+def rand_shape_3d(dim0=10, dim1=10, dim2=10):
+    return (
+        _np.random.randint(1, dim0 + 1),
+        _np.random.randint(1, dim1 + 1),
+        _np.random.randint(1, dim2 + 1),
+    )
+
+
+def rand_shape_nd(num_dim, dim=10):
+    return tuple(_np.random.randint(1, dim + 1, size=num_dim))
+
+
+def simple_forward(fn, *inputs, ctx=None):
+    arrs = [array(x, ctx=ctx) for x in inputs]
+    out = fn(*arrs)
+    if isinstance(out, (list, tuple)):
+        return [o.asnumpy() for o in out]
+    return out.asnumpy()
+
+
+def check_numeric_gradient(fn, inputs, eps=1e-3, rtol=1e-2, atol=1e-3, ctx=None):
+    """Finite-difference validation of the autograd tape (parity:
+    ``check_numeric_gradient``).  ``fn`` maps NDArrays -> scalar-reducible
+    NDArray; gradients are checked for every input."""
+    from .. import autograd
+
+    ctx = ctx or default_context()
+    arrs = [array(_np.asarray(x, dtype="float64").astype("float32"), ctx=ctx) for x in inputs]
+    for a in arrs:
+        a.attach_grad()
+    with autograd.record():
+        out = fn(*arrs)
+        loss = out.sum() if out.size > 1 else out
+    loss.backward()
+    analytic = [a.grad.asnumpy() for a in arrs]
+
+    def f_scalar(flat_inputs):
+        arrs2 = [array(x, ctx=ctx) for x in flat_inputs]
+        out2 = fn(*arrs2)
+        return float(out2.sum().asscalar() if out2.size > 1 else out2.asscalar())
+
+    numeric = []
+    base = [_np.asarray(x, dtype="float32").copy() for x in inputs]
+    for k, x in enumerate(base):
+        g = _np.zeros_like(x, dtype="float64")
+        flat = x.reshape(-1)
+        for i in range(flat.size):
+            orig = flat[i]
+            flat[i] = orig + eps
+            fp = f_scalar(base)
+            flat[i] = orig - eps
+            fm = f_scalar(base)
+            flat[i] = orig
+            g.reshape(-1)[i] = (fp - fm) / (2 * eps)
+        numeric.append(g)
+    for k, (a_g, n_g) in enumerate(zip(analytic, numeric)):
+        assert_almost_equal(a_g, n_g.astype("float32"), rtol=rtol, atol=atol, names=(f"analytic[{k}]", f"numeric[{k}]"))
+
+
+def check_consistency(fn, inputs, ctx_list=None, rtol=None, atol=None, grad=True):
+    """Run ``fn`` under every context in ``ctx_list`` and cross-compare
+    outputs (and input grads) — the reference's main cross-backend oracle
+    ([U:python/mxnet/test_utils.py] check_consistency), with jax-CPU as the
+    reference backend instead of the CUDA/CPU pair."""
+    from .. import autograd
+
+    if ctx_list is None:
+        ctx_list = [_context.cpu(), _context.tpu()]
+    results = []
+    grads = []
+    for ctx in ctx_list:
+        arrs = [array(_np.asarray(x, dtype="float32"), ctx=ctx) for x in inputs]
+        if grad:
+            for a in arrs:
+                a.attach_grad()
+            with autograd.record():
+                out = fn(*arrs)
+                loss = out.sum() if out.size > 1 else out
+            loss.backward()
+            grads.append([a.grad.asnumpy() for a in arrs])
+            results.append(out.asnumpy())
+        else:
+            results.append(fn(*arrs).asnumpy())
+    ref = results[0]
+    for i, res in enumerate(results[1:], 1):
+        assert_almost_equal(res, ref, rtol=rtol, atol=atol, names=(f"ctx[{i}]", "ctx[0]"))
+    if grad:
+        for i, gs in enumerate(grads[1:], 1):
+            for k, (g, g0) in enumerate(zip(gs, grads[0])):
+                assert_almost_equal(g, g0, rtol=rtol, atol=atol, names=(f"grad{k}@ctx[{i}]", f"grad{k}@ctx[0]"))
+    return results

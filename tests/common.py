@@ -1,0 +1,36 @@
+"""Shared test helpers (parity: [U:tests/python/unittest/common.py]).
+
+``with_seed`` — reproducible-but-rotating RNG seeds with the seed printed on
+failure, the reference's core test idiom."""
+import functools
+import os
+import random as pyrandom
+
+import numpy as np
+
+import incubator_mxnet_tpu as mx
+
+
+def with_seed(seed=None):
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            if seed is not None:
+                this_seed = seed
+            elif "MXNET_TEST_SEED" in os.environ:
+                this_seed = int(os.environ["MXNET_TEST_SEED"])
+            else:
+                this_seed = np.random.randint(0, 2 ** 31)
+            np.random.seed(this_seed)
+            mx.random.seed(this_seed)
+            pyrandom.seed(this_seed)
+            try:
+                return fn(*args, **kwargs)
+            except BaseException:
+                print(f"*** test failed with seed {this_seed}: "
+                      f"set MXNET_TEST_SEED={this_seed} to reproduce ***")
+                raise
+
+        return wrapper
+
+    return deco

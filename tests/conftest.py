@@ -1,0 +1,38 @@
+"""Test harness config.
+
+Tests run on a *virtual 8-device CPU mesh* (SURVEY.md §4: the reference's
+single-host multi-process distributed tests map to
+``xla_force_host_platform_device_count``), NOT the tunneled TPU chip — the
+tunnel adds an RPC per eager op and hangs all of jax when it wedges.
+
+The axon PJRT plugin registers itself from sitecustomize before conftest
+runs (and jax is already imported), so env vars alone are too late: the
+backend factory must be deregistered in-process, and jax_platforms set via
+config.update (the env var was already parsed as 'axon').
+"""
+import os
+import sys
+
+# XLA flags are read when the CPU backend is *created* (lazily), so this is
+# still early enough.
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_default_matmul_precision", "highest")
+
+try:  # drop the tunneled-TPU backend registered by sitecustomize, if any
+    from jax._src import xla_bridge as _xb
+
+    _xb._backend_factories.pop("axon", None)
+except Exception:
+    pass
+
+assert jax.default_backend() == "cpu", f"tests must run on cpu, got {jax.default_backend()}"
+assert len(jax.devices()) == 8, f"expected 8 virtual cpu devices, got {len(jax.devices())}"

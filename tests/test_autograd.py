@@ -1,0 +1,228 @@
+"""Autograd tape semantics (parity model: [U:tests/python/unittest/test_autograd.py])."""
+import numpy as np
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import autograd
+from incubator_mxnet_tpu.utils.test_utils import assert_almost_equal, check_numeric_gradient
+
+from common import with_seed
+
+
+def test_record_backward_simple():
+    x = mx.nd.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = (x * x).sum()
+    y.backward()
+    assert_almost_equal(x.grad, 2 * x.asnumpy())
+
+
+def test_chain_rule():
+    x = mx.nd.array([[0.5, -0.5], [1.0, 2.0]])
+    x.attach_grad()
+    with autograd.record():
+        y = mx.nd.exp(x) * 2
+        z = (y + x).sum()
+    z.backward()
+    assert_almost_equal(x.grad, 2 * np.exp(x.asnumpy()) + 1)
+
+
+def test_head_grad():
+    x = mx.nd.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 3
+    y.backward(mx.nd.array([10.0, 20.0]))
+    assert_almost_equal(x.grad, np.array([30.0, 60.0]))
+
+
+def test_grad_req_add_and_zero():
+    x = mx.nd.array([1.0, 1.0])
+    x.attach_grad(grad_req="add")
+    for _ in range(3):
+        with autograd.record():
+            y = (x * x).sum()
+        y.backward()
+    assert_almost_equal(x.grad, np.array([6.0, 6.0]))
+    x.zero_grad()
+    assert_almost_equal(x.grad, np.array([0.0, 0.0]))
+
+
+def test_write_overwrites():
+    x = mx.nd.array([2.0])
+    x.attach_grad()
+    for _ in range(2):
+        with autograd.record():
+            y = (x * x).sum()
+        y.backward()
+    assert_almost_equal(x.grad, np.array([4.0]))
+
+
+def test_multi_input_multi_use():
+    a = mx.nd.array([3.0])
+    b = mx.nd.array([4.0])
+    a.attach_grad()
+    b.attach_grad()
+    with autograd.record():
+        c = a * b + a  # dc/da = b + 1, dc/db = a
+    c.backward()
+    assert_almost_equal(a.grad, np.array([5.0]))
+    assert_almost_equal(b.grad, np.array([3.0]))
+
+
+def test_is_recording_training():
+    assert not autograd.is_recording()
+    with autograd.record():
+        assert autograd.is_recording()
+        assert autograd.is_training()
+        with autograd.pause():
+            assert not autograd.is_recording()
+        with autograd.predict_mode():
+            assert not autograd.is_training()
+    with autograd.train_mode():
+        assert autograd.is_training()
+
+
+def test_detach():
+    x = mx.nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 3
+        z = (y.detach() * x).sum()
+    z.backward()
+    assert_almost_equal(x.grad, np.array([6.0]))  # y treated as constant
+
+
+def test_autograd_grad_api():
+    x = mx.nd.array([2.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = (x * x * x).sum()
+    (g,) = autograd.grad([y], [x])
+    assert_almost_equal(g, 3 * x.asnumpy() ** 2)
+    # .grad buffer untouched by autograd.grad
+    assert_almost_equal(x.grad, np.zeros(2))
+
+
+def test_retain_graph():
+    x = mx.nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = (x * x).sum()
+    y.backward(retain_graph=True)
+    assert_almost_equal(x.grad, np.array([4.0]))
+    y.backward()
+    assert_almost_equal(x.grad, np.array([4.0]))
+
+
+def test_custom_function():
+    class Sigmoid(autograd.Function):
+        def forward(self, x):
+            y = mx.nd.sigmoid(x)
+            self.save_for_backward(y)
+            return y
+
+        def backward(self, dy):
+            (y,) = self.saved_tensors
+            return dy * y * (1 - y)
+
+    f = Sigmoid()
+    x = mx.nd.array([0.5, -0.5])
+    x.attach_grad()
+    with autograd.record():
+        y = f(x)
+        z = y.sum()
+    z.backward()
+    s = 1 / (1 + np.exp(-x.asnumpy()))
+    assert_almost_equal(x.grad, s * (1 - s), rtol=1e-5, atol=1e-6)
+
+
+def test_mark_variables():
+    x = mx.nd.array([1.0, 2.0])
+    g = mx.nd.zeros((2,))
+    autograd.mark_variables([x], [g])
+    with autograd.record():
+        y = (x * 4).sum()
+    y.backward()
+    assert_almost_equal(x.grad, np.array([4.0, 4.0]))
+
+
+@with_seed()
+def test_numeric_gradient_matmul():
+    a = np.random.uniform(-1, 1, (3, 4)).astype("float32")
+    b = np.random.uniform(-1, 1, (4, 2)).astype("float32")
+    check_numeric_gradient(lambda x, y: mx.nd.dot(x, y), [a, b])
+
+
+@with_seed()
+def test_numeric_gradient_elemwise():
+    x = np.random.uniform(0.5, 2.0, (5, 5)).astype("float32")
+    check_numeric_gradient(lambda a: mx.nd.log(a) * mx.nd.sqrt(a), [x])
+
+
+def test_getitem_grad():
+    x = mx.nd.array([[1.0, 2.0], [3.0, 4.0]])
+    x.attach_grad()
+    with autograd.record():
+        y = (x[0] * 2).sum()
+    y.backward()
+    assert_almost_equal(x.grad, np.array([[2.0, 2.0], [0.0, 0.0]]))
+
+
+def test_multi_output_op_grad():
+    x = mx.nd.array([[1.0, 2.0, 3.0, 4.0]])
+    x.attach_grad()
+    with autograd.record():
+        parts = mx.nd.split(x, 2, axis=1)
+        z = (parts[0] * 2 + parts[1] * 3).sum()
+    z.backward()
+    assert_almost_equal(x.grad, np.array([[2.0, 2.0, 3.0, 3.0]]))
+
+
+def test_stop_gradient_blocks():
+    x = mx.nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        z = (3 * mx.nd.stop_gradient(x * x)).sum()
+    z.backward()
+    assert float(x.grad.asscalar()) == 0.0
+
+
+def test_function_grad_alignment_with_constant_input():
+    """Custom Function must pair grads positionally even when an earlier
+    input is not attached (regression for provenance filtering bug)."""
+
+    class F(autograd.Function):
+        def forward(self, a, b):
+            return a * b
+
+        def backward(self, dy):
+            return dy * 0 + 111, dy * 0 + 222
+
+    c = mx.nd.array([1.0])
+    v = mx.nd.array([1.0])
+    v.attach_grad()
+    with autograd.record():
+        out = F()(c, v).sum()
+    out.backward()
+    assert float(v.grad.asscalar()) == 222.0
+
+
+def test_grad_rejects_unmarked_intermediate():
+    import pytest
+
+    x = mx.nd.array([1.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 2
+        z = (y * 3).sum()
+    with pytest.raises(ValueError):
+        autograd.grad([z], [y])
+
+
+def test_dropout_eval_identity_train_random():
+    d = mx.nd.Dropout(mx.nd.ones((4, 4)), p=0.5)
+    assert float(d.sum().asscalar()) == 16.0
+    with autograd.record():
+        d2 = mx.nd.Dropout(mx.nd.ones((200,)), p=0.5)
+    assert float(d2.sum().asscalar()) != 200.0
