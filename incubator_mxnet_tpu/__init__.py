@@ -45,6 +45,10 @@ from . import optimizer
 from . import lr_scheduler
 from . import runtime
 from . import callback
+from . import kvstore
+from . import kvstore as kv
+from . import gluon
+from . import model
 from .util import np_shape, np_array, is_np_shape, is_np_array, set_np, reset_np
 from . import numpy_ns as np  # mx.np numpy-compat namespace
 from .utils import test_utils

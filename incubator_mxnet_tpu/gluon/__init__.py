@@ -1,0 +1,31 @@
+"""Gluon: the imperative/hybrid front end
+(parity: [U:python/mxnet/gluon/])."""
+from . import parameter
+from .parameter import Parameter, Constant, ParameterDict
+from . import block
+from .block import Block, HybridBlock, SymbolBlock
+from . import nn
+from . import loss
+from .trainer import Trainer
+from . import utils
+from . import data
+from . import rnn
+from . import model_zoo
+from . import contrib
+
+__all__ = [
+    "Parameter",
+    "Constant",
+    "ParameterDict",
+    "Block",
+    "HybridBlock",
+    "SymbolBlock",
+    "Trainer",
+    "nn",
+    "loss",
+    "utils",
+    "data",
+    "rnn",
+    "model_zoo",
+    "contrib",
+]

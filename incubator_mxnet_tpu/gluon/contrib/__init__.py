@@ -1,0 +1,5 @@
+"""``gluon.contrib`` (parity: [U:python/mxnet/gluon/contrib/])."""
+from . import estimator
+from .estimator import Estimator
+
+__all__ = ["estimator", "Estimator"]

@@ -1,0 +1,209 @@
+"""Keras-style Estimator fit loop
+(parity: [U:python/mxnet/gluon/contrib/estimator/]).
+
+``Estimator.fit(train_data, epochs)`` with event handlers: checkpointing,
+logging, early stopping — same handler hook points as the reference
+(train_begin/epoch_begin/batch_begin/batch_end/epoch_end/train_end).
+"""
+from __future__ import annotations
+
+import logging
+import time
+
+from ... import metric as metric_mod
+from .. import loss as loss_mod
+from ..trainer import Trainer
+
+__all__ = [
+    "Estimator",
+    "TrainBegin",
+    "TrainEnd",
+    "EpochBegin",
+    "EpochEnd",
+    "BatchBegin",
+    "BatchEnd",
+    "CheckpointHandler",
+    "EarlyStoppingHandler",
+    "LoggingHandler",
+]
+
+
+class TrainBegin:
+    def train_begin(self, estimator, *args, **kwargs):
+        pass
+
+
+class TrainEnd:
+    def train_end(self, estimator, *args, **kwargs):
+        pass
+
+
+class EpochBegin:
+    def epoch_begin(self, estimator, *args, **kwargs):
+        pass
+
+
+class EpochEnd:
+    def epoch_end(self, estimator, *args, **kwargs):
+        pass
+
+
+class BatchBegin:
+    def batch_begin(self, estimator, *args, **kwargs):
+        pass
+
+
+class BatchEnd:
+    def batch_end(self, estimator, *args, **kwargs):
+        pass
+
+
+class LoggingHandler(TrainBegin, TrainEnd, EpochBegin, EpochEnd, BatchEnd):
+    def __init__(self, log_interval="epoch"):
+        self.log_interval = log_interval
+        self._batches = 0
+
+    def train_begin(self, estimator, *args, **kwargs):
+        self._start = time.time()
+        logging.info("Training begin")
+
+    def train_end(self, estimator, *args, **kwargs):
+        logging.info("Training finished in %.1fs", time.time() - self._start)
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        msgs = []
+        for m in estimator.train_metrics:
+            name, value = m.get()
+            msgs.append(f"{name}={value:.6f}")
+        logging.info("Epoch %d: %s", estimator.current_epoch, " ".join(msgs))
+
+    def batch_end(self, estimator, *args, **kwargs):
+        self._batches += 1
+        if self.log_interval != "epoch" and self._batches % self.log_interval == 0:
+            msgs = []
+            for m in estimator.train_metrics:
+                name, value = m.get()
+                msgs.append(f"{name}={value:.6f}")
+            logging.info("Batch %d: %s", self._batches, " ".join(msgs))
+
+
+class CheckpointHandler(EpochEnd):
+    def __init__(self, model_dir, model_prefix="model", save_best=False, monitor=None):
+        self.model_dir = model_dir
+        self.model_prefix = model_prefix
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        import os
+
+        os.makedirs(self.model_dir, exist_ok=True)
+        path = os.path.join(self.model_dir, f"{self.model_prefix}-epoch{estimator.current_epoch}.params")
+        estimator.net.save_parameters(path)
+
+
+class EarlyStoppingHandler(EpochEnd):
+    def __init__(self, monitor, min_delta=0, patience=0, mode="auto"):
+        self.monitor = monitor
+        self.min_delta = min_delta
+        self.patience = patience
+        self.wait = 0
+        self.best = None
+        self.mode = mode
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        name, value = self.monitor.get()
+        decreasing = "loss" in name or self.mode == "min"
+        improved = (
+            self.best is None
+            or (decreasing and value < self.best - self.min_delta)
+            or (not decreasing and value > self.best + self.min_delta)
+        )
+        if improved:
+            self.best = value
+            self.wait = 0
+        else:
+            self.wait += 1
+            if self.wait >= self.patience:
+                estimator.stop_training = True
+                logging.info("Early stopping: %s did not improve for %d epochs", name, self.wait)
+
+
+class Estimator:
+    """Parity: ``gluon.contrib.estimator.Estimator``."""
+
+    def __init__(self, net, loss=None, train_metrics=None, val_metrics=None, trainer=None, context=None):
+        self.net = net
+        self.loss = loss or loss_mod.SoftmaxCrossEntropyLoss()
+        self.train_metrics = _as_metrics(train_metrics) or [metric_mod.Accuracy()]
+        self.val_metrics = _as_metrics(val_metrics) or [metric_mod.Accuracy()]
+        self.trainer = trainer or Trainer(net.collect_params(), "adam")
+        self.stop_training = False
+        self.current_epoch = 0
+
+    def evaluate(self, val_data, val_metrics=None):
+        from ... import autograd
+
+        metrics = _as_metrics(val_metrics) or self.val_metrics
+        for m in metrics:
+            m.reset()
+        for batch in val_data:
+            data, label = batch[0], batch[1]
+            with autograd.predict_mode():
+                pred = self.net(data)
+            for m in metrics:
+                m.update([label], [pred])
+        return metrics
+
+    def fit(self, train_data, val_data=None, epochs=1, event_handlers=None, batches=None):
+        from ... import autograd
+
+        handlers = event_handlers or [LoggingHandler()]
+        for h in handlers:
+            if isinstance(h, TrainBegin):
+                h.train_begin(self)
+        n_batches = 0
+        for epoch in range(epochs):
+            if self.stop_training:
+                break
+            self.current_epoch = epoch
+            for m in self.train_metrics:
+                m.reset()
+            for h in handlers:
+                if isinstance(h, EpochBegin):
+                    h.epoch_begin(self)
+            for batch in train_data:
+                data, label = batch[0], batch[1]
+                for h in handlers:
+                    if isinstance(h, BatchBegin):
+                        h.batch_begin(self)
+                with autograd.record():
+                    pred = self.net(data)
+                    loss = self.loss(pred, label)
+                loss.backward()
+                self.trainer.step(data.shape[0])
+                for m in self.train_metrics:
+                    if isinstance(m, metric_mod.Loss):
+                        m.update([], [loss])
+                    else:
+                        m.update([label], [pred])
+                for h in handlers:
+                    if isinstance(h, BatchEnd):
+                        h.batch_end(self)
+                n_batches += 1
+                if batches is not None and n_batches >= batches:
+                    break
+            if val_data is not None:
+                self.evaluate(val_data)
+            for h in handlers:
+                if isinstance(h, EpochEnd):
+                    h.epoch_end(self)
+        for h in handlers:
+            if isinstance(h, TrainEnd):
+                h.train_end(self)
+
+
+def _as_metrics(m):
+    if m is None:
+        return None
+    if isinstance(m, (list, tuple)):
+        return list(m)
+    return [m]

@@ -1,0 +1,148 @@
+"""DataLoader (parity: [U:python/mxnet/gluon/data/dataloader.py]).
+
+Same API: batchify over a Dataset with samplers, ``num_workers`` background
+workers, prefetching.  Implementation differences (TPU-first): workers are
+*threads* feeding a bounded prefetch queue rather than forked processes with
+shared-memory NDArray pickling — decode/augment is numpy-side (NumPy releases
+the GIL for the heavy parts) and the hot path for packed datasets is the C++
+RecordIO reader (see native/), so fork+shm machinery (and the engine
+fork-handler dance in [U:src/initialize.cc]) is unnecessary.
+"""
+from __future__ import annotations
+
+import queue as _queue
+import threading
+
+import numpy as _np
+
+from ...ndarray.ndarray import NDArray, array
+from .sampler import BatchSampler, RandomSampler, SequentialSampler
+
+__all__ = ["DataLoader", "default_batchify_fn", "default_mp_batchify_fn"]
+
+
+def default_batchify_fn(data):
+    """Stack samples into a batch (parity: ``default_batchify_fn``)."""
+    if isinstance(data[0], NDArray):
+        import jax.numpy as jnp
+
+        return NDArray(jnp.stack([d._data for d in data]))
+    if isinstance(data[0], (tuple, list)):
+        return tuple(default_batchify_fn(list(items)) for items in zip(*data))
+    arr = _np.asarray(data)
+    if arr.dtype == _np.float64:
+        arr = arr.astype(_np.float32)
+    return array(arr)
+
+
+default_mp_batchify_fn = default_batchify_fn
+
+
+class DataLoader:
+    def __init__(
+        self,
+        dataset,
+        batch_size=None,
+        shuffle=False,
+        sampler=None,
+        last_batch=None,
+        batch_sampler=None,
+        batchify_fn=None,
+        num_workers=0,
+        pin_memory=False,
+        prefetch=None,
+        thread_pool=False,
+        timeout=120,
+    ):
+        self._dataset = dataset
+        self._pin_memory = pin_memory
+        self._timeout = timeout
+        if batch_sampler is None:
+            if batch_size is None:
+                raise ValueError("batch_size must be specified unless batch_sampler is specified")
+            if sampler is None:
+                sampler = RandomSampler(len(dataset)) if shuffle else SequentialSampler(len(dataset))
+            elif shuffle:
+                raise ValueError("shuffle must not be specified if sampler is specified")
+            batch_sampler = BatchSampler(sampler, batch_size, last_batch or "keep")
+        elif batch_size is not None or shuffle or sampler is not None or last_batch is not None:
+            raise ValueError(
+                "batch_size, shuffle, sampler and last_batch must not be specified if batch_sampler is specified."
+            )
+        self._batch_sampler = batch_sampler
+        self._num_workers = max(0, num_workers)
+        self._prefetch = max(0, prefetch if prefetch is not None else 2 * self._num_workers)
+        self._batchify_fn = batchify_fn or default_batchify_fn
+
+    def __len__(self):
+        return len(self._batch_sampler)
+
+    def _make_batch(self, indices):
+        samples = [self._dataset[i] for i in indices]
+        return self._batchify_fn(samples)
+
+    def __iter__(self):
+        if self._num_workers == 0:
+            for indices in self._batch_sampler:
+                yield self._make_batch(indices)
+            return
+        yield from self._threaded_iter()
+
+    def _threaded_iter(self):
+        """Bounded-queue worker pool preserving batch order.  Workers stall
+        once ``prefetch`` batches are waiting unconsumed, bounding memory."""
+        import time as _time
+
+        batches = list(self._batch_sampler)
+        bound = max(self._prefetch, self._num_workers)
+        out_q: dict[int, object] = {}
+        consumed = [0]  # next index the consumer will take
+        lock = threading.Lock()
+        done = threading.Event()
+        work_q = _queue.Queue()
+        for i, b in enumerate(batches):
+            work_q.put((i, b))
+
+        def worker():
+            while not done.is_set():
+                try:
+                    i, indices = work_q.get_nowait()
+                except _queue.Empty:
+                    return
+                # respect the prefetch bound: don't run ahead of the consumer
+                while not done.is_set():
+                    with lock:
+                        if i < consumed[0] + bound:
+                            break
+                    _time.sleep(0.001)
+                if done.is_set():
+                    return
+                try:
+                    batch = self._make_batch(indices)
+                except Exception as e:  # surface in consumer
+                    batch = e
+                with lock:
+                    out_q[i] = batch
+
+        threads = [threading.Thread(target=worker, daemon=True) for _ in range(self._num_workers)]
+        for t in threads:
+            t.start()
+        try:
+            for i in range(len(batches)):
+                import time
+
+                deadline = time.time() + self._timeout
+                while True:
+                    with lock:
+                        if i in out_q:
+                            batch = out_q.pop(i)
+                            consumed[0] = i + 1
+                            break
+                    if time.time() > deadline:
+                        raise RuntimeError(f"DataLoader timed out waiting for batch {i}")
+                    time.sleep(0.001)
+                if isinstance(batch, Exception):
+                    raise batch
+                yield batch
+        finally:
+            done.set()

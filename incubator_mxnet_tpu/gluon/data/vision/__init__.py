@@ -1,0 +1,14 @@
+"""``gluon.data.vision`` (parity: [U:python/mxnet/gluon/data/vision/])."""
+from .datasets import MNIST, FashionMNIST, CIFAR10, CIFAR100, ImageRecordDataset, ImageFolderDataset, SyntheticImageDataset
+from . import transforms
+
+__all__ = [
+    "MNIST",
+    "FashionMNIST",
+    "CIFAR10",
+    "CIFAR100",
+    "ImageRecordDataset",
+    "ImageFolderDataset",
+    "SyntheticImageDataset",
+    "transforms",
+]

@@ -1,0 +1,238 @@
+"""Vision transforms (parity: [U:python/mxnet/gluon/data/vision/transforms.py])."""
+from __future__ import annotations
+
+import numpy as _np
+
+from ...block import Block, HybridBlock
+from ...nn.basic_layers import Sequential
+from ....ndarray.ndarray import NDArray, array
+
+__all__ = [
+    "Compose",
+    "Cast",
+    "ToTensor",
+    "Normalize",
+    "Resize",
+    "CenterCrop",
+    "RandomResizedCrop",
+    "RandomCrop",
+    "RandomFlipLeftRight",
+    "RandomFlipTopBottom",
+    "RandomBrightness",
+    "RandomContrast",
+    "RandomSaturation",
+    "RandomLighting",
+]
+
+
+class Compose(Sequential):
+    """Parity: ``transforms.Compose``."""
+
+    def __init__(self, transforms):
+        super().__init__()
+        for t in transforms:
+            self.add(t)
+
+    def forward(self, x, *args):
+        for child in self._children.values():
+            x = child(x)
+        return x
+
+
+class Cast(HybridBlock):
+    def __init__(self, dtype="float32"):
+        super().__init__()
+        self._dtype = dtype
+
+    def hybrid_forward(self, F, x):
+        return x.astype(self._dtype)
+
+
+class ToTensor(HybridBlock):
+    """HWC uint8 [0,255] -> CHW float32 [0,1] (parity: ``ToTensor``)."""
+
+    def hybrid_forward(self, F, x):
+        if x.ndim == 3:
+            return x.astype("float32").transpose((2, 0, 1)) / 255.0
+        return x.astype("float32").transpose((0, 3, 1, 2)) / 255.0
+
+
+class Normalize(HybridBlock):
+    """Channel-wise normalize of CHW tensors (parity: ``Normalize``)."""
+
+    def __init__(self, mean=0.0, std=1.0):
+        super().__init__()
+        self._mean = _np.asarray(mean, dtype="float32")
+        self._std = _np.asarray(std, dtype="float32")
+
+    def hybrid_forward(self, F, x):
+        c = x.shape[0] if x.ndim == 3 else x.shape[1]
+        mean = _np.broadcast_to(self._mean, (c,)).reshape(
+            (c, 1, 1) if x.ndim == 3 else (1, c, 1, 1)
+        )
+        std = _np.broadcast_to(self._std, (c,)).reshape(
+            (c, 1, 1) if x.ndim == 3 else (1, c, 1, 1)
+        )
+        return (x - array(mean)) / array(std)
+
+
+def _to_np(img):
+    return img.asnumpy() if isinstance(img, NDArray) else _np.asarray(img)
+
+
+class Resize(Block):
+    """Parity: ``transforms.Resize`` (HWC input)."""
+
+    def __init__(self, size, keep_ratio=False, interpolation=1):
+        super().__init__()
+        self._size = (size, size) if isinstance(size, int) else tuple(size)
+
+    def forward(self, x):
+        import jax
+        import jax.numpy as jnp
+
+        data = x._data if isinstance(x, NDArray) else jnp.asarray(_np.asarray(x))
+        h, w = self._size[1], self._size[0]
+        out = jax.image.resize(
+            data.astype(jnp.float32), (h, w, data.shape[-1]), method="linear"
+        )
+        return NDArray(jnp.clip(jnp.round(out), 0, 255).astype(data.dtype))
+
+
+class CenterCrop(Block):
+    def __init__(self, size, interpolation=1):
+        super().__init__()
+        self._size = (size, size) if isinstance(size, int) else tuple(size)
+
+    def forward(self, x):
+        img = _to_np(x)
+        w, h = self._size
+        H, W = img.shape[0], img.shape[1]
+        y0 = max(0, (H - h) // 2)
+        x0 = max(0, (W - w) // 2)
+        return array(img[y0 : y0 + h, x0 : x0 + w])
+
+
+class RandomCrop(Block):
+    def __init__(self, size, pad=None, interpolation=1):
+        super().__init__()
+        self._size = (size, size) if isinstance(size, int) else tuple(size)
+        self._pad = pad
+
+    def forward(self, x):
+        img = _to_np(x)
+        if self._pad:
+            img = _np.pad(img, ((self._pad, self._pad), (self._pad, self._pad), (0, 0)), mode="constant")
+        w, h = self._size
+        H, W = img.shape[0], img.shape[1]
+        y0 = _np.random.randint(0, max(1, H - h + 1))
+        x0 = _np.random.randint(0, max(1, W - w + 1))
+        return array(img[y0 : y0 + h, x0 : x0 + w])
+
+
+class RandomResizedCrop(Block):
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3), interpolation=1):
+        super().__init__()
+        self._size = (size, size) if isinstance(size, int) else tuple(size)
+        self._scale = scale
+        self._ratio = ratio
+
+    def forward(self, x):
+        import jax
+        import jax.numpy as jnp
+
+        img = _to_np(x)
+        H, W = img.shape[0], img.shape[1]
+        area = H * W
+        for _ in range(10):
+            target_area = _np.random.uniform(*self._scale) * area
+            aspect = _np.random.uniform(*self._ratio)
+            w = int(round(_np.sqrt(target_area * aspect)))
+            h = int(round(_np.sqrt(target_area / aspect)))
+            if w <= W and h <= H:
+                x0 = _np.random.randint(0, W - w + 1)
+                y0 = _np.random.randint(0, H - h + 1)
+                crop = img[y0 : y0 + h, x0 : x0 + w]
+                break
+        else:
+            crop = img
+        out = jax.image.resize(
+            jnp.asarray(crop, dtype=jnp.float32),
+            (self._size[1], self._size[0], img.shape[-1]),
+            method="linear",
+        )
+        return NDArray(jnp.clip(jnp.round(out), 0, 255).astype(jnp.uint8 if img.dtype == _np.uint8 else img.dtype))
+
+
+class RandomFlipLeftRight(Block):
+    def __init__(self, p=0.5):
+        super().__init__()
+        self._p = p
+
+    def forward(self, x):
+        if _np.random.rand() < self._p:
+            return array(_to_np(x)[:, ::-1].copy())
+        return x if isinstance(x, NDArray) else array(_to_np(x))
+
+
+class RandomFlipTopBottom(Block):
+    def __init__(self, p=0.5):
+        super().__init__()
+        self._p = p
+
+    def forward(self, x):
+        if _np.random.rand() < self._p:
+            return array(_to_np(x)[::-1].copy())
+        return x if isinstance(x, NDArray) else array(_to_np(x))
+
+
+class _RandomJitter(Block):
+    def __init__(self, amount):
+        super().__init__()
+        self._amount = amount
+
+    def _factor(self):
+        return 1.0 + _np.random.uniform(-self._amount, self._amount)
+
+
+class RandomBrightness(_RandomJitter):
+    def forward(self, x):
+        img = _to_np(x).astype("float32") * self._factor()
+        return array(_np.clip(img, 0, 255).astype(_to_np(x).dtype))
+
+
+class RandomContrast(_RandomJitter):
+    def forward(self, x):
+        img = _to_np(x).astype("float32")
+        mean = img.mean()
+        out = (img - mean) * self._factor() + mean
+        return array(_np.clip(out, 0, 255).astype(_to_np(x).dtype))
+
+
+class RandomSaturation(_RandomJitter):
+    def forward(self, x):
+        img = _to_np(x).astype("float32")
+        gray = img.mean(axis=-1, keepdims=True)
+        out = (img - gray) * self._factor() + gray
+        return array(_np.clip(out, 0, 255).astype(_to_np(x).dtype))
+
+
+class RandomLighting(Block):
+    """AlexNet-style PCA lighting noise (parity: ``RandomLighting``)."""
+
+    _eigval = _np.asarray([55.46, 4.794, 1.148], dtype="float32")
+    _eigvec = _np.asarray(
+        [[-0.5675, 0.7192, 0.4009], [-0.5808, -0.0045, -0.814], [-0.5836, -0.6948, 0.4203]],
+        dtype="float32",
+    )
+
+    def __init__(self, alpha=0.1):
+        super().__init__()
+        self._alpha = alpha
+
+    def forward(self, x):
+        img = _to_np(x).astype("float32")
+        a = _np.random.normal(0, self._alpha, 3).astype("float32")
+        noise = (self._eigvec * a * self._eigval).sum(axis=1)
+        out = img + noise
+        return array(_np.clip(out, 0, 255).astype(_to_np(x).dtype))

@@ -1,0 +1,463 @@
+"""Basic neural-network layers.
+
+Parity target: [U:python/mxnet/gluon/nn/basic_layers.py] — Sequential,
+Dense, Dropout, BatchNorm, LayerNorm, GroupNorm, InstanceNorm, Embedding,
+Flatten, Lambda/HybridLambda.  Authoring convention (hybrid_forward with
+params as kwargs) matches the reference so user subclasses port unchanged.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from .. import block as _block
+from ..block import Block, HybridBlock, collect_aux_update
+from ... import initializer as init_mod
+
+__all__ = [
+    "Sequential",
+    "HybridSequential",
+    "Dense",
+    "Dropout",
+    "BatchNorm",
+    "LayerNorm",
+    "GroupNorm",
+    "InstanceNorm",
+    "Embedding",
+    "Flatten",
+    "Lambda",
+    "HybridLambda",
+    "Concatenate",
+    "HybridConcatenate",
+    "Identity",
+]
+
+
+class Sequential(Block):
+    """Sequential container (parity: ``nn.Sequential``)."""
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+
+    def add(self, *blocks):
+        for b in blocks:
+            self.register_child(b)
+
+    def forward(self, x, *args):
+        for child in self._children.values():
+            x = child(x, *args)
+            args = ()
+        return x
+
+    def __len__(self):
+        return len(self._children)
+
+    def __getitem__(self, key):
+        layers = list(self._children.values())
+        if isinstance(key, slice):
+            net = type(self)()
+            net.add(*layers[key])
+            return net
+        return layers[key]
+
+    def __iter__(self):
+        return iter(self._children.values())
+
+    def hybridize(self, active=True, **kwargs):
+        super().hybridize(active, **kwargs)
+
+
+class HybridSequential(HybridBlock):
+    """Hybridizable sequential container (parity: ``nn.HybridSequential``)."""
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+
+    def add(self, *blocks):
+        for b in blocks:
+            self.register_child(b)
+
+    def forward(self, x, *args):
+        # container: no own params; recurse into children directly
+        return self._seq_forward(x, *args)
+
+    def _seq_forward(self, x, *args):
+        for child in self._children.values():
+            x = child(x, *args)
+            args = ()
+        return x
+
+    def hybrid_forward(self, F, x, *args, **params):
+        return self._seq_forward(x, *args)
+
+    def __len__(self):
+        return len(self._children)
+
+    def __getitem__(self, key):
+        layers = list(self._children.values())
+        if isinstance(key, slice):
+            net = type(self)()
+            net.add(*layers[key])
+            return net
+        return layers[key]
+
+    def __iter__(self):
+        return iter(self._children.values())
+
+
+class Dense(HybridBlock):
+    """Fully-connected layer (parity: ``nn.Dense`` → FullyConnected op →
+    one MXU matmul).  ``in_units`` may be deferred."""
+
+    def __init__(
+        self,
+        units,
+        activation=None,
+        use_bias=True,
+        flatten=True,
+        dtype="float32",
+        weight_initializer=None,
+        bias_initializer="zeros",
+        in_units=0,
+        prefix=None,
+        params=None,
+    ):
+        super().__init__(prefix=prefix, params=params)
+        self._units = units
+        self._flatten = flatten
+        self._use_bias = use_bias
+        self._act_type = activation
+        with self.name_scope():
+            self.weight = self.params.get(
+                "weight",
+                shape=(units, in_units),
+                dtype=dtype,
+                init=weight_initializer,
+                allow_deferred_init=True,
+            )
+            if use_bias:
+                self.bias = self.params.get(
+                    "bias", shape=(units,), dtype=dtype, init=bias_initializer, allow_deferred_init=True
+                )
+
+    def _shape_inference(self, x, *args):
+        in_units = int(_np.prod(x.shape[1:])) if self._flatten else x.shape[-1]
+        self.weight._finish_deferred_init((self._units, in_units))
+        if self._use_bias:
+            self.bias._finish_deferred_init((self._units,))
+
+    def hybrid_forward(self, F, x, weight, bias=None):
+        out = F.FullyConnected(x, weight, bias, num_hidden=self._units, no_bias=bias is None, flatten=self._flatten)
+        if self._act_type is not None:
+            out = F.Activation(out, act_type=self._act_type)
+        return out
+
+    def __repr__(self):
+        shape = self.weight.shape
+        return f"Dense({shape[1] if shape and len(shape) > 1 else None} -> {self._units}, " \
+               f"{'linear' if self._act_type is None else self._act_type})"
+
+
+class Dropout(HybridBlock):
+    """Parity: ``nn.Dropout``."""
+
+    def __init__(self, rate, axes=(), prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._rate = rate
+        self._axes = axes
+
+    def hybrid_forward(self, F, x):
+        return F.Dropout(x, p=self._rate, axes=self._axes)
+
+    def __repr__(self):
+        return f"Dropout(p = {self._rate}, axes={self._axes})"
+
+
+class BatchNorm(HybridBlock):
+    """Batch normalization with running statistics (parity: ``nn.BatchNorm``).
+
+    Under hybridize the running-stat update rides the compiled graph as
+    extra outputs (see block.collect_aux_update); eagerly it's applied
+    immediately — either way semantics match the reference's in-op
+    aux mutation.
+    """
+
+    def __init__(
+        self,
+        axis=1,
+        momentum=0.9,
+        epsilon=1e-5,
+        center=True,
+        scale=True,
+        use_global_stats=False,
+        beta_initializer="zeros",
+        gamma_initializer="ones",
+        running_mean_initializer="zeros",
+        running_variance_initializer="ones",
+        in_channels=0,
+        prefix=None,
+        params=None,
+    ):
+        super().__init__(prefix=prefix, params=params)
+        self._axis = axis
+        self._momentum = momentum
+        self._epsilon = epsilon
+        self._center = center
+        self._scale = scale
+        self._use_global_stats = use_global_stats
+        self.in_channels = in_channels
+        with self.name_scope():
+            self.gamma = self.params.get(
+                "gamma",
+                grad_req="write" if scale else "null",
+                shape=(in_channels,),
+                init=gamma_initializer,
+                allow_deferred_init=True,
+                differentiable=scale,
+            )
+            self.beta = self.params.get(
+                "beta",
+                grad_req="write" if center else "null",
+                shape=(in_channels,),
+                init=beta_initializer,
+                allow_deferred_init=True,
+                differentiable=center,
+            )
+            self.running_mean = self.params.get(
+                "running_mean",
+                grad_req="null",
+                shape=(in_channels,),
+                init=running_mean_initializer,
+                allow_deferred_init=True,
+                differentiable=False,
+            )
+            self.running_var = self.params.get(
+                "running_var",
+                grad_req="null",
+                shape=(in_channels,),
+                init=running_variance_initializer,
+                allow_deferred_init=True,
+                differentiable=False,
+            )
+
+    def _shape_inference(self, x, *args):
+        c = x.shape[self._axis]
+        for p in (self.gamma, self.beta, self.running_mean, self.running_var):
+            p._finish_deferred_init((c,))
+
+    def cast(self, dtype):
+        if _np.dtype(dtype).kind == "f" and str(dtype) in ("float16", "bfloat16"):
+            dtype = "float32"  # parity: BN statistics stay fp32
+        super().cast(dtype)
+
+    def hybrid_forward(self, F, x, gamma, beta, running_mean, running_var):
+        from ... import autograd
+
+        training = autograd.is_training()
+        use_global = self._use_global_stats or not training
+        out = F.BatchNorm(
+            x,
+            gamma,
+            beta,
+            running_mean,
+            running_var,
+            eps=self._epsilon,
+            momentum=self._momentum,
+            fix_gamma=not self._scale,
+            use_global_stats=use_global,
+        )
+        out, batch_mean, batch_var = out
+        if not use_global:
+            m = self._momentum
+            collect_aux_update(self.running_mean, running_mean * m + batch_mean * (1 - m))
+            collect_aux_update(self.running_var, running_var * m + batch_var * (1 - m))
+        return out
+
+    def __repr__(self):
+        return f"BatchNorm(axis={self._axis}, eps={self._epsilon}, momentum={self._momentum}, in_channels={self.in_channels})"
+
+
+class LayerNorm(HybridBlock):
+    """Parity: ``nn.LayerNorm``."""
+
+    def __init__(
+        self,
+        axis=-1,
+        epsilon=1e-5,
+        center=True,
+        scale=True,
+        beta_initializer="zeros",
+        gamma_initializer="ones",
+        in_channels=0,
+        prefix=None,
+        params=None,
+    ):
+        super().__init__(prefix=prefix, params=params)
+        self._axis = axis
+        self._epsilon = epsilon
+        self._center = center
+        self._scale = scale
+        with self.name_scope():
+            self.gamma = self.params.get(
+                "gamma", grad_req="write" if scale else "null", shape=(in_channels,),
+                init=gamma_initializer, allow_deferred_init=True
+            )
+            self.beta = self.params.get(
+                "beta", grad_req="write" if center else "null", shape=(in_channels,),
+                init=beta_initializer, allow_deferred_init=True
+            )
+
+    def _shape_inference(self, x, *args):
+        c = x.shape[self._axis]
+        self.gamma._finish_deferred_init((c,))
+        self.beta._finish_deferred_init((c,))
+
+    def hybrid_forward(self, F, x, gamma, beta):
+        return F.LayerNorm(x, gamma, beta, axis=self._axis, eps=self._epsilon)
+
+
+class GroupNorm(HybridBlock):
+    """Parity: ``nn.GroupNorm``."""
+
+    def __init__(self, num_groups=1, epsilon=1e-5, center=True, scale=True,
+                 beta_initializer="zeros", gamma_initializer="ones", in_channels=0,
+                 prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._num_groups = num_groups
+        self._epsilon = epsilon
+        with self.name_scope():
+            self.gamma = self.params.get("gamma", grad_req="write" if scale else "null",
+                                         shape=(in_channels,), init=gamma_initializer,
+                                         allow_deferred_init=True)
+            self.beta = self.params.get("beta", grad_req="write" if center else "null",
+                                        shape=(in_channels,), init=beta_initializer,
+                                        allow_deferred_init=True)
+
+    def _shape_inference(self, x, *args):
+        c = x.shape[1]
+        self.gamma._finish_deferred_init((c,))
+        self.beta._finish_deferred_init((c,))
+
+    def hybrid_forward(self, F, x, gamma, beta):
+        return F.GroupNorm(x, gamma, beta, num_groups=self._num_groups, eps=self._epsilon)
+
+
+class InstanceNorm(HybridBlock):
+    """Parity: ``nn.InstanceNorm``."""
+
+    def __init__(self, axis=1, epsilon=1e-5, center=True, scale=False,
+                 beta_initializer="zeros", gamma_initializer="ones", in_channels=0,
+                 prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._epsilon = epsilon
+        with self.name_scope():
+            self.gamma = self.params.get("gamma", grad_req="write" if scale else "null",
+                                         shape=(in_channels,), init=gamma_initializer,
+                                         allow_deferred_init=True)
+            self.beta = self.params.get("beta", grad_req="write" if center else "null",
+                                        shape=(in_channels,), init=beta_initializer,
+                                        allow_deferred_init=True)
+
+    def _shape_inference(self, x, *args):
+        c = x.shape[1]
+        self.gamma._finish_deferred_init((c,))
+        self.beta._finish_deferred_init((c,))
+
+    def hybrid_forward(self, F, x, gamma, beta):
+        return F.InstanceNorm(x, gamma, beta, eps=self._epsilon)
+
+
+class Embedding(HybridBlock):
+    """Parity: ``nn.Embedding`` (gather from the table; ``sparse_grad`` is
+    accepted but dense on TPU — documented divergence)."""
+
+    def __init__(self, input_dim, output_dim, dtype="float32", weight_initializer=None,
+                 sparse_grad=False, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._input_dim = input_dim
+        self._output_dim = output_dim
+        with self.name_scope():
+            self.weight = self.params.get(
+                "weight", shape=(input_dim, output_dim), dtype=dtype, init=weight_initializer
+            )
+
+    def hybrid_forward(self, F, x, weight):
+        return F.Embedding(x, weight, input_dim=self._input_dim, output_dim=self._output_dim)
+
+    def __repr__(self):
+        return f"Embedding({self._input_dim} -> {self._output_dim})"
+
+
+class Flatten(HybridBlock):
+    """Parity: ``nn.Flatten``."""
+
+    def hybrid_forward(self, F, x):
+        return F.Flatten(x)
+
+    def __repr__(self):
+        return "Flatten"
+
+
+class Identity(HybridBlock):
+    def hybrid_forward(self, F, x):
+        return x
+
+
+class Lambda(Block):
+    """Wrap a function as a Block (parity: ``nn.Lambda``)."""
+
+    def __init__(self, function, prefix=None):
+        super().__init__(prefix=prefix)
+        if isinstance(function, str):
+            from ... import ndarray as nd
+
+            function = getattr(nd, function)
+        self._func = function
+
+    def forward(self, *args):
+        return self._func(*args)
+
+
+class HybridLambda(HybridBlock):
+    """Parity: ``nn.HybridLambda``."""
+
+    def __init__(self, function, prefix=None):
+        super().__init__(prefix=prefix)
+        if isinstance(function, str):
+            self._name_of_func = function
+
+            def fn(F, *args):
+                return getattr(F, function)(*args)
+
+            self._func = fn
+        else:
+            self._func = lambda F, *args: function(F, *args)
+
+    def hybrid_forward(self, F, x, *args):
+        return self._func(F, x, *args)
+
+
+class Concatenate(Sequential):
+    """Run children on the same input, concat outputs (parity:
+    ``contrib.nn.Concurrent``)."""
+
+    def __init__(self, axis=-1, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self.axis = axis
+
+    def forward(self, x):
+        from ... import ndarray as nd
+
+        outs = [child(x) for child in self._children.values()]
+        return nd.concat(*outs, dim=self.axis)
+
+
+class HybridConcatenate(HybridSequential):
+    def __init__(self, axis=-1, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self.axis = axis
+
+    def _seq_forward(self, x, *args):
+        from ... import ndarray as nd
+
+        outs = [child(x) for child in self._children.values()]
+        return nd.concat(*outs, dim=self.axis)

@@ -1,0 +1,287 @@
+"""Convolution / pooling layers.
+
+Parity target: [U:python/mxnet/gluon/nn/conv_layers.py] — Conv1D/2D/3D,
+Conv*DTranspose, Max/Avg/GlobalMax/GlobalAvg pooling, ReflectionPad2D.
+NCHW/OIHW conventions preserved; XLA:TPU handles the layout for the MXU.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from ..block import HybridBlock
+
+__all__ = [
+    "Conv1D",
+    "Conv2D",
+    "Conv3D",
+    "Conv1DTranspose",
+    "Conv2DTranspose",
+    "Conv3DTranspose",
+    "MaxPool1D",
+    "MaxPool2D",
+    "MaxPool3D",
+    "AvgPool1D",
+    "AvgPool2D",
+    "AvgPool3D",
+    "GlobalMaxPool1D",
+    "GlobalMaxPool2D",
+    "GlobalMaxPool3D",
+    "GlobalAvgPool1D",
+    "GlobalAvgPool2D",
+    "GlobalAvgPool3D",
+    "ReflectionPad2D",
+]
+
+
+def _tup(v, n):
+    if isinstance(v, int):
+        return (v,) * n
+    return tuple(v)
+
+
+class _Conv(HybridBlock):
+    def __init__(
+        self,
+        channels,
+        kernel_size,
+        strides,
+        padding,
+        dilation,
+        groups,
+        layout,
+        in_channels=0,
+        activation=None,
+        use_bias=True,
+        weight_initializer=None,
+        bias_initializer="zeros",
+        op_name="Convolution",
+        adj=None,
+        prefix=None,
+        params=None,
+    ):
+        super().__init__(prefix=prefix, params=params)
+        ndim = len(kernel_size)
+        self._channels = channels
+        self._in_channels = in_channels
+        self._kernel = kernel_size
+        self._stride = strides
+        self._pad = padding
+        self._dilate = dilation
+        self._groups = groups
+        self._layout = layout
+        self._act_type = activation
+        self._use_bias = use_bias
+        self._op_name = op_name
+        self._adj = adj
+        with self.name_scope():
+            if op_name == "Convolution":
+                wshape = (channels, in_channels // groups if in_channels else 0) + kernel_size
+            else:  # Deconvolution: (in, out/groups, *k)
+                wshape = (in_channels if in_channels else 0, channels // groups) + kernel_size
+            self.weight = self.params.get(
+                "weight", shape=wshape, init=weight_initializer, allow_deferred_init=True
+            )
+            if use_bias:
+                self.bias = self.params.get("bias", shape=(channels,), init=bias_initializer)
+            else:
+                self.bias = None
+
+    def _shape_inference(self, x, *args):
+        c_in = x.shape[1]
+        if self._op_name == "Convolution":
+            self.weight._finish_deferred_init((self._channels, c_in // self._groups) + self._kernel)
+        else:
+            self.weight._finish_deferred_init((c_in, self._channels // self._groups) + self._kernel)
+        self._in_channels = c_in
+
+    def hybrid_forward(self, F, x, weight, bias=None):
+        kwargs = dict(
+            kernel=self._kernel,
+            stride=self._stride,
+            dilate=self._dilate,
+            pad=self._pad,
+            num_filter=self._channels,
+            num_group=self._groups,
+            no_bias=bias is None,
+        )
+        if self._op_name == "Deconvolution":
+            kwargs["adj"] = self._adj
+            out = F.Deconvolution(x, weight, bias, **kwargs)
+        else:
+            out = F.Convolution(x, weight, bias, **kwargs)
+        if self._act_type is not None:
+            out = F.Activation(out, act_type=self._act_type)
+        return out
+
+    def __repr__(self):
+        return (
+            f"{type(self).__name__}({self._in_channels or None} -> {self._channels}, "
+            f"kernel_size={self._kernel}, stride={self._stride}, padding={self._pad})"
+        )
+
+
+class Conv1D(_Conv):
+    def __init__(self, channels, kernel_size, strides=1, padding=0, dilation=1, groups=1,
+                 layout="NCW", in_channels=0, activation=None, use_bias=True,
+                 weight_initializer=None, bias_initializer="zeros", prefix=None, params=None):
+        super().__init__(channels, _tup(kernel_size, 1), _tup(strides, 1), _tup(padding, 1),
+                         _tup(dilation, 1), groups, layout, in_channels, activation, use_bias,
+                         weight_initializer, bias_initializer, prefix=prefix, params=params)
+
+
+class Conv2D(_Conv):
+    def __init__(self, channels, kernel_size, strides=(1, 1), padding=(0, 0), dilation=(1, 1),
+                 groups=1, layout="NCHW", in_channels=0, activation=None, use_bias=True,
+                 weight_initializer=None, bias_initializer="zeros", prefix=None, params=None):
+        super().__init__(channels, _tup(kernel_size, 2), _tup(strides, 2), _tup(padding, 2),
+                         _tup(dilation, 2), groups, layout, in_channels, activation, use_bias,
+                         weight_initializer, bias_initializer, prefix=prefix, params=params)
+
+
+class Conv3D(_Conv):
+    def __init__(self, channels, kernel_size, strides=(1, 1, 1), padding=(0, 0, 0),
+                 dilation=(1, 1, 1), groups=1, layout="NCDHW", in_channels=0, activation=None,
+                 use_bias=True, weight_initializer=None, bias_initializer="zeros", prefix=None, params=None):
+        super().__init__(channels, _tup(kernel_size, 3), _tup(strides, 3), _tup(padding, 3),
+                         _tup(dilation, 3), groups, layout, in_channels, activation, use_bias,
+                         weight_initializer, bias_initializer, prefix=prefix, params=params)
+
+
+class Conv1DTranspose(_Conv):
+    def __init__(self, channels, kernel_size, strides=1, padding=0, output_padding=0, dilation=1,
+                 groups=1, layout="NCW", in_channels=0, activation=None, use_bias=True,
+                 weight_initializer=None, bias_initializer="zeros", prefix=None, params=None):
+        super().__init__(channels, _tup(kernel_size, 1), _tup(strides, 1), _tup(padding, 1),
+                         _tup(dilation, 1), groups, layout, in_channels, activation, use_bias,
+                         weight_initializer, bias_initializer, op_name="Deconvolution",
+                         adj=_tup(output_padding, 1), prefix=prefix, params=params)
+
+
+class Conv2DTranspose(_Conv):
+    def __init__(self, channels, kernel_size, strides=(1, 1), padding=(0, 0), output_padding=(0, 0),
+                 dilation=(1, 1), groups=1, layout="NCHW", in_channels=0, activation=None,
+                 use_bias=True, weight_initializer=None, bias_initializer="zeros", prefix=None, params=None):
+        super().__init__(channels, _tup(kernel_size, 2), _tup(strides, 2), _tup(padding, 2),
+                         _tup(dilation, 2), groups, layout, in_channels, activation, use_bias,
+                         weight_initializer, bias_initializer, op_name="Deconvolution",
+                         adj=_tup(output_padding, 2), prefix=prefix, params=params)
+
+
+class Conv3DTranspose(_Conv):
+    def __init__(self, channels, kernel_size, strides=(1, 1, 1), padding=(0, 0, 0),
+                 output_padding=(0, 0, 0), dilation=(1, 1, 1), groups=1, layout="NCDHW",
+                 in_channels=0, activation=None, use_bias=True, weight_initializer=None,
+                 bias_initializer="zeros", prefix=None, params=None):
+        super().__init__(channels, _tup(kernel_size, 3), _tup(strides, 3), _tup(padding, 3),
+                         _tup(dilation, 3), groups, layout, in_channels, activation, use_bias,
+                         weight_initializer, bias_initializer, op_name="Deconvolution",
+                         adj=_tup(output_padding, 3), prefix=prefix, params=params)
+
+
+class _Pooling(HybridBlock):
+    def __init__(self, pool_size, strides, padding, ceil_mode, global_pool, pool_type,
+                 count_include_pad=None, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        if strides is None:
+            strides = pool_size
+        self._kwargs = dict(
+            kernel=pool_size,
+            stride=strides,
+            pad=padding,
+            global_pool=global_pool,
+            pool_type=pool_type,
+            pooling_convention="full" if ceil_mode else "valid",
+        )
+        if count_include_pad is not None:
+            self._kwargs["count_include_pad"] = count_include_pad
+
+    def hybrid_forward(self, F, x):
+        return F.Pooling(x, **self._kwargs)
+
+    def __repr__(self):
+        return f"{type(self).__name__}(size={self._kwargs['kernel']}, stride={self._kwargs['stride']}, padding={self._kwargs['pad']})"
+
+
+class MaxPool1D(_Pooling):
+    def __init__(self, pool_size=2, strides=None, padding=0, layout="NCW", ceil_mode=False, prefix=None, params=None):
+        super().__init__(_tup(pool_size, 1), _tup(strides, 1) if strides is not None else None,
+                         _tup(padding, 1), ceil_mode, False, "max", prefix=prefix, params=params)
+
+
+class MaxPool2D(_Pooling):
+    def __init__(self, pool_size=(2, 2), strides=None, padding=0, layout="NCHW", ceil_mode=False, prefix=None, params=None):
+        super().__init__(_tup(pool_size, 2), _tup(strides, 2) if strides is not None else None,
+                         _tup(padding, 2), ceil_mode, False, "max", prefix=prefix, params=params)
+
+
+class MaxPool3D(_Pooling):
+    def __init__(self, pool_size=(2, 2, 2), strides=None, padding=0, layout="NCDHW", ceil_mode=False, prefix=None, params=None):
+        super().__init__(_tup(pool_size, 3), _tup(strides, 3) if strides is not None else None,
+                         _tup(padding, 3), ceil_mode, False, "max", prefix=prefix, params=params)
+
+
+class AvgPool1D(_Pooling):
+    def __init__(self, pool_size=2, strides=None, padding=0, layout="NCW", ceil_mode=False,
+                 count_include_pad=True, prefix=None, params=None):
+        super().__init__(_tup(pool_size, 1), _tup(strides, 1) if strides is not None else None,
+                         _tup(padding, 1), ceil_mode, False, "avg", count_include_pad, prefix=prefix, params=params)
+
+
+class AvgPool2D(_Pooling):
+    def __init__(self, pool_size=(2, 2), strides=None, padding=0, layout="NCHW", ceil_mode=False,
+                 count_include_pad=True, prefix=None, params=None):
+        super().__init__(_tup(pool_size, 2), _tup(strides, 2) if strides is not None else None,
+                         _tup(padding, 2), ceil_mode, False, "avg", count_include_pad, prefix=prefix, params=params)
+
+
+class AvgPool3D(_Pooling):
+    def __init__(self, pool_size=(2, 2, 2), strides=None, padding=0, layout="NCDHW", ceil_mode=False,
+                 count_include_pad=True, prefix=None, params=None):
+        super().__init__(_tup(pool_size, 3), _tup(strides, 3) if strides is not None else None,
+                         _tup(padding, 3), ceil_mode, False, "avg", count_include_pad, prefix=prefix, params=params)
+
+
+class GlobalMaxPool1D(_Pooling):
+    def __init__(self, layout="NCW", prefix=None, params=None):
+        super().__init__((1,), None, (0,), False, True, "max", prefix=prefix, params=params)
+
+
+class GlobalMaxPool2D(_Pooling):
+    def __init__(self, layout="NCHW", prefix=None, params=None):
+        super().__init__((1, 1), None, (0, 0), False, True, "max", prefix=prefix, params=params)
+
+
+class GlobalMaxPool3D(_Pooling):
+    def __init__(self, layout="NCDHW", prefix=None, params=None):
+        super().__init__((1, 1, 1), None, (0, 0, 0), False, True, "max", prefix=prefix, params=params)
+
+
+class GlobalAvgPool1D(_Pooling):
+    def __init__(self, layout="NCW", prefix=None, params=None):
+        super().__init__((1,), None, (0,), False, True, "avg", prefix=prefix, params=params)
+
+
+class GlobalAvgPool2D(_Pooling):
+    def __init__(self, layout="NCHW", prefix=None, params=None):
+        super().__init__((1, 1), None, (0, 0), False, True, "avg", prefix=prefix, params=params)
+
+
+class GlobalAvgPool3D(_Pooling):
+    def __init__(self, layout="NCDHW", prefix=None, params=None):
+        super().__init__((1, 1, 1), None, (0, 0, 0), False, True, "avg", prefix=prefix, params=params)
+
+
+class ReflectionPad2D(HybridBlock):
+    """Parity: ``nn.ReflectionPad2D``."""
+
+    def __init__(self, padding=0, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        if isinstance(padding, int):
+            padding = (0, 0, 0, 0, padding, padding, padding, padding)
+        self._padding = padding
+
+    def hybrid_forward(self, F, x):
+        return F.pad(x, mode="reflect", pad_width=self._padding)
+
+
+_np  # keep import

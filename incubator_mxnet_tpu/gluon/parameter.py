@@ -1,0 +1,392 @@
+"""Gluon Parameter / ParameterDict.
+
+Parity target: [U:python/mxnet/gluon/parameter.py].  Same lifecycle as the
+reference: construct (shape may contain 0 = unknown), ``initialize`` (may
+defer until the first forward infers shapes), ``data()``/``grad()`` access,
+``grad_req`` write/add/null, lr_mult/wd_mult, save/load by name.
+
+Differences by design: a Parameter holds ONE NDArray (SPMD sharding over a
+mesh replaces the reference's per-GPU replica list — see parallel/), and
+``row_sparse`` stype is represented densely (documented divergence,
+docs/sparse.md).
+"""
+from __future__ import annotations
+
+import re
+
+import numpy as _np
+
+from ..base import DeferredInitializationError
+from ..context import cpu, current_context
+from ..ndarray.ndarray import NDArray, zeros
+from .. import initializer as _init_mod
+
+__all__ = ["Parameter", "Constant", "ParameterDict", "tensor_types"]
+
+tensor_types = (NDArray,)
+
+
+def _shape_is_known(shape):
+    if shape is None:
+        return False
+    return all(s > 0 for s in shape)
+
+
+class Parameter:
+    """A trainable (or auxiliary) tensor of a Block."""
+
+    def __init__(
+        self,
+        name,
+        grad_req="write",
+        shape=None,
+        dtype="float32",
+        lr_mult=1.0,
+        wd_mult=1.0,
+        init=None,
+        allow_deferred_init=False,
+        differentiable=True,
+        stype="default",
+        grad_stype="default",
+    ):
+        self.name = name
+        self._grad_req = grad_req if differentiable else "null"
+        if isinstance(shape, int):
+            shape = (shape,)
+        self._shape = tuple(shape) if shape is not None else None
+        self.dtype = dtype
+        self.lr_mult = lr_mult
+        self.wd_mult = wd_mult
+        self.init = init
+        self.allow_deferred_init = allow_deferred_init
+        self._data = None
+        self._deferred_init = None
+        self._stype = stype
+
+    # -- properties ------------------------------------------------------
+    @property
+    def shape(self):
+        return self._shape
+
+    @shape.setter
+    def shape(self, new_shape):
+        if self._shape is None:
+            self._shape = tuple(new_shape)
+            return
+        if len(self._shape) != len(new_shape) or any(
+            s not in (0, n) for s, n in zip(self._shape, new_shape)
+        ):
+            raise ValueError(
+                f"Parameter {self.name}: shape {new_shape} incompatible with inferred {self._shape}"
+            )
+        self._shape = tuple(new_shape)
+
+    @property
+    def grad_req(self):
+        return self._grad_req
+
+    @grad_req.setter
+    def grad_req(self, req):
+        if req not in ("write", "add", "null"):
+            raise ValueError(req)
+        self._grad_req = req
+        if self._data is not None:
+            if req == "null":
+                self._data._grad = None
+                self._data._grad_req = "null"
+            else:
+                self._data.attach_grad(req)
+
+    @property
+    def stype(self):
+        return self._stype
+
+    # -- init ------------------------------------------------------------
+    def initialize(self, init=None, ctx=None, default_init=None, force_reinit=False):
+        """Allocate and initialize (parity: ``Parameter.initialize``).
+        Defers when the shape is still unknown and deferred init is allowed."""
+        if default_init is None:
+            default_init = _init_mod.Uniform()
+        if self._data is not None and not force_reinit:
+            return
+        if ctx is None:
+            ctx = current_context()
+        if isinstance(ctx, (list, tuple)):
+            ctx = ctx[0]  # SPMD replaces per-device replica lists
+        if not _shape_is_known(self._shape):
+            if self.allow_deferred_init:
+                self._deferred_init = (init, ctx, default_init)
+                return
+            raise ValueError(
+                f"Cannot initialize Parameter {self.name} because it has "
+                f"invalid shape {self._shape}; pass concrete shapes or build "
+                "the network with deferred initialization (run a forward pass)"
+            )
+        self._finish_init(init, ctx, default_init)
+
+    def _finish_init(self, init, ctx, default_init):
+        data = zeros(self._shape, dtype=self.dtype, ctx=ctx)
+        initializer = init or self.init or default_init
+        if not isinstance(initializer, (_init_mod.Initializer, _init_mod.Load, _init_mod.Mixed)):
+            initializer = _init_mod.create(initializer)
+        initializer(_init_mod.InitDesc(self.name), data)
+        self._data = data
+        self._deferred_init = None
+        if self._grad_req != "null":
+            self._data.attach_grad(self._grad_req)
+
+    def _finish_deferred_init(self, inferred_shape=None):
+        if self._deferred_init is None:
+            return
+        if inferred_shape is not None:
+            self.shape = inferred_shape
+        if not _shape_is_known(self._shape):
+            raise DeferredInitializationError(
+                f"Parameter {self.name} has unknown shape {self._shape} and "
+                "deferred initialization could not infer it"
+            )
+        init, ctx, default_init = self._deferred_init
+        self._finish_init(init, ctx, default_init)
+
+    # -- access ----------------------------------------------------------
+    def _check_initialized(self):
+        if self._data is not None:
+            return
+        if self._deferred_init is not None:
+            raise DeferredInitializationError(
+                f"Parameter {self.name} has not been initialized yet because "
+                "initialization was deferred. Actual initialization happens "
+                "during the first forward pass. Please pass one batch of data "
+                "through the network before accessing Parameters."
+            )
+        raise RuntimeError(
+            f"Parameter {self.name} has not been initialized. You should "
+            "initialize parameters with Block.initialize() before using them."
+        )
+
+    def data(self, ctx=None):
+        """The parameter value.  Inside a hybridize trace, returns the traced
+        stand-in so child blocks compose into one compiled graph."""
+        traced = getattr(self, "_traced_data", None)
+        if traced is not None:
+            return traced
+        self._check_initialized()
+        return self._data
+
+    def list_data(self):
+        return [self.data()]
+
+    def grad(self, ctx=None):
+        self._check_initialized()
+        if self._data._grad is None:
+            raise RuntimeError(
+                f"Cannot get gradient array for Parameter {self.name} because grad_req='null'"
+            )
+        return self._data._grad
+
+    def list_grad(self):
+        return [self.grad()]
+
+    def list_ctx(self):
+        self._check_initialized()
+        return [self._data.context]
+
+    def set_data(self, data):
+        if self._data is None:
+            # loading into an uninitialized/deferred parameter: adopt the
+            # incoming shape and materialize (parity: load_parameters works
+            # without a prior initialize())
+            self.shape = data.shape
+            if self._deferred_init is not None:
+                init, ctx, default_init = self._deferred_init
+            else:
+                from ..context import current_context
+
+                ctx, default_init = current_context(), _init_mod.Zero()
+            self._finish_init(_init_mod.Zero(), ctx, default_init)
+        self._check_initialized()
+        if tuple(data.shape) != tuple(self._data.shape):
+            raise AssertionError(
+                f"Failed to update param {self.name}: shape mismatch, "
+                f"expected {tuple(self._data.shape)}, got {tuple(data.shape)}"
+            )
+        if isinstance(data, NDArray):
+            self._data._data = data._data.astype(self._data.dtype)
+        else:
+            self._data[:] = data
+        self._data._version += 1
+
+    def zero_grad(self):
+        if self._data is not None and self._data._grad is not None:
+            self._data.zero_grad()
+
+    def reset_ctx(self, ctx):
+        self._check_initialized()
+        self._data = self._data.as_in_context(ctx if not isinstance(ctx, (list, tuple)) else ctx[0])
+        if self._grad_req != "null":
+            self._data.attach_grad(self._grad_req)
+
+    def cast(self, dtype):
+        self.dtype = dtype
+        if self._data is not None:
+            grad_req = self._grad_req
+            self._data = self._data.astype(dtype)
+            if grad_req != "null":
+                self._data.attach_grad(grad_req)
+
+    def var(self):
+        from .. import symbol as _sym
+
+        return _sym.var(self.name, shape=self._shape, dtype=self.dtype)
+
+    def __repr__(self):
+        return f"Parameter {self.name} (shape={self._shape}, dtype={self.dtype})"
+
+
+class Constant(Parameter):
+    """Non-trainable constant parameter (parity: ``gluon.Constant``)."""
+
+    def __init__(self, name, value):
+        if not isinstance(value, NDArray):
+            value = NDArray(_np.asarray(value, dtype="float32"))
+        self.value = value
+
+        class _CInit(_init_mod.Initializer):
+            def __call__(self, _, arr):
+                arr[:] = value
+
+            def _init_weight(self, _, arr):
+                arr[:] = value
+
+        super().__init__(
+            name,
+            grad_req="null",
+            shape=value.shape,
+            dtype=value.dtype,
+            init=_CInit(),
+        )
+
+
+class ParameterDict:
+    """Ordered name -> Parameter mapping with prefix sharing
+    (parity: ``gluon.ParameterDict``)."""
+
+    def __init__(self, prefix="", shared=None):
+        self._prefix = prefix
+        self._params = {}
+        self._shared = shared
+
+    @property
+    def prefix(self):
+        return self._prefix
+
+    def __repr__(self):
+        lines = "\n".join(f"  {v}" for v in self._params.values())
+        return f"ParameterDict '{self._prefix}' (\n{lines}\n)"
+
+    def __getitem__(self, key):
+        return self._params[key]
+
+    def __iter__(self):
+        return iter(self._params)
+
+    def __len__(self):
+        return len(self._params)
+
+    def __contains__(self, key):
+        return key in self._params
+
+    def items(self):
+        return self._params.items()
+
+    def keys(self):
+        return self._params.keys()
+
+    def values(self):
+        return self._params.values()
+
+    def get(self, name, **kwargs):
+        """Create-or-retrieve by suffix name (parity semantics: checks shared
+        dict first, validates attribute compatibility)."""
+        full = self._prefix + name
+        param = self._get_impl(full)
+        if param is None:
+            param = Parameter(full, **kwargs)
+            self._params[full] = param
+        else:
+            for k, v in kwargs.items():
+                if k == "shape" and v is not None:
+                    param.shape = tuple(s if s is not None else 0 for s in (v if not isinstance(v, int) else (v,)))
+                elif k == "init" and v is not None and param.init is None:
+                    param.init = v
+        return param
+
+    def get_constant(self, name, value=None):
+        full = self._prefix + name
+        param = self._get_impl(full)
+        if param is None:
+            if value is None:
+                raise KeyError(f"No constant named {full}")
+            param = Constant(full, value)
+            self._params[full] = param
+        return param
+
+    def _get_impl(self, full):
+        if full in self._params:
+            return self._params[full]
+        if self._shared is not None and full in self._shared:
+            self._params[full] = self._shared[full]
+            return self._params[full]
+        return None
+
+    def update(self, other):
+        for k, v in other.items():
+            if k in self._params and self._params[k] is not v:
+                raise ValueError(f"Cannot update self with other because they have different Parameters with the same name {k}")
+            self._params[k] = v
+
+    def initialize(self, init=None, ctx=None, verbose=False, force_reinit=False):
+        if init is None:
+            init = _init_mod.Uniform()
+        for _, v in self.items():
+            v.initialize(None, ctx, init, force_reinit=force_reinit)
+
+    def zero_grad(self):
+        for v in self.values():
+            v.zero_grad()
+
+    def reset_ctx(self, ctx):
+        for v in self.values():
+            v.reset_ctx(ctx)
+
+    def setattr(self, name, value):
+        for v in self.values():
+            setattr(v, name, value)
+
+    def save(self, filename, strip_prefix=""):
+        from ..ndarray.utils import save as nd_save
+
+        arg_dict = {}
+        for param in self.values():
+            block = param.data()
+            name = param.name
+            if strip_prefix and name.startswith(strip_prefix):
+                name = name[len(strip_prefix):]
+            arg_dict[name] = block
+        nd_save(filename, arg_dict)
+
+    def load(self, filename, ctx=None, allow_missing=False, ignore_extra=False, restore_prefix=""):
+        from ..ndarray.utils import load as nd_load
+
+        loaded = nd_load(filename)
+        loaded = {restore_prefix + k: v for k, v in loaded.items()}
+        if not allow_missing:
+            for name in self.keys():
+                if name not in loaded:
+                    raise IOError(f"Parameter {name} is missing in file {filename}")
+        for name, v in loaded.items():
+            if name not in self._params:
+                if not ignore_extra:
+                    raise IOError(f"Parameter {name} loaded from {filename} is not present in ParameterDict")
+                continue
+            self[name].set_data(v)

@@ -1,0 +1,28 @@
+"""``gluon.rnn`` (parity: [U:python/mxnet/gluon/rnn/])."""
+from .rnn_cell import (
+    RecurrentCell,
+    RNNCell,
+    LSTMCell,
+    GRUCell,
+    SequentialRNNCell,
+    DropoutCell,
+    ResidualCell,
+    BidirectionalCell,
+    ZoneoutCell,
+)
+from .rnn_layer import RNN, LSTM, GRU
+
+__all__ = [
+    "RecurrentCell",
+    "RNNCell",
+    "LSTMCell",
+    "GRUCell",
+    "SequentialRNNCell",
+    "DropoutCell",
+    "ResidualCell",
+    "BidirectionalCell",
+    "ZoneoutCell",
+    "RNN",
+    "LSTM",
+    "GRU",
+]

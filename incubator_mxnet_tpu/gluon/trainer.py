@@ -1,0 +1,175 @@
+"""Gluon Trainer.
+
+Parity target: [U:python/mxnet/gluon/trainer.py].  Same API and step
+semantics (``step(batch_size)`` = allreduce grads, then optimizer update
+with ``rescale_grad = 1/batch_size``).  The reference binds params to a
+KVStore for cross-device aggregation; here single-process gradients already
+live on one (possibly mesh-sharded) array, and multi-host aggregation rides
+the kvstore facade ('dist_sync' → psum inside the compiled step — see
+kvstore/ and parallel/).
+"""
+from __future__ import annotations
+
+from .. import optimizer as opt_mod
+from .parameter import Parameter, ParameterDict
+
+__all__ = ["Trainer"]
+
+
+class Trainer:
+    def __init__(
+        self,
+        params,
+        optimizer,
+        optimizer_params=None,
+        kvstore="device",
+        compression_params=None,
+        update_on_kvstore=None,
+    ):
+        if isinstance(params, (dict, ParameterDict)):
+            params = list(params.values())
+        if not isinstance(params, (list, tuple)):
+            raise ValueError("First argument must be a list or dict of Parameters")
+        self._all_params = list(params)
+        self._params = []
+        self._param2idx = {}
+        for i, p in enumerate(params):
+            if not isinstance(p, Parameter):
+                raise ValueError(f"First argument must be a list or dict of Parameters, got {type(p)}")
+            self._param2idx[p.name] = i
+            self._params.append(p)
+        self._compression_params = compression_params
+        self._contains_sparse = False
+        optimizer_params = optimizer_params or {}
+        self._scale = float(optimizer_params.get("rescale_grad", 1.0))
+        self._init_optimizer(optimizer, optimizer_params)
+        self._kvstore_type = kvstore
+        self._kvstore = None
+        self._kv_initialized = False
+        self._update_on_kvstore = update_on_kvstore
+        self._states = {}
+
+    def _init_optimizer(self, optimizer, optimizer_params):
+        param_dict = {i: p for i, p in enumerate(self._params)}
+        if isinstance(optimizer, opt_mod.Optimizer):
+            if optimizer_params and list(optimizer_params) != ["rescale_grad"]:
+                raise ValueError(
+                    "optimizer_params must be None if optimizer is an Optimizer instance"
+                )
+            self._optimizer = optimizer
+            self._optimizer.param_dict = param_dict
+        else:
+            self._optimizer = opt_mod.create(optimizer, param_dict=param_dict, **optimizer_params)
+
+    def _init_kvstore(self):
+        from .. import kvstore as kv_mod
+
+        if isinstance(self._kvstore_type, str):
+            self._kvstore = kv_mod.create(self._kvstore_type)
+        else:
+            self._kvstore = self._kvstore_type
+        if self._kvstore is not None:
+            for i, p in enumerate(self._params):
+                if p._data is not None:
+                    self._kvstore.init(i, p.data())
+        self._kv_initialized = True
+
+    @property
+    def learning_rate(self):
+        return self._optimizer.learning_rate
+
+    @property
+    def optimizer(self):
+        return self._optimizer
+
+    def set_learning_rate(self, lr):
+        self._optimizer.set_learning_rate(lr)
+
+    def _check_and_rescale_grad(self, scale):
+        self._optimizer.rescale_grad = scale
+
+    def step(self, batch_size, ignore_stale_grad=False):
+        """Gradient allreduce + optimizer update (parity: ``Trainer.step``)."""
+        if not self._kv_initialized:
+            self._init_kvstore()
+        self._optimizer.rescale_grad = self._scale / batch_size
+        self.allreduce_grads()
+        self._update(ignore_stale_grad)
+
+    def allreduce_grads(self):
+        """Aggregate gradients across devices/hosts via the kvstore facade
+        (single-replica SPMD: aggregation happened inside the compiled step
+        via psum, so this is a no-op unless a dist kvstore is attached)."""
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if self._kvstore is None:
+            return
+        for i, p in enumerate(self._params):
+            if p.grad_req != "null" and p._data is not None and p._data._grad is not None:
+                self._kvstore.pushpull(i, p.grad(), out=p.grad())
+
+    def update(self, batch_size, ignore_stale_grad=False):
+        """Optimizer update only (assumes grads already aggregated)."""
+        if not self._kv_initialized:
+            self._init_kvstore()
+        self._optimizer.rescale_grad = self._scale / batch_size
+        self._update(ignore_stale_grad)
+
+    def _update(self, ignore_stale_grad=False):
+        for i, p in enumerate(self._params):
+            if p.grad_req == "null" or p._data is None:
+                continue
+            if p._data._grad is None:
+                if ignore_stale_grad:
+                    continue
+                raise UserWarning(f"Gradient of Parameter `{p.name}` has no grad buffer")
+            if i not in self._states:
+                self._states[i] = self._optimizer.create_state_multi_precision(i, p.data())
+            self._optimizer.update_multi_precision(i, p.data(), p.grad(), self._states[i])
+
+    def save_states(self, fname):
+        """Parity: ``Trainer.save_states`` (optimizer state snapshot)."""
+        import pickle
+
+        flat = {}
+        for i, st in self._states.items():
+            flat[i] = _states_to_numpy(st)
+        with open(fname, "wb") as f:
+            pickle.dump({"states": flat, "num_update": self._optimizer.num_update}, f)
+
+    def load_states(self, fname):
+        import pickle
+
+        with open(fname, "rb") as f:
+            payload = pickle.load(f)
+        for i, st in payload["states"].items():
+            if i not in self._states:
+                self._states[i] = self._optimizer.create_state_multi_precision(i, self._params[i].data())
+            _numpy_to_states(self._states[i], st)
+        self._optimizer.num_update = payload.get("num_update", self._optimizer.num_update)
+        self._optimizer.begin_num_update = self._optimizer.num_update
+
+
+def _states_to_numpy(st):
+    from ..ndarray.ndarray import NDArray
+
+    if st is None:
+        return None
+    if isinstance(st, NDArray):
+        return st.asnumpy()
+    if isinstance(st, (list, tuple)):
+        return type(st)(_states_to_numpy(s) for s in st)
+    return st
+
+
+def _numpy_to_states(st, data):
+    from ..ndarray.ndarray import NDArray
+
+    if st is None or data is None:
+        return
+    if isinstance(st, NDArray):
+        st[:] = data
+        return
+    if isinstance(st, (list, tuple)):
+        for s, d in zip(st, data):
+            _numpy_to_states(s, d)

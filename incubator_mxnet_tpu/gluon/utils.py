@@ -1,0 +1,92 @@
+"""Gluon utilities (parity: [U:python/mxnet/gluon/utils.py]):
+``split_data``/``split_and_load`` (multi-device batch slicing),
+``clip_global_norm``, ``check_sha1``, ``download`` (gated: zero-egress
+sandbox)."""
+from __future__ import annotations
+
+import hashlib
+import os
+
+import numpy as _np
+
+from ..ndarray.ndarray import NDArray, array
+
+__all__ = ["split_data", "split_and_load", "clip_global_norm", "check_sha1", "download"]
+
+
+def split_data(data, num_slice, batch_axis=0, even_split=True):
+    size = data.shape[batch_axis]
+    if even_split and size % num_slice != 0:
+        raise ValueError(
+            f"data with shape {data.shape} cannot be evenly split into {num_slice} "
+            f"slices along axis {batch_axis}"
+        )
+    step = size // num_slice
+    slices = []
+    for i in range(num_slice):
+        begin = i * step
+        end = (i + 1) * step if i < num_slice - 1 else size
+        slices.append(data.slice_axis(batch_axis, begin, end))
+    return slices
+
+
+def split_and_load(data, ctx_list, batch_axis=0, even_split=True):
+    """Split a batch across contexts (parity: ``gluon.utils.split_and_load``).
+    On a single TPU mesh this is commonly [one ctx] → returns [data]."""
+    if not isinstance(data, NDArray):
+        data = array(data)
+    if len(ctx_list) == 1:
+        return [data.as_in_context(ctx_list[0])]
+    slices = split_data(data, len(ctx_list), batch_axis, even_split)
+    return [s.as_in_context(ctx) for s, ctx in zip(slices, ctx_list)]
+
+
+def clip_global_norm(arrays, max_norm, check_isfinite=True):
+    """Parity: ``gluon.utils.clip_global_norm``."""
+    import math
+
+    total = 0.0
+    for a in arrays:
+        n = float(a.norm().asscalar())
+        total += n * n
+    total = math.sqrt(total)
+    if check_isfinite and not math.isfinite(total):
+        import warnings
+
+        warnings.warn("nan or inf is detected. Clipping results will be undefined.")
+    scale = max_norm / (total + 1e-8)
+    if scale < 1.0:
+        for a in arrays:
+            a *= scale
+    return total
+
+
+def check_sha1(filename, sha1_hash):
+    sha1 = hashlib.sha1()
+    with open(filename, "rb") as f:
+        while True:
+            data = f.read(1048576)
+            if not data:
+                break
+            sha1.update(data)
+    return sha1.hexdigest() == sha1_hash
+
+
+def download(url, path=None, overwrite=False, sha1_hash=None, retries=5, verify_ssl=True):
+    """Parity shim: this sandbox has zero egress; only file:// and existing
+    local paths are served."""
+    fname = path or url.split("/")[-1]
+    if os.path.exists(fname) and not overwrite:
+        return fname
+    if url.startswith("file://"):
+        import shutil
+
+        shutil.copy(url[7:], fname)
+        return fname
+    raise RuntimeError(
+        f"download({url}) unavailable: no network egress in this environment; "
+        "place the file locally and pass its path"
+    )
+
+
+_np  # keep import

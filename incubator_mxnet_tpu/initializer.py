@@ -42,13 +42,18 @@ def register(klass):
     return klass
 
 
+_ALIASES = {"zeros": "zero", "ones": "one", "gaussian": "normal"}
+
+
 def create(initializer, **kwargs):
     if initializer is None:
         return None
     if isinstance(initializer, Initializer):
         return initializer
     if isinstance(initializer, str):
-        return _REGISTRY[initializer.lower()](**kwargs)
+        name = initializer.lower()
+        name = _ALIASES.get(name, name)
+        return _REGISTRY[name](**kwargs)
     raise TypeError(initializer)
 
 

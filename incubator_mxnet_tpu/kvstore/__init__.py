@@ -1,0 +1,3 @@
+from .kvstore import KVStore, KVStoreLocal, KVStoreDist, create
+
+__all__ = ["KVStore", "KVStoreLocal", "KVStoreDist", "create"]

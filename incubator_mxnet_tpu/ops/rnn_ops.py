@@ -1,0 +1,115 @@
+"""Fused multi-layer RNN — the TPU equivalent of the reference's cuDNN
+fused path ([U:src/operator/rnn.cc], [U:src/operator/nn/cudnn/
+cudnn_rnn-inl.h]).
+
+One ``lax.scan`` per layer/direction: weights stay resident, the time loop
+is compiled (no per-step dispatch), and XLA pipelines the gate matmuls onto
+the MXU.  Gate orders match rnn_cell.py (LSTM [i,f,g,o], GRU [r,z,n]).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+
+def _cell_step(mode, hidden_size):
+    if mode == "rnn_tanh":
+        def step(carry, gates_x, w_h, b_h):
+            (h,) = carry
+            g = gates_x + h @ w_h.T + b_h
+            nh = jnp.tanh(g)
+            return (nh,), nh
+        n_gates = 1
+    elif mode == "rnn_relu":
+        def step(carry, gates_x, w_h, b_h):
+            (h,) = carry
+            g = gates_x + h @ w_h.T + b_h
+            nh = jnp.maximum(g, 0)
+            return (nh,), nh
+        n_gates = 1
+    elif mode == "lstm":
+        def step(carry, gates_x, w_h, b_h):
+            h, c = carry
+            g = gates_x + h @ w_h.T + b_h
+            i, f, gg, o = jnp.split(g, 4, axis=-1)
+            i = jax.nn.sigmoid(i)
+            f = jax.nn.sigmoid(f)
+            gg = jnp.tanh(gg)
+            o = jax.nn.sigmoid(o)
+            nc = f * c + i * gg
+            nh = o * jnp.tanh(nc)
+            return (nh, nc), nh
+        n_gates = 4
+    elif mode == "gru":
+        def step(carry, gates_x, w_h, b_h):
+            (h,) = carry
+            hh = h @ w_h.T + b_h
+            xr, xz, xn = jnp.split(gates_x, 3, axis=-1)
+            hr, hz, hn = jnp.split(hh, 3, axis=-1)
+            r = jax.nn.sigmoid(xr + hr)
+            z = jax.nn.sigmoid(xz + hz)
+            n = jnp.tanh(xn + r * hn)
+            nh = (1 - z) * n + z * h
+            return (nh,), nh
+        n_gates = 3
+    else:
+        raise ValueError(mode)
+    return step, n_gates
+
+
+@register("RNNFused")
+def rnn_fused(
+    data,
+    h0,
+    c0,
+    *weights,
+    mode="lstm",
+    num_layers=1,
+    hidden_size=0,
+    bidirectional=False,
+    dropout=0.0,
+    training=False,
+    key=None,
+):
+    """data: (T, N, C); h0/c0: (num_layers*dirs, N, H); weights: per layer,
+    per direction: i2h_w, h2h_w, i2h_b, h2h_b.  Returns (out, h_n[, c_n])."""
+    step, n_gates = _cell_step(mode, hidden_size)
+    dirs = 2 if bidirectional else 1
+    x = data
+    h_finals = []
+    c_finals = []
+    widx = 0
+    for layer in range(num_layers):
+        outs_dir = []
+        for d in range(dirs):
+            w_i, w_h, b_i, b_h = weights[widx : widx + 4]
+            widx += 4
+            sidx = layer * dirs + d
+            h_init = h0[sidx]
+            carry = (h_init, c0[sidx]) if mode == "lstm" else (h_init,)
+            seq = x if d == 0 else jnp.flip(x, axis=0)
+            # precompute input projection for the whole sequence: one big MXU matmul
+            gates_x = jnp.einsum("tnc,gc->tng", seq, w_i) + b_i
+
+            def scan_fn(c, gx, _w_h=w_h, _b_h=b_h):
+                return step(c, gx, _w_h, _b_h)
+
+            final_carry, out = lax.scan(scan_fn, carry, gates_x)
+            if d == 1:
+                out = jnp.flip(out, axis=0)
+            outs_dir.append(out)
+            h_finals.append(final_carry[0])
+            if mode == "lstm":
+                c_finals.append(final_carry[1])
+        x = outs_dir[0] if dirs == 1 else jnp.concatenate(outs_dir, axis=-1)
+        if dropout > 0 and training and layer < num_layers - 1 and key is not None:
+            key, sub = jax.random.split(key)
+            mask = jax.random.bernoulli(sub, 1 - dropout, x.shape).astype(x.dtype)
+            x = x * mask / (1 - dropout)
+    h_n = jnp.stack(h_finals)
+    if mode == "lstm":
+        return x, h_n, jnp.stack(c_finals)
+    return x, h_n

@@ -1,0 +1,264 @@
+"""Gluon Block/HybridBlock/Parameter/Trainer tests
+(parity model: [U:tests/python/unittest/test_gluon.py])."""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import autograd, gluon
+from incubator_mxnet_tpu.gluon import nn
+from incubator_mxnet_tpu.utils.test_utils import assert_almost_equal
+
+from common import with_seed
+
+
+def test_parameter_basic():
+    p = gluon.Parameter("weight", shape=(3, 4))
+    p.initialize()
+    assert p.data().shape == (3, 4)
+    assert p.grad().shape == (3, 4)
+    assert p.list_ctx() == [mx.current_context()]
+    p.set_data(mx.nd.ones((3, 4)))
+    assert_almost_equal(p.data(), np.ones((3, 4)))
+
+
+def test_parameter_deferred_init():
+    p = gluon.Parameter("weight", shape=(4, 0), allow_deferred_init=True)
+    p.initialize()
+    with pytest.raises(mx.DeferredInitializationError):
+        p.data()
+    p._finish_deferred_init((4, 7))
+    assert p.data().shape == (4, 7)
+
+
+def test_parameter_shape_mismatch():
+    p = gluon.Parameter("weight", shape=(3, 4))
+    with pytest.raises(ValueError):
+        p.shape = (3, 5)
+
+
+def test_dense_deferred_and_explicit():
+    net = nn.Dense(8, in_units=4)
+    net.initialize()
+    assert net.weight.shape == (8, 4)
+    net2 = nn.Dense(8)
+    net2.initialize()
+    out = net2(mx.nd.ones((2, 5)))
+    assert out.shape == (2, 8)
+    assert net2.weight.shape == (8, 5)
+
+
+def test_dense_flatten_false():
+    net = nn.Dense(6, flatten=False)
+    net.initialize()
+    out = net(mx.nd.ones((2, 3, 4)))
+    assert out.shape == (2, 3, 6)
+
+
+def test_collect_params_and_naming():
+    net = nn.HybridSequential(prefix="model_")
+    with net.name_scope():
+        net.add(nn.Dense(4))
+        net.add(nn.Dense(2))
+    params = net.collect_params()
+    names = list(params.keys())
+    assert all(n.startswith("model_") for n in names)
+    assert any("dense0_weight" in n for n in names)
+    sel = net.collect_params(".*weight")
+    assert all(n.endswith("weight") for n in sel.keys())
+
+
+def test_hybridize_matches_eager():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu"), nn.Dense(4))
+    net.initialize()
+    x = mx.nd.random.uniform(shape=(3, 8))
+    eager = net(x).asnumpy()
+    net.hybridize()
+    hybrid = net(x).asnumpy()
+    assert_almost_equal(eager, hybrid, rtol=1e-5, atol=1e-6)
+
+
+def test_hybridize_gradients_match():
+    def make():
+        net = nn.HybridSequential()
+        net.add(nn.Dense(16, activation="tanh"), nn.Dense(1))
+        return net
+
+    mx.random.seed(3)
+    net = make()
+    net.initialize(mx.init.Xavier())
+    x = mx.nd.random.uniform(shape=(4, 8))
+    with autograd.record():
+        loss = (net(x) ** 2).sum()
+    loss.backward()
+    g_eager = net[0].weight.grad().asnumpy().copy()
+    net.hybridize()
+    with autograd.record():
+        loss = (net(x) ** 2).sum()
+    loss.backward()
+    g_hybrid = net[0].weight.grad().asnumpy()
+    assert_almost_equal(g_eager, g_hybrid, rtol=1e-4, atol=1e-5)
+
+
+def test_trainer_step_updates():
+    net = nn.Dense(1, in_units=2)
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd", {"learning_rate": 1.0})
+    x = mx.nd.ones((1, 2))
+    with autograd.record():
+        loss = net(x).sum()
+    loss.backward()
+    w0 = net.weight.data().asnumpy().copy()
+    trainer.step(1)
+    # d loss/d w = x = 1 -> w_new = w - 1
+    assert_almost_equal(net.weight.data(), w0 - 1.0, rtol=1e-5, atol=1e-6)
+
+
+def test_trainer_save_load_states(tmp_path):
+    net = nn.Dense(2, in_units=2)
+    net.initialize()
+    tr = gluon.Trainer(net.collect_params(), "sgd", {"learning_rate": 0.1, "momentum": 0.9})
+    x = mx.nd.ones((1, 2))
+    with autograd.record():
+        loss = net(x).sum()
+    loss.backward()
+    tr.step(1)
+    f = str(tmp_path / "trainer.states")
+    tr.save_states(f)
+    tr2 = gluon.Trainer(net.collect_params(), "sgd", {"learning_rate": 0.1, "momentum": 0.9})
+    with autograd.record():
+        loss = net(x).sum()
+    loss.backward()
+    tr2.load_states(f)
+    tr2.step(1)  # should use loaded momentum
+
+
+def test_conv2d_shapes():
+    net = nn.Conv2D(8, kernel_size=3, padding=1)
+    net.initialize()
+    out = net(mx.nd.ones((2, 3, 16, 16)))
+    assert out.shape == (2, 8, 16, 16)
+    assert net.weight.shape == (8, 3, 3, 3)
+    net = nn.Conv2D(8, kernel_size=3, strides=2)
+    net.initialize()
+    assert net(mx.nd.ones((2, 3, 16, 16))).shape == (2, 8, 7, 7)
+
+
+def test_conv2d_groups_and_transpose():
+    net = nn.Conv2D(8, kernel_size=3, padding=1, groups=2)
+    net.initialize()
+    assert net(mx.nd.ones((1, 4, 8, 8))).shape == (1, 8, 8, 8)
+    dconv = nn.Conv2DTranspose(4, kernel_size=2, strides=2)
+    dconv.initialize()
+    assert dconv(mx.nd.ones((1, 3, 8, 8))).shape == (1, 4, 16, 16)
+
+
+def test_pooling_layers():
+    x = mx.nd.random.uniform(shape=(2, 3, 8, 8))
+    assert nn.MaxPool2D(2)(x).shape == (2, 3, 4, 4)
+    assert nn.AvgPool2D(2)(x).shape == (2, 3, 4, 4)
+    assert nn.GlobalAvgPool2D()(x).shape == (2, 3, 1, 1)
+    assert nn.MaxPool2D(3, 2, ceil_mode=True)(x).shape == (2, 3, 4, 4)
+    # avg pool correctness
+    v = nn.AvgPool2D(2)(mx.nd.ones((1, 1, 4, 4)))
+    assert_almost_equal(v, np.ones((1, 1, 2, 2)))
+
+
+def test_batchnorm_train_vs_eval():
+    bn = nn.BatchNorm(in_channels=3)
+    bn.initialize()
+    x = mx.nd.random.normal(3, 5, shape=(4, 3, 2, 2))
+    with autograd.record():
+        out_train = bn(x)
+    # batch-normalized output should be ~zero-mean
+    assert abs(float(out_train.mean().asscalar())) < 0.2
+    # eval mode uses running stats: after one update they are still close to
+    # their init (mean 0, var 1 with momentum 0.9), so the output mean stays
+    # far from zero — distinctly NOT batch-normalized
+    out_eval = bn(x)
+    assert abs(float(out_eval.mean().asscalar())) > 0.5
+    # manual check: (x - running_mean)/sqrt(running_var + eps)
+    rm = bn.running_mean.data().asnumpy().reshape(1, 3, 1, 1)
+    rv = bn.running_var.data().asnumpy().reshape(1, 3, 1, 1)
+    expect = (x.asnumpy() - rm) / np.sqrt(rv + 1e-5)
+    assert_almost_equal(out_eval, expect, rtol=1e-4, atol=1e-4)
+
+
+def test_embedding():
+    emb = nn.Embedding(10, 4)
+    emb.initialize()
+    idx = mx.nd.array([[1, 2], [3, 4]], dtype="int32")
+    out = emb(idx)
+    assert out.shape == (2, 2, 4)
+    assert_almost_equal(out[0, 0], emb.weight.data()[1])
+
+
+def test_block_save_load_parameters(tmp_path):
+    net = nn.HybridSequential()
+    net.add(nn.Dense(4), nn.Dense(2))
+    net.initialize()
+    net(mx.nd.ones((1, 3)))  # materialize deferred shapes
+    f = str(tmp_path / "net.params")
+    net.save_parameters(f)
+    net2 = nn.HybridSequential()
+    net2.add(nn.Dense(4), nn.Dense(2))
+    net2.load_parameters(f)
+    x = mx.nd.ones((1, 3))
+    assert_almost_equal(net(x), net2(x), rtol=1e-6, atol=1e-7)
+
+
+def test_sequential_getitem_len():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(4), nn.Dense(3), nn.Dense(2))
+    assert len(net) == 3
+    assert isinstance(net[1], nn.Dense)
+    assert len(net[1:]) == 2
+
+
+def test_custom_hybrid_block():
+    class Net(gluon.HybridBlock):
+        def __init__(self, **kwargs):
+            super().__init__(**kwargs)
+            with self.name_scope():
+                self.fc = nn.Dense(4)
+                self.scale = self.params.get("scale", shape=(1,), init=mx.init.One())
+
+        def hybrid_forward(self, F, x, scale):
+            return self.fc(x) * scale
+
+    net = Net()
+    net.initialize()
+    x = mx.nd.ones((2, 3))
+    out = net(x)
+    assert out.shape == (2, 4)
+    net.hybridize()
+    out2 = net(x)
+    assert_almost_equal(out, out2, rtol=1e-5, atol=1e-6)
+
+
+def test_grad_req_null_param_not_updated():
+    net = nn.Dense(2, in_units=2)
+    net.initialize()
+    net.weight.grad_req = "null"
+    with autograd.record():
+        loss = net(mx.nd.ones((1, 2))).sum()
+    loss.backward()
+    assert float(net.bias.grad().abs().sum().asscalar()) > 0
+
+
+def test_zoneout_split_utils():
+    arrs = gluon.utils.split_and_load(mx.nd.arange(0, 12).reshape((6, 2)), [mx.cpu()])
+    assert len(arrs) == 1 and arrs[0].shape == (6, 2)
+    total = gluon.utils.clip_global_norm([mx.nd.ones((2, 2)) * 3], 1.0)
+    assert total == pytest.approx(6.0, rel=1e-4)
+
+
+@with_seed()
+def test_activations_block():
+    x = mx.nd.array([[-1.0, 0.0, 1.0]])
+    assert_almost_equal(nn.LeakyReLU(0.1)(x), np.array([[-0.1, 0.0, 1.0]]), rtol=1e-5, atol=1e-6)
+    prelu = nn.PReLU()
+    prelu.initialize()
+    assert_almost_equal(prelu(x), np.array([[-0.25, 0.0, 1.0]]), rtol=1e-5, atol=1e-6)
+    selu = nn.SELU()(x).asnumpy()
+    assert selu[0, 2] == pytest.approx(1.0507, rel=1e-3)

@@ -1,0 +1,104 @@
+"""Loss tests (parity model: [U:tests/python/unittest/test_loss.py])."""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import gluon
+from incubator_mxnet_tpu.utils.test_utils import assert_almost_equal
+
+
+def test_l2_loss():
+    loss = gluon.loss.L2Loss()
+    pred = mx.nd.array([[1.0, 2.0], [3.0, 4.0]])
+    label = mx.nd.array([[1.5, 2.0], [3.0, 3.0]])
+    out = loss(pred, label)
+    expect = ((np.array([[0.5, 0], [0, 1.0]]) ** 2) / 2).mean(axis=1)
+    assert_almost_equal(out, expect, rtol=1e-5, atol=1e-6)
+
+
+def test_l1_loss():
+    loss = gluon.loss.L1Loss()
+    pred = mx.nd.array([[1.0, -1.0]])
+    label = mx.nd.array([[0.0, 0.0]])
+    assert float(loss(pred, label).asscalar()) == pytest.approx(1.0)
+
+
+def test_softmax_ce_sparse_matches_manual():
+    loss = gluon.loss.SoftmaxCrossEntropyLoss()
+    logits = mx.nd.array([[1.0, 2.0, 3.0], [1.0, 1.0, 1.0]])
+    label = mx.nd.array([2, 0])
+    out = loss(logits, label).asnumpy()
+    p = np.exp(logits.asnumpy())
+    p /= p.sum(axis=1, keepdims=True)
+    manual = -np.log(p[np.arange(2), [2, 0]])
+    assert_almost_equal(out, manual, rtol=1e-5, atol=1e-6)
+
+
+def test_softmax_ce_dense_label():
+    loss = gluon.loss.SoftmaxCrossEntropyLoss(sparse_label=False)
+    logits = mx.nd.array([[1.0, 2.0, 3.0]])
+    label = mx.nd.array([[0.0, 0.0, 1.0]])
+    sparse = gluon.loss.SoftmaxCrossEntropyLoss()(logits, mx.nd.array([2]))
+    assert_almost_equal(loss(logits, label), sparse, rtol=1e-5, atol=1e-6)
+
+
+def test_sigmoid_bce():
+    loss = gluon.loss.SigmoidBCELoss()
+    pred = mx.nd.array([[0.0]])
+    label = mx.nd.array([[1.0]])
+    assert float(loss(pred, label).asscalar()) == pytest.approx(np.log(2), rel=1e-4)
+
+
+def test_kl_div():
+    loss = gluon.loss.KLDivLoss()
+    logp = mx.nd.log(mx.nd.array([[0.25, 0.75]]))
+    label = mx.nd.array([[0.25, 0.75]])
+    assert float(loss(logp, label).asscalar()) == pytest.approx(0.0, abs=1e-5)
+
+
+def test_huber():
+    loss = gluon.loss.HuberLoss(rho=1.0)
+    pred = mx.nd.array([[0.0, 0.0]])
+    label = mx.nd.array([[0.5, 3.0]])
+    out = float(loss(pred, label).asscalar())
+    assert out == pytest.approx((0.5 * 0.25 + (3.0 - 0.5)) / 2, rel=1e-4)
+
+
+def test_hinge():
+    loss = gluon.loss.HingeLoss()
+    pred = mx.nd.array([[0.5], [2.0]])
+    label = mx.nd.array([[1.0], [1.0]])
+    out = loss(pred, label).asnumpy()
+    assert_almost_equal(out, np.array([0.5, 0.0]), rtol=1e-5, atol=1e-6)
+
+
+def test_triplet():
+    loss = gluon.loss.TripletLoss(margin=1.0)
+    anchor = mx.nd.array([[0.0, 0.0]])
+    pos = mx.nd.array([[0.1, 0.0]])
+    neg = mx.nd.array([[2.0, 0.0]])
+    out = float(loss(anchor, pos, neg).asscalar())
+    assert out == pytest.approx(0.0)  # relu(0.01 - 4 + 1) = 0
+
+
+def test_ctc_loss_simple():
+    """CTC on a trivial 1-label problem: strong evidence for the label."""
+    loss = gluon.loss.CTCLoss(layout="TNC")
+    T, B, C = 4, 1, 3
+    logits = np.full((T, B, C), -5.0, dtype="float32")
+    logits[:, 0, 1] = 5.0  # label 1 everywhere
+    label = mx.nd.array([[1]])
+    out = float(loss(mx.nd.array(logits), label).asscalar())
+    assert np.isfinite(out)
+    # strong-evidence sequence should have small loss
+    assert out < 1.0
+
+
+def test_loss_weight_and_sample_weight():
+    loss = gluon.loss.L1Loss(weight=2.0)
+    pred = mx.nd.array([[1.0]])
+    label = mx.nd.array([[0.0]])
+    assert float(loss(pred, label).asscalar()) == pytest.approx(2.0)
+    loss2 = gluon.loss.L1Loss()
+    sw = mx.nd.array([[0.0]])
+    assert float(loss2(pred, label, sw).asscalar()) == pytest.approx(0.0)

@@ -1,0 +1,76 @@
+"""Metric tests (parity model: metric coverage in [U:tests/python/unittest/])."""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+
+
+def test_accuracy():
+    m = mx.metric.Accuracy()
+    pred = mx.nd.array([[0.3, 0.7], [0.9, 0.1], [0.4, 0.6]])
+    label = mx.nd.array([1, 0, 0])
+    m.update([label], [pred])
+    name, acc = m.get()
+    assert name == "accuracy"
+    assert acc == pytest.approx(2 / 3)
+
+
+def test_topk():
+    m = mx.metric.TopKAccuracy(top_k=2)
+    pred = mx.nd.array([[0.1, 0.2, 0.7], [0.7, 0.2, 0.1]])
+    label = mx.nd.array([1, 2])
+    m.update([label], [pred])
+    assert m.get()[1] == pytest.approx(0.5)
+
+
+def test_mse_rmse_mae():
+    pred = mx.nd.array([[1.0], [2.0]])
+    label = mx.nd.array([[1.5], [2.5]])
+    m = mx.metric.MSE()
+    m.update([label], [pred])
+    assert m.get()[1] == pytest.approx(0.25)
+    m = mx.metric.RMSE()
+    m.update([label], [pred])
+    assert m.get()[1] == pytest.approx(0.5)
+    m = mx.metric.MAE()
+    m.update([label], [pred])
+    assert m.get()[1] == pytest.approx(0.5)
+
+
+def test_perplexity():
+    m = mx.metric.Perplexity()
+    pred = mx.nd.array([[0.5, 0.5], [0.9, 0.1]])
+    label = mx.nd.array([0, 0])
+    m.update([label], [pred])
+    expected = np.exp(-(np.log(0.5) + np.log(0.9)) / 2)
+    assert m.get()[1] == pytest.approx(expected, rel=1e-4)
+
+
+def test_f1():
+    m = mx.metric.F1()
+    pred = mx.nd.array([[0.2, 0.8], [0.8, 0.2], [0.3, 0.7]])
+    label = mx.nd.array([1, 1, 0])
+    m.update([label], [pred])
+    # tp=1 fp=1 fn=1 -> f1 = 0.5
+    assert m.get()[1] == pytest.approx(0.5)
+
+
+def test_composite_and_create():
+    m = mx.metric.create(["acc", "ce"])
+    pred = mx.nd.array([[0.3, 0.7]])
+    label = mx.nd.array([1])
+    m.update([label], [pred])
+    names, values = m.get()
+    assert "accuracy" in names[0]
+
+
+def test_custom_metric():
+    m = mx.metric.CustomMetric(lambda l, p: float(np.abs(l - p).sum()), name="absdiff")
+    m.update([mx.nd.array([1.0])], [mx.nd.array([3.0])])
+    assert m.get()[1] == pytest.approx(2.0)
+
+
+def test_loss_metric():
+    m = mx.metric.Loss()
+    m.update([], [mx.nd.array([2.0, 4.0])])
+    assert m.get()[1] == pytest.approx(3.0)

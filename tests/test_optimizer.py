@@ -1,0 +1,158 @@
+"""Optimizer tests (parity model: [U:tests/python/unittest/test_optimizer.py]):
+each optimizer is validated against a pure-numpy reference update."""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu.utils.test_utils import assert_almost_equal
+
+from common import with_seed
+
+
+def _run_steps(opt_name, np_update, steps=5, shape=(4, 3), **opt_args):
+    np.random.seed(0)
+    w0 = np.random.uniform(-1, 1, shape).astype("float32")
+    grads = [np.random.uniform(-1, 1, shape).astype("float32") for _ in range(steps)]
+
+    opt = mx.optimizer.create(opt_name, **opt_args)
+    w = mx.nd.array(w0)
+    state = opt.create_state(0, w)
+    for g in grads:
+        opt.update(0, w, mx.nd.array(g), state)
+
+    w_ref, aux = w0.copy(), {}
+    for t, g in enumerate(grads, 1):
+        w_ref = np_update(w_ref, g, t, aux)
+    assert_almost_equal(w, w_ref, rtol=1e-4, atol=1e-5)
+
+
+def test_sgd():
+    lr, wd = 0.1, 0.01
+
+    def upd(w, g, t, aux):
+        return w - lr * (g + wd * w)
+
+    _run_steps("sgd", upd, learning_rate=lr, wd=wd)
+
+
+def test_sgd_momentum():
+    lr, mom, wd = 0.1, 0.9, 0.0
+
+    def upd(w, g, t, aux):
+        m = aux.setdefault("m", np.zeros_like(w))
+        m[:] = mom * m - lr * (g + wd * w)
+        return w + m
+
+    _run_steps("sgd", upd, learning_rate=lr, momentum=mom, wd=wd)
+
+
+def test_nag():
+    lr, mom = 0.1, 0.9
+
+    def upd(w, g, t, aux):
+        m = aux.setdefault("m", np.zeros_like(w))
+        m[:] = mom * m + g
+        return w - lr * (mom * m + g)
+
+    _run_steps("nag", upd, learning_rate=lr, momentum=mom, wd=0.0)
+
+
+def test_adam():
+    lr, b1, b2, eps = 0.01, 0.9, 0.999, 1e-8
+
+    def upd(w, g, t, aux):
+        m = aux.setdefault("m", np.zeros_like(w))
+        v = aux.setdefault("v", np.zeros_like(w))
+        m[:] = b1 * m + (1 - b1) * g
+        v[:] = b2 * v + (1 - b2) * g * g
+        lr_t = lr * np.sqrt(1 - b2 ** t) / (1 - b1 ** t)
+        return w - lr_t * m / (np.sqrt(v) + eps)
+
+    _run_steps("adam", upd, learning_rate=lr, wd=0.0)
+
+
+def test_rmsprop():
+    lr, rho, eps = 0.01, 0.9, 1e-8
+
+    def upd(w, g, t, aux):
+        n = aux.setdefault("n", np.zeros_like(w))
+        n[:] = rho * n + (1 - rho) * g * g
+        return w - lr * g / np.sqrt(n + eps)
+
+    _run_steps("rmsprop", upd, learning_rate=lr, rho=rho, epsilon=eps, wd=0.0)
+
+
+def test_adagrad():
+    lr, eps = 0.05, 1e-7
+
+    def upd(w, g, t, aux):
+        h = aux.setdefault("h", np.zeros_like(w))
+        h[:] = h + g * g
+        return w - lr * g / (np.sqrt(h) + eps)
+
+    _run_steps("adagrad", upd, learning_rate=lr, wd=0.0)
+
+
+def test_signum():
+    lr, mom = 0.01, 0.9
+
+    def upd(w, g, t, aux):
+        m = aux.setdefault("m", np.zeros_like(w))
+        m[:] = mom * m - (1 - mom) * g
+        return w + lr * np.sign(m)
+
+    _run_steps("signum", upd, learning_rate=lr, momentum=mom, wd=0.0)
+
+
+def test_lamb_decreases_loss():
+    opt = mx.optimizer.create("lamb", learning_rate=0.1)
+    w = mx.nd.array(np.full((4, 4), 5.0, dtype="float32"))
+    state = opt.create_state(0, w)
+    for _ in range(50):
+        grad = 2 * w
+        opt.update(0, w, grad, state)
+    assert float(w.abs().mean().asscalar()) < 1.0
+
+
+def test_clip_gradient():
+    opt = mx.optimizer.create("sgd", learning_rate=1.0, clip_gradient=0.1)
+    w = mx.nd.zeros((2,))
+    opt.update(0, w, mx.nd.array([10.0, -10.0]), None)
+    assert_almost_equal(w, np.array([-0.1, 0.1]), rtol=1e-5, atol=1e-6)
+
+
+def test_multi_precision_bf16():
+    opt = mx.optimizer.create("sgd", learning_rate=0.1, momentum=0.9, multi_precision=True)
+    w = mx.nd.ones((4,), dtype="bfloat16")
+    state = opt.create_state_multi_precision(0, w)
+    g = mx.nd.ones((4,), dtype="bfloat16") * 0.001
+    for _ in range(10):
+        opt.update_multi_precision(0, w, g, state)
+    # fp32 master accumulates small updates that bf16 alone would lose
+    _, w32 = state
+    assert float(w32.asnumpy()[0]) < 1.0
+
+
+def test_lr_scheduler_factor():
+    sched = mx.lr_scheduler.FactorScheduler(step=10, factor=0.5, base_lr=1.0)
+    assert sched(5) == 1.0
+    assert sched(15) == 0.5
+    assert sched(25) == 0.25
+
+
+def test_lr_scheduler_warmup_cosine():
+    sched = mx.lr_scheduler.CosineScheduler(100, base_lr=1.0, warmup_steps=10)
+    assert sched(5) == pytest.approx(0.5)
+    assert sched(10) == pytest.approx(1.0)
+    assert sched(100) == pytest.approx(0.0, abs=1e-6)
+
+
+def test_optimizer_lr_wd_mult():
+    opt = mx.optimizer.create("sgd", learning_rate=1.0, param_idx2name={0: "a_weight", 1: "b_bias"}, wd=0.1)
+    opt.set_wd_mult({})
+    # bias gets wd_mult 0 automatically
+    assert opt._get_wd(1) == 0.0
+    assert opt._get_wd(0) == pytest.approx(0.1)
+    opt.set_lr_mult({"a_weight": 0.5})
+    assert opt._get_lr(0) == pytest.approx(0.5)
+    assert opt._get_lr(1) == pytest.approx(1.0)

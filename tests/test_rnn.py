@@ -1,0 +1,121 @@
+"""RNN cell/layer tests (parity model: gluon rnn coverage in
+[U:tests/python/unittest/test_gluon_rnn.py])."""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import autograd, gluon
+from incubator_mxnet_tpu.utils.test_utils import assert_almost_equal
+
+from common import with_seed
+
+
+def test_rnn_cell_unroll():
+    cell = gluon.rnn.RNNCell(8)
+    cell.initialize()
+    x = mx.nd.random.uniform(shape=(2, 5, 4))  # NTC
+    outputs, states = cell.unroll(5, x, layout="NTC")
+    assert outputs.shape == (2, 5, 8)
+    assert states[0].shape == (2, 8)
+
+
+def test_lstm_cell_step_and_state_info():
+    cell = gluon.rnn.LSTMCell(6)
+    cell.initialize()
+    x = mx.nd.random.uniform(shape=(3, 4))
+    states = cell.begin_state(batch_size=3)
+    out, new_states = cell(x, states)
+    assert out.shape == (3, 6)
+    assert len(new_states) == 2
+    info = cell.state_info(3)
+    assert info[0]["shape"] == (3, 6)
+
+
+def test_gru_cell():
+    cell = gluon.rnn.GRUCell(6)
+    cell.initialize()
+    x = mx.nd.random.uniform(shape=(3, 4))
+    out, states = cell(x, cell.begin_state(batch_size=3))
+    assert out.shape == (3, 6)
+
+
+def test_sequential_cell_stack():
+    stack = gluon.rnn.SequentialRNNCell()
+    stack.add(gluon.rnn.LSTMCell(8))
+    stack.add(gluon.rnn.LSTMCell(8))
+    stack.initialize()
+    x = mx.nd.random.uniform(shape=(2, 3, 4))
+    outputs, states = stack.unroll(3, x, layout="NTC")
+    assert outputs.shape == (2, 3, 8)
+    assert len(states) == 4
+
+
+def test_bidirectional_cell():
+    bi = gluon.rnn.BidirectionalCell(gluon.rnn.LSTMCell(4), gluon.rnn.LSTMCell(4))
+    bi.initialize()
+    x = mx.nd.random.uniform(shape=(2, 3, 5))
+    outputs, states = bi.unroll(3, x, layout="NTC")
+    assert outputs.shape == (2, 3, 8)
+
+
+@with_seed()
+def test_fused_lstm_matches_cell_unroll():
+    """The lax.scan fused layer must agree with the step-by-step cell."""
+    hidden, T, B, C = 5, 4, 2, 3
+    layer = gluon.rnn.LSTM(hidden, input_size=C)
+    layer.initialize()
+    cell = gluon.rnn.LSTMCell(hidden, input_size=C)
+    cell.initialize()
+    # copy weights layer -> cell
+    cell.i2h_weight.set_data(layer.l0_i2h_weight.data())
+    cell.h2h_weight.set_data(layer.l0_h2h_weight.data())
+    cell.i2h_bias.set_data(layer.l0_i2h_bias.data())
+    cell.h2h_bias.set_data(layer.l0_h2h_bias.data())
+    x = mx.nd.random.uniform(shape=(T, B, C))
+    fused = layer(x)  # TNC
+    x_ntc = mx.nd.swapaxes(x, 0, 1)
+    unrolled, _ = cell.unroll(T, x_ntc, layout="NTC")
+    assert_almost_equal(fused, mx.nd.swapaxes(unrolled, 0, 1), rtol=1e-4, atol=1e-5)
+
+
+def test_lstm_layer_with_states_and_grad():
+    layer = gluon.rnn.LSTM(8, num_layers=2)
+    layer.initialize()
+    x = mx.nd.random.uniform(shape=(6, 2, 4))
+    states = layer.begin_state(batch_size=2)
+    out, new_states = layer(x, states)
+    assert out.shape == (6, 2, 8)
+    assert new_states[0].shape == (2, 2, 8)
+    layer.collect_params()  # all params exist
+    with autograd.record():
+        out = layer(x)
+        loss = out.sum()
+    loss.backward()
+    g = layer.l0_i2h_weight.grad()
+    assert float(g.abs().sum().asscalar()) > 0
+
+
+def test_gru_layer_ntc():
+    layer = gluon.rnn.GRU(8, layout="NTC")
+    layer.initialize()
+    x = mx.nd.random.uniform(shape=(2, 6, 4))
+    assert layer(x).shape == (2, 6, 8)
+
+
+def test_rnn_relu_layer():
+    layer = gluon.rnn.RNN(8, activation="relu")
+    layer.initialize()
+    x = mx.nd.random.uniform(shape=(3, 2, 4))
+    assert layer(x).shape == (3, 2, 8)
+
+
+def test_dropout_and_residual_cells():
+    base = gluon.rnn.RNNCell(4, input_size=4)
+    res = gluon.rnn.ResidualCell(base)
+    res.initialize()
+    x = mx.nd.random.uniform(shape=(2, 4))
+    out, _ = res(x, base.begin_state(batch_size=2))
+    d = gluon.rnn.DropoutCell(0.5)
+    out2, _ = d(x, [])
+    assert out.shape == (2, 4)
+    assert out2.shape == (2, 4)
