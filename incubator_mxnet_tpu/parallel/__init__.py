@@ -1,0 +1,59 @@
+"""Parallelism & distribution layer — the TPU-native replacement for the
+reference's KVStore/ps-lite/NCCL stack (SURVEY.md §2.3).
+
+The reference scales by bolting communication onto an imperative loop
+(DataParallelExecutorGroup slices batches across GPUs, KVStore pushes
+gradients to parameter servers over ZMQ, [U:src/kvstore/kvstore_dist.cc],
+[U:python/mxnet/module/executor_group.py]).  TPU-first design inverts this:
+pick a ``jax.sharding.Mesh`` with named axes (dp/tp/pp/sp/ep), annotate
+parameter and batch shardings with ``PartitionSpec``, compile ONE SPMD
+train step with ``jax.jit``, and let XLA insert the collectives over
+ICI/DCN.  There is no separate communication code path to maintain.
+
+* :mod:`mesh` — device-mesh construction (``make_mesh``) and multi-host
+  bootstrap (``init_distributed`` = the scheduler-role analog).
+* :mod:`sharding` — name-pattern → PartitionSpec rules for parameters,
+  batch specs, ZeRO-style optimizer-state sharding.
+* :mod:`trainer` — ``SPMDTrainer``: compiles a Gluon block + loss +
+  optimizer into one donated-buffer train step over the mesh (the fused
+  equivalent of CachedOp fwd + backward + KVStore pushpull + optimizer).
+* :mod:`ring` — ring attention / sequence-parallel collectives over the
+  'sp' mesh axis (capability the reference lacks; SURVEY.md §5).
+"""
+from .mesh import (
+    MeshConfig,
+    make_mesh,
+    current_mesh,
+    local_mesh,
+    init_distributed,
+    mesh_scope,
+)
+from .sharding import (
+    ShardingRules,
+    default_rules,
+    fsdp_rules,
+    param_sharding,
+    batch_pspec,
+    shard_array,
+    replicate,
+)
+from .trainer import SPMDTrainer
+from .ring import ring_attention, ring_attention_sharded
+
+__all__ = [
+    "MeshConfig",
+    "make_mesh",
+    "current_mesh",
+    "local_mesh",
+    "init_distributed",
+    "mesh_scope",
+    "ShardingRules",
+    "default_rules",
+    "param_sharding",
+    "batch_pspec",
+    "shard_array",
+    "replicate",
+    "SPMDTrainer",
+    "ring_attention",
+    "ring_attention_sharded",
+]

@@ -1,0 +1,112 @@
+"""Parameter/batch sharding rules.
+
+The reference's device-placement machinery is ``group2ctx`` symbol
+attributes resolved by a graph pass ([U:3rdparty/tvm/nnvm/src/pass/
+place_device.cc]) — manual, per-node, copy-based.  Here placement is
+declarative: an ordered list of (name-regex → PartitionSpec) rules, applied
+to parameter names.  XLA's SPMD partitioner derives every collective from
+these annotations.
+
+Conventions:
+* Batch axis shards over ('dp', 'fsdp') — fsdp contributes to batch
+  parallelism too; it differs from dp only in that parameters/optimizer
+  state are *also* sharded over it (ZeRO-1/3 style).
+* A rule whose spec doesn't divide the actual shape falls back to
+  replication on the offending axis (mirrors XLA's requirement that
+  sharded dims divide evenly; keeps small params cheap).
+"""
+from __future__ import annotations
+
+import re
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = [
+    "ShardingRules",
+    "default_rules",
+    "fsdp_rules",
+    "param_sharding",
+    "batch_pspec",
+    "shard_array",
+    "replicate",
+]
+
+
+def _axis_size(mesh: Mesh, names) -> int:
+    if names is None:
+        return 1
+    if isinstance(names, str):
+        names = (names,)
+    size = 1
+    for n in names:
+        size *= mesh.shape[n]
+    return size
+
+
+class ShardingRules:
+    """Ordered (regex → PartitionSpec) table; first match wins."""
+
+    def __init__(self, rules=(), default=P()):
+        self._rules = [(re.compile(pat), spec) for pat, spec in rules]
+        self._default = default
+
+    def add(self, pattern, spec):
+        self._rules.append((re.compile(pattern), spec))
+        return self
+
+    def spec_for(self, name: str, shape, mesh: Mesh) -> P:
+        for pat, spec in self._rules:
+            if pat.search(name):
+                return _fit_spec(spec, shape, mesh)
+        return _fit_spec(self._default, shape, mesh)
+
+    def __iter__(self):
+        return iter(self._rules)
+
+
+def _fit_spec(spec: P, shape, mesh: Mesh) -> P:
+    """Clip a spec to the rank of ``shape`` and drop axes that don't divide
+    evenly (replicate instead) — the safe-fallback contract."""
+    out = []
+    for i, dim in enumerate(shape):
+        names = spec[i] if i < len(spec) else None
+        if names is not None and dim % _axis_size(mesh, names) != 0:
+            names = None
+        out.append(names)
+    return P(*out)
+
+
+def default_rules() -> ShardingRules:
+    """Replicate everything — correct for pure data parallel; grads get
+    psum'd by XLA because batch is sharded and params are not."""
+    return ShardingRules()
+
+
+def fsdp_rules() -> ShardingRules:
+    """ZeRO-style: shard every parameter's axis 0 over 'fsdp'.  Optimizer
+    state inherits the parameter's sharding in SPMDTrainer, which is what
+    makes this ZeRO-1/2 rather than just weight sharding."""
+    return ShardingRules(default=P("fsdp"))
+
+
+def param_sharding(mesh: Mesh, name: str, shape, rules: ShardingRules) -> NamedSharding:
+    return NamedSharding(mesh, rules.spec_for(name, shape, mesh))
+
+
+def batch_pspec(ndim: int, sp_axis: int | None = None) -> P:
+    """Batch spec: axis 0 over (dp, fsdp); optionally a sequence axis over
+    'sp' for context parallelism."""
+    parts = [None] * ndim
+    parts[0] = ("dp", "fsdp")
+    if sp_axis is not None and 0 < sp_axis < ndim:
+        parts[sp_axis] = "sp"
+    return P(*parts)
+
+
+def shard_array(mesh: Mesh, arr, spec: P):
+    return jax.device_put(arr, NamedSharding(mesh, spec))
+
+
+def replicate(mesh: Mesh, arr):
+    return jax.device_put(arr, NamedSharding(mesh, P()))

@@ -1,0 +1,367 @@
+"""SPMDTrainer — the fully-fused TPU training path.
+
+Parity map (SURVEY.md §3.2): in the reference, one training step is
+CachedOp::Forward + Imperative::Backward + KVStore pushpull + a fused
+optimizer op per parameter — four engine round-trips per step, with
+cross-device communication handled by comm.h/NCCL/ps-lite.  Here the whole
+step is ONE ``jax.jit``-compiled SPMD program over a named mesh:
+
+    loss, grads = value_and_grad(forward ∘ loss)        # the tape
+    new_params  = optimizer kernels (same registry as Trainer)
+    collectives = inserted by XLA from sharding annotations (dp → grad
+                  psum, tp → activation all-gather/reduce-scatter, ...)
+
+Parameters and optimizer state are donated (static_alloc analog), so
+steady-state HBM holds one copy.  The Gluon ``Trainer`` remains the
+imperative-parity path; SPMDTrainer is the performance path the benchmarks
+use — same Block, same loss, same Optimizer subclass.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as _np
+
+from .. import autograd
+from .. import optimizer as opt_mod
+from ..ndarray.ndarray import NDArray
+from ..random import get_key, push_traced_key, pop_traced_key
+from ..gluon.block import _aux_stack, _tls as _block_tls
+from ..gluon.parameter import ParameterDict
+from .mesh import current_mesh, local_mesh
+from .sharding import ShardingRules, default_rules, batch_pspec, param_sharding
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+__all__ = ["SPMDTrainer"]
+
+
+class _EveryKey(dict):
+    """dict that answers ``t`` for every key — feeds the traced update count
+    into optimizer kernels (Adam/LAMB bias correction) without retracing."""
+
+    def __init__(self, t):
+        super().__init__()
+        self._t = t
+
+    def __contains__(self, k):
+        return True
+
+    def __getitem__(self, k):
+        return self._t
+
+    def __setitem__(self, k, v):
+        pass
+
+
+def _state_to_arrays(st):
+    if st is None:
+        return None
+    if isinstance(st, NDArray):
+        return st._data
+    if isinstance(st, (list, tuple)):
+        return tuple(_state_to_arrays(s) for s in st)
+    return st
+
+
+def _state_to_ndarrays(st):
+    if st is None:
+        return None
+    if isinstance(st, (jnp.ndarray, jax.Array)) or hasattr(st, "dtype"):
+        return NDArray(st)
+    if isinstance(st, (list, tuple)):
+        return tuple(_state_to_ndarrays(s) for s in st)
+    return st
+
+
+class SPMDTrainer:
+    """Compile a Gluon block + loss + optimizer into one sharded train step.
+
+    Parameters
+    ----------
+    block : gluon.Block
+        Initialized model (``block.initialize()`` already called, possibly
+        warmed once for deferred shapes).
+    loss_fn : callable(outputs, label) -> NDArray
+        Per-sample loss (a ``gluon.loss`` Block or any NDArray function).
+    optimizer : str or Optimizer
+    mesh : jax.sharding.Mesh, optional
+        Defaults to the ambient ``mesh_scope`` or a pure-dp local mesh.
+    rules : ShardingRules, optional
+        Parameter placement (tp/fsdp).  Default: replicate (pure dp).
+    sp_axis : int, optional
+        Input axis to shard over 'sp' (sequence/context parallelism).
+    """
+
+    def __init__(
+        self,
+        block,
+        loss_fn,
+        optimizer,
+        optimizer_params=None,
+        mesh=None,
+        rules: ShardingRules | None = None,
+        sp_axis: int | None = None,
+        donate: bool = True,
+    ):
+        self._block = block
+        self._loss_fn = loss_fn
+        if isinstance(optimizer, opt_mod.Optimizer):
+            self._optimizer = optimizer
+        else:
+            self._optimizer = opt_mod.create(optimizer, **(optimizer_params or {}))
+        self._mesh = mesh or current_mesh() or local_mesh()
+        self._rules = rules or default_rules()
+        self._sp_axis = sp_axis
+        self._donate = donate
+
+        params = block.collect_params()
+        if isinstance(params, (dict, ParameterDict)):
+            params = list(params.values())
+        params.sort(key=lambda p: p.name)
+        self._params = params
+        self._trainable_idx = [i for i, p in enumerate(params) if p.grad_req != "null"]
+        self._optimizer.param_dict = {i: params[i] for i in self._trainable_idx}
+
+        # Materialize param arrays on the mesh with their rule shardings.
+        self._param_shardings = [
+            param_sharding(self._mesh, p.name, p.shape, self._rules) for p in params
+        ]
+        self._param_arrays = [
+            jax.device_put(p._data._data, s) for p, s in zip(params, self._param_shardings)
+        ]
+        # Optimizer state: same sharding as its parameter (ZeRO comes from
+        # the parameter rule; state simply follows).
+        self._opt_states = []
+        self._state_shardings = []
+        for i in self._trainable_idx:
+            st = self._optimizer.create_state_multi_precision(i, params[i].data())
+            arrs = _state_to_arrays(st)
+            shard = jax.tree_util.tree_map(
+                lambda a: self._sharding_like(a, self._param_shardings[i]), arrs
+            )
+            arrs = jax.tree_util.tree_map(lambda a, s: jax.device_put(a, s), arrs, shard)
+            self._opt_states.append(arrs)
+            self._state_shardings.append(shard)
+
+        self._t = self._optimizer.begin_num_update
+        self._step_cache = {}
+
+    # ------------------------------------------------------------------
+    def _sharding_like(self, arr, param_sh):
+        spec = param_sh.spec
+        fitted = []
+        for i, d in enumerate(arr.shape):
+            names = spec[i] if i < len(spec) else None
+            fitted.append(names)
+        return NamedSharding(self._mesh, P(*fitted))
+
+    @property
+    def mesh(self):
+        return self._mesh
+
+    @property
+    def optimizer(self):
+        return self._optimizer
+
+    @property
+    def num_update(self):
+        return self._t
+
+    def learning_rate(self):
+        opt = self._optimizer
+        if opt.lr_scheduler is not None:
+            return float(opt.lr_scheduler(self._t))
+        return float(opt.lr)
+
+    # ------------------------------------------------------------------
+    def shard_batch(self, *arrays):
+        """Place host batch arrays on the mesh with (dp, fsdp)[, sp]
+        sharding.  Accepts numpy or NDArray; returns jax.Arrays.  In
+        multi-process runs each host passes its local shard."""
+        out = []
+        for a in arrays:
+            if isinstance(a, NDArray):
+                a = a._data
+            a = _np.asarray(a) if not isinstance(a, jax.Array) else a
+            spec = batch_pspec(a.ndim, self._sp_axis)
+            sharding = NamedSharding(self._mesh, spec)
+            if jax.process_count() > 1:
+                out.append(jax.make_array_from_process_local_data(sharding, a))
+            else:
+                out.append(jax.device_put(a, sharding))
+        return tuple(out)
+
+    # ------------------------------------------------------------------
+    def step(self, data, label, batch_size=None):
+        """Run one fused train step; returns the scalar loss (NDArray).
+
+        ``batch_size`` defaults to the global batch (axis 0 of data); grads
+        are rescaled by 1/batch_size like ``Trainer.step``.
+        """
+        inputs = data if isinstance(data, (list, tuple)) else (data,)
+        arrays = self.shard_batch(*inputs, label)
+        if batch_size is None:
+            batch_size = arrays[0].shape[0]
+        sig = tuple((a.shape, str(a.dtype)) for a in arrays)
+        fn = self._step_cache.get(sig)
+        if fn is None:
+            fn = self._build_step(arrays)
+            self._step_cache[sig] = fn
+        self._t += 1
+        self._optimizer.num_update = self._t
+        lr = self.learning_rate()
+        rescale = self._optimizer.rescale_grad / batch_size
+        key = get_key()
+        new_params, new_states, loss = fn(
+            key,
+            jnp.float32(self._t),
+            jnp.float32(lr),
+            jnp.float32(rescale),
+            self._param_arrays,
+            self._opt_states,
+            *arrays,
+        )
+        self._param_arrays = new_params
+        self._opt_states = new_states
+        return NDArray(loss)
+
+    # ------------------------------------------------------------------
+    def _build_step(self, example_arrays):
+        block = self._block
+        loss_fn = self._loss_fn
+        opt = self._optimizer
+        params = self._params
+        trainable_idx = self._trainable_idx
+        n_inputs = len(example_arrays) - 1
+        aux_idx_cell = []
+
+        def forward_loss(train_arrs, full_arrs, key, batch):
+            full = list(full_arrs)
+            for j, arr in zip(trainable_idx, train_arrs):
+                full[j] = arr
+            saved = []
+            for p, a in zip(params, full):
+                saved.append(getattr(p, "_traced_data", None))
+                p._traced_data = NDArray(a)
+            push_traced_key(key)
+            collector = []
+            _aux_stack().append(collector)
+            prev = getattr(_block_tls, "tracing", 0)
+            _block_tls.tracing = prev + 1
+            try:
+                with autograd._scope(False, True):  # training=True, no tape
+                    ins = [NDArray(b) for b in batch[:n_inputs]]
+                    out = block(*ins)
+                    label = NDArray(batch[n_inputs])
+                    loss = loss_fn(out, label)
+                    # Differentiate the SUM (matching ``loss.backward()`` on a
+                    # vector loss: implicit ones head-grads); Trainer-parity
+                    # mean-reduction comes from rescale_grad = 1/batch_size.
+                    loss_data = loss._data.astype(jnp.float32)
+                    loss_scalar = jnp.sum(loss_data)
+                    loss_mean = jnp.mean(loss_data)
+            finally:
+                _block_tls.tracing = prev
+                _aux_stack().pop()
+                pop_traced_key()
+                for p, s in zip(params, saved):
+                    p._traced_data = s
+            if not aux_idx_cell:
+                idx_map = {id(p): i for i, p in enumerate(params)}
+                aux_idx_cell.append([idx_map[id(p)] for p, _ in collector])
+            aux_vals = tuple(
+                v._data if isinstance(v, NDArray) else v for _, v in collector
+            )
+            return loss_scalar, (aux_vals, loss_mean)
+
+        def pure_step(key, t, lr, rescale, param_arrs, opt_states, *batch):
+            train_arrs = [param_arrs[j] for j in trainable_idx]
+            (_, (aux_vals, loss_mean)), grads = jax.value_and_grad(
+                forward_loss, has_aux=True
+            )(train_arrs, param_arrs, key, batch)
+
+            # Optimizer: reuse the registered Optimizer's own update methods
+            # with traced t/lr — exact parity with the imperative Trainer.
+            save = (
+                opt._index_update_count,
+                opt.num_update,
+                opt.lr,
+                opt.lr_scheduler,
+                opt.rescale_grad,
+            )
+            opt._index_update_count = _EveryKey(t)
+            opt.num_update = t
+            opt.lr = lr
+            opt.lr_scheduler = None
+            opt.rescale_grad = rescale
+            # shadow the bookkeeping method: count is the traced t
+            opt._update_count = lambda idx: None
+            try:
+                new_full = list(param_arrs)
+                new_states = []
+                for slot, j in enumerate(trainable_idx):
+                    w = NDArray(param_arrs[j])
+                    g = NDArray(grads[slot])
+                    st = _state_to_ndarrays(opt_states[slot])
+                    opt.update_multi_precision(j, w, g, st)
+                    new_full[j] = w._data
+                    new_states.append(_state_to_arrays(st))
+            finally:
+                (
+                    opt._index_update_count,
+                    opt.num_update,
+                    opt.lr,
+                    opt.lr_scheduler,
+                    opt.rescale_grad,
+                ) = save
+                del opt._update_count  # restore the class method
+            # aux side effects (BatchNorm running stats) overwrite their
+            # frozen params.
+            for k, v in zip(aux_idx_cell[0] if aux_idx_cell else [], aux_vals):
+                new_full[k] = v.astype(new_full[k].dtype)
+            return new_full, new_states, loss_mean
+
+        out_shardings = (
+            list(self._param_shardings),
+            list(self._state_shardings),
+            NamedSharding(self._mesh, P()),
+        )
+        donate = (4, 5) if self._donate else ()
+        with self._mesh:
+            fn = jax.jit(
+                pure_step,
+                donate_argnums=donate,
+                out_shardings=out_shardings,
+            )
+        return fn
+
+    # ------------------------------------------------------------------
+    def sync_to_block(self):
+        """Write the trainer-held (possibly sharded) arrays back into the
+        Gluon Parameters — call before ``save_parameters`` or eager eval.
+        Arrays are gathered off the mesh so eager ops don't mix
+        single-device inputs with mesh-sharded weights."""
+        with autograd.pause():
+            for p, a in zip(self._params, self._param_arrays):
+                p._data._data = jnp.asarray(_np.asarray(a))
+                p._data._version += 1
+
+    def save_states(self, fname):
+        import pickle
+
+        flat = jax.tree_util.tree_map(_np.asarray, self._opt_states)
+        with open(fname, "wb") as f:
+            pickle.dump({"states": flat, "num_update": self._t}, f)
+
+    def load_states(self, fname):
+        import pickle
+
+        with open(fname, "rb") as f:
+            payload = pickle.load(f)
+        loaded = payload["states"]
+        self._opt_states = jax.tree_util.tree_map(
+            lambda a, s: jax.device_put(jnp.asarray(a), s),
+            loaded,
+            self._state_shardings,
+        )
+        self._t = payload["num_update"]
