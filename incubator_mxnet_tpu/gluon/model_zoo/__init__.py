@@ -1,5 +1,16 @@
 """Model zoo (parity: [U:python/mxnet/gluon/model_zoo/])."""
 from . import vision
+from . import bert
 from .vision import get_model
+from .bert import BERTModel, BERTForPretrain, bert_base, bert_large, bert_sharding_rules
 
-__all__ = ["vision", "get_model"]
+__all__ = [
+    "vision",
+    "bert",
+    "get_model",
+    "BERTModel",
+    "BERTForPretrain",
+    "bert_base",
+    "bert_large",
+    "bert_sharding_rules",
+]

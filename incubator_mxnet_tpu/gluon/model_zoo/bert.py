@@ -1,0 +1,130 @@
+"""BERT model family (baseline workload 3, BASELINE.md).
+
+The reference ships BERT via GluonNLP (out-of-repo); in-repo here because
+BERT-base pretraining is a headline benchmark.  Architecture follows the
+original BERT conventions (post-LN encoder, learned positions, GELU).
+
+TP/SP sharding: :func:`bert_sharding_rules` gives the Megatron-style
+placement — QKV/FFN-in column-parallel, out-proj/FFN-out row-parallel,
+embeddings vocab-sharded — consumed by ``parallel.SPMDTrainer``.
+"""
+from __future__ import annotations
+
+from jax.sharding import PartitionSpec as P
+
+from ..block import HybridBlock
+from ..nn import Dense, Dropout, Embedding, LayerNorm
+from ..nn.transformer import TransformerEncoder
+
+__all__ = [
+    "BERTModel",
+    "BERTForPretrain",
+    "bert_base",
+    "bert_large",
+    "bert_sharding_rules",
+]
+
+
+class BERTModel(HybridBlock):
+    """Token+segment+position embeddings → encoder stack → (sequence
+    output, pooled output)."""
+
+    def __init__(self, vocab_size=30522, units=768, hidden_size=3072,
+                 num_layers=12, num_heads=12, max_length=512, type_vocab=2,
+                 dropout=0.1, dtype="float32", prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._units = units
+        with self.name_scope():
+            self.word_embed = Embedding(vocab_size, units, dtype=dtype, prefix="word_embed_")
+            self.token_type_embed = Embedding(type_vocab, units, dtype=dtype, prefix="type_embed_")
+            self.position_embed = Embedding(max_length, units, dtype=dtype, prefix="pos_embed_")
+            self.embed_ln = LayerNorm(prefix="embed_ln_")
+            self.encoder = TransformerEncoder(
+                num_layers, units, hidden_size, num_heads, dropout=dropout,
+                activation="gelu", dtype=dtype, prefix="enc_",
+            )
+            self.pooler = Dense(units, activation="tanh", flatten=False, dtype=dtype, prefix="pooler_")
+        self._embed_dropout = Dropout(dropout) if dropout else None
+        if self._embed_dropout is not None:
+            self.register_child(self._embed_dropout, "embed_dropout")
+
+    def forward(self, token_ids, token_types=None):
+        from ... import ndarray as F
+
+        x = self.word_embed(token_ids)
+        if token_types is not None:
+            x = x + self.token_type_embed(token_types)
+        positions = F.arange(0, token_ids.shape[1], dtype="int32")
+        x = x + self.position_embed(positions)
+        x = self.embed_ln(x)
+        if self._embed_dropout is not None:
+            x = self._embed_dropout(x)
+        seq = self.encoder(x)  # [B, S, D]
+        pooled = self.pooler(F.slice_axis(seq, axis=1, begin=0, end=1).reshape((0, -1)))
+        return seq, pooled
+
+
+class BERTForPretrain(HybridBlock):
+    """MLM head (tied-style decoder over vocab) + NSP head."""
+
+    def __init__(self, bert: BERTModel, vocab_size=30522, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self.bert = bert
+        units = bert._units
+        with self.name_scope():
+            self.mlm_transform = Dense(units, activation=None, flatten=False, prefix="mlm_dense_")
+            self.mlm_ln = LayerNorm(prefix="mlm_ln_")
+            self.mlm_decoder = Dense(vocab_size, flatten=False, prefix="mlm_decoder_")
+            self.nsp = Dense(2, flatten=False, prefix="nsp_")
+
+    def forward(self, token_ids, token_types=None):
+        from ... import ndarray as F
+
+        seq, pooled = self.bert(token_ids, token_types)
+        h = self.mlm_transform(seq)
+        h = F.LeakyReLU(h, act_type="gelu")
+        h = self.mlm_ln(h)
+        mlm_logits = self.mlm_decoder(h)       # [B, S, V]
+        nsp_logits = self.nsp(pooled)          # [B, 2]
+        return mlm_logits, nsp_logits
+
+
+def bert_base(vocab_size=30522, max_length=512, dropout=0.1, dtype="float32", **kwargs):
+    return BERTModel(vocab_size, units=768, hidden_size=3072, num_layers=12,
+                     num_heads=12, max_length=max_length, dropout=dropout,
+                     dtype=dtype, **kwargs)
+
+
+def bert_large(vocab_size=30522, max_length=512, dropout=0.1, dtype="float32", **kwargs):
+    return BERTModel(vocab_size, units=1024, hidden_size=4096, num_layers=24,
+                     num_heads=16, max_length=max_length, dropout=dropout,
+                     dtype=dtype, **kwargs)
+
+
+
+def bert_sharding_rules(fsdp=False):
+    """Megatron-style TP placement for the layer names above.
+
+    Dense weights are [out, in] (x·Wᵀ), so column-parallel = shard axis 0
+    over 'tp', row-parallel = shard axis 1.  XLA then keeps the attention/
+    FFN block's activations tp-sharded between the two projections and
+    inserts one reduce-scatter/all-gather pair per block.
+    """
+    from ...parallel.sharding import ShardingRules
+
+    default = P("fsdp") if fsdp else P()
+    return ShardingRules(
+        [
+            (r"qkv_weight$", P("tp", None)),
+            (r"(q|kv)_weight$", P("tp", None)),
+            (r"qkv_bias$", P("tp")),
+            (r"(q|kv)_bias$", P("tp")),
+            (r"ffn1_weight$", P("tp", None)),
+            (r"ffn1_bias$", P("tp")),
+            (r"out_weight$", P(None, "tp")),
+            (r"ffn2_weight$", P(None, "tp")),
+            (r"(word|pos|type)_embed.*weight$", P("tp", None)),
+            (r"mlm_decoder_weight$", P("tp", None)),
+        ],
+        default=default,
+    )

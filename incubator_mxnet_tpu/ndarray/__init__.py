@@ -23,6 +23,7 @@ from .ndarray import (
 from .utils import save, load
 from ..ops import registry as _registry
 from . import random  # noqa: F401
+from . import contrib  # noqa: F401
 
 __all__ = [
     "NDArray",

@@ -1,0 +1,31 @@
+"""``mx.nd.contrib`` namespace.
+
+Parity target: [U:python/mxnet/contrib/ndarray.py] — contrib ops
+(MultiBox* detection ops, box_nms, fused attention, ...).  Names resolve
+through the same registry as ``nd.<op>``; ops registered with a
+``contrib_`` prefix are reachable here without the prefix, and every
+top-level op is also visible (MXNet exposes several ops in both places).
+"""
+from __future__ import annotations
+
+from ..ops import registry as _registry
+
+_WRAPPER_CACHE = {}
+
+
+def __getattr__(name):
+    if name.startswith("__"):
+        raise AttributeError(name)
+    if name in _WRAPPER_CACHE:
+        return _WRAPPER_CACHE[name]
+    from . import _make_wrapper
+
+    for candidate in (f"contrib_{name}", name):
+        try:
+            op = _registry.get_op(candidate)
+        except KeyError:
+            continue
+        fn = _make_wrapper(op)
+        _WRAPPER_CACHE[name] = fn
+        return fn
+    raise AttributeError(f"nd.contrib has no op {name!r}")

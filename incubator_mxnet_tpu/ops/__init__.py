@@ -11,5 +11,6 @@ from .registry import register, get_op, list_ops, Op
 from . import tensor  # noqa: F401  (registers tensor ops)
 from . import nn  # noqa: F401  (registers NN ops)
 from . import rnn_ops  # noqa: F401  (registers fused RNN)
+from . import attention  # noqa: F401  (registers fused/flash attention)
 
 __all__ = ["register", "get_op", "list_ops", "Op", "registry", "tensor", "nn"]

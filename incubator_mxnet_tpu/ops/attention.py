@@ -1,0 +1,244 @@
+"""Fused scaled-dot-product attention — the TPU answer to cuDNN fused
+attention (the reference has no fused attention at all; its transformer
+support lived out-of-repo in GluonNLP.  SURVEY.md §5 marks this as the one
+area where this framework intentionally EXCEEDS the reference).
+
+Three tiers, chosen by :func:`flash_attention`:
+
+1. **Pallas flash kernel** (TPU, and CPU tests via ``interpret=True``):
+   blockwise online-softmax forward — queries tiled over the grid, K/V
+   streamed through VMEM in ``block_k`` chunks, so the S×S score matrix is
+   never materialized in HBM.  Accumulation in fp32 on the MXU
+   (``preferred_element_type``), inputs may be bf16.
+2. **XLA reference path** (non-TPU backends / ``MXNET_TPU_FLASH=off``):
+   same math as one fused jnp expression; XLA fuses adequately for short
+   sequences.
+3. **Ring attention** (``parallel/ring.py``) for sequence-parallel long
+   context — built on the same online-softmax update.
+
+Gradients: ``jax.custom_vjp`` — backward recomputes attention probabilities
+from the saved (q, k, v), so no S×S residual is stored *between* fwd and
+bwd.  The backward itself currently materializes the S×S score matrix
+(fine through BERT/WMT-scale sequence lengths; a blockwise Pallas backward
+is the planned long-context upgrade — until then use ring attention /
+sequence parallelism for very long sequences, which never forms S×S).
+"""
+from __future__ import annotations
+
+import functools
+import math
+import os
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["flash_attention", "attention_reference"]
+
+
+def _use_pallas():
+    mode = os.environ.get("MXNET_TPU_FLASH", "auto")
+    if mode == "off":
+        return False, False
+    if mode == "interpret":
+        return True, True
+    on_tpu = jax.default_backend() == "tpu"
+    if mode == "on":
+        return True, not on_tpu
+    return on_tpu, False  # auto
+
+
+# ---------------------------------------------------------------------------
+# Pallas forward kernel
+# ---------------------------------------------------------------------------
+
+
+def online_softmax_update(o, m, l, s, v, matmul):
+    """One blockwise online-softmax accumulation step (shared by the Pallas
+    kernel below and parallel/ring.py).  ``m``/``l`` carry a trailing
+    keepdim; ``s`` may contain -inf for masked entries; fully-masked rows
+    keep zero mass (caller fixes l==0 before the final divide)."""
+    m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
+    m_safe = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
+    p = jnp.exp(s - m_safe)
+    p = jnp.where(jnp.isneginf(s), 0.0, p)
+    corr = jnp.where(jnp.isneginf(m), 0.0, jnp.exp(m - m_safe))
+    l_new = l * corr + p.sum(axis=-1, keepdims=True)
+    o_new = o * corr + matmul(p, v)
+    return o_new, m_new, l_new
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k, causal, scale):
+    """One (batch·head, q-block) grid cell: stream K/V blocks, online
+    softmax in fp32.  Shapes: q_ref [1, Bq, D], k/v_ref [1, Sk, D]."""
+    i = _pl.program_id(1)
+    block_q = q_ref.shape[1]
+    d = q_ref.shape[2]
+    seq_k = k_ref.shape[1]
+    nk = seq_k // block_k
+
+    q = q_ref[0].astype(jnp.float32) * scale  # [Bq, D]
+    m0 = jnp.full((block_q, 1), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((block_q, 1), jnp.float32)
+    acc0 = jnp.zeros((block_q, d), jnp.float32)
+
+    def body(j, carry):
+        m, l, acc = carry
+        k = k_ref[0, _pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, _pl.ds(j * block_k, block_k), :]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [Bq, Bk]
+        if causal:
+            q_pos = i * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            k_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, -jnp.inf)
+        acc_new, m_new, l_new = online_softmax_update(
+            acc, m, l, s, v,
+            lambda p, v: jax.lax.dot_general(
+                p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ),
+        )
+        return m_new, l_new, acc_new
+
+    m, l, acc = lax.fori_loop(0, nk, body, (m0, l0, acc0))
+    l = jnp.where(l == 0.0, 1.0, l)
+    o_ref[0] = (acc / l).astype(o_ref.dtype)
+
+
+try:  # pallas import is deferred-safe: CPU-only jax builds still have it
+    from jax.experimental import pallas as _pl
+    from jax.experimental.pallas import tpu as _pltpu
+
+    _HAVE_PALLAS = True
+except Exception:  # pragma: no cover
+    _pl = None
+    _pltpu = None
+    _HAVE_PALLAS = False
+
+
+def _flash_fwd_pallas(q, k, v, causal, scale, interpret, block_q=128, block_k=128):
+    """q/k/v: [BH, S, D] (batch·heads flattened)."""
+    bh, sq, d = q.shape
+    sk = k.shape[1]
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    if sq % block_q or sk % block_k:
+        raise ValueError(f"sequence lengths ({sq},{sk}) must divide blocks ({block_q},{block_k})")
+    kernel = functools.partial(_fwd_kernel, block_k=block_k, causal=causal, scale=scale)
+    grid = (bh, sq // block_q)
+    return _pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        grid=grid,
+        in_specs=[
+            _pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            _pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),
+            _pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=_pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+        interpret=interpret,
+    )(q, k, v)
+
+
+# ---------------------------------------------------------------------------
+# Reference path (XLA-fused) + custom VJP
+# ---------------------------------------------------------------------------
+
+
+def attention_reference(q, k, v, causal=False, scale=None):
+    """Plain jnp attention: q/k/v [B, H, S, D] (or [BH, S, D])."""
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    s = jnp.einsum("...qd,...kd->...qk", q.astype(jnp.float32) * scale, k.astype(jnp.float32))
+    if causal:
+        sq, sk = s.shape[-2], s.shape[-1]
+        mask = jnp.arange(sq)[:, None] >= jnp.arange(sk)[None, :]
+        s = jnp.where(mask, s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("...qk,...kd->...qd", p.astype(v.dtype), v)
+
+
+def _pallas_blocks(sq, sk, block_q=128, block_k=128):
+    """Largest MXU-friendly blocks that evenly divide the sequence lengths,
+    or None if none exists (→ fall back to the XLA path rather than crash
+    on unpadded/bucketed lengths)."""
+    bq = next((b for b in (block_q, 64, 32, 16, 8) if sq % b == 0), None)
+    bk = next((b for b in (block_k, 64, 32, 16, 8) if sk % b == 0), None)
+    if bq is None or bk is None:
+        return None
+    return min(bq, sq), min(bk, sk)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _flash(q, k, v, causal, scale):
+    use, interpret = _use_pallas()
+    if use and _HAVE_PALLAS:
+        b, h, s, d = q.shape
+        blocks = _pallas_blocks(s, k.shape[2])
+        if blocks is not None:
+            out = _flash_fwd_pallas(
+                q.reshape(b * h, s, d), k.reshape(b * h, -1, d), v.reshape(b * h, -1, d),
+                causal, scale, interpret, block_q=blocks[0], block_k=blocks[1],
+            )
+            return out.reshape(b, h, s, d)
+    return attention_reference(q, k, v, causal, scale)
+
+
+def _flash_fwd(q, k, v, causal, scale):
+    return _flash(q, k, v, causal, scale), (q, k, v)
+
+
+def _flash_bwd(causal, scale, res, do):
+    """Rematerialized backward (standard flash-attention gradient algebra)."""
+    q, k, v = res
+    qf = q.astype(jnp.float32) * scale
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    s = jnp.einsum("...qd,...kd->...qk", qf, kf)
+    if causal:
+        sq, sk = s.shape[-2], s.shape[-1]
+        mask = jnp.arange(sq)[:, None] >= jnp.arange(sk)[None, :]
+        s = jnp.where(mask, s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    dof = do.astype(jnp.float32)
+    o = jnp.einsum("...qk,...kd->...qd", p, vf)
+    dv = jnp.einsum("...qk,...qd->...kd", p, dof)
+    dp = jnp.einsum("...qd,...kd->...qk", dof, vf)
+    delta = jnp.sum(dof * o, axis=-1, keepdims=True)
+    ds = p * (dp - delta)
+    dq = jnp.einsum("...qk,...kd->...qd", ds, kf) * scale
+    dk = jnp.einsum("...qk,...qd->...kd", ds, qf)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q, k, v, causal=False, scale=None):
+    """Fused attention on [B, H, S, D] arrays; differentiable; bf16-safe."""
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    return _flash(q, k, v, causal, float(scale))
+
+
+from .registry import register  # noqa: E402
+
+
+@register("fused_attention")
+def fused_attention(q, k, v, num_heads=1, causal=False, scale=None):
+    """[B, S, D] convenience form: split heads → flash attention → merge.
+    Registered so it is reachable as ``nd.fused_attention`` /
+    ``nd.contrib.fused_attention`` (the role cuDNN fused MHA plays for the
+    reference's GPU builds)."""
+    b, s, d = q.shape
+    h = num_heads
+    if d % h:
+        raise ValueError(f"feature dim {d} not divisible by num_heads {h}")
+
+    def split(x):
+        return x.reshape(b, x.shape[1], h, d // h).transpose(0, 2, 1, 3)
+
+    out = flash_attention(split(q), split(k), split(v), causal=causal, scale=scale)
+    return out.transpose(0, 2, 1, 3).reshape(b, s, d)

@@ -41,7 +41,12 @@ __all__ = [
     "mesh_scope",
 ]
 
-AXES = ("dp", "fsdp", "tp", "pp", "sp", "ep")
+# Outermost → innermost.  jax.devices() enumerates in topology order on TPU
+# and the last axes step fastest through it, so the bandwidth-hungriest
+# axes (tp per-layer collectives, then sp ring traffic) sit innermost =
+# ICI-adjacent; low-traffic axes (pp point-to-point, dp once-per-step psum)
+# sit outermost.
+AXES = ("dp", "pp", "fsdp", "ep", "sp", "tp")
 
 _tls = threading.local()
 
@@ -79,10 +84,7 @@ class MeshConfig:
 def make_mesh(config: MeshConfig | None = None, devices=None, **axis_sizes) -> jax.sharding.Mesh:
     """Build a named mesh.  ``make_mesh(tp=2)`` → dp fills the rest.
 
-    Device order matters for ICI locality: adjacent mesh positions should be
-    ICI neighbors.  ``jax.devices()`` enumerates in topology order on TPU,
-    and the innermost (last) mesh axes step fastest — so put the
-    bandwidth-hungry axes (tp, sp) innermost, which this axis order does.
+    Axis order/locality rationale: see the ``AXES`` comment above.
     """
     if config is None:
         config = MeshConfig(**axis_sizes)

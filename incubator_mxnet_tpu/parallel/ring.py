@@ -27,18 +27,15 @@ __all__ = ["ring_attention", "ring_attention_sharded"]
 
 
 def _online_update(o, m, l, s, v):
-    """One blockwise online-softmax accumulation step.  ``s`` may contain
-    -inf for masked entries; fully-masked rows stay at zero mass."""
-    m_new = jnp.maximum(m, s.max(axis=-1))
-    m_safe = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
-    p = jnp.exp(s - m_safe[..., None])
-    p = jnp.where(jnp.isneginf(s), 0.0, p)
-    corr = jnp.where(jnp.isneginf(m), 0.0, jnp.exp(m - m_safe))
-    l_new = l * corr + p.sum(axis=-1)
-    o_new = o * corr[..., None] + jnp.einsum(
-        "bhqk,bhkd->bhqd", p.astype(v.dtype), v
-    ).astype(jnp.float32)
-    return o_new, m_new, l_new
+    """Blockwise online-softmax step — shares the masked-row algebra with
+    the Pallas flash kernel (ops/attention.py: online_softmax_update);
+    ``m``/``l`` carry a trailing keepdim."""
+    from ..ops.attention import online_softmax_update
+
+    return online_softmax_update(
+        o, m, l, s, v,
+        lambda p, v: jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v).astype(jnp.float32),
+    )
 
 
 def ring_attention(q, k, v, axis_name="sp", causal=False, scale=None):
@@ -59,8 +56,8 @@ def ring_attention(q, k, v, axis_name="sp", causal=False, scale=None):
     # Derive accumulators from q so they carry its device-varying provenance
     # (jax's shard_map vma check requires loop carries to match).
     o = qf * 0.0
-    m = qf[..., 0] * 0.0 - jnp.inf
-    l = qf[..., 0] * 0.0
+    m = qf[..., :1] * 0.0 - jnp.inf
+    l = qf[..., :1] * 0.0
     perm = [(j, (j + 1) % n) for j in range(n)]
 
     def body(i, carry):
@@ -83,7 +80,7 @@ def ring_attention(q, k, v, axis_name="sp", causal=False, scale=None):
         0, (o, m, l, k, v)
     )
     l = jnp.where(l == 0.0, 1.0, l)
-    return (o / l[..., None]).astype(q.dtype)
+    return (o / l).astype(q.dtype)
 
 
 def ring_attention_sharded(q, k, v, mesh, causal=False, scale=None, batch_axes=("dp", "fsdp")):

@@ -118,6 +118,12 @@ class SPMDTrainer:
         if isinstance(params, (dict, ParameterDict)):
             params = list(params.values())
         params.sort(key=lambda p: p.name)
+        for p in params:
+            if p._data is None:
+                raise ValueError(
+                    f"Parameter {p.name} is not materialized (deferred init?). "
+                    "Run one eager forward pass before building SPMDTrainer."
+                )
         self._params = params
         self._trainable_idx = [i for i, p in enumerate(params) if p.grad_req != "null"]
         self._optimizer.param_dict = {i: params[i] for i in self._trainable_idx}

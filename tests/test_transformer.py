@@ -1,0 +1,202 @@
+"""Transformer layers, flash attention (pallas-interpret + reference), BERT."""
+import os
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import gluon
+from incubator_mxnet_tpu.gluon import nn
+from incubator_mxnet_tpu.gluon.model_zoo import bert as bert_zoo
+from incubator_mxnet_tpu.ops import attention as att
+
+import jax
+import jax.numpy as jnp
+
+
+class TestFlashAttention:
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_pallas_interpret_matches_reference(self, causal, monkeypatch):
+        """Flash kernel (interpret mode on CPU) vs plain XLA attention."""
+        rng = np.random.RandomState(0)
+        q = jnp.asarray(rng.randn(3, 2, 128, 32).astype(np.float32))
+        k = jnp.asarray(rng.randn(3, 2, 128, 32).astype(np.float32))
+        v = jnp.asarray(rng.randn(3, 2, 128, 32).astype(np.float32))
+        ref = att.attention_reference(q, k, v, causal=causal)
+        monkeypatch.setenv("MXNET_TPU_FLASH", "interpret")
+        out = att.flash_attention(q, k, v, causal=causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+    def test_gradients_match_reference(self):
+        rng = np.random.RandomState(1)
+        q = jnp.asarray(rng.randn(2, 2, 64, 16).astype(np.float32))
+        k = jnp.asarray(rng.randn(2, 2, 64, 16).astype(np.float32))
+        v = jnp.asarray(rng.randn(2, 2, 64, 16).astype(np.float32))
+
+        def f_flash(q, k, v):
+            return att.flash_attention(q, k, v, causal=True).sum()
+
+        def f_ref(q, k, v):
+            return att.attention_reference(q, k, v, causal=True).sum()
+
+        g1 = jax.grad(f_flash, argnums=(0, 1, 2))(q, k, v)
+        g2 = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g1, g2):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-5)
+
+    def test_nd_contrib_namespace(self):
+        x = mx.nd.random.normal(shape=(2, 16, 32))
+        out = mx.nd.contrib.fused_attention(x, x, x, num_heads=4)
+        assert out.shape == (2, 16, 32)
+
+    def test_bf16_supported(self):
+        rng = np.random.RandomState(2)
+        q = jnp.asarray(rng.randn(1, 2, 64, 16)).astype(jnp.bfloat16)
+        out = att.flash_attention(q, q, q)
+        assert out.dtype == jnp.bfloat16
+
+
+class TestTransformerLayers:
+    def test_encoder_cell_shapes_and_grad(self):
+        mx.random.seed(0)
+        cell = nn.TransformerEncoderCell(units=64, hidden_size=128, num_heads=4)
+        cell.initialize()
+        x = mx.nd.random.normal(shape=(2, 16, 64))
+        with mx.autograd.record():
+            y = cell(x)
+            loss = (y * y).sum()
+        loss.backward()
+        assert y.shape == (2, 16, 64)
+        g = cell.collect_params()[f"{cell.prefix}attn_qkv_weight"].grad()
+        assert float((g.asnumpy() ** 2).sum()) > 0
+
+    def test_encoder_hybridize_consistency(self):
+        mx.random.seed(1)
+        enc = nn.TransformerEncoder(num_layers=2, units=32, hidden_size=64, num_heads=2)
+        enc.initialize()
+        x = mx.nd.random.normal(shape=(2, 8, 32))
+        eager = enc(x).asnumpy()
+        enc.hybridize()
+        jitted = enc(x).asnumpy()
+        np.testing.assert_allclose(eager, jitted, rtol=2e-5, atol=2e-5)
+
+    def test_decoder_cross_attention(self):
+        mx.random.seed(2)
+        dec = nn.TransformerDecoder(num_layers=1, units=32, hidden_size=64, num_heads=2)
+        dec.initialize()
+        tgt = mx.nd.random.normal(shape=(2, 6, 32))
+        mem = mx.nd.random.normal(shape=(2, 10, 32))
+        out = dec(tgt, mem)
+        assert out.shape == (2, 6, 32)
+
+    def test_causal_masking_in_mha(self):
+        """Causal MHA output at position t must not depend on inputs > t."""
+        mx.random.seed(3)
+        mha = nn.MultiHeadAttention(units=16, num_heads=2, causal=True)
+        mha.initialize()
+        x1 = mx.nd.random.normal(shape=(1, 8, 16))
+        y1 = mha(x1).asnumpy()
+        x2 = x1.asnumpy().copy()
+        x2[0, -1] = 99.0  # perturb the last position
+        y2 = mha(mx.nd.array(x2)).asnumpy()
+        np.testing.assert_allclose(y1[0, :-1], y2[0, :-1], rtol=1e-5, atol=1e-5)
+        assert not np.allclose(y1[0, -1], y2[0, -1])
+
+    def test_sinusoidal_positions(self):
+        enc = nn.SinusoidalPositionalEncoding(units=32)
+        x = mx.nd.zeros((1, 10, 32))
+        out = enc(x).asnumpy()
+        assert not np.allclose(out[0, 1], out[0, 2])
+
+    def test_sinusoidal_odd_units(self):
+        enc = nn.SinusoidalPositionalEncoding(units=31)
+        out = enc(mx.nd.zeros((1, 4, 31))).asnumpy()
+        assert out.shape == (1, 4, 31)
+
+    def test_flash_unaligned_seq_falls_back(self, monkeypatch):
+        """Non-power-of-two sequence lengths must not crash the pallas path
+        (falls back to smaller blocks or the XLA reference)."""
+        monkeypatch.setenv("MXNET_TPU_FLASH", "interpret")
+        rng = np.random.RandomState(0)
+        q = jnp.asarray(rng.randn(1, 2, 200, 16).astype(np.float32))
+        out = att.flash_attention(q, q, q, causal=True)
+        ref = att.attention_reference(q, q, q, causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-4)
+
+
+class TestBERT:
+    def _tiny_bert(self, seed=0):
+        mx.random.seed(seed)
+        net = bert_zoo.BERTModel(
+            vocab_size=128, units=32, hidden_size=64, num_layers=2,
+            num_heads=2, max_length=64, dropout=0.0,
+        )
+        net.initialize()
+        return net
+
+    def test_forward_shapes(self):
+        net = self._tiny_bert()
+        ids = mx.nd.array(np.random.RandomState(0).randint(0, 128, (4, 16)), dtype="int32")
+        types = mx.nd.zeros((4, 16), dtype="int32")
+        seq, pooled = net(ids, types)
+        assert seq.shape == (4, 16, 32)
+        assert pooled.shape == (4, 32)
+
+    def test_pretrain_heads_and_training_step(self):
+        mx.random.seed(1)
+        base = bert_zoo.BERTModel(vocab_size=64, units=32, hidden_size=64,
+                                  num_layers=1, num_heads=2, max_length=32, dropout=0.0)
+        model = bert_zoo.BERTForPretrain(base, vocab_size=64)
+        model.initialize()
+        rng = np.random.RandomState(0)
+        ids = mx.nd.array(rng.randint(0, 64, (2, 8)), dtype="int32")
+        labels = mx.nd.array(rng.randint(0, 64, (2, 8)), dtype="float32")
+        trainer = gluon.Trainer(model.collect_params(), "adam", {"learning_rate": 1e-3})
+        loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+        with mx.autograd.record():
+            mlm, nsp = model(ids)
+            loss = loss_fn(mlm.reshape((-1, 64)), labels.reshape((-1,)))
+        loss.backward()
+        trainer.step(ids.shape[0])
+        assert mlm.shape == (2, 8, 64)
+        assert nsp.shape == (2, 2)
+
+    def test_bert_spmd_tp_training(self):
+        """BERT with Megatron-style tp=2 sharding trains and matches the
+        replicated result (XLA-inserted collectives)."""
+        from incubator_mxnet_tpu.parallel import make_mesh, SPMDTrainer
+
+        def make(seed):
+            mx.random.seed(seed)
+            base = bert_zoo.BERTModel(vocab_size=64, units=32, hidden_size=64,
+                                      num_layers=1, num_heads=2, max_length=32,
+                                      dropout=0.0)
+            model = bert_zoo.BERTForPretrain(base, vocab_size=64)
+            model.initialize()
+            model(mx.nd.zeros((2, 8), dtype="int32"))  # materialize deferred shapes
+            return model
+
+        rng = np.random.RandomState(0)
+        ids = mx.nd.array(rng.randint(0, 64, (8, 8)), dtype="int32")
+        labels = rng.randint(0, 64, (8, 8)).astype(np.float32)
+
+        def loss_fn(out, label):
+            mlm, nsp = out
+            return gluon.loss.SoftmaxCrossEntropyLoss()(
+                mlm.reshape((-1, 64)), label.reshape((-1,))
+            )
+
+        m_rep = make(7)
+        m_tp = make(7)
+        a = SPMDTrainer(m_rep, loss_fn, "adam", {"learning_rate": 1e-3},
+                        mesh=make_mesh(dp=8))
+        b = SPMDTrainer(m_tp, loss_fn, "adam", {"learning_rate": 1e-3},
+                        mesh=make_mesh(dp=4, tp=2),
+                        rules=bert_zoo.bert_sharding_rules())
+        la = lb = None
+        for _ in range(2):
+            la = a.step(ids, mx.nd.array(labels))
+            lb = b.step(ids, mx.nd.array(labels))
+        np.testing.assert_allclose(
+            la.asnumpy(), lb.asnumpy(), rtol=2e-4, atol=2e-5
+        )
