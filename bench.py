@@ -1,0 +1,83 @@
+#!/usr/bin/env python
+"""Headline benchmark — BERT-base pretraining throughput (samples/sec).
+
+One fused SPMD train step (forward + backward + Adam, donated buffers) via
+``parallel.SPMDTrainer`` on the local mesh: config 3 of BASELINE.md.  Model
+init runs on the CPU backend (one eager forward for deferred shapes; avoids
+per-op RPCs through the axon tunnel), then parameters are device_put onto
+the accelerator mesh and every step is a single jitted program.
+
+Prints ONE JSON line:
+  {"metric": "bert_base_samples_per_sec", "value": N, "unit":
+   "samples/sec/chip", "vs_baseline": N}
+
+vs_baseline divides by 100 samples/sec/device — recalled MXNet-era
+GluonNLP BERT-base (seq 128, fp16) per-V100 pretraining throughput
+(UNVERIFIED: reference mount was empty; see BASELINE.md provenance note).
+"""
+import json
+import time
+
+import numpy as np
+
+BASELINE_SAMPLES_PER_SEC = 100.0
+
+
+def main():
+    import jax
+
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu.gluon.model_zoo.bert import bert_base, BERTForPretrain
+    from incubator_mxnet_tpu.ndarray.ndarray import NDArray
+    from incubator_mxnet_tpu.parallel import make_mesh, SPMDTrainer
+
+    backend = jax.default_backend()
+    B, S, vocab = 32, 128, 30522
+    warmup, steps = (2, 20) if backend != "cpu" else (1, 2)
+
+    cpu = jax.local_devices(backend="cpu")[0]
+    with jax.default_device(cpu):
+        mx.random.seed(0)
+        bert = bert_base(vocab_size=vocab, max_length=512, dropout=0.1)
+        net = BERTForPretrain(bert, vocab_size=vocab)
+        net.initialize()
+        rng = np.random.RandomState(0)
+        tok = mx.nd.array(rng.randint(0, vocab, (B, S)), dtype="int32")
+        seg = mx.nd.zeros((B, S), dtype="int32")
+        labels = mx.nd.array(rng.randint(0, vocab, (B, S)), dtype="int32")
+        net(tok, seg)  # materialize deferred-init shapes
+
+    def mlm_loss(out, label):
+        import jax.numpy as jnp
+        mlm_logits, _ = out
+        logp = jax.nn.log_softmax(mlm_logits._data.astype(jnp.float32), axis=-1)
+        nll = -jnp.take_along_axis(
+            logp, label._data.astype(jnp.int32)[..., None], axis=-1
+        )[..., 0]
+        return NDArray(nll.mean(axis=-1))
+
+    mesh = make_mesh()  # pure-dp over whatever local devices exist
+    trainer = SPMDTrainer(net, mlm_loss, "adam", {"learning_rate": 1e-4}, mesh=mesh)
+
+    for _ in range(warmup):
+        loss = trainer.step((tok, seg), labels)
+    jax.block_until_ready(trainer._param_arrays)
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = trainer.step((tok, seg), labels)
+    jax.block_until_ready(trainer._param_arrays)
+    dt = time.perf_counter() - t0
+
+    n_chips = mesh.devices.size
+    samples_per_sec = B * steps / dt / n_chips
+    print(json.dumps({
+        "metric": "bert_base_samples_per_sec",
+        "value": round(samples_per_sec, 2),
+        "unit": "samples/sec/chip",
+        "vs_baseline": round(samples_per_sec / BASELINE_SAMPLES_PER_SEC, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

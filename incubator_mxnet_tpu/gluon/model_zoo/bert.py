@@ -14,7 +14,7 @@ from jax.sharding import PartitionSpec as P
 
 from ..block import HybridBlock
 from ..nn import Dense, Dropout, Embedding, LayerNorm
-from ..nn.transformer import TransformerEncoder
+from ..nn.transformer import PositionalEmbedding, TransformerEncoder
 
 __all__ = [
     "BERTModel",
@@ -34,10 +34,11 @@ class BERTModel(HybridBlock):
                  dropout=0.1, dtype="float32", prefix=None, params=None):
         super().__init__(prefix=prefix, params=params)
         self._units = units
+        self._vocab_size = vocab_size
         with self.name_scope():
             self.word_embed = Embedding(vocab_size, units, dtype=dtype, prefix="word_embed_")
             self.token_type_embed = Embedding(type_vocab, units, dtype=dtype, prefix="type_embed_")
-            self.position_embed = Embedding(max_length, units, dtype=dtype, prefix="pos_embed_")
+            self.position_embed = PositionalEmbedding(max_length, units, dtype=dtype, prefix="pos_embed_")
             self.embed_ln = LayerNorm(prefix="embed_ln_")
             self.encoder = TransformerEncoder(
                 num_layers, units, hidden_size, num_heads, dropout=dropout,
@@ -54,8 +55,7 @@ class BERTModel(HybridBlock):
         x = self.word_embed(token_ids)
         if token_types is not None:
             x = x + self.token_type_embed(token_types)
-        positions = F.arange(0, token_ids.shape[1], dtype="int32")
-        x = x + self.position_embed(positions)
+        x = self.position_embed(x)
         x = self.embed_ln(x)
         if self._embed_dropout is not None:
             x = self._embed_dropout(x)
@@ -67,10 +67,12 @@ class BERTModel(HybridBlock):
 class BERTForPretrain(HybridBlock):
     """MLM head (tied-style decoder over vocab) + NSP head."""
 
-    def __init__(self, bert: BERTModel, vocab_size=30522, prefix=None, params=None):
+    def __init__(self, bert: BERTModel, vocab_size=None, prefix=None, params=None):
         super().__init__(prefix=prefix, params=params)
         self.bert = bert
         units = bert._units
+        if vocab_size is None:
+            vocab_size = bert._vocab_size  # MLM decoder must match the embedding vocab
         with self.name_scope():
             self.mlm_transform = Dense(units, activation=None, flatten=False, prefix="mlm_dense_")
             self.mlm_ln = LayerNorm(prefix="mlm_ln_")

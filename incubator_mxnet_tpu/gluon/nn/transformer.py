@@ -259,4 +259,5 @@ class SinusoidalPositionalEncoding(HybridBlock):
         from ...ndarray.ndarray import NDArray
 
         seq = x.shape[1]
-        return x + NDArray(jnp.asarray(self._table[:seq]))
+        table = jnp.asarray(self._table[:seq]).astype(x.dtype)  # no bf16→f32 promotion
+        return x + NDArray(table)
