@@ -49,6 +49,12 @@ from . import kvstore
 from . import kvstore as kv
 from . import gluon
 from . import model
+from . import symbol
+from . import symbol as sym
+from .executor import Executor
+from . import io
+from . import module
+from . import module as mod
 from .util import np_shape, np_array, is_np_shape, is_np_array, set_np, reset_np
 from . import numpy_ns as np  # mx.np numpy-compat namespace
 from .utils import test_utils
@@ -56,6 +62,12 @@ from .utils import test_utils
 __all__ = [
     "nd",
     "np",
+    "sym",
+    "symbol",
+    "Executor",
+    "io",
+    "module",
+    "mod",
     "autograd",
     "random",
     "engine",

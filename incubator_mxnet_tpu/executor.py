@@ -1,0 +1,278 @@
+"""Executor — runs a bound Symbol graph as one jit-compiled XLA program.
+
+Parity target: ``GraphExecutor`` ([U:src/executor/graph_executor.cc]) and
+its Python wrapper ([U:python/mxnet/executor.py]).  The reference's
+bind-time passes (InferShape/InferType, PlanMemory, AttachOpExecs) collapse
+into XLA compilation: the graph is interpreted once per input-shape
+signature inside ``jax.jit`` — memory planning, in-place reuse, fusion and
+scheduling are the compiler's.  ``backward`` is ``jax.vjp`` of the same
+program (the nnvm Gradient pass analog), with gradients DCE'd by XLA down
+to the ``grad_req != 'null'`` subset.
+
+BatchNorm-style auxiliary states: the op returns batch stats functionally;
+the executor blends them into the moving stats inside the jitted train
+forward and writes them back after execution (the reference mutates aux
+arrays inside the op kernel).
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+import jax
+import jax.numpy as jnp
+
+from . import autograd
+from .base import _as_np_dtype
+from .context import current_context
+from .ndarray.ndarray import NDArray
+from .ops.registry import get_op
+from .random import get_key, push_traced_key, pop_traced_key
+
+__all__ = ["Executor"]
+
+
+def _as_ndarray(v, dtype=None):
+    if isinstance(v, NDArray):
+        return v
+    arr = jnp.asarray(_np.asarray(v, dtype=dtype))
+    return NDArray(arr)
+
+
+class Executor:
+    def __init__(self, symbol, ctx=None, args=None, args_grad=None,
+                 grad_req="write", aux_states=None):
+        self._symbol = symbol
+        self._ctx = ctx or current_context()
+        self.outputs = []
+
+        arg_names = symbol.list_arguments()
+        aux_names = symbol.list_auxiliary_states()
+
+        if isinstance(args, (list, tuple)):
+            args = dict(zip(arg_names, args))
+        args = {k: _as_ndarray(v) for k, v in (args or {}).items()}
+        if isinstance(aux_states, (list, tuple)):
+            aux_states = dict(zip(aux_names, aux_states))
+        aux_states = {k: _as_ndarray(v) for k, v in (aux_states or {}).items()}
+
+        self._arg_dict = args
+        self._aux_dict = aux_states
+
+        # grad_req: str | list | dict  → per-arg dict
+        if isinstance(grad_req, str):
+            grad_req = {n: grad_req for n in arg_names}
+        elif isinstance(grad_req, (list, tuple)):
+            grad_req = dict(zip(arg_names, grad_req))
+        self._grad_req = {n: grad_req.get(n, "null") for n in arg_names}
+
+        if isinstance(args_grad, (list, tuple)):
+            args_grad = dict(zip(arg_names, args_grad))
+        self._grad_dict = {k: _as_ndarray(v) for k, v in (args_grad or {}).items()}
+        for n in arg_names:
+            if self._grad_req[n] != "null" and n not in self._grad_dict:
+                if n in args:
+                    self._grad_dict[n] = NDArray(jnp.zeros_like(args[n]._data))
+
+        self._fwd_cache = {}
+        self._bwd_cache = {}
+        self._last_batch_sig = None
+
+    # ------------------------------------------------------------------
+    @classmethod
+    def simple_bind(cls, symbol, ctx=None, grad_req="write", type_dict=None,
+                    **shapes):
+        """Infer all shapes from the given input shapes, allocate zeroed
+        arg/aux/grad arrays (parity: ``Symbol.simple_bind``; the user then
+        fills params via an initializer)."""
+        arg_shapes, _, aux_shapes = symbol.infer_shape(**shapes)
+        arg_dtypes, _, aux_dtypes = symbol.infer_type(
+            **{k: tuple(v) for k, v in shapes.items()})
+        type_dict = type_dict or {}
+
+        args, auxs = {}, {}
+        for name, shape, dt in zip(symbol.list_arguments(), arg_shapes, arg_dtypes):
+            if shape is None:
+                raise ValueError(f"simple_bind: could not infer shape of {name!r}")
+            dtype = _as_np_dtype(type_dict.get(name, dt or "float32"))
+            args[name] = NDArray(jnp.zeros(shape, dtype))
+        for name, shape, dt in zip(symbol.list_auxiliary_states(), aux_shapes, aux_dtypes):
+            dtype = _as_np_dtype(type_dict.get(name, dt or "float32"))
+            auxs[name] = NDArray(jnp.zeros(shape, dtype))
+        return cls(symbol, ctx, args=args, grad_req=grad_req, aux_states=auxs)
+
+    # ------------------------------------------------------------------
+    @property
+    def arg_dict(self):
+        return self._arg_dict
+
+    @property
+    def grad_dict(self):
+        return self._grad_dict
+
+    @property
+    def aux_dict(self):
+        return self._aux_dict
+
+    @property
+    def arg_arrays(self):
+        return [self._arg_dict[n] for n in self._symbol.list_arguments()]
+
+    @property
+    def grad_arrays(self):
+        return [self._grad_dict.get(n) for n in self._symbol.list_arguments()]
+
+    @property
+    def aux_arrays(self):
+        return [self._aux_dict[n] for n in self._symbol.list_auxiliary_states()]
+
+    # ------------------------------------------------------------------
+    def _graph_eval(self, var_arrays, training):
+        """Interpret the graph over raw jax arrays.  Returns (outputs,
+        aux_updates) where aux_updates maps aux var name → new value."""
+        sym = self._symbol
+        values = {}
+        aux_updates = {}
+        for node in sym._topo():
+            if node.op is None:
+                values[id(node)] = (var_arrays[node.name],)
+                continue
+            ins = [values[id(src)][idx] for src, idx in node.inputs]
+            attrs = {k: v for k, v in node.attrs.items() if not k.startswith("__")}
+            op = get_op(node.op)
+            out = op.fn(*ins, **attrs)
+            values[id(node)] = out if isinstance(out, tuple) else (out,)
+            if node.op == "BatchNorm" and training and not attrs.get("use_global_stats", False):
+                names = node.attrs.get("__input_names__") or []
+                momentum = attrs.get("momentum", 0.9)
+                _, bmean, bvar = values[id(node)][:3]
+                for (src, _), pname in zip(node.inputs, names):
+                    if pname == "moving_mean":
+                        aux_updates[src.name] = (
+                            momentum * var_arrays[src.name] + (1 - momentum) * bmean)
+                    elif pname == "moving_var":
+                        aux_updates[src.name] = (
+                            momentum * var_arrays[src.name] + (1 - momentum) * bvar)
+        outs = [values[id(node)][idx] for node, idx in sym._outputs]
+        return outs, aux_updates
+
+    def _collect_inputs(self):
+        arrays = {}
+        for d in (self._arg_dict, self._aux_dict):
+            for k, v in d.items():
+                arrays[k] = v._data
+        return arrays
+
+    def _signature(self, arrays):
+        return tuple(sorted((k, v.shape, str(v.dtype)) for k, v in arrays.items()))
+
+    # ------------------------------------------------------------------
+    def forward(self, is_train=False, **kwargs):
+        for k, v in kwargs.items():
+            if k not in self._arg_dict:
+                raise ValueError(
+                    f"forward: {k!r} is not an argument of this executor "
+                    f"(arguments: {sorted(self._arg_dict)})")
+            nd = _as_ndarray(v, dtype=self._arg_dict[k].dtype)
+            self._arg_dict[k]._data = nd._data.astype(self._arg_dict[k].dtype)
+            self._arg_dict[k]._version += 1
+        arrays = self._collect_inputs()
+        sig = (self._signature(arrays), bool(is_train))
+        fn = self._fwd_cache.get(sig)
+        if fn is None:
+            training = bool(is_train)
+
+            def pure(var_arrays, key):
+                push_traced_key(key)
+                try:
+                    with autograd._scope(False, training):
+                        return self._graph_eval(var_arrays, training)
+                finally:
+                    pop_traced_key()
+
+            fn = jax.jit(pure)
+            self._fwd_cache[sig] = fn
+        outs, aux_updates = fn(arrays, get_key())
+        self.outputs = [NDArray(o, ctx=self._ctx) for o in outs]
+        for name, new in aux_updates.items():
+            self._aux_dict[name]._data = new
+            self._aux_dict[name]._version += 1
+        self._last_batch_sig = sig[0]
+        return self.outputs
+
+    # ------------------------------------------------------------------
+    def backward(self, out_grads=None, is_train=True):
+        arrays = self._collect_inputs()
+        wrt = [n for n, r in self._grad_req.items() if r != "null"]
+        if not wrt:
+            return
+        sig = self._signature(arrays)
+        fn = self._bwd_cache.get(sig)
+        if fn is None:
+
+            def pure_grads(var_arrays, key, cotangents):
+                push_traced_key(key)
+                try:
+                    with autograd._scope(False, True):
+                        def outs_of(wrt_arrays):
+                            merged = dict(var_arrays)
+                            merged.update(wrt_arrays)
+                            outs, _ = self._graph_eval(merged, True)
+                            return outs
+
+                        wrt_arrays = {n: var_arrays[n] for n in wrt}
+                        outs, vjp_fn = jax.vjp(outs_of, wrt_arrays)
+                        if cotangents is None:
+                            cotangents = [jnp.ones_like(o) for o in outs]
+                        else:
+                            cotangents = [c.astype(o.dtype) for c, o in zip(cotangents, outs)]
+                        (grads,) = vjp_fn(list(cotangents))
+                        return grads
+                finally:
+                    pop_traced_key()
+
+            fn = jax.jit(pure_grads)
+            self._bwd_cache[sig] = fn
+
+        if out_grads is not None:
+            if isinstance(out_grads, NDArray):
+                out_grads = [out_grads]
+            out_grads = [g._data if isinstance(g, NDArray) else jnp.asarray(g)
+                         for g in out_grads]
+        grads = fn(arrays, get_key(), out_grads)
+        for name, g in grads.items():
+            req = self._grad_req[name]
+            tgt = self._grad_dict.get(name)
+            if tgt is None:
+                tgt = self._grad_dict[name] = NDArray(jnp.zeros_like(g))
+            if req == "add":
+                tgt._data = tgt._data + g
+            else:
+                tgt._data = g.astype(tgt._data.dtype)
+            tgt._version += 1
+
+    # ------------------------------------------------------------------
+    def copy_params_from(self, arg_params, aux_params=None,
+                         allow_extra_params=False):
+        for k, v in (arg_params or {}).items():
+            if k in self._arg_dict:
+                self._arg_dict[k]._data = _as_ndarray(v)._data.astype(self._arg_dict[k].dtype)
+                self._arg_dict[k]._version += 1
+            elif not allow_extra_params:
+                raise ValueError(f"unknown argument {k!r}")
+        for k, v in (aux_params or {}).items():
+            if k in self._aux_dict:
+                self._aux_dict[k]._data = _as_ndarray(v)._data.astype(self._aux_dict[k].dtype)
+                self._aux_dict[k]._version += 1
+            elif not allow_extra_params:
+                raise ValueError(f"unknown aux state {k!r}")
+
+    def reshape(self, partial_shaping=False, allow_up_sizing=False, **new_shapes):
+        """Rebind with new input shapes sharing weights (the bucketing
+        primitive — cheap here: just a new jit signature)."""
+        args = dict(self._arg_dict)
+        for k, shape in new_shapes.items():
+            if k in args:
+                args[k] = NDArray(jnp.zeros(shape, args[k].dtype))
+        ex = Executor(self._symbol, self._ctx, args=args,
+                      grad_req=self._grad_req, aux_states=dict(self._aux_dict))
+        return ex

@@ -1,0 +1,20 @@
+"""``mx.io`` namespace (parity: [U:python/mxnet/io/])."""
+from .io import (
+    DataDesc,
+    DataBatch,
+    DataIter,
+    NDArrayIter,
+    ResizeIter,
+    PrefetchingIter,
+    CSVIter,
+)
+
+__all__ = [
+    "DataDesc",
+    "DataBatch",
+    "DataIter",
+    "NDArrayIter",
+    "ResizeIter",
+    "PrefetchingIter",
+    "CSVIter",
+]

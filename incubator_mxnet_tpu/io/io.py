@@ -1,0 +1,411 @@
+"""``mx.io`` — legacy data-iterator API.
+
+Parity target: [U:python/mxnet/io/io.py] (DataIter/DataBatch/DataDesc,
+NDArrayIter, ResizeIter, PrefetchingIter).  The C++ record-file iterators
+([U:src/io/]) are provided by :mod:`incubator_mxnet_tpu.recordio` and the
+native pipeline; this module is the pure-Python contract the Module API
+trains from.
+"""
+from __future__ import annotations
+
+import threading
+import queue as _queue
+
+import numpy as _np
+
+from ..ndarray.ndarray import NDArray
+from .. import ndarray as nd
+
+__all__ = [
+    "DataDesc",
+    "DataBatch",
+    "DataIter",
+    "NDArrayIter",
+    "ResizeIter",
+    "PrefetchingIter",
+    "CSVIter",
+]
+
+
+class DataDesc:
+    """Shape/dtype descriptor of one input (parity: ``DataDesc`` — a
+    namedtuple in the reference; kept a small class for layout attrs)."""
+
+    __slots__ = ("name", "shape", "dtype", "layout")
+
+    def __init__(self, name, shape, dtype=_np.float32, layout="NCHW"):
+        self.name = name
+        self.shape = tuple(shape)
+        self.dtype = _np.dtype(dtype)
+        self.layout = layout
+
+    def __iter__(self):  # tuple-unpacking compat: name, shape
+        return iter((self.name, self.shape))
+
+    def __getitem__(self, i):
+        return (self.name, self.shape, self.dtype, self.layout)[i]
+
+    def __repr__(self):
+        return f"DataDesc[{self.name},{self.shape},{self.dtype},{self.layout}]"
+
+    @staticmethod
+    def get_batch_axis(layout):
+        if layout is None:
+            return 0
+        return layout.find("N")
+
+
+class DataBatch:
+    """One minibatch: lists of data/label NDArrays + padding bookkeeping."""
+
+    def __init__(self, data, label=None, pad=None, index=None,
+                 bucket_key=None, provide_data=None, provide_label=None):
+        self.data = data
+        self.label = label
+        self.pad = pad
+        self.index = index
+        self.bucket_key = bucket_key
+        self.provide_data = provide_data
+        self.provide_label = provide_label
+
+    def __repr__(self):
+        shapes = [getattr(d, "shape", None) for d in (self.data or [])]
+        return f"DataBatch: data shapes {shapes} pad {self.pad}"
+
+
+class DataIter:
+    """Iterator contract (parity: ``mx.io.DataIter``)."""
+
+    def __init__(self, batch_size=0):
+        self.batch_size = batch_size
+
+    def __iter__(self):
+        return self
+
+    def reset(self):
+        pass
+
+    def next(self):
+        if self.iter_next():
+            return DataBatch(self.getdata(), self.getlabel(),
+                             pad=self.getpad(), index=self.getindex())
+        raise StopIteration
+
+    def __next__(self):
+        return self.next()
+
+    def iter_next(self):
+        raise NotImplementedError
+
+    def getdata(self):
+        raise NotImplementedError
+
+    def getlabel(self):
+        raise NotImplementedError
+
+    def getindex(self):
+        return None
+
+    def getpad(self):
+        return 0
+
+
+def _init_data(data, allow_empty, default_name):
+    """Normalize data argument to list of (name, ndarray) (parity:
+    ``_init_data`` in the reference)."""
+    if data is None:
+        if not allow_empty:
+            raise ValueError("data cannot be None")
+        return []
+    if isinstance(data, (NDArray, _np.ndarray)):
+        data = [data]
+    if isinstance(data, (list, tuple)):
+        if not allow_empty and len(data) == 0:
+            raise ValueError("data cannot be empty")
+        data = {(default_name if i == 0 and len(data) == 1 else f"_{i}_{default_name}"): d
+                for i, d in enumerate(data)}
+    if not isinstance(data, dict):
+        raise TypeError("data must be NDArray, numpy array, list or dict")
+    out = []
+    for k, v in data.items():
+        if isinstance(v, NDArray):
+            v = v.asnumpy()
+        out.append((k, _np.asarray(v)))
+    return out
+
+
+class NDArrayIter(DataIter):
+    """In-memory iterator (parity: ``mx.io.NDArrayIter``), incl.
+    ``last_batch_handle`` = 'pad' | 'discard' | 'roll_over' and shuffle."""
+
+    def __init__(self, data, label=None, batch_size=1, shuffle=False,
+                 last_batch_handle="pad", data_name="data",
+                 label_name="softmax_label"):
+        super().__init__(batch_size)
+        self.data = _init_data(data, allow_empty=False, default_name=data_name)
+        self.label = _init_data(label, allow_empty=True, default_name=label_name)
+        self.num_data = self.data[0][1].shape[0]
+        self.shuffle = shuffle
+        self.last_batch_handle = last_batch_handle
+        self.cursor = -batch_size
+        self._carry = _np.array([], dtype=_np.int64)  # roll_over leftovers
+        self._order = _np.arange(self.num_data)
+        if last_batch_handle == "discard":
+            self.num_batches = self.num_data // batch_size
+        else:
+            self.num_batches = (self.num_data + batch_size - 1) // batch_size
+        self._rng = _np.random.RandomState(0)
+        if shuffle:
+            self._rng.shuffle(self._order)
+
+    @property
+    def provide_data(self):
+        return [DataDesc(k, (self.batch_size,) + v.shape[1:], v.dtype)
+                for k, v in self.data]
+
+    @property
+    def provide_label(self):
+        return [DataDesc(k, (self.batch_size,) + v.shape[1:], v.dtype)
+                for k, v in self.label]
+
+    def reset(self):
+        if self.last_batch_handle == "roll_over":
+            # unconsumed tail rolls into the next epoch's first batch
+            # (parity: the reference defers the partial batch, it does NOT
+            # pad it — padding would double-count samples in metrics)
+            consumed = max(self.cursor, 0)
+            self._carry = self._order[consumed:] if consumed < len(self._order) else \
+                _np.array([], dtype=_np.int64)
+        self.cursor = -self.batch_size
+        base = _np.arange(self.num_data)
+        if self.shuffle:
+            self._rng.shuffle(base)
+        self._order = _np.concatenate([self._carry, base]) if len(self._carry) else base
+
+    def iter_next(self):
+        self.cursor += self.batch_size
+        if self.last_batch_handle in ("discard", "roll_over"):
+            return self.cursor + self.batch_size <= len(self._order)
+        return self.cursor < self.num_data
+
+    def _slice(self, arrays):
+        out = []
+        total = len(self._order)
+        for _, arr in arrays:
+            lo = self.cursor
+            hi = self.cursor + self.batch_size
+            if hi <= total:
+                idx = self._order[lo:hi]
+            else:  # pad by wrapping (parity: 'pad' repeats head samples)
+                idx = _np.concatenate([self._order[lo:],
+                                       self._order[: hi - total]])
+            out.append(nd.array(arr[idx], dtype=arr.dtype))
+        return out
+
+    def getdata(self):
+        return self._slice(self.data)
+
+    def getlabel(self):
+        return self._slice(self.label)
+
+    def getpad(self):
+        hi = self.cursor + self.batch_size
+        if self.last_batch_handle == "pad" and hi > self.num_data:
+            return hi - self.num_data
+        return 0
+
+    def getindex(self):
+        hi = min(self.cursor + self.batch_size, self.num_data)
+        return self._order[self.cursor:hi]
+
+
+class ResizeIter(DataIter):
+    """Resize an iterator to ``size`` batches per epoch (parity:
+    ``mx.io.ResizeIter``; loops the underlying iter if needed)."""
+
+    def __init__(self, data_iter, size, reset_internal=True):
+        super().__init__(data_iter.batch_size)
+        self.data_iter = data_iter
+        self.size = size
+        self.reset_internal = reset_internal
+        self.cur = 0
+        self.current_batch = None
+
+    @property
+    def provide_data(self):
+        return self.data_iter.provide_data
+
+    @property
+    def provide_label(self):
+        return self.data_iter.provide_label
+
+    def reset(self):
+        self.cur = 0
+        if self.reset_internal:
+            self.data_iter.reset()
+
+    def iter_next(self):
+        if self.cur == self.size:
+            return False
+        try:
+            self.current_batch = self.data_iter.next()
+        except StopIteration:
+            self.data_iter.reset()
+            self.current_batch = self.data_iter.next()
+        self.cur += 1
+        return True
+
+    def next(self):
+        if self.iter_next():
+            return self.current_batch
+        raise StopIteration
+
+    def getdata(self):
+        return self.current_batch.data
+
+    def getlabel(self):
+        return self.current_batch.label
+
+    def getindex(self):
+        return self.current_batch.index
+
+    def getpad(self):
+        return self.current_batch.pad
+
+
+class PrefetchingIter(DataIter):
+    """Double-buffer prefetch on a worker thread (parity:
+    ``mx.io.PrefetchingIter`` / the C++ ThreadedIter — [U:src/io/
+    iter_prefetcher.h]).  Overlaps host batch prep with device compute."""
+
+    def __init__(self, iters, rename_data=None, rename_label=None, depth=2):
+        if not isinstance(iters, (list, tuple)):
+            iters = [iters]
+        super().__init__(iters[0].batch_size)
+        if len(iters) != 1:
+            raise NotImplementedError("composite prefetch not supported; pass one iter")
+        self.data_iter = iters[0]
+        self._queue = _queue.Queue(maxsize=depth)
+        self._stop = threading.Event()
+        self._thread = None
+        self.current_batch = None
+        self._start()
+
+    @property
+    def provide_data(self):
+        return self.data_iter.provide_data
+
+    @property
+    def provide_label(self):
+        return self.data_iter.provide_label
+
+    def _worker(self):
+        while not self._stop.is_set():
+            try:
+                batch = self.data_iter.next()
+            except StopIteration:
+                batch = None
+            # bounded put that notices reset(): never blocks forever with a
+            # stale pre-reset batch (that race duplicated epoch tails)
+            while not self._stop.is_set():
+                try:
+                    self._queue.put(batch, timeout=0.05)
+                    break
+                except _queue.Full:
+                    continue
+            if batch is None:
+                return
+
+    def _start(self):
+        self._thread = threading.Thread(target=self._worker, daemon=True)
+        self._thread.start()
+
+    def reset(self):
+        self._stop.set()
+        # drain until the worker exits so no pre-reset batch survives
+        while self._thread.is_alive():
+            try:
+                self._queue.get(timeout=0.05)
+            except _queue.Empty:
+                pass
+        self._thread.join()
+        try:
+            while True:
+                self._queue.get_nowait()
+        except _queue.Empty:
+            pass
+        self._stop.clear()
+        self.data_iter.reset()
+        self._start()
+
+    def iter_next(self):
+        batch = self._queue.get()
+        if batch is None:
+            return False
+        self.current_batch = batch
+        return True
+
+    def next(self):
+        if self.iter_next():
+            return self.current_batch
+        raise StopIteration
+
+    def getdata(self):
+        return self.current_batch.data
+
+    def getlabel(self):
+        return self.current_batch.label
+
+    def getindex(self):
+        return self.current_batch.index
+
+    def getpad(self):
+        return self.current_batch.pad
+
+
+class CSVIter(DataIter):
+    """CSV reader (parity: [U:src/io/iter_csv.cc] exposed as mx.io.CSVIter).
+    Loads into memory then delegates to NDArrayIter semantics."""
+
+    def __init__(self, data_csv, data_shape, label_csv=None, label_shape=(1,),
+                 batch_size=1, round_batch=True, **kwargs):
+        super().__init__(batch_size)
+        data = _np.loadtxt(data_csv, delimiter=",", dtype=_np.float32)
+        data = data.reshape((-1,) + tuple(data_shape))
+        label = None
+        if label_csv is not None:
+            label = _np.loadtxt(label_csv, delimiter=",", dtype=_np.float32)
+            label = label.reshape((-1,) + tuple(label_shape))
+        self._iter = NDArrayIter(
+            data, label, batch_size,
+            last_batch_handle="pad" if round_batch else "discard",
+            data_name="data", label_name="label")
+
+    @property
+    def provide_data(self):
+        return self._iter.provide_data
+
+    @property
+    def provide_label(self):
+        return self._iter.provide_label
+
+    def reset(self):
+        self._iter.reset()
+
+    def next(self):
+        return self._iter.next()
+
+    def iter_next(self):
+        return self._iter.iter_next()
+
+    def getdata(self):
+        return self._iter.getdata()
+
+    def getlabel(self):
+        return self._iter.getlabel()
+
+    def getpad(self):
+        return self._iter.getpad()
+
+    def getindex(self):
+        return self._iter.getindex()

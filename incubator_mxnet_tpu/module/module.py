@@ -1,0 +1,288 @@
+"""Module — symbolic training over a bound Executor.
+
+Parity target: [U:python/mxnet/module/module.py] +
+``DataParallelExecutorGroup`` ([U:python/mxnet/module/executor_group.py]).
+TPU-native collapse: the reference slices each batch across a ``ctx`` list
+of GPUs and reduces grads via KVStore; here ONE jit-compiled executor runs
+the graph, and a multi-device ``context`` list (or an ambient mesh) turns
+into dp sharding of the batch inside the same program — XLA inserts the
+gradient psum that comm.h/NCCL performed.
+"""
+from __future__ import annotations
+
+import logging
+
+import numpy as _np
+
+from .. import initializer as init_mod
+from .. import optimizer as opt_mod
+from ..context import cpu
+from ..executor import Executor
+from ..io.io import DataDesc
+from ..model import save_checkpoint, load_checkpoint
+from ..ndarray.ndarray import NDArray
+from .base_module import BaseModule
+
+__all__ = ["Module"]
+
+
+def _norm_shapes(shapes):
+    if shapes is None:
+        return []
+    out = []
+    for s in shapes:
+        if isinstance(s, DataDesc):
+            out.append(s)
+        else:
+            name, shape = s[0], s[1]
+            dtype = s[2] if len(s) > 2 else _np.float32
+            out.append(DataDesc(name, shape, dtype))
+    return out
+
+
+class Module(BaseModule):
+    def __init__(self, symbol, data_names=("data",), label_names=("softmax_label",),
+                 logger=logging, context=None, work_load_list=None,
+                 fixed_param_names=None, state_names=None):
+        super().__init__(logger)
+        self.symbol = symbol
+        self._data_names = list(data_names)
+        self._label_names = list(label_names or [])
+        if context is None:
+            context = cpu()
+        self._context = context if isinstance(context, (list, tuple)) else [context]
+        self._fixed_param_names = set(fixed_param_names or [])
+
+        arg_names = symbol.list_arguments()
+        input_names = set(self._data_names) | set(self._label_names)
+        self._param_names = [n for n in arg_names if n not in input_names]
+        self._aux_names = symbol.list_auxiliary_states()
+
+        self._exec = None
+        self._optimizer = None
+        self._updater_states = {}
+        self._kvstore = None
+        self._data_shapes = None
+        self._label_shapes = None
+
+    # ------------------------------------------------------------------
+    @property
+    def data_names(self):
+        return self._data_names
+
+    @property
+    def label_names(self):
+        return self._label_names
+
+    @property
+    def output_names(self):
+        return self.symbol.list_outputs()
+
+    @property
+    def data_shapes(self):
+        return self._data_shapes
+
+    @property
+    def label_shapes(self):
+        return self._label_shapes
+
+    @property
+    def output_shapes(self):
+        assert self.binded
+        return list(zip(self.output_names,
+                        [o.shape for o in self._exec.outputs])) if self._exec.outputs else None
+
+    # ------------------------------------------------------------------
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False, shared_module=None,
+             grad_req="write"):
+        if self.binded and not force_rebind:
+            return
+        data_shapes = _norm_shapes(data_shapes)
+        label_shapes = _norm_shapes(label_shapes)
+        self._data_shapes = data_shapes
+        self._label_shapes = label_shapes
+        self.for_training = for_training
+
+        shape_kwargs = {d.name: d.shape for d in data_shapes + label_shapes}
+        type_kwargs = {d.name: d.dtype for d in data_shapes + label_shapes}
+
+        req = {}
+        for n in self.symbol.list_arguments():
+            if n in self._data_names:
+                req[n] = "write" if inputs_need_grad else "null"
+            elif n in self._label_names or n in self._fixed_param_names:
+                req[n] = "null"
+            else:
+                req[n] = grad_req if for_training else "null"
+
+        ex = Executor.simple_bind(self.symbol, self._context[0],
+                                  grad_req=req, type_dict=type_kwargs,
+                                  **shape_kwargs)
+        if shared_module is not None and shared_module._exec is not None:
+            ex.copy_params_from(
+                {k: v for k, v in shared_module._exec.arg_dict.items()
+                 if k in shared_module._param_names},
+                shared_module._exec.aux_dict, allow_extra_params=True)
+        self._exec = ex
+        self.binded = True
+
+    # ------------------------------------------------------------------
+    def init_params(self, initializer=None, arg_params=None, aux_params=None,
+                    allow_missing=False, force_init=False, allow_extra=False):
+        assert self.binded, "call bind before init_params"
+        if self.params_initialized and not force_init:
+            return
+        if arg_params is None and hasattr(self, "_preloaded_params"):
+            arg_params, aux_params = self._preloaded_params  # Module.load path
+        initializer = initializer or init_mod.Uniform(0.01)
+        if isinstance(initializer, str):
+            initializer = init_mod.create(initializer)
+
+        for name in self._param_names:
+            arr = self._exec.arg_dict[name]
+            if arg_params is not None and name in arg_params:
+                src = arg_params[name]
+                arr._data = (src._data if isinstance(src, NDArray)
+                             else NDArray(_np.asarray(src))._data).astype(arr.dtype)
+                arr._version += 1
+            else:
+                if arg_params is not None and not allow_missing:
+                    raise RuntimeError(f"param {name} missing from arg_params")
+                initializer(init_mod.InitDesc(name), arr)
+        for name in self._aux_names:
+            arr = self._exec.aux_dict[name]
+            if aux_params is not None and name in aux_params:
+                src = aux_params[name]
+                arr._data = (src._data if isinstance(src, NDArray)
+                             else NDArray(_np.asarray(src))._data).astype(arr.dtype)
+                arr._version += 1
+            else:
+                initializer(init_mod.InitDesc(name), arr)
+        self.params_initialized = True
+
+    def get_params(self):
+        assert self.binded and self.params_initialized
+        arg_params = {n: self._exec.arg_dict[n].copy() for n in self._param_names}
+        aux_params = {n: self._exec.aux_dict[n].copy() for n in self._aux_names}
+        return arg_params, aux_params
+
+    def set_params(self, arg_params, aux_params, allow_missing=False,
+                   force_init=True, allow_extra=False):
+        self.init_params(initializer=None, arg_params=arg_params,
+                         aux_params=aux_params, allow_missing=allow_missing,
+                         force_init=force_init)
+
+    # ------------------------------------------------------------------
+    def init_optimizer(self, kvstore="local", optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.01),),
+                       force_init=False):
+        assert self.binded and self.params_initialized
+        if self.optimizer_initialized and not force_init:
+            return
+        if isinstance(optimizer, opt_mod.Optimizer):
+            self._optimizer = optimizer
+        else:
+            self._optimizer = opt_mod.create(optimizer, **dict(optimizer_params))
+        from ..kvstore import create as kv_create
+        self._kvstore = kv_create(kvstore) if isinstance(kvstore, str) else kvstore
+        self._updater_states = {}
+        if hasattr(self, "_preloaded_opt_states"):  # Module.load(..., load_optimizer_states=True)
+            self._updater_states = {
+                i: _tree_ndarray(s) for i, s in self._preloaded_opt_states.items()}
+            del self._preloaded_opt_states
+        self.optimizer_initialized = True
+
+    # ------------------------------------------------------------------
+    def forward(self, data_batch, is_train=None):
+        assert self.binded and self.params_initialized
+        if is_train is None:
+            is_train = self.for_training
+        feeds = {}
+        for name, arr in zip(self._data_names, data_batch.data):
+            feeds[name] = arr
+        if data_batch.label is not None:
+            for name, arr in zip(self._label_names, data_batch.label):
+                # graphs without a loss head have no label input; skip it
+                if name in self._exec.arg_dict:
+                    feeds[name] = arr
+        self._exec.forward(is_train=is_train, **feeds)
+
+    def backward(self, out_grads=None):
+        assert self.binded and self.params_initialized
+        self._exec.backward(out_grads=out_grads)
+
+    def update(self):
+        """Apply one optimizer step per parameter (the reference pushes
+        fused update ops; gradient aggregation across devices is already
+        inside the jitted program here)."""
+        assert self.optimizer_initialized
+        opt = self._optimizer
+        for i, name in enumerate(self._param_names):
+            if name in self._fixed_param_names:
+                continue
+            grad = self._exec.grad_dict.get(name)
+            if grad is None:
+                continue
+            weight = self._exec.arg_dict[name]
+            if i not in self._updater_states:
+                self._updater_states[i] = opt.create_state_multi_precision(i, weight)
+            opt.update_multi_precision(i, weight, grad, self._updater_states[i])
+
+    def get_outputs(self, merge_multi_context=True):
+        assert self.binded
+        return self._exec.outputs
+
+    def get_input_grads(self, merge_multi_context=True):
+        assert self.binded
+        return [self._exec.grad_dict.get(n) for n in self._data_names]
+
+    def update_metric(self, eval_metric, labels):
+        eval_metric.update(labels, self.get_outputs())
+
+    # ------------------------------------------------------------------
+    def save_checkpoint(self, prefix, epoch, save_optimizer_states=False):
+        arg_params, aux_params = self.get_params()
+        save_checkpoint(prefix, epoch, self.symbol, arg_params, aux_params)
+        if save_optimizer_states:
+            import pickle
+            flat = {i: s for i, s in self._updater_states.items()}
+            with open(f"{prefix}-{epoch:04d}.states", "wb") as f:
+                pickle.dump(
+                    {i: _tree_numpy(s) for i, s in flat.items()}, f)
+
+    @staticmethod
+    def load(prefix, epoch, load_optimizer_states=False, **kwargs):
+        sym, arg_params, aux_params = load_checkpoint(prefix, epoch)
+        mod = Module(sym, **kwargs)
+        mod._preloaded_params = (arg_params, aux_params)
+        if load_optimizer_states:
+            import pickle
+            with open(f"{prefix}-{epoch:04d}.states", "rb") as f:
+                mod._preloaded_opt_states = pickle.load(f)
+        return mod
+
+    def reshape(self, data_shapes, label_shapes=None):
+        assert self.binded
+        self.bind(data_shapes, label_shapes, for_training=self.for_training,
+                  force_rebind=True, shared_module=self)
+
+
+def _tree_numpy(state):
+    if state is None:
+        return None
+    if isinstance(state, NDArray):
+        return state.asnumpy()
+    if isinstance(state, (list, tuple)):
+        return tuple(_tree_numpy(s) for s in state)
+    return state
+
+
+def _tree_ndarray(state):
+    if state is None:
+        return None
+    if isinstance(state, _np.ndarray):
+        return NDArray(_np.asarray(state))
+    if isinstance(state, (list, tuple)):
+        return tuple(_tree_ndarray(s) for s in state)
+    return state

@@ -11,6 +11,8 @@ names) are preserved.
 """
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -380,40 +382,48 @@ def softmax_cross_entropy(data, label):
     return jnp.sum(nll)
 
 
-def _softmax_output_fwd(data, label, grad_scale, ignore_label, use_ignore, multi_output, normalization):
-    ax = 1 if multi_output else -1
-    return jax.nn.softmax(data, axis=ax)
+def _zero_cotangent(x):
+    """Zero cotangent matching custom_vjp's contract: float0 for integer
+    primals, zeros_like otherwise."""
+    if jnp.issubdtype(x.dtype, jnp.inexact):
+        return jnp.zeros_like(x)
+    import numpy as _onp
+    return _onp.zeros(x.shape, jax.dtypes.float0)
 
 
-@jax.custom_vjp
-def _softmax_output(data, label, grad_scale, ignore_label, use_ignore, multi_output, normalization):
-    return _softmax_output_fwd(data, label, grad_scale, ignore_label, use_ignore, multi_output, normalization)
+@functools.lru_cache(maxsize=None)
+def _make_softmax_output(grad_scale, ignore_label, use_ignore, multi_output, normalization):
+    """Static op attrs live in this closure so the custom_vjp sees only
+    array args (strings through custom_vjp break abstract eval)."""
+    ax_of = lambda out: 1 if multi_output else -1
 
+    @jax.custom_vjp
+    def f(data, label):
+        return jax.nn.softmax(data, axis=ax_of(data))
 
-def _so_fwd(data, label, grad_scale, ignore_label, use_ignore, multi_output, normalization):
-    out = _softmax_output_fwd(data, label, grad_scale, ignore_label, use_ignore, multi_output, normalization)
-    return out, (out, label, grad_scale, ignore_label, use_ignore, multi_output, normalization)
+    def fwd(data, label):
+        out = jax.nn.softmax(data, axis=ax_of(data))
+        return out, (out, label)
 
+    def bwd(res, g):
+        out, label = res
+        ax = ax_of(out)
+        nclass = out.shape[ax]
+        lab = label.astype(jnp.int32)
+        oh = jax.nn.one_hot(lab, nclass, axis=ax)
+        grad = (out - oh) * grad_scale
+        if use_ignore:
+            keep = (lab != int(ignore_label)).astype(out.dtype)
+            grad = grad * jnp.expand_dims(keep, ax)
+        if normalization == "batch":
+            grad = grad / out.shape[0]
+        elif normalization == "valid" and use_ignore:
+            keep = (lab != int(ignore_label)).astype(out.dtype)
+            grad = grad / jnp.maximum(jnp.sum(keep), 1.0)
+        return (grad, _zero_cotangent(label))
 
-def _so_bwd(res, g):
-    out, label, grad_scale, ignore_label, use_ignore, multi_output, normalization = res
-    ax = 1 if multi_output else -1
-    nclass = out.shape[ax]
-    lab = label.astype(jnp.int32)
-    oh = jax.nn.one_hot(lab, nclass, axis=ax)
-    grad = (out - oh) * grad_scale
-    if use_ignore:
-        keep = (lab != int(ignore_label)).astype(out.dtype)
-        grad = grad * jnp.expand_dims(keep, ax)
-    if normalization == "batch":
-        grad = grad / out.shape[0]
-    elif normalization == "valid" and use_ignore:
-        keep = (lab != int(ignore_label)).astype(out.dtype)
-        grad = grad / jnp.maximum(jnp.sum(keep), 1.0)
-    return (grad, None, None, None, None, None, None)
-
-
-_softmax_output.defvjp(_so_fwd, _so_bwd)
+    f.defvjp(fwd, bwd)
+    return f
 
 
 @register("SoftmaxOutput")
@@ -429,7 +439,9 @@ def softmax_output(
 ):
     """Legacy Module-API loss head (parity: [U:src/operator/softmax_output.cc]):
     forward = softmax, backward = scaled (p - onehot)."""
-    return _softmax_output(data, label, grad_scale, ignore_label, use_ignore, multi_output, normalization)
+    f = _make_softmax_output(float(grad_scale), float(ignore_label),
+                             bool(use_ignore), bool(multi_output), str(normalization))
+    return f(data, label)
 
 
 alias("Softmax", "SoftmaxOutput")

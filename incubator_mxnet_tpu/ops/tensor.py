@@ -773,3 +773,61 @@ def linalg_syrk(a, transpose=False, alpha=1.0):
 
 
 _np  # keep import
+
+
+# ---------------------------------------------------------------------------
+# Scalar-operand arithmetic ops (parity: [U:src/operator/tensor/
+# elemwise_binary_scalar_op_basic.cc]).  NDArray dunders compute these
+# directly; they are registered so the symbolic front end (mx.sym) can emit
+# them as graph nodes.
+# ---------------------------------------------------------------------------
+
+
+@register("_plus_scalar")
+def _plus_scalar(data, scalar=0.0):
+    return data + data.dtype.type(scalar)
+
+
+@register("_minus_scalar")
+def _minus_scalar(data, scalar=0.0):
+    return data - data.dtype.type(scalar)
+
+
+@register("_rminus_scalar")
+def _rminus_scalar(data, scalar=0.0):
+    return data.dtype.type(scalar) - data
+
+
+@register("_mul_scalar")
+def _mul_scalar(data, scalar=1.0):
+    return data * data.dtype.type(scalar)
+
+
+@register("_div_scalar")
+def _div_scalar(data, scalar=1.0):
+    return data / data.dtype.type(scalar)
+
+
+@register("_rdiv_scalar")
+def _rdiv_scalar(data, scalar=1.0):
+    return data.dtype.type(scalar) / data
+
+
+@register("_power_scalar")
+def _power_scalar(data, scalar=1.0):
+    return data ** data.dtype.type(scalar)
+
+
+@register("_rpower_scalar")
+def _rpower_scalar(data, scalar=1.0):
+    return data.dtype.type(scalar) ** data
+
+
+@register("_sym_zeros")
+def _sym_zeros(shape=(), dtype="float32"):
+    return jnp.zeros(tuple(shape), dtype=_as_np_dtype(dtype))
+
+
+@register("_sym_ones")
+def _sym_ones(shape=(), dtype="float32"):
+    return jnp.ones(tuple(shape), dtype=_as_np_dtype(dtype))

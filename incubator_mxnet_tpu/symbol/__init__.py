@@ -1,0 +1,46 @@
+"""``mx.sym`` / ``mx.symbol`` namespace.
+
+Op wrappers are synthesized on attribute access from the same pure-function
+registry as ``mx.nd`` (parity: the reference generates both namespaces from
+the one C op registry — [U:python/mxnet/symbol/register.py])."""
+from __future__ import annotations
+
+from ..ops import registry as _registry
+from .symbol import (
+    Symbol,
+    Variable,
+    var,
+    Group,
+    load,
+    load_json,
+    zeros,
+    ones,
+    _make_sym_op,
+)
+
+__all__ = [
+    "Symbol",
+    "Variable",
+    "var",
+    "Group",
+    "load",
+    "load_json",
+    "zeros",
+    "ones",
+]
+
+
+def __getattr__(name):
+    if name.startswith("__"):
+        raise AttributeError(name)
+    try:
+        _registry.get_op(name)
+    except KeyError:
+        raise AttributeError(f"symbol op {name!r} is not registered") from None
+    w = _make_sym_op(name)
+    globals()[name] = w
+    return w
+
+
+def __dir__():
+    return sorted(set(list(globals()) + _registry.list_ops()))

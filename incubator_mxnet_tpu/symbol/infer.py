@@ -1,0 +1,226 @@
+"""Shape/type inference over a Symbol graph.
+
+Parity target: the nnvm InferShape/InferType passes
+([U:3rdparty/tvm/nnvm/src/pass/infer_shape.cc],
+[U:src/executor/infer_graph_attr_pass.cc]).  TPU-native twist: per-op
+output shapes come from ``jax.eval_shape`` of the SAME pure function that
+computes — there is no hand-maintained FInferShape table.  What does need
+hand rules is the *backward* direction the reference gets from its
+bidirectional pass: inferring parameter shapes (weight/bias/gamma/...)
+from the data shape plus op attrs.  Those rules live in
+``PARAM_SHAPE_RULES`` below and cover the parameterized ops.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+import jax
+import jax.numpy as jnp
+
+from ..base import _as_np_dtype
+from ..ops.registry import get_op
+from .symbol import is_aux_name
+
+__all__ = ["infer_shape", "infer_type", "PARAM_SHAPE_RULES"]
+
+
+def _conv_weight(data_shape, attrs):
+    num_filter = attrs.get("num_filter", 0)
+    kernel = tuple(attrs.get("kernel", ()))
+    groups = attrs.get("num_group", 1)
+    cin = data_shape[1] // groups
+    return (num_filter, cin) + kernel
+
+
+def _deconv_weight(data_shape, attrs):
+    num_filter = attrs.get("num_filter", 0)
+    kernel = tuple(attrs.get("kernel", ()))
+    groups = attrs.get("num_group", 1)
+    return (data_shape[1], num_filter // groups) + kernel
+
+
+def _fc_weight(data_shape, attrs):
+    num_hidden = attrs.get("num_hidden", 0)
+    if attrs.get("flatten", True):
+        in_units = int(_np.prod(data_shape[1:]))
+    else:
+        in_units = data_shape[-1]
+    return (num_hidden, in_units)
+
+
+def _channel(data_shape, attrs):
+    axis = attrs.get("axis", 1) % len(data_shape)
+    return (data_shape[axis],)
+
+
+def _last_dim(data_shape, attrs):
+    axis = attrs.get("axis", -1) % len(data_shape)
+    return (data_shape[axis],)
+
+
+# op → {param_name: rule(data_shape, attrs) -> shape}
+PARAM_SHAPE_RULES = {
+    "FullyConnected": {
+        "weight": _fc_weight,
+        "bias": lambda d, a: (a.get("num_hidden", 0),),
+    },
+    "Convolution": {
+        "weight": _conv_weight,
+        "bias": lambda d, a: (a.get("num_filter", 0),),
+    },
+    "Deconvolution": {
+        "weight": _deconv_weight,
+        "bias": lambda d, a: (a.get("num_filter", 0),),
+    },
+    "BatchNorm": {
+        "gamma": _channel, "beta": _channel,
+        "moving_mean": _channel, "moving_var": _channel,
+    },
+    "LayerNorm": {"gamma": _last_dim, "beta": _last_dim},
+    "RMSNorm": {"gamma": _last_dim},
+    "InstanceNorm": {"gamma": _channel, "beta": _channel},
+    "GroupNorm": {"gamma": _channel, "beta": _channel},
+    "Embedding": {
+        "weight": lambda d, a: (a.get("input_dim", 0), a.get("output_dim", 0)),
+    },
+    # loss heads: label shape from data shape (the bidirectional-inference
+    # direction the reference's InferShape pass provides — lets predict-
+    # style binds omit label shapes)
+    "SoftmaxOutput": {
+        "label": lambda d, a: ((d[0],) + d[2:]) if a.get("multi_output") else d[:-1],
+    },
+    "LinearRegressionOutput": {"label": lambda d, a: d},
+    "MAERegressionOutput": {"label": lambda d, a: d},
+    "LogisticRegressionOutput": {"label": lambda d, a: d},
+}
+PARAM_SHAPE_RULES["fully_connected"] = PARAM_SHAPE_RULES["FullyConnected"]
+PARAM_SHAPE_RULES["Softmax"] = PARAM_SHAPE_RULES["SoftmaxOutput"]
+
+
+def _clean_attrs(attrs):
+    return {k: v for k, v in attrs.items() if not k.startswith("__")}
+
+
+_INT_DTYPES = ("int32", "int64", "uint8", "int8", "bool")
+
+
+def _graph_infer(symbol, shape_hints, dtype_hints, allow_unknown=False):
+    """One forward topo pass.  Returns (node → tuple-of-ShapeDtypeStruct,
+    var_name → ShapeDtypeStruct)."""
+    values = {}   # id(node) -> tuple of ShapeDtypeStruct
+    varspec = {}  # var name -> ShapeDtypeStruct
+
+    for node in symbol._topo():
+        if node.op is None:
+            shape = shape_hints.get(node.name, node.attrs.get("__shape__"))
+            dtype = dtype_hints.get(node.name, node.attrs.get("__dtype__", "float32"))
+            if shape is None:
+                values[id(node)] = None  # unknown until a consumer rule fires
+            else:
+                spec = jax.ShapeDtypeStruct(tuple(shape), _as_np_dtype(dtype))
+                values[id(node)] = (spec,)
+                varspec[node.name] = spec
+            continue
+
+        rules = PARAM_SHAPE_RULES.get(node.op, {})
+        input_names = node.attrs.get("__input_names__") or []
+        data_spec = None
+        if node.inputs:
+            first = values.get(id(node.inputs[0][0]))
+            if first is not None:
+                data_spec = first[node.inputs[0][1]]
+        # derive unknown parameter-variable shapes from the data shape
+        for (src, idx), pname in zip(node.inputs, input_names):
+            if values.get(id(src)) is None and src.op is None:
+                rule = rules.get(pname)
+                if rule is not None and data_spec is not None:
+                    shape = tuple(rule(data_spec.shape, node.attrs))
+                    dtype = dtype_hints.get(src.name,
+                                            src.attrs.get("__dtype__", str(data_spec.dtype)))
+                    spec = jax.ShapeDtypeStruct(shape, _as_np_dtype(dtype))
+                    values[id(src)] = (spec,)
+                    varspec[src.name] = spec
+
+        in_specs = []
+        missing = False
+        for src, idx in node.inputs:
+            v = values.get(id(src))
+            if v is None:
+                missing = True
+                break
+            in_specs.append(v[idx])
+        if missing:
+            if allow_unknown:
+                values[id(node)] = None
+                continue
+            unknown = [s.name for s, _ in node.inputs if values.get(id(s)) is None]
+            raise ValueError(
+                f"infer_shape: cannot infer inputs {unknown} of node "
+                f"{node.name!r} (op {node.op}); provide their shapes")
+
+        op = get_op(node.op)
+        attrs = _clean_attrs(node.attrs)
+        out = jax.eval_shape(lambda *a: op.fn(*a, **attrs), *in_specs)
+        if isinstance(out, (list, tuple)):
+            values[id(node)] = tuple(out)
+        else:
+            values[id(node)] = (out,)
+    return values, varspec
+
+
+def infer_shape(symbol, *args, allow_unknown=False, **kwargs):
+    """Returns (arg_shapes, out_shapes, aux_shapes) in the order of
+    ``list_arguments()`` / ``list_outputs()`` / ``list_auxiliary_states()``
+    (parity: ``Symbol.infer_shape``)."""
+    if args:
+        names = symbol.list_arguments()
+        for name, shape in zip(names, args):
+            if shape is not None:
+                kwargs.setdefault(name, shape)
+    shape_hints = {k: tuple(v) for k, v in kwargs.items() if v is not None}
+    dtype_hints = {k: "int32" for k in shape_hints
+                   if k.endswith(("label", "idx", "indices", "token_ids"))}
+    values, varspec = _graph_infer(symbol, shape_hints, dtype_hints,
+                                   allow_unknown=allow_unknown)
+
+    def var_shape(name):
+        spec = varspec.get(name)
+        return tuple(spec.shape) if spec is not None else None
+
+    arg_shapes = [var_shape(n) for n in symbol.list_arguments()]
+    aux_shapes = [var_shape(n) for n in symbol.list_auxiliary_states()]
+    out_shapes = []
+    for node, idx in symbol._outputs:
+        v = values.get(id(node))
+        out_shapes.append(tuple(v[idx].shape) if v is not None else None)
+    return arg_shapes, out_shapes, aux_shapes
+
+
+def infer_type(symbol, **kwargs):
+    """(arg_dtypes, out_dtypes, aux_dtypes); needs shapes only when the
+    graph has no variable shape annotations."""
+    shape_hints, dtype_hints = {}, {}
+    for k, v in kwargs.items():
+        if v is None:
+            continue
+        if isinstance(v, (tuple, list)):
+            shape_hints[k] = tuple(v)
+        else:
+            dtype_hints[k] = str(_np.dtype(v)) if not isinstance(v, str) else v
+    for node in symbol._topo():
+        if node.op is None and "__shape__" in node.attrs:
+            shape_hints.setdefault(node.name, tuple(node.attrs["__shape__"]))
+    values, varspec = _graph_infer(symbol, shape_hints, dtype_hints,
+                                   allow_unknown=True)
+
+    def var_dtype(name):
+        spec = varspec.get(name)
+        return _np.dtype(spec.dtype) if spec is not None else None
+
+    arg_dtypes = [var_dtype(n) for n in symbol.list_arguments()]
+    aux_dtypes = [var_dtype(n) for n in symbol.list_auxiliary_states()]
+    out_dtypes = []
+    for node, idx in symbol._outputs:
+        v = values.get(id(node))
+        out_dtypes.append(_np.dtype(v[idx].dtype) if v is not None else None)
+    return arg_dtypes, out_dtypes, aux_dtypes

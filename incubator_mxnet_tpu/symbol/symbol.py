@@ -1,0 +1,444 @@
+"""``mx.sym`` — the symbolic front end.
+
+Parity target: [U:python/mxnet/symbol/symbol.py] over the nnvm graph IR
+([U:3rdparty/tvm/nnvm/include/nnvm/graph.h]).  TPU-native design: a Symbol
+is a tiny pure-Python DAG over the SAME pure-function op registry that
+``mx.nd`` dispatches to — there is no second operator implementation.
+``bind``/``simple_bind`` lower the DAG to one ``jax.jit``-compiled XLA
+program (the GraphExecutor analog, [U:src/executor/graph_executor.cc]);
+memory planning, fusion and scheduling are XLA's.
+
+Reference behaviors kept:
+* auto-created parameter variables (``sym.FullyConnected(data, num_hidden=10,
+  name='fc1')`` creates ``fc1_weight``/``fc1_bias``),
+* ``list_arguments`` / ``list_auxiliary_states`` split by the
+  moving-stat naming convention,
+* ``infer_shape`` with partial inputs (param shapes derived from data
+  shapes — the deferred-init path Module.bind depends on),
+* JSON (de)serialization, ``__getitem__`` output selection, ``Group``.
+"""
+from __future__ import annotations
+
+import inspect
+import json
+import threading
+
+from ..ops.registry import get_op, list_ops
+
+__all__ = ["Symbol", "Variable", "var", "Group", "load", "load_json", "zeros", "ones"]
+
+_tls = threading.local()
+
+
+def _name_counters():
+    if not hasattr(_tls, "sym_counters"):
+        _tls.sym_counters = {}
+    return _tls.sym_counters
+
+
+def _auto_name(hint):
+    c = _name_counters()
+    idx = c.get(hint, 0)
+    c[hint] = idx + 1
+    return f"{hint}{idx}"
+
+
+def _reset_naming():  # test helper
+    _tls.sym_counters = {}
+
+
+# Aux-state naming convention (parity: BatchNorm's auxiliary moving stats
+# are not trainable arguments — [U:src/operator/nn/batch_norm.cc]).
+AUX_SUFFIXES = ("moving_mean", "moving_var", "running_mean", "running_var")
+
+
+def is_aux_name(name: str) -> bool:
+    return name.endswith(AUX_SUFFIXES)
+
+
+# Ops whose trailing tensor params are optional-but-autocreated unless a
+# flag disables them.
+_OPTIONAL_TENSOR = {
+    "FullyConnected": {"bias": "no_bias"},
+    "fully_connected": {"bias": "no_bias"},
+    "Convolution": {"bias": "no_bias"},
+    "Deconvolution": {"bias": "no_bias"},
+}
+
+# Explicit tensor-input lists for ops where signature inspection is not
+# enough.  Everything else: parameters without a default are tensor inputs.
+_TENSOR_PARAMS = {
+    "BatchNorm": ("data", "gamma", "beta", "moving_mean", "moving_var"),
+    "Dropout": ("data",),
+}
+
+
+def _tensor_params(opname, fn):
+    """Tensor-input parameter names, or None for variadic ops (``*args``
+    like concat/add_n/stack, which take any number of tensor inputs)."""
+    if opname in _TENSOR_PARAMS:
+        return list(_TENSOR_PARAMS[opname])
+    sig = inspect.signature(fn)
+    names = []
+    for p in sig.parameters.values():
+        if p.kind == p.VAR_POSITIONAL:
+            return None  # variadic
+        if p.kind == p.VAR_KEYWORD:
+            break
+        if p.default is inspect.Parameter.empty:
+            names.append(p.name)
+        else:
+            break
+    extra = _OPTIONAL_TENSOR.get(opname)
+    if extra:
+        names.extend(extra)
+    return names
+
+
+class _Node:
+    """One graph node: a Variable (op is None) or an op application."""
+
+    __slots__ = ("op", "name", "inputs", "attrs")
+
+    def __init__(self, op, name, inputs=(), attrs=None):
+        self.op = op                  # registry op name, or None for Variable
+        self.name = name
+        self.inputs = list(inputs)    # list of (_Node, out_index)
+        self.attrs = dict(attrs or {})  # static (non-tensor) op kwargs
+
+
+class Symbol:
+    """A handle to one or more graph outputs."""
+
+    __slots__ = ("_outputs",)
+
+    def __init__(self, outputs):
+        self._outputs = list(outputs)  # list of (_Node, int)
+
+    # -- introspection --------------------------------------------------
+    @property
+    def name(self):
+        if len(self._outputs) == 1:
+            return self._outputs[0][0].name
+        return None
+
+    def _topo(self):
+        seen, order = set(), []
+
+        def visit(node):
+            if id(node) in seen:
+                return
+            seen.add(id(node))
+            for n, _ in node.inputs:
+                visit(n)
+            order.append(node)
+
+        for n, _ in self._outputs:
+            visit(n)
+        return order
+
+    def list_arguments(self):
+        return [n.name for n in self._topo()
+                if n.op is None and not is_aux_name(n.name)]
+
+    def list_auxiliary_states(self):
+        return [n.name for n in self._topo()
+                if n.op is None and is_aux_name(n.name)]
+
+    def list_outputs(self):
+        out = []
+        for node, idx in self._outputs:
+            if node.op is None:
+                out.append(node.name)
+            else:
+                out.append(f"{node.name}_output" if idx == 0 else f"{node.name}_output{idx}")
+        return out
+
+    def list_inputs(self):
+        return [n.name for n in self._topo() if n.op is None]
+
+    def get_internals(self):
+        """Symbol over every node's primary output (parity:
+        ``Symbol.get_internals`` — used to tap intermediate features)."""
+        return Symbol([(n, 0) for n in self._topo()])
+
+    def __getitem__(self, idx):
+        if isinstance(idx, str):
+            names = self.list_outputs()
+            idx = names.index(idx)
+        return Symbol([self._outputs[idx]])
+
+    def __len__(self):
+        return len(self._outputs)
+
+    def __iter__(self):
+        return (Symbol([o]) for o in self._outputs)
+
+    def __repr__(self):
+        names = ", ".join(self.list_outputs())
+        return f"<Symbol {names}>"
+
+    # -- arithmetic sugar ------------------------------------------------
+    def __add__(self, other):
+        return _binary("broadcast_add", "_plus_scalar", self, other)
+
+    def __radd__(self, other):
+        return _binary("broadcast_add", "_plus_scalar", self, other)
+
+    def __sub__(self, other):
+        return _binary("broadcast_sub", "_minus_scalar", self, other)
+
+    def __rsub__(self, other):
+        return _binary("broadcast_sub", "_rminus_scalar", self, other, swap=True)
+
+    def __mul__(self, other):
+        return _binary("broadcast_mul", "_mul_scalar", self, other)
+
+    def __rmul__(self, other):
+        return _binary("broadcast_mul", "_mul_scalar", self, other)
+
+    def __truediv__(self, other):
+        return _binary("broadcast_div", "_div_scalar", self, other)
+
+    def __rtruediv__(self, other):
+        return _binary("broadcast_div", "_rdiv_scalar", self, other, swap=True)
+
+    def __pow__(self, other):
+        return _binary("broadcast_power", "_power_scalar", self, other)
+
+    def __neg__(self):
+        return self * -1.0
+
+    # -- graph ops -------------------------------------------------------
+    def infer_shape(self, *args, **kwargs):
+        from .infer import infer_shape
+        return infer_shape(self, *args, **kwargs)
+
+    def infer_shape_partial(self, *args, **kwargs):
+        from .infer import infer_shape
+        return infer_shape(self, *args, allow_unknown=True, **kwargs)
+
+    def infer_type(self, **kwargs):
+        from .infer import infer_type
+        return infer_type(self, **kwargs)
+
+    def eval(self, ctx=None, **kwargs):
+        """Eager evaluation with NDArray bindings (parity: ``Symbol.eval``)."""
+        from ..executor import Executor
+        ex = Executor(self, ctx, args=kwargs, grad_req="null")
+        return ex.forward(is_train=False)
+
+    def bind(self, ctx=None, args=None, args_grad=None, grad_req="write",
+             aux_states=None, **kwargs):
+        from ..executor import Executor
+        return Executor(self, ctx, args=args or {}, args_grad=args_grad,
+                        grad_req=grad_req, aux_states=aux_states)
+
+    def simple_bind(self, ctx=None, grad_req="write", type_dict=None, **shapes):
+        from ..executor import Executor
+        return Executor.simple_bind(self, ctx, grad_req=grad_req,
+                                    type_dict=type_dict, **shapes)
+
+    # -- serialization ---------------------------------------------------
+    def tojson(self, remove_amp_cast=True):
+        nodes = self._topo()
+        nid = {id(n): i for i, n in enumerate(nodes)}
+        payload = {
+            "nodes": [
+                {
+                    "op": n.op or "null",
+                    "name": n.name,
+                    "attrs": {k: _attr_str(v) for k, v in n.attrs.items()},
+                    "inputs": [[nid[id(src)], idx] for src, idx in n.inputs],
+                }
+                for n in nodes
+            ],
+            "arg_nodes": [i for i, n in enumerate(nodes) if n.op is None],
+            "heads": [[nid[id(n)], idx] for n, idx in self._outputs],
+            "attrs": {"mxnet_version": ["int", 10700], "format": "incubator_mxnet_tpu"},
+        }
+        return json.dumps(payload, indent=2)
+
+    def save(self, fname):
+        with open(fname, "w") as f:
+            f.write(self.tojson())
+
+    # -- composition ------------------------------------------------------
+    def __call__(self, **kwargs):
+        """Compose: replace free variables by other symbols (parity:
+        ``Symbol.__call__``)."""
+        mapping = {}
+        for node in self._topo():
+            if node.op is None and node.name in kwargs:
+                repl = kwargs[node.name]
+                mapping[id(node)] = repl._outputs[0]
+        if not mapping:
+            return self
+        memo = {}
+
+        def clone_entry(entry):
+            src, idx = entry
+            if id(src) in mapping:
+                return mapping[id(src)]
+            if id(src) in memo:
+                return (memo[id(src)], idx)
+            new_inputs = [clone_entry(e) for e in src.inputs]
+            new = _Node(src.op, src.name, new_inputs, src.attrs)
+            memo[id(src)] = new
+            return (new, idx)
+
+        return Symbol([clone_entry(e) for e in self._outputs])
+
+
+def _attr_str(v):
+    if isinstance(v, (list, tuple)):
+        return json.dumps(list(v))
+    return json.dumps(v) if not isinstance(v, str) else v
+
+
+def _parse_attr(s):
+    if not isinstance(s, str):
+        return s
+    try:
+        return json.loads(s)
+    except (ValueError, TypeError):
+        return s
+
+
+def _binary(broadcast_op, scalar_op, lhs, rhs, swap=False):
+    if isinstance(rhs, Symbol):
+        return _apply_op(broadcast_op, (lhs, rhs), {})
+    attrs = {"scalar": float(rhs)}
+    return _apply_op(scalar_op, (lhs,), attrs)
+
+
+def Variable(name, attr=None, shape=None, lr_mult=None, wd_mult=None,
+             dtype=None, init=None, stype=None, **kwargs):
+    """Create a symbolic variable (parity: ``mx.sym.Variable``)."""
+    attrs = {}
+    if shape is not None:
+        attrs["__shape__"] = tuple(shape)
+    if dtype is not None:
+        attrs["__dtype__"] = str(dtype)
+    if init is not None:
+        attrs["__init__"] = init if isinstance(init, str) else init.__class__.__name__
+    if lr_mult is not None:
+        attrs["__lr_mult__"] = lr_mult
+    if wd_mult is not None:
+        attrs["__wd_mult__"] = wd_mult
+    if attr:
+        attrs.update(attr)
+    return Symbol([(_Node(None, name, attrs=attrs), 0)])
+
+
+var = Variable
+
+
+def Group(symbols):
+    outs = []
+    for s in symbols:
+        outs.extend(s._outputs)
+    return Symbol(outs)
+
+
+def zeros(shape, dtype="float32", name=None, **kwargs):
+    name = name or _auto_name("_zeros")
+    return _apply_op("_sym_zeros", (), {"shape": tuple(shape) if not isinstance(shape, int) else (shape,), "dtype": dtype}, name=name)
+
+
+def ones(shape, dtype="float32", name=None, **kwargs):
+    name = name or _auto_name("_ones")
+    return _apply_op("_sym_ones", (), {"shape": tuple(shape) if not isinstance(shape, int) else (shape,), "dtype": dtype}, name=name)
+
+
+def _apply_op(opname, args, kwargs, name=None):
+    """Build an op node: positional/keyword Symbols are tensor inputs,
+    everything else static attrs; missing tensor params are auto-created as
+    Variables named ``<node>_<param>``."""
+    op = get_op(opname)
+    tnames = _tensor_params(opname, op.fn)
+    name = name or _auto_name(opname.lower().lstrip("_"))
+
+    if tnames is None:  # variadic op: all positional Symbols are inputs
+        inputs, input_names = [], []
+        for i, a in enumerate(args):
+            if not isinstance(a, Symbol):
+                raise TypeError(f"{opname}: positional arg {i} must be a Symbol, got {type(a)}")
+            entry = a._outputs
+            if len(entry) != 1:
+                raise ValueError(f"{opname}: input {i} must be a single-output symbol")
+            inputs.append(entry[0])
+            input_names.append(f"arg{i}")
+        attrs = {k: v for k, v in kwargs.items() if not isinstance(v, Symbol)}
+        node = _Node(opname, name, inputs, attrs)
+        node.attrs["__input_names__"] = input_names
+        return Symbol([(node, 0)])
+
+    provided = {}
+    for i, a in enumerate(args):
+        if isinstance(a, Symbol):
+            if i >= len(tnames):
+                raise ValueError(f"{opname}: too many tensor inputs")
+            provided[tnames[i]] = a
+        else:
+            raise TypeError(f"{opname}: positional arg {i} must be a Symbol, got {type(a)}")
+    attrs = {}
+    for k, v in kwargs.items():
+        if isinstance(v, Symbol):
+            provided[k] = v
+        else:
+            attrs[k] = v
+
+    inputs, input_names = [], []
+    optional = _OPTIONAL_TENSOR.get(opname, {})
+    for t in tnames:
+        if t in provided:
+            entry = provided[t]._outputs
+            if len(entry) != 1:
+                raise ValueError(f"{opname}: input {t} must be a single-output symbol")
+            inputs.append(entry[0])
+            input_names.append(t)
+        else:
+            flag = optional.get(t)
+            if flag is not None and attrs.get(flag, False):
+                continue  # e.g. no_bias=True
+            # missing inputs auto-create variables, incl. the MXNet idiom
+            # sym.SoftmaxOutput(data, name='softmax') → 'softmax_label'
+            inputs.append((_Node(None, f"{name}_{t}"), 0))
+            input_names.append(t)
+
+    # pass skipped-optional info through attrs so the executor calls the op
+    # with the right arity
+    node = _Node(opname, name, inputs, attrs)
+    node.attrs["__input_names__"] = input_names
+    return Symbol([(node, 0)])
+
+
+def _make_sym_op(opname):
+    def sym_op(*args, name=None, **kwargs):
+        return _apply_op(opname, args, kwargs, name=name)
+
+    sym_op.__name__ = opname
+    sym_op.__qualname__ = f"sym.{opname}"
+    op = get_op(opname)
+    sym_op.__doc__ = op.doc
+    return sym_op
+
+
+def load_json(json_str):
+    payload = json.loads(json_str)
+    nodes = []
+    for spec in payload["nodes"]:
+        attrs = {k: _parse_attr(v) for k, v in spec.get("attrs", {}).items()}
+        op = spec["op"]
+        node = _Node(None if op == "null" else op, spec["name"], attrs=attrs)
+        nodes.append((node, spec.get("inputs", [])))
+    for node, inputs in nodes:
+        node.inputs = [(nodes[nid][0], idx) for nid, idx in inputs]
+    heads = payload["heads"]
+    return Symbol([(nodes[nid][0], idx) for nid, idx in heads])
+
+
+def load(fname):
+    with open(fname) as f:
+        return load_json(f.read())

@@ -1,0 +1,210 @@
+"""Symbol/Executor tests (parity idioms: tests/python/unittest/
+test_symbol.py + test_executor.py in the reference — compose, infer_shape,
+json round-trip, bind fwd/bwd against the imperative oracle)."""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import sym
+import incubator_mxnet_tpu.ndarray as nd
+
+
+def _mlp_sym():
+    data = sym.Variable("data")
+    label = sym.Variable("softmax_label")
+    fc1 = sym.FullyConnected(data, num_hidden=16, name="fc1")
+    act = sym.Activation(fc1, act_type="relu", name="relu1")
+    fc2 = sym.FullyConnected(act, num_hidden=4, name="fc2")
+    return sym.SoftmaxOutput(fc2, label=label, name="softmax")
+
+
+class TestSymbolGraph:
+    def test_list_arguments_order_and_autocreate(self):
+        out = _mlp_sym()
+        args = out.list_arguments()
+        assert args == ["data", "fc1_weight", "fc1_bias", "fc2_weight",
+                        "fc2_bias", "softmax_label"]
+        assert out.list_outputs() == ["softmax_output"]
+
+    def test_no_bias(self):
+        data = sym.Variable("data")
+        fc = sym.FullyConnected(data, num_hidden=8, no_bias=True, name="fc")
+        assert fc.list_arguments() == ["data", "fc_weight"]
+
+    def test_infer_shape(self):
+        out = _mlp_sym()
+        arg_shapes, out_shapes, aux_shapes = out.infer_shape(
+            data=(32, 10), softmax_label=(32,))
+        d = dict(zip(out.list_arguments(), arg_shapes))
+        assert d["fc1_weight"] == (16, 10)
+        assert d["fc1_bias"] == (16,)
+        assert d["fc2_weight"] == (4, 16)
+        assert out_shapes == [(32, 4)]
+        assert aux_shapes == []
+
+    def test_infer_shape_conv(self):
+        data = sym.Variable("data")
+        c = sym.Convolution(data, num_filter=8, kernel=(3, 3), pad=(1, 1), name="conv")
+        b = sym.BatchNorm(c, name="bn")
+        arg_shapes, out_shapes, aux_shapes = b.infer_shape(data=(2, 3, 8, 8))
+        d = dict(zip(b.list_arguments(), arg_shapes))
+        assert d["conv_weight"] == (8, 3, 3, 3)
+        assert d["conv_bias"] == (8,)
+        assert d["bn_gamma"] == (8,)
+        assert out_shapes[0] == (2, 8, 8, 8)
+        assert aux_shapes == [(8,), (8,)]
+        assert b.list_auxiliary_states() == ["bn_moving_mean", "bn_moving_var"]
+
+    def test_json_roundtrip(self):
+        out = _mlp_sym()
+        s2 = sym.load_json(out.tojson())
+        assert s2.list_arguments() == out.list_arguments()
+        assert s2.list_outputs() == out.list_outputs()
+        a1, o1, _ = out.infer_shape(data=(4, 6), softmax_label=(4,))
+        a2, o2, _ = s2.infer_shape(data=(4, 6), softmax_label=(4,))
+        assert a1 == a2 and o1 == o2
+
+    def test_arithmetic_sugar_and_eval(self):
+        a = sym.Variable("a")
+        b = sym.Variable("b")
+        c = 2.0 * a + b / 4.0 - 1.0
+        out = c.eval(a=mx.nd.ones((2, 2)), b=mx.nd.ones((2, 2)) * 4)
+        np.testing.assert_allclose(out[0].asnumpy(), np.full((2, 2), 2.0))
+
+    def test_group_and_getitem(self):
+        a = sym.Variable("a")
+        s1 = sym.relu(a, name="r")
+        s2 = sym.tanh(a, name="t")
+        g = sym.Group([s1, s2])
+        assert len(g) == 2
+        assert g[0].list_outputs() == ["r_output"]
+
+    def test_get_internals(self):
+        out = _mlp_sym()
+        internals = out.get_internals()
+        names = internals.list_outputs()
+        assert "relu1_output" in names
+        feat = internals["relu1_output"]
+        _, out_shapes, _ = feat.infer_shape(data=(8, 10))
+        assert out_shapes == [(8, 16)]
+
+    def test_compose(self):
+        data = sym.Variable("data")
+        net1 = sym.FullyConnected(data, num_hidden=8, name="fca")
+        data2 = sym.Variable("d2")
+        pre = sym.tanh(data2, name="pre")
+        composed = net1(data=pre)
+        assert "d2" in composed.list_arguments()
+        assert "data" not in composed.list_arguments()
+
+
+class TestExecutor:
+    def test_forward_matches_imperative(self):
+        out = _mlp_sym()
+        ex = out.simple_bind(mx.cpu(), data=(8, 10), softmax_label=(8,))
+        rng = np.random.RandomState(0)
+        for k, v in ex.arg_dict.items():
+            if k.endswith("weight"):
+                v._data = mx.nd.array(rng.randn(*v.shape) * 0.1)._data
+        x = rng.randn(8, 10).astype(np.float32)
+        y = rng.randint(0, 4, (8,)).astype(np.float32)
+        outs = ex.forward(is_train=False, data=x, softmax_label=y)
+
+        h = nd.FullyConnected(mx.nd.array(x), ex.arg_dict["fc1_weight"],
+                              ex.arg_dict["fc1_bias"], num_hidden=16)
+        h = nd.Activation(h, act_type="relu")
+        o = nd.FullyConnected(h, ex.arg_dict["fc2_weight"],
+                              ex.arg_dict["fc2_bias"], num_hidden=4)
+        ref = nd.softmax(o)
+        np.testing.assert_allclose(outs[0].asnumpy(), ref.asnumpy(),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_backward_matches_autograd(self):
+        out = _mlp_sym()
+        ex = out.simple_bind(mx.cpu(), data=(8, 10), softmax_label=(8,))
+        rng = np.random.RandomState(3)
+        for k, v in ex.arg_dict.items():
+            if k.endswith("weight"):
+                v._data = mx.nd.array(rng.randn(*v.shape) * 0.1)._data
+        x = rng.randn(8, 10).astype(np.float32)
+        y = rng.randint(0, 4, (8,)).astype(np.float32)
+        ex.forward(is_train=True, data=x, softmax_label=y)
+        ex.backward()
+
+        params = {k: mx.nd.array(v.asnumpy()) for k, v in ex.arg_dict.items()
+                  if k not in ("data", "softmax_label")}
+        for p in params.values():
+            p.attach_grad()
+        with mx.autograd.record():
+            h = nd.FullyConnected(mx.nd.array(x), params["fc1_weight"],
+                                  params["fc1_bias"], num_hidden=16)
+            h = nd.Activation(h, act_type="relu")
+            o = nd.FullyConnected(h, params["fc2_weight"],
+                                  params["fc2_bias"], num_hidden=4)
+            loss = nd.SoftmaxOutput(o, mx.nd.array(y))
+        loss.backward()
+        for k in params:
+            np.testing.assert_allclose(
+                ex.grad_dict[k].asnumpy(), params[k].grad.asnumpy(),
+                rtol=1e-4, atol=1e-6, err_msg=k)
+
+    def test_grad_req_null_and_add(self):
+        a = sym.Variable("a")
+        out = sym.sum(a * a, name="s")
+        ex = out.bind(mx.cpu(), args={"a": mx.nd.ones((3,))},
+                      grad_req="add")
+        ex.forward(is_train=True)
+        ex.backward()
+        ex.backward()
+        np.testing.assert_allclose(ex.grad_dict["a"].asnumpy(), np.full((3,), 4.0))
+
+    def test_batchnorm_aux_update(self):
+        data = sym.Variable("data")
+        net = sym.BatchNorm(sym.FullyConnected(data, num_hidden=6, name="fc"),
+                            name="bn", momentum=0.5)
+        ex = net.simple_bind(mx.cpu(), data=(16, 4))
+        rng = np.random.RandomState(0)
+        ex.arg_dict["fc_weight"]._data = mx.nd.array(rng.randn(6, 4))._data
+        ex.arg_dict["bn_gamma"]._data = mx.nd.ones((6,))._data
+        mm0 = ex.aux_dict["bn_moving_mean"].asnumpy().copy()
+        ex.forward(is_train=True, data=rng.randn(16, 4).astype(np.float32))
+        mm1 = ex.aux_dict["bn_moving_mean"].asnumpy()
+        assert not np.allclose(mm0, mm1)
+        ex.forward(is_train=False, data=rng.randn(16, 4).astype(np.float32))
+        np.testing.assert_allclose(mm1, ex.aux_dict["bn_moving_mean"].asnumpy())
+
+
+class TestReviewRegressions:
+    def test_auto_label_creation(self):
+        """sym.SoftmaxOutput(data) without an explicit label must create
+        '<name>_label' (the idiom Module's default label_names relies on)."""
+        data = sym.Variable("data")
+        fc = sym.FullyConnected(data, num_hidden=4, name="fc")
+        out = sym.SoftmaxOutput(fc, name="softmax")
+        assert "softmax_label" in out.list_arguments()
+        ex = out.simple_bind(mx.cpu(), data=(8, 10))
+        assert ex.arg_dict["softmax_label"].shape == (8,)
+
+    def test_label_shape_inferred_from_data(self):
+        data = sym.Variable("data")
+        fc = sym.FullyConnected(data, num_hidden=4, name="fc")
+        out = sym.SoftmaxOutput(fc, label=sym.Variable("softmax_label"), name="softmax")
+        arg_shapes, _, _ = out.infer_shape(data=(8, 10))
+        d = dict(zip(out.list_arguments(), arg_shapes))
+        assert d["softmax_label"] == (8,)
+
+    def test_variadic_concat(self):
+        a = sym.Variable("a")
+        b = sym.Variable("b")
+        c = sym.concat(a, b, dim=1)
+        _, out_shapes, _ = c.infer_shape(a=(2, 3), b=(2, 5))
+        assert out_shapes == [(2, 8)]
+        out = c.eval(a=mx.nd.ones((2, 3)), b=mx.nd.zeros((2, 5)))
+        assert out[0].shape == (2, 8)
+
+    def test_forward_unknown_feed_raises(self):
+        data = sym.Variable("data")
+        out = sym.relu(data, name="r")
+        ex = out.simple_bind(mx.cpu(), data=(2, 2))
+        with pytest.raises(ValueError, match="not an argument"):
+            ex.forward(is_train=False, dta=np.zeros((2, 2), np.float32))
