@@ -55,6 +55,8 @@ from .executor import Executor
 from . import io
 from . import module
 from . import module as mod
+from . import recordio
+from . import image
 from .util import np_shape, np_array, is_np_shape, is_np_array, set_np, reset_np
 from . import numpy_ns as np  # mx.np numpy-compat namespace
 from .utils import test_utils

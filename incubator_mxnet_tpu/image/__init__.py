@@ -1,0 +1,3 @@
+"""``mx.image`` namespace (parity: [U:python/mxnet/image/])."""
+from .image import *  # noqa: F401,F403
+from .image import __all__  # noqa: F401

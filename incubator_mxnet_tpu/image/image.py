@@ -1,0 +1,313 @@
+"""``mx.image`` — image decode/augment utilities.
+
+Parity target: [U:python/mxnet/image/image.py] (``imdecode``, ``imresize``,
+``fixed_crop``/``center_crop``/``random_crop``, ``color_normalize``,
+augmenter list, ``ImageIter``).  The reference backs these with C++ OpenCV
+ops; here decode uses PIL (host side — decode never belongs on the TPU)
+and the array math is NDArray ops.  The high-throughput training path is
+``mx.io.ImageRecordIter`` (native C++); this module is the flexible
+per-image API.
+"""
+from __future__ import annotations
+
+import io as _pyio
+import os
+import random as _pyrandom
+
+import numpy as _np
+
+from .. import ndarray as nd
+from ..ndarray.ndarray import NDArray
+from ..io.io import DataBatch, DataDesc, DataIter
+
+__all__ = [
+    "imdecode", "imread", "imresize", "resize_short", "fixed_crop",
+    "center_crop", "random_crop", "color_normalize", "HorizontalFlipAug",
+    "CastAug", "ColorNormalizeAug", "ResizeAug", "ForceResizeAug",
+    "RandomCropAug", "CenterCropAug", "CreateAugmenter", "ImageIter",
+]
+
+
+def imdecode(buf, flag=1, to_rgb=True, out=None):
+    """Decode encoded image bytes → HWC uint8 NDArray (parity:
+    ``mx.image.imdecode``; OpenCV's BGR default is normalized to RGB when
+    ``to_rgb``, matching the reference flag semantics)."""
+    from PIL import Image
+
+    if isinstance(buf, NDArray):
+        buf = buf.asnumpy().tobytes()
+    img = Image.open(_pyio.BytesIO(bytes(buf)))
+    img = img.convert("RGB" if flag else "L")
+    arr = _np.asarray(img)
+    if flag and not to_rgb:
+        arr = arr[..., ::-1].copy()  # caller wants BGR
+    if not flag:
+        arr = arr[..., None]
+    res = nd.array(arr, dtype="uint8")
+    if out is not None:
+        out._data = res._data
+        out._version += 1
+        return out
+    return res
+
+
+def imread(filename, flag=1, to_rgb=True):
+    with open(filename, "rb") as f:
+        return imdecode(f.read(), flag=flag, to_rgb=to_rgb)
+
+
+def imresize(src, w, h, interp=1):
+    """Resize HWC image to (h, w) (parity: ``mx.image.imresize``)."""
+    from PIL import Image
+
+    arr = src.asnumpy() if isinstance(src, NDArray) else _np.asarray(src)
+    squeeze = arr.ndim == 3 and arr.shape[2] == 1
+    img = Image.fromarray(arr[..., 0] if squeeze else arr)
+    method = Image.NEAREST if interp == 0 else Image.BILINEAR
+    out = _np.asarray(img.resize((w, h), method))
+    if squeeze:
+        out = out[..., None]
+    return nd.array(out, dtype=str(arr.dtype))
+
+
+def resize_short(src, size, interp=1):
+    """Resize shorter side to ``size`` keeping aspect ratio."""
+    arr = src.asnumpy() if isinstance(src, NDArray) else _np.asarray(src)
+    h, w = arr.shape[:2]
+    if h > w:
+        new_h, new_w = int(size * h / w), size
+    else:
+        new_h, new_w = size, int(size * w / h)
+    return imresize(src, new_w, new_h, interp)
+
+
+def fixed_crop(src, x0, y0, w, h, size=None, interp=1):
+    arr = src.asnumpy() if isinstance(src, NDArray) else _np.asarray(src)
+    out = arr[y0:y0 + h, x0:x0 + w]
+    res = nd.array(out, dtype=str(arr.dtype))
+    if size is not None and (w, h) != size:
+        res = imresize(res, size[0], size[1], interp)
+    return res
+
+
+def center_crop(src, size, interp=1):
+    arr = src.asnumpy() if isinstance(src, NDArray) else _np.asarray(src)
+    h, w = arr.shape[:2]
+    cw, ch = size
+    x0 = max((w - cw) // 2, 0)
+    y0 = max((h - ch) // 2, 0)
+    out = fixed_crop(src, x0, y0, min(cw, w), min(ch, h), size, interp)
+    return out, (x0, y0, cw, ch)
+
+
+def random_crop(src, size, interp=1):
+    arr = src.asnumpy() if isinstance(src, NDArray) else _np.asarray(src)
+    h, w = arr.shape[:2]
+    cw, ch = size
+    x0 = _pyrandom.randint(0, max(w - cw, 0))
+    y0 = _pyrandom.randint(0, max(h - ch, 0))
+    out = fixed_crop(src, x0, y0, min(cw, w), min(ch, h), size, interp)
+    return out, (x0, y0, cw, ch)
+
+
+def color_normalize(src, mean, std=None):
+    src = src if isinstance(src, NDArray) else nd.array(src)
+    src = NDArray(src._data.astype("float32"))
+    out = src - (mean if isinstance(mean, NDArray) else nd.array(_np.asarray(mean, dtype=_np.float32)))
+    if std is not None:
+        out = out / (std if isinstance(std, NDArray) else nd.array(_np.asarray(std, dtype=_np.float32)))
+    return out
+
+
+# -- augmenters (parity: Augmenter classes) ---------------------------------
+
+class Augmenter:
+    def __call__(self, src):
+        raise NotImplementedError
+
+
+class ResizeAug(Augmenter):
+    def __init__(self, size, interp=1):
+        self.size, self.interp = size, interp
+
+    def __call__(self, src):
+        return resize_short(src, self.size, self.interp)
+
+
+class ForceResizeAug(Augmenter):
+    def __init__(self, size, interp=1):
+        self.size, self.interp = size, interp
+
+    def __call__(self, src):
+        return imresize(src, self.size[0], self.size[1], self.interp)
+
+
+class RandomCropAug(Augmenter):
+    def __init__(self, size, interp=1):
+        self.size, self.interp = size, interp
+
+    def __call__(self, src):
+        return random_crop(src, self.size, self.interp)[0]
+
+
+class CenterCropAug(Augmenter):
+    def __init__(self, size, interp=1):
+        self.size, self.interp = size, interp
+
+    def __call__(self, src):
+        return center_crop(src, self.size, self.interp)[0]
+
+
+class HorizontalFlipAug(Augmenter):
+    def __init__(self, p=0.5):
+        self.p = p
+
+    def __call__(self, src):
+        if _pyrandom.random() < self.p:
+            arr = src.asnumpy() if isinstance(src, NDArray) else _np.asarray(src)
+            return nd.array(arr[:, ::-1].copy(), dtype=str(arr.dtype))
+        return src
+
+
+class CastAug(Augmenter):
+    def __init__(self, dtype="float32"):
+        self.dtype = dtype
+
+    def __call__(self, src):
+        return NDArray(src._data.astype(self.dtype))
+
+
+class ColorNormalizeAug(Augmenter):
+    def __init__(self, mean, std):
+        self.mean = _np.asarray(mean, dtype=_np.float32)
+        self.std = _np.asarray(std, dtype=_np.float32) if std is not None else None
+
+    def __call__(self, src):
+        return color_normalize(src, nd.array(self.mean),
+                               nd.array(self.std) if self.std is not None else None)
+
+
+def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_mirror=False,
+                    mean=None, std=None, interp=1, **kwargs):
+    """Build the standard augmenter list (parity: ``CreateAugmenter``)."""
+    auglist = []
+    if resize > 0:
+        auglist.append(ResizeAug(resize, interp))
+    crop_size = (data_shape[2], data_shape[1])
+    if rand_crop:
+        auglist.append(RandomCropAug(crop_size, interp))
+    else:
+        auglist.append(CenterCropAug(crop_size, interp))
+    if rand_mirror:
+        auglist.append(HorizontalFlipAug(0.5))
+    auglist.append(CastAug())
+    if mean is True:
+        mean = _np.array([123.68, 116.28, 103.53], dtype=_np.float32)
+    if std is True:
+        std = _np.array([58.395, 57.12, 57.375], dtype=_np.float32)
+    if mean is not None:
+        auglist.append(ColorNormalizeAug(mean, std))
+    return auglist
+
+
+class ImageIter(DataIter):
+    """Python-side image iterator over .lst/.rec inputs (parity:
+    ``mx.image.ImageIter`` — the flexible pipeline; the C++ one is
+    ``mx.io.ImageRecordIter``)."""
+
+    def __init__(self, batch_size, data_shape, path_imgrec=None,
+                 path_imglist=None, path_root="", shuffle=False,
+                 aug_list=None, imglist=None, label_width=1,
+                 data_name="data", label_name="softmax_label", **kwargs):
+        super().__init__(batch_size)
+        assert path_imgrec or path_imglist or imglist, "need a data source"
+        self._shape = tuple(data_shape)
+        self._data_name = data_name
+        self._label_name = label_name
+        self.auglist = aug_list if aug_list is not None else CreateAugmenter(
+            data_shape, **kwargs)
+        self._rec = None
+        self._items = []
+        if path_imgrec:
+            from ..recordio import MXIndexedRecordIO, MXRecordIO
+            idx_path = os.path.splitext(path_imgrec)[0] + ".idx"
+            if os.path.exists(idx_path):
+                self._rec = MXIndexedRecordIO(idx_path, path_imgrec, "r")
+                self._items = list(self._rec.keys)
+            else:
+                self._rec = MXRecordIO(path_imgrec, "r")
+                offsets = []
+                pos = self._rec.tell()
+                while self._rec.read() is not None:
+                    offsets.append(pos)
+                    pos = self._rec.tell()
+                self._items = offsets
+        else:
+            if imglist is None:
+                imglist = []
+                with open(path_imglist) as f:
+                    for line in f:
+                        parts = line.strip().split("\t")
+                        imglist.append((float(parts[1]), parts[-1]))
+            self._items = [(lab, os.path.join(path_root, p)) for lab, p in imglist]
+        self._order = list(range(len(self._items)))
+        self._shuffle = shuffle
+        self._cursor = 0
+        if shuffle:
+            _pyrandom.shuffle(self._order)
+
+    @property
+    def provide_data(self):
+        return [DataDesc(self._data_name, (self.batch_size,) + self._shape)]
+
+    @property
+    def provide_label(self):
+        return [DataDesc(self._label_name, (self.batch_size,))]
+
+    def reset(self):
+        self._cursor = 0
+        if self._shuffle:
+            _pyrandom.shuffle(self._order)
+
+    def _read_one(self, i):
+        from ..recordio import unpack
+        item = self._items[self._order[i]]
+        if self._rec is not None:
+            if hasattr(self._rec, "read_idx"):
+                payload = self._rec.read_idx(item)
+            else:
+                self._rec.fh.seek(item)
+                payload = self._rec.read()
+            header, img_bytes = unpack(payload)
+            label = header.label
+            img = imdecode(img_bytes)
+        else:
+            label, path = item
+            img = imread(path)
+        for aug in self.auglist:
+            img = aug(img)
+        lab = label if _np.isscalar(label) else _np.asarray(label).ravel()[0]
+        return img, float(lab)
+
+    def next(self):
+        c, h, w = self._shape
+        remaining = len(self._order) - self._cursor
+        if remaining <= 0:
+            raise StopIteration
+        n = min(self.batch_size, remaining)
+        data = _np.zeros((self.batch_size, c, h, w), dtype=_np.float32)
+        label = _np.zeros((self.batch_size,), dtype=_np.float32)
+        for i in range(n):
+            img, lab = self._read_one(self._cursor + i)
+            arr = img.asnumpy() if isinstance(img, NDArray) else _np.asarray(img)
+            data[i] = arr.transpose(2, 0, 1)
+            label[i] = lab
+        self._cursor += n
+        pad = self.batch_size - n
+        if pad:
+            for i in range(n, self.batch_size):
+                data[i] = data[i - n]
+                label[i] = label[i - n]
+        return DataBatch([nd.array(data)], [nd.array(label)], pad=pad,
+                         provide_data=self.provide_data,
+                         provide_label=self.provide_label)

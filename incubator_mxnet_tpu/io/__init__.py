@@ -8,6 +8,7 @@ from .io import (
     PrefetchingIter,
     CSVIter,
 )
+from .record_iter import ImageRecordIter
 
 __all__ = [
     "DataDesc",
@@ -17,4 +18,5 @@ __all__ = [
     "ResizeIter",
     "PrefetchingIter",
     "CSVIter",
+    "ImageRecordIter",
 ]

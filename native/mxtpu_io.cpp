@@ -1,0 +1,403 @@
+// Native data pipeline: RecordIO reader + JPEG decode/augment thread pool.
+//
+// Parity target: the reference's C++ ImageRecordIter pipeline
+// ([U:src/io/iter_image_recordio_2.cc]): RecordIO chunk readers → OpenCV
+// decode+augment worker pool → batcher → double-buffered prefetch.  Here:
+// a reader thread parses the dmlc RecordIO framing, a pool of decode
+// workers does libjpeg decode + resize/crop/mirror/normalize straight into
+// per-batch float buffers (NCHW), and the Python side device_puts the
+// filled buffer (host staging → TPU).  Sharded reading via
+// part_index/num_parts matches the reference's distributed contract.
+//
+// C ABI (ctypes-consumed; no pybind11 in this environment):
+//   MXTImageIterCreate / Next / Reset / Free, MXTRecordCount.
+//
+// RecordIO framing (dmlc-core recordio.h): [magic=0xced7230a][lrec][payload]
+// with 4-byte alignment padding; lrec upper 3 bits = continuation flag,
+// lower 29 = length.  Image payload = IRHeader{flag,label,id,id2} (24B) +
+// flag*4 bytes of extra float labels + encoded image.
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <algorithm>
+#include <atomic>
+#include <condition_variable>
+#include <mutex>
+#include <random>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include <jpeglib.h>
+#include <setjmp.h>
+
+namespace {
+
+void WarnOnce(const char* what) {
+  static std::atomic<int> warned{0};
+  if (warned.fetch_add(1) == 0)
+    std::fprintf(stderr, "[mxtpu_io] WARNING: %s (reported once)\n", what);
+}
+
+constexpr uint32_t kMagic = 0xced7230a;
+
+struct IRHeader {
+  uint32_t flag;
+  float label;
+  uint64_t id;
+  uint64_t id2;
+};
+
+// ---------------------------------------------------------------------------
+// RecordIO parsing
+// ---------------------------------------------------------------------------
+
+struct Record {
+  std::vector<uint8_t> payload;  // IRHeader + extra labels + image bytes
+};
+
+class RecordIOReader {
+ public:
+  explicit RecordIOReader(const std::string& path) : file_(nullptr) {
+    file_ = std::fopen(path.c_str(), "rb");
+  }
+  ~RecordIOReader() {
+    if (file_) std::fclose(file_);
+  }
+  bool ok() const { return file_ != nullptr; }
+
+  void Seek(uint64_t offset) { std::fseek(file_, (long)offset, SEEK_SET); }
+  uint64_t Tell() { return (uint64_t)std::ftell(file_); }
+
+  // Read one logical record (reassembling continuation parts).
+  bool Next(Record* out) {
+    out->payload.clear();
+    while (true) {
+      uint32_t magic, lrec;
+      if (std::fread(&magic, 4, 1, file_) != 1) return false;
+      if (magic != kMagic) return false;  // corrupt or EOF padding
+      if (std::fread(&lrec, 4, 1, file_) != 1) return false;
+      uint32_t cflag = lrec >> 29u;
+      uint32_t len = lrec & ((1u << 29u) - 1u);
+      size_t off = out->payload.size();
+      out->payload.resize(off + len);
+      if (len && std::fread(out->payload.data() + off, 1, len, file_) != len)
+        return false;
+      size_t pad = (4 - (len % 4)) % 4;
+      if (pad) std::fseek(file_, (long)pad, SEEK_CUR);
+      // cflag: 0 = whole record, 1 = first part, 2 = middle, 3 = last
+      if (cflag == 0 || cflag == 3) return true;
+    }
+  }
+
+ private:
+  std::FILE* file_;
+};
+
+// ---------------------------------------------------------------------------
+// JPEG decode (libjpeg) with error-trap (corrupt images must not abort)
+// ---------------------------------------------------------------------------
+
+struct JpegErrorMgr {
+  jpeg_error_mgr pub;
+  jmp_buf setjmp_buffer;
+};
+
+void JpegErrorExit(j_common_ptr cinfo) {
+  auto* err = reinterpret_cast<JpegErrorMgr*>(cinfo->err);
+  longjmp(err->setjmp_buffer, 1);
+}
+
+// decode to RGB u8, returns false on failure
+bool DecodeJpeg(const uint8_t* data, size_t len, std::vector<uint8_t>* out,
+                int* out_h, int* out_w) {
+  jpeg_decompress_struct cinfo;
+  JpegErrorMgr jerr;
+  cinfo.err = jpeg_std_error(&jerr.pub);
+  jerr.pub.error_exit = JpegErrorExit;
+  if (setjmp(jerr.setjmp_buffer)) {
+    jpeg_destroy_decompress(&cinfo);
+    return false;
+  }
+  jpeg_create_decompress(&cinfo);
+  jpeg_mem_src(&cinfo, const_cast<uint8_t*>(data), (unsigned long)len);
+  jpeg_read_header(&cinfo, TRUE);
+  cinfo.out_color_space = JCS_RGB;
+  jpeg_start_decompress(&cinfo);
+  int w = (int)cinfo.output_width, h = (int)cinfo.output_height;
+  out->resize((size_t)w * h * 3);
+  while (cinfo.output_scanline < cinfo.output_height) {
+    uint8_t* row = out->data() + (size_t)cinfo.output_scanline * w * 3;
+    jpeg_read_scanlines(&cinfo, &row, 1);
+  }
+  jpeg_finish_decompress(&cinfo);
+  jpeg_destroy_decompress(&cinfo);
+  *out_h = h;
+  *out_w = w;
+  return true;
+}
+
+// ---------------------------------------------------------------------------
+// Augment: bilinear resize + crop + mirror + normalize → NCHW float32
+// ---------------------------------------------------------------------------
+
+void BilinearResize(const uint8_t* src, int sh, int sw, uint8_t* dst, int dh,
+                    int dw) {
+  const float ry = dh > 1 ? (float)(sh - 1) / (dh - 1) : 0.f;
+  const float rx = dw > 1 ? (float)(sw - 1) / (dw - 1) : 0.f;
+  for (int y = 0; y < dh; ++y) {
+    float fy = y * ry;
+    int y0 = (int)fy, y1 = y0 + 1 < sh ? y0 + 1 : sh - 1;
+    float wy = fy - y0;
+    for (int x = 0; x < dw; ++x) {
+      float fx = x * rx;
+      int x0 = (int)fx, x1 = x0 + 1 < sw ? x0 + 1 : sw - 1;
+      float wx = fx - x0;
+      for (int c = 0; c < 3; ++c) {
+        float v00 = src[(y0 * sw + x0) * 3 + c];
+        float v01 = src[(y0 * sw + x1) * 3 + c];
+        float v10 = src[(y1 * sw + x0) * 3 + c];
+        float v11 = src[(y1 * sw + x1) * 3 + c];
+        float v = v00 * (1 - wy) * (1 - wx) + v01 * (1 - wy) * wx +
+                  v10 * wy * (1 - wx) + v11 * wy * wx;
+        dst[(y * dw + x) * 3 + c] = (uint8_t)(v + 0.5f);
+      }
+    }
+  }
+}
+
+struct AugmentConfig {
+  int h = 224, w = 224, c = 3;
+  int rand_crop = 0;
+  int rand_mirror = 0;
+  int resize_shorter = 0;  // 0 = resize exactly to crop target
+  float mean[3] = {0.f, 0.f, 0.f};
+  float std_[3] = {1.f, 1.f, 1.f};
+};
+
+// Decode record → write NCHW float32 into out (h*w*c floats).
+bool ProcessImage(const uint8_t* img, size_t len, const AugmentConfig& cfg,
+                  std::mt19937* rng, float* out) {
+  std::vector<uint8_t> rgb;
+  int h = 0, w = 0;
+  if (!DecodeJpeg(img, len, &rgb, &h, &w)) return false;
+
+  std::vector<uint8_t> resized;
+  const uint8_t* cur = rgb.data();
+  int ch = h, cw = w;
+  int target_h = cfg.h, target_w = cfg.w;
+  int min_side = cfg.resize_shorter;
+  if (min_side <= 0 && (h < target_h || w < target_w))
+    min_side = target_h > target_w ? target_h : target_w;
+  if (min_side > 0) {
+    // resize shorter side to min_side, then crop
+    float scale = (float)min_side / (h < w ? h : w);
+    int nh = (int)(h * scale + 0.5f), nw = (int)(w * scale + 0.5f);
+    if (nh < target_h) nh = target_h;
+    if (nw < target_w) nw = target_w;
+    resized.resize((size_t)nh * nw * 3);
+    BilinearResize(cur, ch, cw, resized.data(), nh, nw);
+    cur = resized.data();
+    ch = nh;
+    cw = nw;
+  } else if (h != target_h || w != target_w) {
+    if (h >= target_h && w >= target_w) {
+      // big enough: crop directly below
+    } else {
+      resized.resize((size_t)target_h * target_w * 3);
+      BilinearResize(cur, ch, cw, resized.data(), target_h, target_w);
+      cur = resized.data();
+      ch = target_h;
+      cw = target_w;
+    }
+  }
+
+  int y0 = (ch - target_h) / 2, x0 = (cw - target_w) / 2;
+  if (cfg.rand_crop && rng) {
+    y0 = ch > target_h ? (int)((*rng)() % (uint32_t)(ch - target_h + 1)) : 0;
+    x0 = cw > target_w ? (int)((*rng)() % (uint32_t)(cw - target_w + 1)) : 0;
+  }
+  bool mirror = cfg.rand_mirror && rng && ((*rng)() & 1u);
+
+  const size_t plane = (size_t)target_h * target_w;
+  for (int y = 0; y < target_h; ++y) {
+    for (int x = 0; x < target_w; ++x) {
+      int sx = mirror ? (target_w - 1 - x) : x;
+      const uint8_t* px = cur + ((size_t)(y0 + y) * cw + (x0 + sx)) * 3;
+      for (int c = 0; c < 3; ++c) {
+        out[c * plane + (size_t)y * target_w + x] =
+            ((float)px[c] - cfg.mean[c]) / cfg.std_[c];
+      }
+    }
+  }
+  return true;
+}
+
+// ---------------------------------------------------------------------------
+// Pipeline: reader thread → record queue → decode pool → batch
+// ---------------------------------------------------------------------------
+
+struct ImageIter {
+  std::string rec_path;
+  AugmentConfig cfg;
+  int batch = 0;
+  int num_threads = 4;
+  int shuffle = 0;
+  unsigned seed = 0;
+  int part_index = 0, num_parts = 1;
+
+  std::vector<uint64_t> offsets;  // record start offsets (this shard's)
+  std::vector<size_t> order;      // iteration order over offsets
+  size_t cursor = 0;              // next record to hand out
+  size_t epoch = 0;               // advances augment RNG across epochs
+  std::mt19937 epoch_rng;
+
+  // scan all record offsets once, shard by part_index/num_parts
+  bool Init() {
+    RecordIOReader r(rec_path);
+    if (!r.ok()) return false;
+    std::vector<uint64_t> all;
+    Record rec;
+    uint64_t off = r.Tell();
+    while (r.Next(&rec)) {
+      all.push_back(off);
+      off = r.Tell();
+    }
+    for (size_t i = 0; i < all.size(); ++i)
+      if ((int)(i % (size_t)num_parts) == part_index) offsets.push_back(all[i]);
+    order.resize(offsets.size());
+    for (size_t i = 0; i < order.size(); ++i) order[i] = i;
+    epoch_rng.seed(seed);
+    Reset();
+    return true;
+  }
+
+  void Reset() {
+    cursor = 0;
+    ++epoch;
+    if (shuffle)
+      std::shuffle(order.begin(), order.end(), epoch_rng);
+  }
+
+  // Fill one batch. Returns number of valid samples (0 = epoch end).
+  int NextBatch(float* out_data, float* out_label) {
+    size_t remaining = order.size() - cursor;
+    if (remaining == 0) return 0;
+    int n = (int)(remaining < (size_t)batch ? remaining : (size_t)batch);
+
+    std::atomic<int> next_idx{0};
+    std::atomic<int> n_ok{0};
+    const size_t sample_floats = (size_t)cfg.h * cfg.w * cfg.c;
+    size_t base = cursor;
+
+    auto worker = [&](int tid) {
+      RecordIOReader r(rec_path);  // per-thread handle: no seek contention
+      std::mt19937 rng(seed + (unsigned)(base * 131 + tid) +
+                       (unsigned)(epoch * 7919));  // fresh augs every epoch
+      Record rec;
+      while (true) {
+        int i = next_idx.fetch_add(1);
+        if (i >= n) break;
+        float* slot = out_data + (size_t)i * sample_floats;
+        r.Seek(offsets[order[base + i]]);
+        if (!r.Next(&rec) || rec.payload.size() < sizeof(IRHeader)) {
+          // corrupt/truncated record: never hand uninitialized memory to
+          // the training batch
+          std::memset(slot, 0, sample_floats * sizeof(float));
+          out_label[i] = 0.f;
+          WarnOnce("corrupt record");
+          continue;
+        }
+        IRHeader hdr;
+        std::memcpy(&hdr, rec.payload.data(), sizeof(hdr));
+        size_t img_off = sizeof(IRHeader) + (size_t)hdr.flag * 4;
+        // vector labels (flag > 0): header.label is 0; use the first
+        // element like the Python fallback does
+        float label = hdr.label;
+        if (hdr.flag > 0 && rec.payload.size() >= sizeof(IRHeader) + 4)
+          std::memcpy(&label, rec.payload.data() + sizeof(IRHeader), 4);
+        if (rec.payload.size() <= img_off) {
+          std::memset(slot, 0, sample_floats * sizeof(float));
+          out_label[i] = label;
+          WarnOnce("empty image payload");
+          continue;
+        }
+        if (ProcessImage(rec.payload.data() + img_off,
+                         rec.payload.size() - img_off, cfg, &rng, slot)) {
+          out_label[i] = label;
+          n_ok.fetch_add(1);
+        } else {
+          // decode failure (non-JPEG or corrupt): zero the slot, keep the
+          // label so batch shape stays static for XLA, and warn loudly —
+          // silent all-zero images are a training-killing failure mode
+          std::memset(slot, 0, sample_floats * sizeof(float));
+          out_label[i] = label;
+          WarnOnce("JPEG decode failed (non-JPEG payload? repack with "
+                   "tools/im2rec.py, which re-encodes to JPEG)");
+        }
+      }
+    };
+
+    int nt = num_threads < n ? num_threads : n;
+    std::vector<std::thread> pool;
+    pool.reserve(nt);
+    for (int t = 0; t < nt; ++t) pool.emplace_back(worker, t);
+    for (auto& th : pool) th.join();
+
+    cursor += (size_t)n;
+    return n;
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* MXTImageIterCreate(const char* rec_path, int batch, int h, int w, int c,
+                         int num_threads, int shuffle, unsigned seed,
+                         int part_index, int num_parts, const float* mean_rgb,
+                         const float* std_rgb, int rand_mirror, int rand_crop,
+                         int resize_shorter) {
+  if (c != 3) return nullptr;  // RGB-only pipeline; caller falls back
+  auto* it = new ImageIter();
+  it->rec_path = rec_path;
+  it->batch = batch;
+  it->cfg.h = h;
+  it->cfg.w = w;
+  it->cfg.c = c;
+  it->cfg.rand_mirror = rand_mirror;
+  it->cfg.rand_crop = rand_crop;
+  it->cfg.resize_shorter = resize_shorter;
+  for (int i = 0; i < 3; ++i) {
+    it->cfg.mean[i] = mean_rgb ? mean_rgb[i] : 0.f;
+    it->cfg.std_[i] = std_rgb ? std_rgb[i] : 1.f;
+  }
+  it->num_threads = num_threads > 0 ? num_threads : 4;
+  it->shuffle = shuffle;
+  it->seed = seed;
+  it->part_index = part_index;
+  it->num_parts = num_parts > 0 ? num_parts : 1;
+  if (!it->Init()) {
+    delete it;
+    return nullptr;
+  }
+  return it;
+}
+
+long MXTImageIterNumSamples(void* handle) {
+  return (long)static_cast<ImageIter*>(handle)->offsets.size();
+}
+
+int MXTImageIterNext(void* handle, float* out_data, float* out_label) {
+  return static_cast<ImageIter*>(handle)->NextBatch(out_data, out_label);
+}
+
+void MXTImageIterReset(void* handle) {
+  static_cast<ImageIter*>(handle)->Reset();
+}
+
+void MXTImageIterFree(void* handle) { delete static_cast<ImageIter*>(handle); }
+
+}  // extern "C"

@@ -1,0 +1,253 @@
+"""RecordIO + image pipeline tests (parity idioms: test_recordio.py /
+test_io.py / test_image.py in the reference)."""
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import recordio
+
+
+@pytest.fixture(scope="module")
+def img_pack(tmp_path_factory):
+    """12 synthetic JPEGs in 2 class dirs, packed via tools/im2rec.py."""
+    root = tmp_path_factory.mktemp("imgs")
+    from PIL import Image
+    rng = np.random.RandomState(0)
+    for i in range(12):
+        cls = root / ("cat" if i % 2 == 0 else "dog")
+        cls.mkdir(exist_ok=True)
+        h, w = rng.randint(40, 120), rng.randint(40, 120)
+        arr = rng.randint(0, 255, (h, w, 3), dtype=np.uint8)
+        Image.fromarray(arr).save(str(cls / f"img{i}.jpg"), quality=90)
+    prefix = str(tmp_path_factory.mktemp("pack") / "data")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    subprocess.run([sys.executable, os.path.join(repo, "tools", "im2rec.py"),
+                    prefix, str(root)], check=True, capture_output=True)
+    return prefix
+
+
+class TestRecordIO:
+    def test_write_read_roundtrip(self, tmp_path):
+        path = str(tmp_path / "t.rec")
+        w = recordio.MXRecordIO(path, "w")
+        payloads = [b"hello", b"x" * 1001, b""]
+        for p in payloads:
+            w.write(p)
+        w.close()
+        r = recordio.MXRecordIO(path, "r")
+        got = [r.read() for _ in payloads]
+        assert got == payloads
+        assert r.read() is None
+
+    def test_indexed_roundtrip(self, tmp_path):
+        rec = str(tmp_path / "t.rec")
+        idx = str(tmp_path / "t.idx")
+        w = recordio.MXIndexedRecordIO(idx, rec, "w")
+        for i in range(5):
+            w.write_idx(i * 7, f"rec{i}".encode())
+        w.close()
+        r = recordio.MXIndexedRecordIO(idx, rec, "r")
+        assert r.read_idx(21) == b"rec3"
+        assert r.read_idx(0) == b"rec0"
+        assert r.keys == [0, 7, 14, 21, 28]
+
+    def test_pack_unpack_scalar_and_vector_label(self):
+        h = recordio.IRHeader(0, 3.0, 42, 0)
+        s = recordio.pack(h, b"payload")
+        h2, data = recordio.unpack(s)
+        assert data == b"payload" and h2.label == 3.0 and h2.id == 42
+
+        hv = recordio.IRHeader(0, [1.0, 2.0, 3.0], 7, 0)
+        s = recordio.pack(hv, b"img")
+        h3, data = recordio.unpack(s)
+        np.testing.assert_allclose(h3.label, [1.0, 2.0, 3.0])
+        assert data == b"img"
+
+    def test_pack_img_roundtrip(self):
+        # smooth gradient: JPEG-friendly, so the roundtrip error is tight
+        y, x = np.mgrid[0:16, 0:16]
+        arr = np.stack([y * 8, x * 8, (y + x) * 4], axis=-1).astype(np.uint8)
+        s = recordio.pack_img(recordio.IRHeader(0, 1.0, 0, 0), arr, quality=100)
+        h, img = recordio.unpack_img(s)
+        assert img.shape == (16, 16, 3)
+        assert np.abs(img.astype(int) - arr.astype(int)).mean() < 3
+
+
+class TestImageRecordIter:
+    def test_native_pipeline(self, img_pack):
+        it = mx.io.ImageRecordIter(img_pack + ".rec", (3, 32, 32),
+                                   batch_size=5, shuffle=True, seed=3,
+                                   rand_mirror=True)
+        assert it.num_samples == 12
+        batches = list(it)
+        assert len(batches) == 3
+        assert batches[0].data[0].shape == (5, 3, 32, 32)
+        assert batches[-1].pad == 3
+        it.reset()
+        assert len(list(it)) == 3
+
+    def test_label_multiset_matches_fallback(self, img_pack):
+        import incubator_mxnet_tpu.io.record_iter as ri
+
+        def labels(it):
+            out = []
+            for b in it:
+                valid = b.data[0].shape[0] - (b.pad or 0)
+                out.extend(float(x) for x in b.label[0].asnumpy()[:valid])
+            return sorted(out)
+
+        it_native = mx.io.ImageRecordIter(img_pack + ".rec", (3, 32, 32), batch_size=5)
+        assert it_native._handle is not None, "native lib should be available"
+        saved, ri._LIB = ri._LIB, None
+        try:
+            it_py = mx.io.ImageRecordIter(img_pack + ".rec", (3, 32, 32), batch_size=5)
+            assert it_py._handle is None
+            assert labels(it_native) == labels(it_py) == [0.0] * 6 + [1.0] * 6
+        finally:
+            ri._LIB = saved
+
+    def test_sharding_partitions(self, img_pack):
+        its = [mx.io.ImageRecordIter(img_pack + ".rec", (3, 32, 32),
+                                     batch_size=4, part_index=i, num_parts=3)
+               for i in range(3)]
+        counts = [it.num_samples for it in its]
+        assert sum(counts) == 12 and all(c == 4 for c in counts)
+
+    def test_normalization_applied(self, img_pack):
+        it = mx.io.ImageRecordIter(img_pack + ".rec", (3, 32, 32), batch_size=12,
+                                   mean_r=128, mean_g=128, mean_b=128,
+                                   std_r=64, std_g=64, std_b=64)
+        b = next(iter(it))
+        arr = b.data[0].asnumpy()
+        assert arr.min() >= -2.0 and arr.max() <= 2.0
+        assert abs(arr.mean()) < 0.6  # roughly centered
+
+
+class TestImageModule:
+    def test_imdecode_imresize_crop(self):
+        from PIL import Image
+        import io as pio
+        arr = np.random.RandomState(1).randint(0, 255, (40, 60, 3), np.uint8)
+        buf = pio.BytesIO()
+        Image.fromarray(arr).save(buf, format="PNG")
+        img = mx.image.imdecode(buf.getvalue())
+        assert img.shape == (40, 60, 3)
+        np.testing.assert_array_equal(img.asnumpy(), arr)  # png lossless
+
+        r = mx.image.imresize(img, 30, 20)
+        assert r.shape == (20, 30, 3)
+        c, _ = mx.image.center_crop(img, (32, 32))
+        assert c.shape == (32, 32, 3)
+        rs = mx.image.resize_short(img, 32)
+        assert min(rs.shape[:2]) == 32
+
+    def test_color_normalize(self):
+        img = mx.nd.ones((4, 4, 3)) * 100
+        out = mx.image.color_normalize(img, mx.nd.array(np.array([50., 50., 50.], np.float32)),
+                                       mx.nd.array(np.array([25., 25., 25.], np.float32)))
+        np.testing.assert_allclose(out.asnumpy(), np.full((4, 4, 3), 2.0))
+
+    def test_image_iter_from_rec(self, img_pack):
+        it = mx.image.ImageIter(4, (3, 28, 28), path_imgrec=img_pack + ".rec",
+                                rand_crop=True, rand_mirror=True)
+        b = next(it)
+        assert b.data[0].shape == (4, 3, 28, 28)
+        assert b.label[0].shape == (4,)
+
+    def test_create_augmenter_pipeline(self):
+        augs = mx.image.CreateAugmenter((3, 24, 24), resize=28, rand_crop=True,
+                                        rand_mirror=True, mean=True, std=True)
+        img = mx.nd.array(np.random.RandomState(0).randint(
+            0, 255, (40, 50, 3)).astype(np.uint8), dtype="uint8")
+        for aug in augs:
+            img = aug(img)
+        assert img.shape == (24, 24, 3)
+        assert img.dtype == np.float32
+
+
+class TestReviewRegressions:
+    def test_vector_label_native_matches_fallback(self, tmp_path):
+        """flag>0 records: native must read label[0] like the fallback."""
+        import incubator_mxnet_tpu.io.record_iter as ri
+        from PIL import Image
+        import io as pio
+        rec_path = str(tmp_path / "v.rec")
+        w = recordio.MXRecordIO(rec_path, "w")
+        rng = np.random.RandomState(0)
+        for i in range(4):
+            buf = pio.BytesIO()
+            Image.fromarray(rng.randint(0, 255, (20, 20, 3), np.uint8)).save(buf, "JPEG")
+            w.write(recordio.pack(recordio.IRHeader(0, [float(i + 1), 9.0], i, 0),
+                                  buf.getvalue()))
+        w.close()
+
+        def labels(it):
+            return [float(x) for b in it
+                    for x in b.label[0].asnumpy()[:b.data[0].shape[0] - (b.pad or 0)]]
+
+        it_native = mx.io.ImageRecordIter(rec_path, (3, 16, 16), batch_size=4)
+        assert it_native._handle is not None
+        saved, ri._LIB = ri._LIB, None
+        try:
+            it_py = mx.io.ImageRecordIter(rec_path, (3, 16, 16), batch_size=4)
+        finally:
+            ri._LIB = saved
+        assert labels(it_native) == labels(it_py) == [1.0, 2.0, 3.0, 4.0]
+
+    def test_png_sources_reencoded_by_im2rec(self, tmp_path):
+        """PNG inputs must not become silent zero tensors in the native path."""
+        from PIL import Image
+        root = tmp_path / "pngs"
+        root.mkdir()
+        arr = np.full((30, 30, 3), 200, np.uint8)
+        for i in range(3):
+            Image.fromarray(arr).save(str(root / f"p{i}.png"))
+        prefix = str(tmp_path / "pk")
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        subprocess.run([sys.executable, os.path.join(repo, "tools", "im2rec.py"),
+                        prefix, str(root)], check=True, capture_output=True)
+        it = mx.io.ImageRecordIter(prefix + ".rec", (3, 16, 16), batch_size=3)
+        assert it._handle is not None
+        b = next(iter(it))
+        # pixels ≈ 200, nothing zeroed out
+        assert b.data[0].asnumpy().mean() > 150
+
+    def test_grayscale_uses_fallback(self, img_pack):
+        it = mx.io.ImageRecordIter(img_pack + ".rec", (1, 24, 24), batch_size=4)
+        assert it._handle is None  # native path is RGB-only
+        b = next(iter(it))
+        assert b.data[0].shape == (4, 1, 24, 24)
+
+    def test_indexed_writer_reset(self, tmp_path):
+        w = recordio.MXIndexedRecordIO(str(tmp_path / "a.idx"),
+                                       str(tmp_path / "a.rec"), "w")
+        w.write_idx(0, b"old")
+        w.reset()
+        w.write_idx(1, b"new")
+        w.close()
+        r = recordio.MXIndexedRecordIO(str(tmp_path / "a.idx"),
+                                       str(tmp_path / "a.rec"), "r")
+        assert r.keys == [1] and r.read_idx(1) == b"new"
+
+    def test_iter_next_protocol(self, img_pack):
+        it = mx.io.ImageRecordIter(img_pack + ".rec", (3, 16, 16), batch_size=4)
+        seen = 0
+        while it.iter_next():
+            d = it.getdata()
+            assert d[0].shape == (4, 3, 16, 16)
+            batch = it.next()  # must consume the same batch, not skip one
+            seen += batch.data[0].shape[0] - (batch.pad or 0)
+        assert seen == 12
+
+    def test_augmentation_varies_across_epochs(self, img_pack):
+        it = mx.io.ImageRecordIter(img_pack + ".rec", (3, 24, 24), batch_size=12,
+                                   rand_crop=True, rand_mirror=True, seed=7)
+        assert it._handle is not None
+        e1 = next(iter(it)).data[0].asnumpy().copy()
+        it.reset()
+        e2 = next(iter(it)).data[0].asnumpy()
+        assert not np.allclose(e1, e2), "augment RNG must advance across epochs"

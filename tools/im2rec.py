@@ -1,0 +1,95 @@
+#!/usr/bin/env python
+"""im2rec — pack an image folder/list into RecordIO (parity:
+[U:tools/im2rec.py]).  Produces ``.rec`` + ``.idx`` files readable by both
+the native C++ pipeline and the reference format.
+
+Usage:
+  python tools/im2rec.py <prefix> <root> --list        # generate .lst
+  python tools/im2rec.py <prefix> <root>               # pack from .lst
+List format (reference-compatible): ``index\\tlabel\\trelpath`` per line.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from incubator_mxnet_tpu.recordio import (  # noqa: E402
+    IRHeader, MXIndexedRecordIO, pack, pack_img)
+
+_EXTS = (".jpg", ".jpeg", ".png")
+
+
+def make_list(prefix, root):
+    classes = sorted(d for d in os.listdir(root)
+                     if os.path.isdir(os.path.join(root, d)))
+    label_of = {c: i for i, c in enumerate(classes)}
+    entries = []
+    if classes:
+        for c in classes:
+            for fn in sorted(os.listdir(os.path.join(root, c))):
+                if fn.lower().endswith(_EXTS):
+                    entries.append((label_of[c], os.path.join(c, fn)))
+    else:
+        for fn in sorted(os.listdir(root)):
+            if fn.lower().endswith(_EXTS):
+                entries.append((0, fn))
+    with open(prefix + ".lst", "w") as f:
+        for i, (label, rel) in enumerate(entries):
+            f.write(f"{i}\t{float(label)}\t{rel}\n")
+    print(f"wrote {len(entries)} entries to {prefix}.lst")
+
+
+def pack_list(prefix, root, quality=95, resize=0):
+    rec = MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "w")
+    n = 0
+    with open(prefix + ".lst") as f:
+        for line in f:
+            parts = line.strip().split("\t")
+            if len(parts) < 3:
+                continue
+            idx, label, rel = int(parts[0]), float(parts[1]), parts[2]
+            path = os.path.join(root, rel)
+            header = IRHeader(0, label, idx, 0)
+            is_jpeg = rel.lower().endswith((".jpg", ".jpeg"))
+            if resize or not is_jpeg:
+                # non-JPEG sources are re-encoded: the native training
+                # pipeline (native/mxtpu_io.cpp) decodes JPEG only
+                import numpy as np
+                from PIL import Image
+                img = Image.open(path).convert("RGB")
+                if resize:
+                    w, h = img.size
+                    scale = resize / min(w, h)
+                    img = img.resize((int(w * scale + 0.5), int(h * scale + 0.5)),
+                                     Image.BILINEAR)
+                rec.write_idx(idx, pack_img(header, np.asarray(img), quality))
+            else:
+                with open(path, "rb") as imf:
+                    rec.write_idx(idx, pack(header, imf.read()))
+            n += 1
+    rec.close()
+    print(f"packed {n} images into {prefix}.rec")
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("prefix")
+    p.add_argument("root")
+    p.add_argument("--list", action="store_true", help="generate .lst only")
+    p.add_argument("--quality", type=int, default=95)
+    p.add_argument("--resize", type=int, default=0,
+                   help="resize shorter side before packing")
+    args = p.parse_args()
+    if args.list:
+        make_list(args.prefix, args.root)
+    else:
+        if not os.path.exists(args.prefix + ".lst"):
+            make_list(args.prefix, args.root)
+        pack_list(args.prefix, args.root, args.quality, args.resize)
+
+
+if __name__ == "__main__":
+    main()
