@@ -16,6 +16,7 @@ GluonNLP BERT-base (seq 128, fp16) per-V100 pretraining throughput
 (UNVERIFIED: reference mount was empty; see BASELINE.md provenance note).
 """
 import json
+import os
 import time
 
 import numpy as np
@@ -32,8 +33,14 @@ def main():
     from incubator_mxnet_tpu.parallel import make_mesh, SPMDTrainer
 
     backend = jax.default_backend()
-    B, S, vocab = 32, 128, 30522
+    B, S, vocab = 64, 128, 30522
     warmup, steps = (2, 20) if backend != "cpu" else (1, 2)
+
+    # BASELINE.md config 3 is mixed-precision: bf16 matmuls (MXU-native)
+    # with fp32 softmax/norms/optimizer state, via the mx.amp op lists.
+    from incubator_mxnet_tpu import amp
+    if os.environ.get("MXNET_TPU_BENCH_AMP", "1") == "1":
+        amp.init("bfloat16")
 
     cpu = jax.local_devices(backend="cpu")[0]
     with jax.default_device(cpu):

@@ -1,0 +1,24 @@
+"""``mx.amp`` namespace (parity: [U:python/mxnet/contrib/amp/])."""
+from .amp import (
+    init,
+    init_trainer,
+    is_enabled,
+    disable,
+    scale_loss,
+    unscale,
+    convert_hybrid_block,
+    LossScaler,
+)
+from . import lists
+
+__all__ = [
+    "init",
+    "init_trainer",
+    "is_enabled",
+    "disable",
+    "scale_loss",
+    "unscale",
+    "convert_hybrid_block",
+    "LossScaler",
+    "lists",
+]

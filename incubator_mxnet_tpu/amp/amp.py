@@ -1,0 +1,217 @@
+"""``mx.amp`` — automatic mixed precision.
+
+Parity target: [U:python/mxnet/contrib/amp/amp.py].  The reference
+monkey-patches op invocation to insert ``amp_cast``/``amp_multicast``
+nodes per allow/deny lists and adds a dynamic loss scaler for fp16.
+TPU-native version: one dispatch hook on ``ndarray.invoke`` casts float
+inputs per the same list structure (lists.py) — because Gluon layers,
+``hybridize`` traces, Symbol executors and SPMDTrainer all funnel through
+the same registry dispatch, a single hook covers eager, jitted and SPMD
+execution.  Target dtype is bfloat16 (MXU-native; no loss scaling
+required); float16 is supported with the reference's dynamic LossScaler
+semantics for API/workload parity.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as _np
+
+from ..base import _as_np_dtype
+from . import lists
+
+__all__ = ["init", "init_trainer", "scale_loss", "unscale", "convert_hybrid_block", "LossScaler"]
+
+_FLOAT_KINDS = ("f",)
+
+
+class _AmpPolicy:
+    def __init__(self, target_dtype):
+        self.target = _as_np_dtype(target_dtype)
+        self.fp32 = _np.dtype("float32")
+
+    def _is_float(self, a):
+        # jnp.issubdtype, not numpy kind: ml_dtypes' bfloat16 has kind 'V'
+        return hasattr(a, "dtype") and jnp.issubdtype(a.dtype, jnp.floating)
+
+    def cast_inputs(self, opname, raw):
+        if opname in lists.TARGET_OPS:
+            return [a.astype(self.target) if self._is_float(a) and a.dtype != self.target else a
+                    for a in raw]
+        if opname in lists.FP32_OPS:
+            return [a.astype(self.fp32) if self._is_float(a) and a.dtype != self.fp32 else a
+                    for a in raw]
+        if opname in lists.WIDEST_OPS:
+            floats = {_np.dtype(a.dtype) for a in raw if self._is_float(a)}
+            if len(floats) > 1:
+                widest = max(floats, key=lambda d: d.itemsize)
+                return [a.astype(widest) if self._is_float(a) and a.dtype != widest else a
+                        for a in raw]
+        return raw
+
+
+def init(target_dtype="bfloat16"):
+    """Enable AMP globally (parity: ``amp.init()``).  Idempotent."""
+    from ..ndarray import ndarray as nd_core
+
+    assert str(target_dtype) in ("bfloat16", "float16"), target_dtype
+    nd_core._amp = _AmpPolicy(target_dtype)
+    # new dtype decisions invalidate existing jit caches built without AMP
+    _clear_block_caches()
+
+
+def is_enabled():
+    from ..ndarray import ndarray as nd_core
+
+    return getattr(nd_core, "_amp", None) is not None
+
+
+def disable():
+    from ..ndarray import ndarray as nd_core
+
+    nd_core._amp = None
+    _clear_block_caches()
+
+
+def _clear_block_caches():
+    """Invalidate every jit cache traced under the previous AMP state:
+    HybridBlock CachedOps, SPMDTrainer fused steps, Symbol executors."""
+    import gc
+
+    from ..executor import Executor
+    from ..gluon.block import HybridBlock
+    from ..parallel.trainer import SPMDTrainer
+
+    for obj in gc.get_objects():
+        try:
+            if isinstance(obj, HybridBlock):
+                obj._cached_graph.clear()
+            elif isinstance(obj, SPMDTrainer):
+                obj._step_cache.clear()
+            elif isinstance(obj, Executor):
+                obj._fwd_cache.clear()
+                obj._bwd_cache.clear()
+        except Exception:
+            pass
+
+
+class LossScaler:
+    """Dynamic loss scaling (parity: [U:python/mxnet/contrib/amp/
+    loss_scaler.py]): double every ``scale_window`` good steps, halve and
+    skip the update on overflow.  bf16 never overflows in practice; this
+    exists for fp16 parity."""
+
+    def __init__(self, init_scale=2.0 ** 16, scale_factor=2.0, scale_window=2000):
+        self.loss_scale = float(init_scale)
+        self._factor = scale_factor
+        self._window = scale_window
+        self._unskipped = 0
+
+    def has_overflow(self, params):
+        """True if any gradient is non-finite.  Accepts Gluon Parameters
+        (grads live on the param's NDArray) or NDArrays with attached
+        grads.  One fused device reduction + a single host sync, not one
+        round-trip per parameter."""
+        checks = []
+        for p in params:
+            data = getattr(p, "_data", None)
+            g = getattr(data, "_grad", None) if data is not None else getattr(p, "_grad", None)
+            if g is not None:
+                checks.append(jnp.isfinite(g._data).all())
+        if not checks:
+            return False
+        return not bool(jnp.all(jnp.stack(checks)))
+
+    def update_scale(self, overflow):
+        if overflow:
+            self.loss_scale = max(self.loss_scale / self._factor, 1.0)
+            self._unskipped = 0
+        else:
+            self._unskipped += 1
+            if self._unskipped >= self._window:
+                self.loss_scale *= self._factor
+                self._unskipped = 0
+
+
+class scale_loss:
+    """``with amp.scale_loss(loss, trainer) as scaled: scaled.backward()``
+    (parity: ``amp.scale_loss``).  Scales the loss up and arranges for the
+    trainer to unscale gradients in the optimizer rescale."""
+
+    def __init__(self, loss, trainer):
+        self._loss = loss
+        self._trainer = trainer
+
+    def __enter__(self):
+        scaler = getattr(self._trainer, "_amp_loss_scaler", None)
+        if scaler is None:
+            return self._loss
+        # grads come out multiplied by loss_scale; the wrapped step
+        # (init_trainer) divides rescale_grad by the same factor
+        if isinstance(self._loss, (list, tuple)):
+            return [l * scaler.loss_scale for l in self._loss]
+        return self._loss * scaler.loss_scale
+
+    def __exit__(self, *a):
+        return False
+
+
+def init_trainer(trainer):
+    """Attach a dynamic LossScaler to a Gluon Trainer and wrap ``step`` to
+    skip updates on overflow (parity: ``amp.init_trainer``)."""
+    scaler = LossScaler()
+    trainer._amp_loss_scaler = scaler
+    orig_step = trainer.step
+
+    def step(batch_size, ignore_stale_grad=False):
+        params = [p for p in trainer._params if p.grad_req != "null"]
+        overflow = scaler.has_overflow(params)
+        if not overflow:
+            if getattr(trainer, "_amp_unscaled", False):
+                # grads were already divided by amp.unscale() (clipping
+                # flow); don't divide a second time
+                trainer._amp_unscaled = False
+                orig_step(batch_size, ignore_stale_grad)
+            else:
+                # fold the loss scale into trainer._scale — Trainer.step
+                # recomputes rescale_grad from it every call
+                saved = trainer._scale
+                trainer._scale = saved / scaler.loss_scale
+                try:
+                    orig_step(batch_size, ignore_stale_grad)
+                finally:
+                    trainer._scale = saved
+        else:
+            trainer._amp_unscaled = False
+        scaler.update_scale(overflow)
+
+    trainer.step = step
+    return trainer
+
+
+def unscale(trainer):
+    """Explicitly divide current grads by the loss scale (for gradient
+    clipping before step)."""
+    scaler = getattr(trainer, "_amp_loss_scaler", None)
+    if scaler is None:
+        return
+    for p in trainer._params:
+        if p.grad_req == "null":
+            continue
+        g = getattr(p._data, "_grad", None) if p._data is not None else None
+        if g is not None:
+            g._data = g._data / scaler.loss_scale
+            g._version += 1
+    trainer._amp_unscaled = True  # wrapped step must not divide again
+
+
+def convert_hybrid_block(block, target_dtype="bfloat16"):
+    """Offline O2-style conversion (parity: ``amp.convert_hybrid_block`` /
+    the C++ ReducePrecision pass): cast the block's parameters to the
+    target dtype in place and return the block.  Combine with ``init()``
+    for list-based op casting."""
+    target = _as_np_dtype(target_dtype)
+    for p in block.collect_params().values():
+        if p._data is not None and not jnp.issubdtype(p._data.dtype, jnp.floating):
+            continue  # integer params (embedding indices etc.) stay put
+        p.cast(target)  # Parameter.cast also rebuilds the grad buffer
+    return block

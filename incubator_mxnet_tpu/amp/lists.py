@@ -1,0 +1,41 @@
+"""AMP op lists (parity: [U:python/mxnet/contrib/amp/lists/symbol_fp16.py]).
+
+Three tiers, consulted by the dispatch hook in ndarray.invoke:
+* TARGET_OPS  — run in the low-precision target dtype (the MXU ops where
+  all the FLOPs are: matmul/conv/attention); float inputs are cast down.
+* FP32_OPS    — numerically sensitive; float inputs are cast UP to fp32
+  (softmax/exp/norm/loss heads).
+* WIDEST_OPS  — multi-input ops that must agree on a dtype; inputs are
+  cast to the widest float dtype present.
+Everything else passes through untouched.
+
+bf16 is the TPU-native target (fp16's loss-scaling machinery is kept for
+API parity but bf16 needs no scaler — same exponent range as fp32).
+"""
+
+TARGET_OPS = {
+    "FullyConnected", "fully_connected",
+    "Convolution", "Deconvolution",
+    "dot", "batch_dot", "linalg_gemm2",
+    "fused_attention",
+    "RNN",
+}
+
+FP32_OPS = {
+    "softmax", "log_softmax", "softmin",
+    "SoftmaxOutput", "Softmax", "softmax_cross_entropy",
+    "LinearRegressionOutput", "MAERegressionOutput", "LogisticRegressionOutput",
+    "BatchNorm", "LayerNorm", "InstanceNorm", "GroupNorm", "RMSNorm",
+    "L2Normalization", "norm",
+    "exp", "log", "log2", "log10", "log1p", "expm1",
+    "sum", "mean", "prod", "nansum", "nanprod",
+    "erf", "erfinv", "gamma", "gammaln",
+    "smooth_l1", "MakeLoss",
+    "power", "broadcast_power", "_power_scalar", "sqrt", "rsqrt", "square",
+}
+
+WIDEST_OPS = {
+    "broadcast_add", "broadcast_sub", "broadcast_mul", "broadcast_div",
+    "elemwise_add", "elemwise_sub", "elemwise_mul", "elemwise_div",
+    "concat", "Concat", "stack", "add_n", "where",
+}

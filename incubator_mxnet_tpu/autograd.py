@@ -224,19 +224,19 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
         slots = node_grads.pop(nid, None)
         if slots is None:
             continue
-        outs = tuple(
-            s if s is not None else None for s in slots
-        )
-        # vjp requires cotangents for every output; fill missing with zeros.
-        # We need output shapes — recover from the vjp closure by probing is
-        # costly, so require all-or-zero: replace None with 0-strength via
-        # zeros_like of the known slot when possible.
-        if any(s is None for s in outs):
-            # Build zeros from recorded output avals stored on the vjp fn.
-            filled = []
-            for s, aval in zip(outs, _out_avals(node)):
-                filled.append(s if s is not None else jnp.zeros(aval.shape, aval.dtype))
-            outs = tuple(filled)
+        # vjp requires a cotangent per output, matching the recorded aval
+        # exactly: fill missing slots with zeros, and cast dtype mismatches
+        # (mixed-precision tapes: an fp32 loss head feeding a bf16-output
+        # node under mx.amp).
+        filled = []
+        for s, aval in zip(slots, _out_avals(node)):
+            if s is None:
+                filled.append(jnp.zeros(aval.shape, aval.dtype))
+            elif s.dtype != aval.dtype:
+                filled.append(s.astype(aval.dtype))
+            else:
+                filled.append(s)
+        outs = tuple(filled)
         in_gs = node.vjp_fn(outs)
         for prov, g in zip(node.in_prov, in_gs):
             if prov is None or g is None:

@@ -1,0 +1,9 @@
+"""``mx.contrib`` namespace (parity: [U:python/mxnet/contrib/]).
+
+Hosts amp (aliased from the top-level module — the reference's import path
+is ``from mxnet.contrib import amp``), quantization, onnx, and the
+detection extras as they land.
+"""
+from .. import amp  # noqa: F401  (reference path: mx.contrib.amp)
+
+__all__ = ["amp"]

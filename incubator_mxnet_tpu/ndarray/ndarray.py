@@ -27,6 +27,8 @@ from ..context import Context, current_context, cpu
 from .. import autograd
 from ..ops.registry import get_op
 
+_amp = None  # set by mx.amp.init(); consulted in invoke()
+
 __all__ = ["NDArray", "array", "zeros", "ones", "full", "arange", "empty", "invoke", "waitall"]
 
 
@@ -512,6 +514,11 @@ def invoke(fn, arrays, kwargs, name="", ctx=None):
     ([U:src/c_api/c_api_ndarray.cc], [U:src/imperative/imperative.cc]).
     """
     raw = [a._data if isinstance(a, NDArray) else a for a in arrays]
+    if _amp is not None:
+        # mx.amp dispatch hook: per-op-list dtype casting (covers eager,
+        # hybridize traces, Symbol executors and SPMDTrainer alike, since
+        # every op funnels through here)
+        raw = _amp.cast_inputs(name, raw)
     if ctx is None:
         for a in arrays:
             if isinstance(a, NDArray):

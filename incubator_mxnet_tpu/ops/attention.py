@@ -83,25 +83,33 @@ def online_softmax_update(o, m, l, s, v, matmul):
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k, causal, scale):
     """One (batch·head, q-block) grid cell: stream K/V blocks, online
-    softmax in fp32.  Shapes: q_ref [1, Bq, D], k/v_ref [1, Sk, D]."""
+    softmax in fp32.  Shapes: q_ref [1, Bq, D], k/v_ref [1, Sk, D].
+
+    Operands stay in their input dtype (bf16 rides the MXU at full rate)
+    with fp32 accumulation via preferred_element_type; matmul precision is
+    pinned per-dtype because the package-global 'highest' default would
+    request an fp32 contraction on bf16 operands, which Mosaic rejects."""
     i = _pl.program_id(1)
     block_q = q_ref.shape[1]
     d = q_ref.shape[2]
     seq_k = k_ref.shape[1]
     nk = seq_k // block_k
+    prec = (jax.lax.Precision.HIGHEST if q_ref.dtype == jnp.float32
+            else jax.lax.Precision.DEFAULT)  # bf16 AND fp16 operands
 
-    q = q_ref[0].astype(jnp.float32) * scale  # [Bq, D]
+    q = q_ref[0]  # [Bq, D], native dtype
     m0 = jnp.full((block_q, 1), -jnp.inf, jnp.float32)
     l0 = jnp.zeros((block_q, 1), jnp.float32)
     acc0 = jnp.zeros((block_q, d), jnp.float32)
 
     def body(j, carry):
         m, l, acc = carry
-        k = k_ref[0, _pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        k = k_ref[0, _pl.ds(j * block_k, block_k), :]
         v = v_ref[0, _pl.ds(j * block_k, block_k), :]
         s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )  # [Bq, Bk]
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32,
+            precision=prec,
+        ) * scale  # [Bq, Bk], fp32 accumulate then scale
         if causal:
             q_pos = i * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
             k_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
@@ -110,7 +118,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k, causal, scale):
             acc, m, l, s, v,
             lambda p, v: jax.lax.dot_general(
                 p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32,
+                preferred_element_type=jnp.float32, precision=prec,
             ),
         )
         return m_new, l_new, acc_new
@@ -194,6 +202,8 @@ def _pallas_blocks(sq, sk, block_q=128, block_k=128):
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
 def _flash(q, k, v, causal, scale):
     use, interpret = _use_pallas(q)
+    if q.dtype == jnp.float16 and not interpret:
+        use = False  # Mosaic has no f16; XLA reference path handles it
     if use and _HAVE_PALLAS:
         b, h, s, d = q.shape
         blocks = _pallas_blocks(s, k.shape[2])

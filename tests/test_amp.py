@@ -1,0 +1,210 @@
+"""AMP tests (parity idioms: tests/python/gpu/test_amp.py — list casting,
+loss scaler dynamics, trainer integration, converted-model correctness)."""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import amp, gluon
+from incubator_mxnet_tpu.gluon import nn
+
+
+@pytest.fixture(autouse=True)
+def _amp_off():
+    yield
+    amp.disable()
+
+
+def _net(seed=3):
+    mx.random.seed(seed)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu"), nn.Dense(4))
+    net.initialize()
+    net(mx.nd.zeros((2, 8)))
+    return net
+
+
+class TestAmpCasting:
+    def test_target_op_runs_bf16(self):
+        amp.init("bfloat16")
+        x = mx.nd.ones((4, 8))
+        w = mx.nd.ones((16, 8))
+        out = mx.nd.FullyConnected(x, w, None, num_hidden=16, no_bias=True)
+        assert out.dtype == np.dtype("bfloat16")
+
+    def test_fp32_op_casts_up(self):
+        amp.init("bfloat16")
+        x = mx.nd.ones((4, 8), dtype="bfloat16")
+        out = mx.nd.softmax(x)
+        assert out.dtype == np.float32
+
+    def test_widest_op_promotes(self):
+        amp.init("bfloat16")
+        a = mx.nd.ones((4,), dtype="bfloat16")
+        b = mx.nd.ones((4,), dtype="float32")
+        out = mx.nd.broadcast_add(a, b)
+        assert out.dtype == np.float32
+
+    def test_disabled_is_nop(self):
+        x = mx.nd.ones((4, 8))
+        w = mx.nd.ones((16, 8))
+        out = mx.nd.FullyConnected(x, w, None, num_hidden=16, no_bias=True)
+        assert out.dtype == np.float32
+
+    def test_gluon_forward_close_to_fp32(self):
+        net = _net()
+        x = mx.nd.array(np.random.RandomState(0).randn(8, 8).astype(np.float32))
+        ref = net(x).asnumpy()
+        amp.init("bfloat16")
+        out = net(x).asnumpy()
+        np.testing.assert_allclose(out, ref, rtol=2e-2, atol=2e-2)
+
+
+class TestLossScaler:
+    def test_dynamics(self):
+        s = amp.LossScaler(init_scale=8.0, scale_factor=2.0, scale_window=2)
+        s.update_scale(False)
+        s.update_scale(False)
+        assert s.loss_scale == 16.0  # doubled after window good steps
+        s.update_scale(True)
+        assert s.loss_scale == 8.0  # halved on overflow
+
+    def test_trainer_skips_on_overflow(self):
+        net = _net()
+        trainer = gluon.Trainer(net.collect_params(), "sgd",
+                                {"learning_rate": 0.1})
+        amp.init_trainer(trainer)
+        x = mx.nd.array(np.random.RandomState(0).randn(4, 8).astype(np.float32))
+        y = mx.nd.array(np.array([0., 1., 2., 3.], np.float32))
+        loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+        w0 = list(net.collect_params().values())[0].data().asnumpy().copy()
+
+        # poison one grad with inf → step must be skipped
+        with mx.autograd.record():
+            loss = loss_fn(net(x), y)
+        loss.backward()
+        p0 = [p for p in trainer._params if p.grad_req != "null"][0]
+        g = p0.grad()
+        import jax.numpy as jnp
+        g._data = g._data.at[0].set(jnp.inf)
+        scale_before = trainer._amp_loss_scaler.loss_scale
+        trainer.step(4)
+        np.testing.assert_array_equal(
+            w0, list(net.collect_params().values())[0].data().asnumpy())
+        assert trainer._amp_loss_scaler.loss_scale < scale_before
+
+    def test_scale_loss_roundtrip_training(self):
+        """fp16-style scaled training must converge like unscaled."""
+        net_a, net_b = _net(seed=9), _net(seed=9)
+        rng = np.random.RandomState(1)
+        X = rng.randn(32, 8).astype(np.float32)
+        Y = rng.randint(0, 4, (32,)).astype(np.float32)
+        loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+        tr_a = gluon.Trainer(net_a.collect_params(), "sgd", {"learning_rate": 0.1})
+        for _ in range(3):
+            with mx.autograd.record():
+                la = loss_fn(net_a(mx.nd.array(X)), mx.nd.array(Y))
+            la.backward()
+            tr_a.step(32)
+
+        tr_b = gluon.Trainer(net_b.collect_params(), "sgd", {"learning_rate": 0.1})
+        amp.init_trainer(tr_b)
+        for _ in range(3):
+            with mx.autograd.record():
+                lb = loss_fn(net_b(mx.nd.array(X)), mx.nd.array(Y))
+                with amp.scale_loss(lb, tr_b) as scaled:
+                    pass
+            scaled.backward()
+            tr_b.step(32)
+
+        pa = net_a._collect_params_with_prefix()
+        pb = net_b._collect_params_with_prefix()
+        for k in pa:
+            np.testing.assert_allclose(pa[k].data().asnumpy(),
+                                       pb[k].data().asnumpy(),
+                                       rtol=1e-4, atol=1e-5, err_msg=k)
+
+
+class TestConvertHybridBlock:
+    def test_params_cast_and_forward_runs(self):
+        net = _net()
+        amp.convert_hybrid_block(net, "bfloat16")
+        for p in net.collect_params().values():
+            assert p.data().dtype == np.dtype("bfloat16")
+        out = net(mx.nd.ones((2, 8), dtype="bfloat16"))
+        assert out.shape == (2, 4)
+
+
+class TestMixedDtypeTape:
+    def test_hybridized_amp_backward(self):
+        """fp32 loss head over a bf16 hybridized block: the tape must cast
+        cotangents at node boundaries (regression: vjp dtype mismatch)."""
+        net = _net()
+        net.hybridize()
+        amp.init("bfloat16")
+        x = mx.nd.array(np.random.RandomState(0).randn(8, 8).astype(np.float32))
+        y = mx.nd.array(np.arange(8, dtype=np.float32) % 4)
+        loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+        with mx.autograd.record():
+            loss = loss_fn(net(x), y)
+        loss.backward()
+        for p in net.collect_params().values():
+            g = p.grad().asnumpy()
+            assert np.isfinite(g).all()
+            assert g.dtype == np.float32  # master-grad stays fp32
+
+
+class TestReviewRegressions:
+    def test_unscale_then_step_single_divide(self):
+        """amp.unscale() before step must not divide by the scale twice."""
+        net_a, net_b = _net(seed=4), _net(seed=4)
+        rng = np.random.RandomState(2)
+        X = rng.randn(16, 8).astype(np.float32)
+        Y = rng.randint(0, 4, (16,)).astype(np.float32)
+        loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+        tr_a = gluon.Trainer(net_a.collect_params(), "sgd", {"learning_rate": 0.1})
+        with mx.autograd.record():
+            la = loss_fn(net_a(mx.nd.array(X)), mx.nd.array(Y))
+        la.backward()
+        tr_a.step(16)
+
+        tr_b = gluon.Trainer(net_b.collect_params(), "sgd", {"learning_rate": 0.1})
+        amp.init_trainer(tr_b)
+        with mx.autograd.record():
+            lb = loss_fn(net_b(mx.nd.array(X)), mx.nd.array(Y))
+            with amp.scale_loss(lb, tr_b) as scaled:
+                pass
+        scaled.backward()
+        amp.unscale(tr_b)  # clipping-style flow
+        tr_b.step(16)
+
+        pa = net_a._collect_params_with_prefix()
+        pb = net_b._collect_params_with_prefix()
+        for k in pa:
+            np.testing.assert_allclose(pa[k].data().asnumpy(),
+                                       pb[k].data().asnumpy(),
+                                       rtol=1e-4, atol=1e-5, err_msg=k)
+
+    def test_amp_init_invalidates_spmd_step_cache(self):
+        from incubator_mxnet_tpu.parallel import SPMDTrainer, make_mesh
+        net = _net(seed=6)
+        loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+        tr = SPMDTrainer(net, loss_fn, "sgd", {"learning_rate": 0.1},
+                         mesh=make_mesh())
+        X = mx.nd.array(np.random.RandomState(0).randn(8, 8).astype(np.float32))
+        Y = mx.nd.array((np.arange(8) % 4).astype(np.float32))
+        tr.step(X, Y)
+        assert tr._step_cache
+        amp.init("bfloat16")
+        assert not tr._step_cache  # must retrace under the AMP hook
+
+    def test_contrib_amp_path(self):
+        assert mx.contrib.amp is mx.amp
+
+    def test_convert_rebuilds_grad_buffer(self):
+        net = _net()
+        amp.convert_hybrid_block(net, "bfloat16")
+        p = list(net.collect_params().values())[0]
+        assert p.data().dtype == np.dtype("bfloat16")
+        assert p.grad().dtype == np.dtype("bfloat16")
