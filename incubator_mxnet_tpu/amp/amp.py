@@ -74,24 +74,11 @@ def disable():
 
 def _clear_block_caches():
     """Invalidate every jit cache traced under the previous AMP state:
-    HybridBlock CachedOps, SPMDTrainer fused steps, Symbol executors."""
-    import gc
+    HybridBlock CachedOps, SPMDTrainer fused steps, Symbol executors —
+    all registered in base._jit_cache_owners at construction."""
+    from ..base import invalidate_jit_caches
 
-    from ..executor import Executor
-    from ..gluon.block import HybridBlock
-    from ..parallel.trainer import SPMDTrainer
-
-    for obj in gc.get_objects():
-        try:
-            if isinstance(obj, HybridBlock):
-                obj._cached_graph.clear()
-            elif isinstance(obj, SPMDTrainer):
-                obj._step_cache.clear()
-            elif isinstance(obj, Executor):
-                obj._fwd_cache.clear()
-                obj._bwd_cache.clear()
-        except Exception:
-            pass
+    invalidate_jit_caches()
 
 
 class LossScaler:
@@ -157,7 +144,10 @@ class scale_loss:
 
 def init_trainer(trainer):
     """Attach a dynamic LossScaler to a Gluon Trainer and wrap ``step`` to
-    skip updates on overflow (parity: ``amp.init_trainer``)."""
+    skip updates on overflow (parity: ``amp.init_trainer``).  Idempotent —
+    wrapping twice would divide gradients by the scale twice."""
+    if getattr(trainer, "_amp_loss_scaler", None) is not None:
+        return trainer
     scaler = LossScaler()
     trainer._amp_loss_scaler = scaler
     orig_step = trainer.step

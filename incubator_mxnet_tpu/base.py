@@ -62,3 +62,24 @@ def _as_np_dtype(dtype):
         if dtype in _DTYPE_ALIASES:
             return _DTYPE_ALIASES[dtype]
     return _np.dtype(dtype)
+
+
+# -- jit-cache invalidation registry ---------------------------------------
+# Objects owning compiled-function caches (HybridBlock, SPMDTrainer,
+# Executor) register themselves here; global dtype-policy changes (mx.amp)
+# invalidate them in O(live instances) instead of scanning the heap.
+import weakref as _weakref
+
+_jit_cache_owners = _weakref.WeakSet()
+
+
+def register_jit_cache_owner(obj):
+    _jit_cache_owners.add(obj)
+
+
+def invalidate_jit_caches():
+    for obj in list(_jit_cache_owners):
+        try:
+            obj._invalidate_jit_cache()
+        except Exception:
+            pass

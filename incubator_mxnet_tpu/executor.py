@@ -76,6 +76,12 @@ class Executor:
         self._fwd_cache = {}
         self._bwd_cache = {}
         self._last_batch_sig = None
+        from .base import register_jit_cache_owner
+        register_jit_cache_owner(self)
+
+    def _invalidate_jit_cache(self):
+        self._fwd_cache.clear()
+        self._bwd_cache.clear()
 
     # ------------------------------------------------------------------
     @classmethod

@@ -332,6 +332,11 @@ class HybridBlock(Block):
         self._active = False
         self._cached_graph = {}
         self._flags = {}
+        from ..base import register_jit_cache_owner
+        register_jit_cache_owner(self)
+
+    def _invalidate_jit_cache(self):
+        self._cached_graph.clear()
 
     def hybridize(self, active=True, static_alloc=False, static_shape=False, **kwargs):
         self._active = active

@@ -24,6 +24,7 @@ from .utils import save, load
 from ..ops import registry as _registry
 from . import random  # noqa: F401
 from . import contrib  # noqa: F401
+from . import sparse  # noqa: F401
 
 __all__ = [
     "NDArray",
@@ -65,6 +66,12 @@ def __getattr__(name):
         raise AttributeError(name)
     if name in _WRAPPER_CACHE:
         return _WRAPPER_CACHE[name]
+    if name == "Custom":
+        # tape-aware custom-op path, NOT the generic invoke wrapper (its
+        # backward is the user's CustomOp.backward, not jax.vjp)
+        from ..operator import _nd_custom
+        _WRAPPER_CACHE[name] = _nd_custom
+        return _nd_custom
     # legacy `nd.random_uniform` style names
     if name.startswith("random_"):
         fn = getattr(random, name[len("random_"):], None)

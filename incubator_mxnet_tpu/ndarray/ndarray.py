@@ -451,11 +451,14 @@ class NDArray:
         return self
 
     def tostype(self, stype):
-        if stype != "default":
-            raise NotImplementedError(
-                "sparse storage types are represented densely on TPU; see docs/sparse.md"
-            )
-        return self
+        if stype == "default":
+            return self
+        from . import sparse as _sparse
+        if stype == "row_sparse":
+            return _sparse.row_sparse_array(self)
+        if stype == "csr":
+            return _sparse.csr_matrix(self)
+        raise ValueError(f"unknown storage type {stype!r}")
 
     # reductions -------------------------------------------------------
     def sum(self, axis=None, keepdims=False, **kw):

@@ -1,0 +1,270 @@
+"""``mx.nd.sparse`` — row_sparse and csr storage types.
+
+Parity target: [U:python/mxnet/ndarray/sparse.py] over the C++ sparse
+NDArray ([U:src/ndarray/ndarray.cc] kRowSparseStorage/kCSRStorage).
+TPU-native stance (SURVEY.md hard part #3): XLA wants static shapes, so
+sparse here is a *storage format* with explicit index/value arrays —
+row_sparse for gradients/embeddings, csr for feature matrices — whose
+compute either stays structured (``sparse.dot`` via segment-sum /
+gather-matmul, ``retain``) or densifies explicitly (``tostype('default')``).
+The number of stored rows/nonzeros is static per array instance, which is
+exactly the contract jit needs.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+import jax
+import jax.numpy as jnp
+
+from ..base import _as_np_dtype
+from .ndarray import NDArray
+
+__all__ = [
+    "BaseSparseNDArray", "RowSparseNDArray", "CSRNDArray",
+    "row_sparse_array", "csr_matrix", "zeros", "array", "empty",
+    "dot", "add", "retain",
+]
+
+
+class BaseSparseNDArray:
+    stype = "undefined"
+
+    @property
+    def shape(self):
+        return self._shape
+
+    @property
+    def dtype(self):
+        return _np.dtype(self.data.dtype)
+
+    @property
+    def context(self):
+        from ..context import current_context
+        return current_context()
+
+    def asnumpy(self):
+        return _np.asarray(self.todense()._data)
+
+    def astype(self, dtype):
+        raise NotImplementedError
+
+    def tostype(self, stype):
+        if stype == self.stype:
+            return self
+        if stype == "default":
+            return self.todense()
+        raise ValueError(f"cannot convert {self.stype} to {stype}")
+
+    def wait_to_read(self):
+        jax.block_until_ready(self.data._data)
+
+    def __repr__(self):
+        return f"<{type(self).__name__} {self.shape} @{self.stype}>"
+
+
+class RowSparseNDArray(BaseSparseNDArray):
+    """(indices[K], values[K, ...]) — K stored rows of a [N, ...] tensor
+    (parity: row_sparse — the gradient format of Embedding/sparse FC)."""
+
+    stype = "row_sparse"
+
+    def __init__(self, data, indices, shape):
+        self.data = data if isinstance(data, NDArray) else NDArray(jnp.asarray(data))
+        self.indices = (indices if isinstance(indices, NDArray)
+                        else NDArray(jnp.asarray(indices, dtype=jnp.int32)))
+        self._shape = tuple(shape)
+        assert self.data.shape[0] == self.indices.shape[0]
+        assert self.data.shape[1:] == self._shape[1:]
+
+    def todense(self):
+        out = jnp.zeros(self._shape, self.data._data.dtype)
+        out = out.at[self.indices._data].add(self.data._data)
+        return NDArray(out)
+
+    def astype(self, dtype):
+        return RowSparseNDArray(NDArray(self.data._data.astype(_as_np_dtype(dtype))),
+                                self.indices, self._shape)
+
+    def copy(self):
+        return RowSparseNDArray(NDArray(self.data._data), NDArray(self.indices._data),
+                                self._shape)
+
+    def retain(self, rows):
+        return retain(self, rows)
+
+    def __add__(self, other):
+        return add(self, other)
+
+
+class CSRNDArray(BaseSparseNDArray):
+    """(data[nnz], indices[nnz], indptr[N+1]) — compressed sparse rows
+    (parity: csr — the input-feature format of the linear examples)."""
+
+    stype = "csr"
+
+    def __init__(self, data, indices, indptr, shape):
+        self.data = data if isinstance(data, NDArray) else NDArray(jnp.asarray(data))
+        self.indices = (indices if isinstance(indices, NDArray)
+                        else NDArray(jnp.asarray(indices, dtype=jnp.int32)))
+        self.indptr = (indptr if isinstance(indptr, NDArray)
+                       else NDArray(jnp.asarray(indptr, dtype=jnp.int32)))
+        self._shape = tuple(shape)
+        assert self.indptr.shape[0] == self._shape[0] + 1
+
+    def todense(self):
+        n, m = self._shape
+        nnz = self.data.shape[0]
+        rows = _csr_rows(self.indptr._data, nnz)
+        out = jnp.zeros((n, m), self.data._data.dtype)
+        out = out.at[rows, self.indices._data].add(self.data._data)
+        return NDArray(out)
+
+    def astype(self, dtype):
+        return CSRNDArray(NDArray(self.data._data.astype(_as_np_dtype(dtype))),
+                          self.indices, self.indptr, self._shape)
+
+    def copy(self):
+        return CSRNDArray(NDArray(self.data._data), NDArray(self.indices._data),
+                          NDArray(self.indptr._data), self._shape)
+
+    def __getitem__(self, i):
+        lo = int(self.indptr._data[i])
+        hi = int(self.indptr._data[i + 1])
+        row = jnp.zeros((self._shape[1],), self.data._data.dtype)
+        row = row.at[self.indices._data[lo:hi]].set(self.data._data[lo:hi])
+        return NDArray(row)
+
+
+def _csr_rows(indptr, nnz):
+    """Row id per stored nonzero (static-shape friendly)."""
+    return jnp.searchsorted(indptr, jnp.arange(nnz), side="right") - 1
+
+
+# ---------------------------------------------------------------------------
+# constructors (parity: mx.nd.sparse.*)
+# ---------------------------------------------------------------------------
+
+
+def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):
+    """From (data, indices) or a dense source (parity)."""
+    if isinstance(arg1, tuple) and len(arg1) == 2:
+        data, indices = arg1
+        return RowSparseNDArray(jnp.asarray(data, dtype=_as_np_dtype(dtype)),
+                                indices, shape)
+    dense = arg1.asnumpy() if isinstance(arg1, NDArray) else _np.asarray(arg1)
+    nz_rows = _np.where(_np.any(dense.reshape(dense.shape[0], -1) != 0, axis=1))[0]
+    return RowSparseNDArray(jnp.asarray(dense[nz_rows], dtype=_as_np_dtype(dtype)),
+                            nz_rows, dense.shape)
+
+
+def csr_matrix(arg1, shape=None, ctx=None, dtype=None):
+    """From (data, indices, indptr) or a dense source (parity)."""
+    if isinstance(arg1, tuple) and len(arg1) == 3:
+        data, indices, indptr = arg1
+        return CSRNDArray(jnp.asarray(data, dtype=_as_np_dtype(dtype)),
+                          indices, indptr, shape)
+    dense = arg1.asnumpy() if isinstance(arg1, NDArray) else _np.asarray(arg1)
+    assert dense.ndim == 2
+    indptr = [0]
+    indices, data = [], []
+    for r in range(dense.shape[0]):
+        cols = _np.where(dense[r] != 0)[0]
+        indices.extend(cols.tolist())
+        data.extend(dense[r, cols].tolist())
+        indptr.append(len(indices))
+    return CSRNDArray(jnp.asarray(_np.array(data, dtype=dense.dtype if dtype is None else _as_np_dtype(dtype))),
+                      _np.array(indices, dtype=_np.int32),
+                      _np.array(indptr, dtype=_np.int32), dense.shape)
+
+
+def zeros(stype, shape, ctx=None, dtype=None):
+    dtype = _as_np_dtype(dtype or "float32")
+    if stype == "row_sparse":
+        return RowSparseNDArray(jnp.zeros((0,) + tuple(shape[1:]), dtype),
+                                jnp.zeros((0,), jnp.int32), shape)
+    if stype == "csr":
+        return CSRNDArray(jnp.zeros((0,), dtype), jnp.zeros((0,), jnp.int32),
+                          jnp.zeros((shape[0] + 1,), jnp.int32), shape)
+    from . import zeros as dense_zeros
+    return dense_zeros(shape, dtype=dtype)
+
+
+def empty(stype, shape, ctx=None, dtype=None):
+    return zeros(stype, shape, ctx, dtype)
+
+
+def array(source_array, ctx=None, dtype=None):
+    if isinstance(source_array, (RowSparseNDArray, CSRNDArray)):
+        return source_array.copy()
+    raise ValueError("use row_sparse_array/csr_matrix for dense sources")
+
+
+# ---------------------------------------------------------------------------
+# ops
+# ---------------------------------------------------------------------------
+
+
+def dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    """sparse · dense (parity: ``mx.nd.sparse.dot``).
+
+    csr × dense and csrᵀ × dense stay structured (gather-matmul /
+    scatter-add — XLA lowers both to efficient TPU gathers); row_sparse
+    falls back to densify-then-dot."""
+    if isinstance(lhs, CSRNDArray) and isinstance(rhs, NDArray):
+        nnz = lhs.data.shape[0]
+        rows = _csr_rows(lhs.indptr._data, nnz)
+        cols = lhs.indices._data
+        vals = lhs.data._data
+        rhs_data = rhs._data.T if transpose_b else rhs._data
+        if not transpose_a:
+            # out[i] = Σ_nz vals·rhs[col]  scattered to row
+            contrib = vals[:, None] * rhs_data[cols]           # [nnz, K]
+            out = jnp.zeros((lhs.shape[0], rhs_data.shape[1]), contrib.dtype)
+            return NDArray(out.at[rows].add(contrib))
+        contrib = vals[:, None] * rhs_data[rows]               # [nnz, K]
+        out = jnp.zeros((lhs.shape[1], rhs_data.shape[1]), contrib.dtype)
+        return NDArray(out.at[cols].add(contrib))
+    if isinstance(lhs, RowSparseNDArray):
+        lhs = lhs.todense()
+    if isinstance(rhs, (RowSparseNDArray, CSRNDArray)):
+        rhs = rhs.todense()
+    a = lhs._data.T if transpose_a else lhs._data
+    b = rhs._data.T if transpose_b else rhs._data
+    return NDArray(jnp.matmul(a, b))
+
+
+def add(lhs, rhs):
+    """row_sparse + row_sparse → row_sparse (merged rows); anything else
+    densifies."""
+    if isinstance(lhs, RowSparseNDArray) and isinstance(rhs, RowSparseNDArray):
+        idx = jnp.concatenate([lhs.indices._data, rhs.indices._data])
+        val = jnp.concatenate([lhs.data._data, rhs.data._data])
+        uniq, inv = jnp.unique(idx, return_inverse=True, size=idx.shape[0],
+                               fill_value=lhs.shape[0])
+        summed = jnp.zeros((idx.shape[0],) + val.shape[1:], val.dtype)
+        summed = summed.at[inv].add(val)
+        keep = uniq < lhs.shape[0]
+        # static-size result: stored rows = len(idx) with tail padding rows
+        # pointing past N filtered on densify; compact eagerly instead
+        uniq_np = _np.asarray(uniq)
+        k = int(keep.sum())
+        return RowSparseNDArray(summed[:k], uniq_np[:k], lhs.shape)
+    l = lhs.todense() if isinstance(lhs, BaseSparseNDArray) else lhs
+    r = rhs.todense() if isinstance(rhs, BaseSparseNDArray) else rhs
+    return NDArray(l._data + r._data)
+
+
+def retain(data, indices):
+    """Keep only the given rows of a row_sparse array (parity:
+    ``sparse.retain`` — the kvstore row_sparse_pull primitive)."""
+    assert isinstance(data, RowSparseNDArray)
+    want = jnp.asarray(indices if not isinstance(indices, NDArray) else indices._data,
+                       dtype=jnp.int32)
+    stored = data.indices._data
+    # membership: for each stored row, is it requested?
+    hit = jnp.isin(stored, want)
+    hit_np = _np.asarray(hit)
+    keep = _np.where(hit_np)[0]
+    return RowSparseNDArray(data.data._data[keep],
+                            _np.asarray(stored)[keep], data.shape)

@@ -1,0 +1,187 @@
+"""``mx.operator`` — user-defined operators in Python.
+
+Parity target: [U:python/mxnet/operator.py] + [U:src/operator/custom/
+custom.cc] (CustomOp/CustomOpProp/register, invoked as ``nd.Custom(...,
+op_type=name)``).  The reference runs Python callbacks on a dedicated
+engine worker thread; here:
+
+* **eager**: the callback runs inline on concrete NDArrays, and autograd
+  records a tape node whose backward calls the user's ``backward``
+  (full differentiability, grad-of-output routing via ``req``).
+* **inside jit traces** (hybridize/Symbol executors): the forward runs via
+  ``jax.pure_callback`` — correct values, host round-trip per call, not
+  differentiable (documented divergence; write a Pallas kernel or
+  registry op for on-device custom kernels — the lib_api.h/MXLoadLib role
+  is played by ``jax.ffi`` + the op registry).
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+import jax
+import jax.numpy as jnp
+
+from . import autograd
+from .ndarray.ndarray import NDArray
+
+__all__ = ["CustomOp", "CustomOpProp", "register", "get_prop"]
+
+_PROPS = {}
+
+
+class CustomOp:
+    """User forward/backward over NDArray lists (parity: ``mx.operator.
+    CustomOp``)."""
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        raise NotImplementedError
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        raise NotImplementedError
+
+    def assign(self, dst, req, src):
+        """Write ``src`` into ``dst`` honoring the grad_req (parity)."""
+        if req == "null":
+            return
+        src = src if isinstance(src, NDArray) else NDArray(jnp.asarray(src))
+        if req in ("write", "inplace"):
+            dst._data = src._data.astype(dst.dtype)
+        elif req == "add":
+            dst._data = dst._data + src._data.astype(dst.dtype)
+        else:
+            raise ValueError(f"unknown req {req!r}")
+        dst._version += 1
+
+
+class CustomOpProp:
+    """Shape/type inference + operator factory (parity: ``CustomOpProp``)."""
+
+    def __init__(self, need_top_grad=True):
+        self.need_top_grad_ = need_top_grad
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def list_auxiliary_states(self):
+        return []
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]] * len(self.list_outputs()), []
+
+    def infer_type(self, in_type):
+        return (in_type, [in_type[0]] * len(self.list_outputs()),
+                [in_type[0]] * len(self.list_auxiliary_states()))
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        raise NotImplementedError
+
+    def declare_backward_dependency(self, out_grad, in_data, out_data):
+        deps = []
+        if self.need_top_grad_:
+            deps.extend(out_grad)
+        deps.extend(in_data)
+        deps.extend(out_data)
+        return deps
+
+
+def register(reg_name):
+    """Decorator registering a CustomOpProp under ``op_type=reg_name``."""
+
+    def deco(prop_cls):
+        _PROPS[reg_name] = prop_cls
+        return prop_cls
+
+    return deco
+
+
+def get_prop(op_type):
+    try:
+        return _PROPS[op_type]
+    except KeyError:
+        raise KeyError(
+            f"custom op {op_type!r} is not registered; use "
+            "@mx.operator.register(name) on a CustomOpProp") from None
+
+
+def _invoke_custom(op_type, inputs, kwargs):
+    """Run a custom op eagerly with tape support."""
+    prop_cls = get_prop(op_type)
+    prop = prop_cls(**kwargs)
+    in_shapes = [list(a.shape) for a in inputs]
+    arg_shapes, out_shapes, aux_shapes = prop.infer_shape(in_shapes)
+    in_types = [a.dtype for a in inputs]
+    _, out_types, aux_types = prop.infer_type(in_types)
+    op = prop.create_operator(None, arg_shapes, in_types)
+    # NOTE divergence: eager nd.Custom allocates fresh aux per call (the
+    # reference persists aux only through a bound executor's aux_states;
+    # stateful custom ops should keep state on the CustomOp instance).
+    aux = [NDArray(jnp.zeros(tuple(s), t)) for s, t in zip(aux_shapes, aux_types)]
+
+    is_train = autograd.is_training() or autograd.is_recording()
+    out_data = [NDArray(jnp.zeros(tuple(s), t)) for s, t in zip(out_shapes, out_types)]
+    op.forward(is_train, ["write"] * len(out_data), list(inputs), out_data, aux)
+
+    if autograd.is_recording():
+        n_in = len(inputs)
+
+        def make_node():
+            from .autograd import _Node
+
+            def vjp_fn(cotangents):
+                in_grad = [NDArray(jnp.zeros_like(a._data)) for a in inputs]
+                out_grad = [NDArray(jnp.asarray(c)) for c in cotangents]
+                op.backward(["write"] * n_in, out_grad, list(inputs),
+                            out_data, in_grad, aux)
+                return tuple(g._data for g in in_grad)
+
+            prov = [autograd._provenance(a) for a in inputs]
+            node = _Node(vjp_fn, prov, len(out_data), name=f"Custom:{op_type}")
+            node._avals = [jax.ShapeDtypeStruct(o.shape, o.dtype) for o in out_data]
+            return node
+
+        node = make_node()
+        for i, o in enumerate(out_data):
+            o._prov = (node, i)
+    return out_data[0] if len(out_data) == 1 else out_data
+
+
+def _custom_entry(*raw, op_type=None, **kwargs):
+    """Registry entry for ``nd.Custom``: eager gets the tape-aware path; a
+    traced call falls back to pure_callback (forward-only)."""
+    if any(isinstance(a, jax.core.Tracer) for a in raw):
+        prop = get_prop(op_type)(**kwargs)
+        in_shapes = [list(a.shape) for a in raw]
+        _, out_shapes, _ = prop.infer_shape(in_shapes)
+        _, out_types, _ = prop.infer_type([a.dtype for a in raw])
+        specs = tuple(jax.ShapeDtypeStruct(tuple(s), t)
+                      for s, t in zip(out_shapes, out_types))
+
+        def host_fn(*arrs):
+            outs = _invoke_custom(op_type, [NDArray(jnp.asarray(a)) for a in arrs], kwargs)
+            outs = outs if isinstance(outs, list) else [outs]
+            return tuple(_np.asarray(o._data) for o in outs)
+
+        out = jax.pure_callback(host_fn, specs, *raw)
+        return out if len(out) > 1 else out[0]
+    res = _invoke_custom(op_type, [NDArray(a) for a in raw], kwargs)
+    if isinstance(res, list):
+        return tuple(o._data for o in res)
+    return res._data
+
+
+def _nd_custom(*args, op_type=None, **kwargs):
+    """``nd.Custom(data..., op_type='name', **params)`` (parity)."""
+    if op_type is None:
+        raise ValueError("nd.Custom requires op_type=")
+    inputs = [a if isinstance(a, NDArray) else NDArray(jnp.asarray(a)) for a in args]
+    return _invoke_custom(op_type, inputs, kwargs)
+
+
+# Symbol-graph path: sym.Custom(..., op_type=...) resolves from the op
+# registry; inside a jitted executor the forward runs via pure_callback.
+from .ops.registry import register as _register  # noqa: E402
+
+_register("Custom", differentiable=False)(_custom_entry)

@@ -151,6 +151,11 @@ class SPMDTrainer:
 
         self._t = self._optimizer.begin_num_update
         self._step_cache = {}
+        from ..base import register_jit_cache_owner
+        register_jit_cache_owner(self)
+
+    def _invalidate_jit_cache(self):
+        self._step_cache.clear()
 
     # ------------------------------------------------------------------
     def _sharding_like(self, arr, param_sh):

@@ -1,0 +1,137 @@
+"""``mx.profiler`` — profiling bridge.
+
+Parity target: [U:python/mxnet/profiler.py] over the C++ engine profiler
+([U:src/profiler/profiler.cc]).  The reference instruments every engine op
+and dumps chrome://tracing JSON; on TPU the equivalent machinery is
+``jax.profiler`` (XLA/xprof traces viewable in TensorBoard/Perfetto, incl.
+per-HLO timing on device), so this module keeps the MXNet control surface
+(``set_config``/``start``/``stop``/``dumps``, scopes/markers) and routes it
+there.  ``MXNET_PROFILER_AUTOSTART=1`` is honored at import like the
+reference env var.
+"""
+from __future__ import annotations
+
+import atexit
+import os
+import time
+
+import jax
+
+__all__ = ["set_config", "start", "stop", "dump", "dumps", "pause", "resume",
+           "scope", "Marker", "state"]
+
+_config = {
+    "filename": "profile.json",   # reference default profile_output.json-ish
+    "profile_all": False,
+    "profile_symbolic": True,
+    "profile_imperative": True,
+    "profile_memory": True,
+    "profile_api": True,
+    "aggregate_stats": False,
+}
+_state = {"running": False, "dir": None, "t0": None}
+_agg = {}  # name -> [count, total_s]; aggregated incrementally (bounded)
+
+
+def _tally(name, dur):
+    cnt_tot = _agg.setdefault(name, [0, 0.0])
+    cnt_tot[0] += 1
+    cnt_tot[1] += dur
+
+
+def set_config(**kwargs):
+    """Parity: ``mx.profiler.set_config`` — unknown keys are accepted and
+    ignored (the reference has many backend-specific flags)."""
+    _config.update(kwargs)
+
+
+def state():
+    return "running" if _state["running"] else "stopped"
+
+
+def start():
+    """Start an xprof trace.  Trace directory = dirname(filename) (the
+    chrome-trace single file of the reference maps onto xprof's directory
+    layout; load it with TensorBoard or xprof)."""
+    if _state["running"]:
+        return
+    logdir = os.path.dirname(os.path.abspath(_config["filename"])) or "."
+    trace_dir = os.path.join(logdir, "mxtpu_profile")
+    os.makedirs(trace_dir, exist_ok=True)
+    try:
+        jax.profiler.start_trace(trace_dir)
+    except Exception:
+        pass  # second start or unsupported backend: keep python markers only
+    _state.update(running=True, dir=trace_dir, t0=time.perf_counter())
+
+
+def stop():
+    if not _state["running"]:
+        return
+    try:
+        jax.profiler.stop_trace()
+    except Exception:
+        pass
+    _state["running"] = False
+
+
+pause = stop  # reference pause/resume ≈ stop/start at xprof granularity
+resume = start
+
+
+def dump(finished=True, profile_process="worker"):
+    """Finish the trace (parity: ``mx.profiler.dump``)."""
+    stop()
+
+
+def dumps(reset=False):
+    """Aggregate stats string (parity: ``mx.profiler.dumps``).  Python-side
+    marker table; device-op detail lives in the xprof trace directory."""
+    lines = ["Profile Statistics (python markers; device ops in "
+             f"{_state['dir'] or 'trace dir (run start() first)'}):",
+             f"{'Name':<40}{'Count':>8}{'Total(ms)':>12}{'Avg(ms)':>12}"]
+    for name, (cnt, tot) in sorted(_agg.items(), key=lambda kv: -kv[1][1]):
+        lines.append(f"{name:<40}{cnt:>8}{tot * 1e3:>12.3f}{tot / cnt * 1e3:>12.3f}")
+    if reset:
+        _agg.clear()
+    return "\n".join(lines)
+
+
+class scope:
+    """``with profiler.scope('fwd'):`` — named region, visible in xprof as
+    a TraceAnnotation and tallied in ``dumps()``."""
+
+    def __init__(self, name="<unk>"):
+        self._name = name
+        self._ctx = None
+        self._t0 = None
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        try:
+            self._ctx = jax.profiler.TraceAnnotation(self._name)
+            self._ctx.__enter__()
+        except Exception:
+            self._ctx = None
+        return self
+
+    def __exit__(self, *a):
+        if self._ctx is not None:
+            self._ctx.__exit__(*a)
+        _tally(self._name, time.perf_counter() - self._t0)
+        return False
+
+
+class Marker:
+    """Instant marker (parity: ``profiler.Marker(...).mark()``)."""
+
+    def __init__(self, name, scope_name="process"):
+        self._name = name
+
+    def mark(self, scope_name="process"):
+        _tally(self._name, 0.0)
+
+
+if os.environ.get("MXNET_PROFILER_AUTOSTART", "0") == "1":
+    start()
+    atexit.register(dump)

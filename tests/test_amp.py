@@ -208,3 +208,11 @@ class TestReviewRegressions:
         p = list(net.collect_params().values())[0]
         assert p.data().dtype == np.dtype("bfloat16")
         assert p.grad().dtype == np.dtype("bfloat16")
+
+    def test_init_trainer_idempotent(self):
+        net = _net(seed=8)
+        tr = gluon.Trainer(net.collect_params(), "sgd", {"learning_rate": 0.1})
+        amp.init_trainer(tr)
+        step1 = tr.step
+        amp.init_trainer(tr)  # must not stack a second wrapper
+        assert tr.step is step1

@@ -1,0 +1,124 @@
+"""Custom-op + profiler tests (parity idioms: test_operator.py's
+CustomOp cases and test_profiler.py in the reference)."""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import profiler
+
+
+@mx.operator.register("mysigmoid")
+class SigmoidProp(mx.operator.CustomOpProp):
+    def __init__(self):
+        super().__init__(need_top_grad=True)
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        return Sigmoid()
+
+
+class Sigmoid(mx.operator.CustomOp):
+    def forward(self, is_train, req, in_data, out_data, aux):
+        x = in_data[0].asnumpy()
+        self.assign(out_data[0], req[0], mx.nd.array(1.0 / (1.0 + np.exp(-x))))
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        y = out_data[0].asnumpy()
+        g = out_grad[0].asnumpy()
+        self.assign(in_grad[0], req[0], mx.nd.array(g * y * (1.0 - y)))
+
+
+@mx.operator.register("myclip2")
+class TwoOutProp(mx.operator.CustomOpProp):
+    def list_outputs(self):
+        return ["pos", "neg"]
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0], in_shape[0]], []
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        return TwoOut()
+
+
+class TwoOut(mx.operator.CustomOp):
+    def forward(self, is_train, req, in_data, out_data, aux):
+        x = in_data[0].asnumpy()
+        self.assign(out_data[0], req[0], mx.nd.array(np.maximum(x, 0)))
+        self.assign(out_data[1], req[1], mx.nd.array(np.minimum(x, 0)))
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        x = in_data[0].asnumpy()
+        g = (out_grad[0].asnumpy() * (x > 0) + out_grad[1].asnumpy() * (x <= 0))
+        self.assign(in_grad[0], req[0], mx.nd.array(g))
+
+
+class TestCustomOp:
+    def test_forward(self):
+        x = mx.nd.array(np.array([-1.0, 0.0, 2.0], np.float32))
+        y = mx.nd.Custom(x, op_type="mysigmoid")
+        np.testing.assert_allclose(y.asnumpy(), 1 / (1 + np.exp([1.0, 0.0, -2.0])),
+                                   rtol=1e-6)
+
+    def test_backward_matches_analytic(self):
+        rng = np.random.RandomState(0)
+        xv = rng.randn(4, 5).astype(np.float32)
+        x = mx.nd.array(xv)
+        x.attach_grad()
+        with mx.autograd.record():
+            y = mx.nd.Custom(x, op_type="mysigmoid")
+            loss = mx.nd.sum(y * y)
+        loss.backward()
+        s = 1 / (1 + np.exp(-xv))
+        np.testing.assert_allclose(x.grad.asnumpy(), 2 * s * s * (1 - s),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_composes_with_builtin_ops_on_tape(self):
+        x = mx.nd.array(np.array([0.5, -0.5], np.float32))
+        x.attach_grad()
+        with mx.autograd.record():
+            h = x * 3.0
+            y = mx.nd.Custom(h, op_type="mysigmoid")
+            loss = mx.nd.sum(y)
+        loss.backward()
+        s = 1 / (1 + np.exp(-3 * np.array([0.5, -0.5])))
+        np.testing.assert_allclose(x.grad.asnumpy(), 3 * s * (1 - s), rtol=1e-5)
+
+    def test_multi_output(self):
+        x = mx.nd.array(np.array([1.0, -2.0, 3.0], np.float32))
+        x.attach_grad()
+        with mx.autograd.record():
+            pos, neg = mx.nd.Custom(x, op_type="myclip2")
+            loss = mx.nd.sum(pos * 2.0) + mx.nd.sum(neg * 5.0)
+        loss.backward()
+        np.testing.assert_allclose(pos.asnumpy(), [1.0, 0.0, 3.0])
+        np.testing.assert_allclose(neg.asnumpy(), [0.0, -2.0, 0.0])
+        np.testing.assert_allclose(x.grad.asnumpy(), [2.0, 5.0, 2.0])
+
+    def test_inside_jit_via_symbol(self):
+        from incubator_mxnet_tpu import sym
+        data = sym.Variable("data")
+        out = sym.Custom(data, op_type="mysigmoid", name="cs")
+        ex = out.bind(mx.cpu(), args={"data": mx.nd.array(np.zeros((2, 2), np.float32))},
+                      grad_req="null")
+        res = ex.forward(is_train=False)
+        np.testing.assert_allclose(res[0].asnumpy(), np.full((2, 2), 0.5), rtol=1e-6)
+
+    def test_unregistered_raises(self):
+        with pytest.raises(KeyError, match="not registered"):
+            mx.nd.Custom(mx.nd.ones((2,)), op_type="nope")
+
+
+class TestProfiler:
+    def test_scope_and_dumps(self):
+        profiler.set_config(filename="/tmp/prof_test/profile.json")
+        with profiler.scope("work"):
+            (mx.nd.ones((64, 64)) @ mx.nd.ones((64, 64))).asnumpy()
+        s = profiler.dumps()
+        assert "work" in s
+
+    def test_start_stop_cycle(self, tmp_path):
+        profiler.set_config(filename=str(tmp_path / "profile.json"))
+        profiler.start()
+        (mx.nd.ones((32, 32)) * 2).asnumpy()
+        profiler.stop()
+        assert profiler.state() == "stopped"
+        profiler.dump()

@@ -1,0 +1,106 @@
+"""Sparse NDArray tests (parity idioms: test_sparse_ndarray.py /
+test_sparse_operator.py in the reference — roundtrips, dot, retain)."""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu.ndarray import sparse
+
+
+def _dense_rs(n=6, m=4, seed=0):
+    rng = np.random.RandomState(seed)
+    d = rng.randn(n, m).astype(np.float32)
+    d[[1, 3, 4]] = 0.0  # sparse rows
+    return d
+
+
+class TestRowSparse:
+    def test_roundtrip(self):
+        d = _dense_rs()
+        rs = sparse.row_sparse_array(d)
+        assert rs.stype == "row_sparse"
+        assert rs.indices.asnumpy().tolist() == [0, 2, 5]
+        np.testing.assert_allclose(rs.asnumpy(), d)
+        np.testing.assert_allclose(rs.tostype("default").asnumpy(), d)
+
+    def test_from_data_indices(self):
+        vals = np.ones((2, 3), np.float32)
+        rs = sparse.row_sparse_array((vals, [1, 4]), shape=(6, 3))
+        dense = rs.asnumpy()
+        assert dense[1].sum() == 3 and dense[4].sum() == 3 and dense.sum() == 6
+
+    def test_nd_tostype(self):
+        d = mx.nd.array(_dense_rs())
+        rs = d.tostype("row_sparse")
+        assert rs.stype == "row_sparse"
+        np.testing.assert_allclose(rs.asnumpy(), d.asnumpy())
+
+    def test_add_merges_rows(self):
+        a = sparse.row_sparse_array((np.ones((2, 3), np.float32), [0, 2]), shape=(5, 3))
+        b = sparse.row_sparse_array((np.ones((2, 3), np.float32) * 2, [2, 4]), shape=(5, 3))
+        c = sparse.add(a, b)
+        assert c.stype == "row_sparse"
+        dense = c.asnumpy()
+        np.testing.assert_allclose(dense[2], np.full(3, 3.0))
+        np.testing.assert_allclose(dense[0], np.ones(3))
+        np.testing.assert_allclose(dense[4], np.full(3, 2.0))
+        assert dense[1].sum() == 0
+
+    def test_retain(self):
+        d = _dense_rs()
+        rs = sparse.row_sparse_array(d)
+        kept = sparse.retain(rs, [0, 5])
+        dense = kept.asnumpy()
+        np.testing.assert_allclose(dense[0], d[0])
+        np.testing.assert_allclose(dense[5], d[5])
+        assert np.abs(dense[2]).sum() == 0
+
+
+class TestCSR:
+    def test_roundtrip(self):
+        d = np.array([[0, 1, 0], [2, 0, 3], [0, 0, 0]], np.float32)
+        cs = sparse.csr_matrix(d)
+        assert cs.stype == "csr"
+        np.testing.assert_allclose(cs.asnumpy(), d)
+        np.testing.assert_allclose(cs[1].asnumpy(), d[1])
+
+    def test_from_triple(self):
+        cs = sparse.csr_matrix((np.array([1., 2.], np.float32),
+                                np.array([0, 2]), np.array([0, 1, 2])),
+                               shape=(2, 3))
+        np.testing.assert_allclose(cs.asnumpy(),
+                                   [[1, 0, 0], [0, 0, 2]])
+
+    def test_dot_dense(self):
+        rng = np.random.RandomState(1)
+        d = rng.randn(5, 7).astype(np.float32)
+        d[d < 0.5] = 0
+        w = rng.randn(7, 3).astype(np.float32)
+        cs = sparse.csr_matrix(d)
+        out = sparse.dot(cs, mx.nd.array(w))
+        np.testing.assert_allclose(out.asnumpy(), d @ w, rtol=1e-5, atol=1e-5)
+
+    def test_dot_transpose(self):
+        rng = np.random.RandomState(2)
+        d = rng.randn(5, 7).astype(np.float32)
+        d[d < 0.5] = 0
+        w = rng.randn(5, 3).astype(np.float32)
+        cs = sparse.csr_matrix(d)
+        out = sparse.dot(cs, mx.nd.array(w), transpose_a=True)
+        np.testing.assert_allclose(out.asnumpy(), d.T @ w, rtol=1e-5, atol=1e-5)
+
+    def test_sparse_zeros(self):
+        z = sparse.zeros("csr", (4, 5))
+        assert z.asnumpy().sum() == 0
+        z2 = sparse.zeros("row_sparse", (4, 5))
+        assert z2.asnumpy().shape == (4, 5)
+
+    def test_dot_transpose_b(self):
+        rng = np.random.RandomState(3)
+        d = rng.randn(5, 7).astype(np.float32)
+        d[d < 0.5] = 0
+        w = rng.randn(3, 7).astype(np.float32)
+        cs = sparse.csr_matrix(d)
+        out = sparse.dot(cs, mx.nd.array(w), transpose_b=True)
+        assert out.shape == (5, 3)
+        np.testing.assert_allclose(out.asnumpy(), d @ w.T, rtol=1e-5, atol=1e-5)
