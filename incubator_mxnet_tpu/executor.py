@@ -197,7 +197,11 @@ class Executor:
 
             fn = jax.jit(pure)
             self._fwd_cache[sig] = fn
-        outs, aux_updates = fn(arrays, get_key())
+        # Remember the key so backward() re-executes the graph with the SAME
+        # stochastic draws (dropout masks) as this forward — the reference
+        # backprops through the cached forward, never a re-sampled one.
+        self._last_key = get_key()
+        outs, aux_updates = fn(arrays, self._last_key)
         self.outputs = [NDArray(o, ctx=self._ctx) for o in outs]
         for name, new in aux_updates.items():
             self._aux_dict[name]._data = new
@@ -244,7 +248,10 @@ class Executor:
                 out_grads = [out_grads]
             out_grads = [g._data if isinstance(g, NDArray) else jnp.asarray(g)
                          for g in out_grads]
-        grads = fn(arrays, get_key(), out_grads)
+        key = getattr(self, "_last_key", None)
+        if key is None:  # backward without a prior forward
+            key = get_key()
+        grads = fn(arrays, key, out_grads)
         for name, g in grads.items():
             req = self._grad_req[name]
             tgt = self._grad_dict.get(name)

@@ -149,6 +149,7 @@ class NDArrayIter(DataIter):
         self.last_batch_handle = last_batch_handle
         self.cursor = -batch_size
         self._carry = _np.array([], dtype=_np.int64)  # roll_over leftovers
+        self._consumed = 0  # index into _order just past the last returned batch
         self._order = _np.arange(self.num_data)
         if last_batch_handle == "discard":
             self.num_batches = self.num_data // batch_size
@@ -172,11 +173,17 @@ class NDArrayIter(DataIter):
         if self.last_batch_handle == "roll_over":
             # unconsumed tail rolls into the next epoch's first batch
             # (parity: the reference defers the partial batch, it does NOT
-            # pad it — padding would double-count samples in metrics)
-            consumed = max(self.cursor, 0)
-            self._carry = self._order[consumed:] if consumed < len(self._order) else \
-                _np.array([], dtype=_np.int64)
+            # pad it — padding would double-count samples in metrics).
+            # ``_consumed`` tracks the position just past the last batch
+            # actually returned, which neither the mid-epoch cursor (start
+            # of the last batch) nor the post-exhaustion cursor can both
+            # provide; a reset before any batch carries nothing.
+            if 0 < self._consumed < len(self._order):
+                self._carry = self._order[self._consumed:]
+            else:
+                self._carry = _np.array([], dtype=_np.int64)
         self.cursor = -self.batch_size
+        self._consumed = 0
         base = _np.arange(self.num_data)
         if self.shuffle:
             self._rng.shuffle(base)
@@ -185,8 +192,12 @@ class NDArrayIter(DataIter):
     def iter_next(self):
         self.cursor += self.batch_size
         if self.last_batch_handle in ("discard", "roll_over"):
-            return self.cursor + self.batch_size <= len(self._order)
-        return self.cursor < self.num_data
+            ok = self.cursor + self.batch_size <= len(self._order)
+        else:
+            ok = self.cursor < self.num_data
+        if ok:
+            self._consumed = min(self.cursor + self.batch_size, len(self._order))
+        return ok
 
     def _slice(self, arrays):
         out = []
@@ -301,15 +312,21 @@ class PrefetchingIter(DataIter):
 
     def _worker(self):
         while not self._stop.is_set():
+            err = None
             try:
                 batch = self.data_iter.next()
             except StopIteration:
                 batch = None
+            except BaseException as e:  # noqa: BLE001 — any failure must
+                # still enqueue a sentinel, or the consumer's blocking
+                # queue.get() hangs forever; re-raised in iter_next()
+                batch, err = None, e
             # bounded put that notices reset(): never blocks forever with a
             # stale pre-reset batch (that race duplicated epoch tails)
+            item = (batch, err)
             while not self._stop.is_set():
                 try:
-                    self._queue.put(batch, timeout=0.05)
+                    self._queue.put(item, timeout=0.05)
                     break
                 except _queue.Full:
                     continue
@@ -339,7 +356,9 @@ class PrefetchingIter(DataIter):
         self._start()
 
     def iter_next(self):
-        batch = self._queue.get()
+        batch, err = self._queue.get()
+        if err is not None:
+            raise err
         if batch is None:
             return False
         self.current_batch = batch

@@ -188,8 +188,13 @@ class Module(BaseModule):
         self._kvstore = kv_create(kvstore) if isinstance(kvstore, str) else kvstore
         self._updater_states = {}
         if hasattr(self, "_preloaded_opt_states"):  # Module.load(..., load_optimizer_states=True)
-            self._updater_states = {
-                i: _tree_ndarray(s) for i, s in self._preloaded_opt_states.items()}
+            for i, s in self._preloaded_opt_states.items():
+                if isinstance(i, int):
+                    # legacy checkpoint keyed by position: remap to the name
+                    # keying update() uses, or the state would be silently
+                    # dropped and momentum/Adam moments reset on resume
+                    i = self._param_names[i]
+                self._updater_states[i] = _tree_ndarray(s)
             del self._preloaded_opt_states
         self.optimizer_initialized = True
 
@@ -218,16 +223,20 @@ class Module(BaseModule):
         inside the jitted program here)."""
         assert self.optimizer_initialized
         opt = self._optimizer
-        for i, name in enumerate(self._param_names):
+        for name in self._param_names:
             if name in self._fixed_param_names:
                 continue
             grad = self._exec.grad_dict.get(name)
             if grad is None:
                 continue
             weight = self._exec.arg_dict[name]
-            if i not in self._updater_states:
-                self._updater_states[i] = opt.create_state_multi_precision(i, weight)
-            opt.update_multi_precision(i, weight, grad, self._updater_states[i])
+            # keyed by parameter NAME, not position: BucketingModule shares
+            # these states across buckets whose list_arguments order can
+            # differ — positional keys would silently apply momentum to the
+            # wrong parameter (and lr_mult/wd_mult lookups are by name).
+            if name not in self._updater_states:
+                self._updater_states[name] = opt.create_state_multi_precision(name, weight)
+            opt.update_multi_precision(name, weight, grad, self._updater_states[name])
 
     def get_outputs(self, merge_multi_context=True):
         assert self.binded

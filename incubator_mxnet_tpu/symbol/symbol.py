@@ -66,10 +66,23 @@ _OPTIONAL_TENSOR = {
 }
 
 # Explicit tensor-input lists for ops where signature inspection is not
-# enough.  Everything else: parameters without a default are tensor inputs.
+# enough.  Everything else: parameters without a default are tensor inputs
+# — unless the caller passed them as non-Symbol kwargs (static attrs), see
+# ``_apply_op``.
 _TENSOR_PARAMS = {
     "BatchNorm": ("data", "gamma", "beta", "moving_mean", "moving_var"),
     "Dropout": ("data",),
+    # shape/axis/reps/... are required static attrs, never tensor inputs
+    "Reshape": ("data",),
+    "reshape": ("data",),
+    "expand_dims": ("data",),
+    "tile": ("data",),
+    "broadcast_to": ("data",),
+    "slice_axis": ("data",),
+    "slice": ("data",),
+    "transpose": ("data",),
+    "repeat": ("data",),
+    "flip": ("data",),
 }
 
 
@@ -392,6 +405,11 @@ def _apply_op(opname, args, kwargs, name=None):
     inputs, input_names = [], []
     optional = _OPTIONAL_TENSOR.get(opname, {})
     for t in tnames:
+        if t in attrs:
+            # supplied as a non-Symbol kwarg → it is a static attr
+            # (e.g. reshape(data, shape=(4, 2))), not a tensor input;
+            # do NOT auto-create a phantom variable for it.
+            continue
         if t in provided:
             entry = provided[t]._outputs
             if len(entry) != 1:

