@@ -1,0 +1,117 @@
+"""SSD single-shot detector (parity: the reference's SSD example family,
+[U:example/ssd/symbol/symbol_builder.py] — BASELINE.md config 5).
+
+TPU-first shape discipline: every stage is fixed-shape — anchors come from
+``contrib_MultiBoxPrior`` on statically-shaped feature maps, the head
+outputs concatenate to one [B, N, C+1] / [B, N·4] pair, and training
+targets/NMS are the mask-based ops in :mod:`...ops.detection`.  The whole
+forward (and the train step via SPMDTrainer) jits.
+
+``SSDForward`` returns (anchors [1, N, 4], cls_preds [B, N, C+1],
+box_preds [B, N·4]) — the triple MultiBoxTarget/MultiBoxDetection consume.
+"""
+from __future__ import annotations
+
+from ..block import HybridBlock
+from .. import nn
+
+__all__ = ["SSD", "ssd_512_resnet18", "SSDAnchorScales"]
+
+# Per-scale (sizes, ratios) — the classic SSD512 schedule, normalized.
+SSDAnchorScales = [
+    ((0.07, 0.1025), (1.0, 2.0, 0.5)),
+    ((0.15, 0.2121), (1.0, 2.0, 0.5, 3.0, 1.0 / 3)),
+    ((0.3, 0.3674), (1.0, 2.0, 0.5, 3.0, 1.0 / 3)),
+    ((0.45, 0.5196), (1.0, 2.0, 0.5, 3.0, 1.0 / 3)),
+    ((0.6, 0.6708), (1.0, 2.0, 0.5)),
+    ((0.75, 0.8216), (1.0, 2.0, 0.5)),
+]
+
+
+def _n_anchors(sizes, ratios):
+    return len(sizes) + len(ratios) - 1
+
+
+class _DownsampleBlock(HybridBlock):
+    """conv1x1 → conv3x3/s2 feature-pyramid step (the example's
+    ``_add_extras``)."""
+
+    def __init__(self, channels, **kwargs):
+        super().__init__(**kwargs)
+        self.body = nn.HybridSequential(prefix="")
+        self.body.add(nn.Conv2D(channels // 2, kernel_size=1))
+        self.body.add(nn.BatchNorm())
+        self.body.add(nn.Activation("relu"))
+        self.body.add(nn.Conv2D(channels, kernel_size=3, strides=2, padding=1))
+        self.body.add(nn.BatchNorm())
+        self.body.add(nn.Activation("relu"))
+
+    def hybrid_forward(self, F, x):
+        return self.body(x)
+
+
+class SSD(HybridBlock):
+    """Generic SSD over a feature extractor.
+
+    Parameters
+    ----------
+    features : HybridBlock
+        Backbone mapping images → the first (highest-resolution) feature
+        map used for prediction.
+    num_classes : int
+        Foreground classes (background is implicit class 0 of the head).
+    scales : list of (sizes, ratios)
+        Anchor schedule per pyramid level; levels beyond the backbone map
+        are built with stride-2 downsample blocks.
+    """
+
+    def __init__(self, features, num_classes, scales=SSDAnchorScales,
+                 channels=256, **kwargs):
+        super().__init__(**kwargs)
+        self.num_classes = num_classes
+        self._scales = list(scales)
+        with self.name_scope():
+            self.features = features
+            self.downsamplers = nn.HybridSequential(prefix="down_")
+            for _ in range(len(self._scales) - 1):
+                self.downsamplers.add(_DownsampleBlock(channels))
+            self.cls_heads = nn.HybridSequential(prefix="cls_")
+            self.box_heads = nn.HybridSequential(prefix="box_")
+            for sizes, ratios in self._scales:
+                a = _n_anchors(sizes, ratios)
+                self.cls_heads.add(nn.Conv2D(a * (num_classes + 1),
+                                             kernel_size=3, padding=1))
+                self.box_heads.add(nn.Conv2D(a * 4, kernel_size=3, padding=1))
+
+    def hybrid_forward(self, F, x):
+        feats = [self.features(x)]
+        for down in self.downsamplers._children.values():
+            feats.append(down(feats[-1]))
+
+        anchors, cls_preds, box_preds = [], [], []
+        for feat, (sizes, ratios), cls_head, box_head in zip(
+                feats, self._scales,
+                self.cls_heads._children.values(),
+                self.box_heads._children.values()):
+            anchors.append(F.contrib.MultiBoxPrior(feat, sizes=sizes,
+                                                   ratios=ratios, clip=True))
+            # [B, A*(C+1), H, W] → [B, H·W·A, C+1]
+            c = cls_head(feat).transpose((0, 2, 3, 1))
+            cls_preds.append(c.reshape((0, -1, self.num_classes + 1)))
+            b = box_head(feat).transpose((0, 2, 3, 1))
+            box_preds.append(b.reshape((0, -1)))
+        return (F.concat(*anchors, dim=1),
+                F.concat(*cls_preds, dim=1),
+                F.concat(*box_preds, dim=1))
+
+
+def ssd_512_resnet18(num_classes=20, **kwargs):
+    """SSD-512 with a ResNet-18 feature backbone (stages through conv4)."""
+    from .vision.resnet import resnet18_v1
+
+    base = resnet18_v1(classes=1)  # classifier head unused
+    features = nn.HybridSequential(prefix="backbone_")
+    # reference keeps everything up to (not incl.) the global pool / output
+    for layer in list(base.features._children.values())[:-2]:
+        features.add(layer)
+    return SSD(features, num_classes, **kwargs)

@@ -1,0 +1,218 @@
+"""Transformer encoder-decoder for seq2seq (WMT en-de, BASELINE.md
+config 4; parity role: the reference's bucketing seq2seq example family,
+[U:example/rnn/bucketing/], with the transformer itself living out-of-repo
+in GluonNLP).
+
+TPU-first inference design: there is no dynamic-shape KV cache — decode
+steps re-run the causal decoder on the prefix padded to a **bucket**
+length (powers of two), so the jit cache holds one program per bucket
+(the BucketingModule discipline applied to inference), every shape is
+static, and causal masking makes the padding invisible to the logits at
+the read position.  Beam bookkeeping runs on the host in numpy; the
+per-step network call is a single jitted program.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as _np
+
+from ..block import HybridBlock
+from ..nn.basic_layers import Dense, Dropout, Embedding
+from ..nn.transformer import (TransformerEncoder, TransformerDecoder,
+                              SinusoidalPositionalEncoding)
+
+__all__ = ["Transformer", "transformer_base", "transformer_big",
+           "transformer_sharding_rules", "beam_search", "greedy_search"]
+
+
+class Transformer(HybridBlock):
+    """Encoder-decoder transformer with tied source/target/output
+    embeddings (the WMT convention)."""
+
+    def __init__(self, vocab_size, units=512, hidden_size=2048, num_heads=8,
+                 num_encoder_layers=6, num_decoder_layers=6, dropout=0.1,
+                 max_length=1024, tie_weights=True, dtype="float32",
+                 prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._units = units
+        self._vocab = vocab_size
+        self._tie = tie_weights
+        with self.name_scope():
+            self.embed = Embedding(vocab_size, units, dtype=dtype, prefix="embed_")
+            self.pos_enc = SinusoidalPositionalEncoding(units, max_length)
+            self.encoder = TransformerEncoder(
+                num_encoder_layers, units, hidden_size, num_heads, dropout,
+                pre_norm=True, activation="relu", dtype=dtype, prefix="enc_")
+            self.decoder = TransformerDecoder(
+                num_decoder_layers, units, hidden_size, num_heads, dropout,
+                pre_norm=True, activation="relu", dtype=dtype, prefix="dec_")
+            if not tie_weights:
+                self.proj = Dense(vocab_size, use_bias=False, flatten=False,
+                                  dtype=dtype, prefix="proj_")
+        self._drop = Dropout(dropout) if dropout else None
+        if self._drop is not None:
+            self.register_child(self._drop, "dropout")
+
+    # -- halves (used by the search loops) ------------------------------
+    def encode(self, src):
+        x = self.embed(src) * math.sqrt(self._units)
+        x = self.pos_enc(x)
+        if self._drop is not None:
+            x = self._drop(x)
+        return self.encoder(x)
+
+    def decode(self, tgt, memory):
+        """tgt [B, T] int tokens → logits [B, T, V] (causal)."""
+        x = self.embed(tgt) * math.sqrt(self._units)
+        x = self.pos_enc(x)
+        if self._drop is not None:
+            x = self._drop(x)
+        h = self.decoder(x, memory)
+        if self._tie:
+            from ... import ndarray as F
+            # Parameter.data() returns the traced stand-in inside a jit
+            # trace, so weight tying composes into the compiled graph
+            return F.dot(h, self.embed.weight.data(), transpose_b=True)
+        return self.proj(h)
+
+    def forward(self, src, tgt):
+        return self.decode(tgt, self.encode(src))
+
+
+def transformer_base(vocab_size, max_length=1024, dropout=0.1, **kwargs):
+    return Transformer(vocab_size, units=512, hidden_size=2048, num_heads=8,
+                       max_length=max_length, dropout=dropout, **kwargs)
+
+
+def transformer_big(vocab_size, max_length=1024, dropout=0.3, **kwargs):
+    """The WMT'14 "big" configuration (BASELINE.md config 4)."""
+    return Transformer(vocab_size, units=1024, hidden_size=4096, num_heads=16,
+                       max_length=max_length, dropout=dropout, **kwargs)
+
+
+def transformer_sharding_rules(fsdp=False):
+    """Megatron-style TP placement for SPMDTrainer (same conventions as
+    ``bert_sharding_rules``): QKV/FFN-in column-parallel, out-proj/FFN-out
+    row-parallel, embedding vocab-sharded."""
+    from ...parallel.sharding import ShardingRules
+
+    dp = "fsdp" if fsdp else None
+    return ShardingRules(rules=[
+        (r".*qkv_weight$", ("tp", dp)),
+        (r".*kv_weight$", ("tp", dp)),
+        (r".*q_weight$", ("tp", dp)),
+        (r".*ffn1_weight$", ("tp", dp)),
+        (r".*(out|ffn2)_weight$", (dp, "tp")),
+        (r".*embed_weight$", ("tp", dp)),
+        (r".*_bias$", (None,)),
+    ])
+
+
+# ---------------------------------------------------------------------------
+# Search (greedy + beam) — bucketed-prefix jit discipline
+# ---------------------------------------------------------------------------
+
+
+def _bucket(n, max_len):
+    b = 8
+    while b < n:
+        b *= 2
+    return min(b, max_len)
+
+
+def _step_logits(model, tgt_padded, memory, t):
+    """Logits for position t given prefix tgt[:, :t+1], padded to a bucket
+    length.  Causality guarantees positions > t cannot leak in."""
+    from ... import ndarray as F
+    logits = model.decode(tgt_padded, memory)  # [B, Tb, V]
+    return logits[:, t]
+
+
+def greedy_search(model, src, bos, eos, max_length=64):
+    """Greedy decode → (tokens [B, max_length], lengths [B])."""
+    import numpy as np
+
+    from ... import ndarray as nd
+
+    memory = model.encode(src)
+    B = src.shape[0]
+    tokens = np.full((B, max_length), eos, np.int32)
+    tokens[:, 0] = bos
+    lengths = np.full(B, max_length, np.int32)
+    done = np.zeros(B, bool)
+    for t in range(max_length - 1):
+        tb = _bucket(t + 1, max_length)
+        logits = _step_logits(model, nd.array(tokens[:, :tb], dtype="int32"),
+                              memory, t)
+        nxt = logits.asnumpy().argmax(axis=-1).astype(np.int32)
+        nxt = np.where(done, eos, nxt)
+        tokens[:, t + 1] = nxt
+        newly = (~done) & (nxt == eos)
+        lengths[newly] = t + 2
+        done |= nxt == eos
+        if done.all():
+            break
+    return tokens, lengths
+
+
+def beam_search(model, src, bos, eos, beam_size=4, max_length=64, alpha=0.6):
+    """Length-penalized beam search (GNMT penalty ((5+len)/6)^alpha).
+
+    Returns (tokens [B, K, max_length], scores [B, K]) sorted best-first.
+    The per-step network call is one jitted decode over [B·K, Tb]; beam
+    bookkeeping is host-side numpy (cheap: K·V topk per step).
+    """
+    import numpy as np
+
+    from ... import ndarray as nd
+
+    memory = model.encode(src)          # [B, S, D]
+    B, K = src.shape[0], beam_size
+    mem = nd.array(np.repeat(memory.asnumpy(), K, axis=0))  # [B·K, S, D]
+
+    tokens = np.full((B, K, max_length), eos, np.int32)
+    tokens[:, :, 0] = bos
+    scores = np.full((B, K), -np.inf, np.float64)
+    scores[:, 0] = 0.0                  # only beam 0 live at t=0
+    done = np.zeros((B, K), bool)
+
+    for t in range(max_length - 1):
+        tb = _bucket(t + 1, max_length)
+        flat = tokens[:, :, :tb].reshape(B * K, tb)
+        logits = _step_logits(model, nd.array(flat, dtype="int32"), mem, t)
+        logp = _log_softmax_np(logits.asnumpy().astype(np.float64))  # [B·K, V]
+        V = logp.shape[-1]
+        logp = logp.reshape(B, K, V)
+        # finished beams only extend with eos at zero cost
+        logp = np.where(done[:, :, None],
+                        np.where(np.arange(V)[None, None] == eos, 0.0, -np.inf),
+                        logp)
+        cand = scores[:, :, None] + logp            # [B, K, V]
+        flat_cand = cand.reshape(B, K * V)
+        top = np.argsort(-flat_cand, axis=1)[:, :K]  # [B, K]
+        new_scores = np.take_along_axis(flat_cand, top, axis=1)
+        src_beam = top // V
+        nxt_tok = (top % V).astype(np.int32)
+
+        tokens = np.take_along_axis(
+            tokens, src_beam[:, :, None], axis=1)
+        tokens[:, :, t + 1] = nxt_tok
+        done = np.take_along_axis(done, src_beam, axis=1) | (nxt_tok == eos)
+        scores = new_scores
+        if done.all():
+            break
+
+    lengths = np.argmax(tokens == eos, axis=-1) + 1
+    lengths[~done] = max_length
+    lp = ((5.0 + lengths) / 6.0) ** alpha
+    final = scores / lp
+    order = np.argsort(-final, axis=1)
+    return (np.take_along_axis(tokens, order[:, :, None], axis=1),
+            np.take_along_axis(final, order, axis=1))
+
+
+def _log_softmax_np(x):
+    m = x.max(axis=-1, keepdims=True)
+    e = _np.exp(x - m)
+    return (x - m) - _np.log(e.sum(axis=-1, keepdims=True))

@@ -12,5 +12,6 @@ from . import tensor  # noqa: F401  (registers tensor ops)
 from . import nn  # noqa: F401  (registers NN ops)
 from . import rnn_ops  # noqa: F401  (registers fused RNN)
 from . import attention  # noqa: F401  (registers fused/flash attention)
+from . import detection  # noqa: F401  (registers MultiBox*/box_nms/box_iou)
 
 __all__ = ["register", "get_op", "list_ops", "Op", "registry", "tensor", "nn"]

@@ -1,0 +1,299 @@
+"""Detection operators — anchors, target assignment, decoding, NMS.
+
+Parity targets: ``MultiBoxPrior``/``MultiBoxTarget``/``MultiBoxDetection``
+([U:src/operator/contrib/multibox_prior.cc], [U:.../multibox_target.cc],
+[U:.../multibox_detection.cc]) and ``box_nms``/``box_iou``
+([U:src/operator/contrib/bounding_box.cc]) — the op set the SSD example
+family ([U:example/ssd/]) is built on, BASELINE.md config 5.
+
+TPU-first design notes (vs the reference's CPU/GPU kernels):
+
+* Everything is **fixed-shape and mask-based** — no dynamic box counts
+  anywhere.  "Suppressed"/"invalid" results are encoded as ``-1`` rows in
+  a constant-shape output, exactly the reference's output convention, so
+  the whole pipeline jits.
+* Matching and NMS are dense matrix computations (IoU matrices on the
+  VPU/MXU) + ``lax.fori_loop`` sequential scans, instead of the
+  reference's per-box scalar loops; ``vmap`` supplies the batch dim.
+* NMS is O(K²) in the post-top-k candidate count: callers bound K via
+  ``topk``/``nms_topk`` (the reference sorts all N; on TPU a static top-k
+  prefilter keeps the IoU matrix MXU-sized).
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register, alias
+
+__all__ = ["box_iou", "multibox_prior", "multibox_target", "multibox_detection",
+           "box_nms"]
+
+
+def _corner_iou(lhs, rhs, eps=1e-12):
+    """IoU of corner-format boxes: lhs [N, 4] x rhs [M, 4] → [N, M]."""
+    lx1, ly1, lx2, ly2 = [lhs[..., i] for i in range(4)]
+    rx1, ry1, rx2, ry2 = [rhs[..., i] for i in range(4)]
+    ix1 = jnp.maximum(lx1[..., :, None], rx1[..., None, :])
+    iy1 = jnp.maximum(ly1[..., :, None], ry1[..., None, :])
+    ix2 = jnp.minimum(lx2[..., :, None], rx2[..., None, :])
+    iy2 = jnp.minimum(ly2[..., :, None], ry2[..., None, :])
+    iw = jnp.clip(ix2 - ix1, 0.0)
+    ih = jnp.clip(iy2 - iy1, 0.0)
+    inter = iw * ih
+    larea = jnp.clip(lx2 - lx1, 0.0) * jnp.clip(ly2 - ly1, 0.0)
+    rarea = jnp.clip(rx2 - rx1, 0.0) * jnp.clip(ry2 - ry1, 0.0)
+    union = larea[..., :, None] + rarea[..., None, :] - inter
+    return inter / jnp.maximum(union, eps)
+
+
+def _center_to_corner(b):
+    cx, cy, w, h = [b[..., i] for i in range(4)]
+    return jnp.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2], axis=-1)
+
+
+@register("box_iou", differentiable=False)
+def box_iou(lhs, rhs, format="corner"):
+    """Pairwise IoU.  lhs [..., N, 4], rhs [..., M, 4] → [..., N, M]."""
+    if format == "center":
+        lhs = _center_to_corner(lhs)
+        rhs = _center_to_corner(rhs)
+    return _corner_iou(lhs, rhs)
+
+
+@register("contrib_MultiBoxPrior", differentiable=False)
+def multibox_prior(data, sizes=(1.0,), ratios=(1.0,), clip=False,
+                   steps=(-1.0, -1.0), offsets=(0.5, 0.5)):
+    """Anchor generation for a [B, C, H, W] feature map → [1, H·W·A, 4]
+    corner boxes normalized to [0, 1], A = len(sizes) + len(ratios) - 1
+    (all sizes at ratios[0], plus sizes[0] at each remaining ratio —
+    the reference's combination rule)."""
+    if isinstance(sizes, (int, float)):
+        sizes = (sizes,)
+    if isinstance(ratios, (int, float)):
+        ratios = (ratios,)
+    h, w = data.shape[-2], data.shape[-1]
+    step_y = steps[0] if steps and steps[0] > 0 else 1.0 / h
+    step_x = steps[1] if steps and steps[1] > 0 else 1.0 / w
+    cy = (jnp.arange(h, dtype=jnp.float32) + offsets[0]) * step_y
+    cx = (jnp.arange(w, dtype=jnp.float32) + offsets[1]) * step_x
+    cy, cx = jnp.meshgrid(cy, cx, indexing="ij")  # [H, W]
+
+    wh = []
+    for s in sizes:
+        r = math.sqrt(ratios[0])
+        wh.append((s * r, s / r))
+    for ratio in ratios[1:]:
+        r = math.sqrt(ratio)
+        wh.append((sizes[0] * r, sizes[0] / r))
+    ws = jnp.asarray([p[0] for p in wh], jnp.float32)  # [A]
+    hs = jnp.asarray([p[1] for p in wh], jnp.float32)
+
+    cx = cx[..., None]  # [H, W, 1]
+    cy = cy[..., None]
+    boxes = jnp.stack([
+        cx - ws / 2, cy - hs / 2, cx + ws / 2, cy + hs / 2], axis=-1)  # [H,W,A,4]
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    return boxes.reshape(1, h * w * len(wh), 4)
+
+
+def _encode_boxes(anchors, gt, variances):
+    """SSD box encoding: corner anchors + corner gt → regression targets."""
+    acx = (anchors[:, 0] + anchors[:, 2]) / 2
+    acy = (anchors[:, 1] + anchors[:, 3]) / 2
+    aw = jnp.maximum(anchors[:, 2] - anchors[:, 0], 1e-12)
+    ah = jnp.maximum(anchors[:, 3] - anchors[:, 1], 1e-12)
+    gcx = (gt[:, 0] + gt[:, 2]) / 2
+    gcy = (gt[:, 1] + gt[:, 3]) / 2
+    gw = jnp.maximum(gt[:, 2] - gt[:, 0], 1e-12)
+    gh = jnp.maximum(gt[:, 3] - gt[:, 1], 1e-12)
+    return jnp.stack([
+        (gcx - acx) / aw / variances[0],
+        (gcy - acy) / ah / variances[1],
+        jnp.log(gw / aw) / variances[2],
+        jnp.log(gh / ah) / variances[3],
+    ], axis=-1)  # [N, 4]
+
+
+def _decode_boxes(anchors, pred, variances, clip):
+    acx = (anchors[:, 0] + anchors[:, 2]) / 2
+    acy = (anchors[:, 1] + anchors[:, 3]) / 2
+    aw = anchors[:, 2] - anchors[:, 0]
+    ah = anchors[:, 3] - anchors[:, 1]
+    cx = pred[:, 0] * variances[0] * aw + acx
+    cy = pred[:, 1] * variances[1] * ah + acy
+    w = jnp.exp(pred[:, 2] * variances[2]) * aw
+    h = jnp.exp(pred[:, 3] * variances[3]) * ah
+    out = jnp.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2], axis=-1)
+    if clip:
+        out = jnp.clip(out, 0.0, 1.0)
+    return out
+
+
+def _match_anchors(anchors, gt_boxes, gt_valid, overlap_threshold):
+    """Reference matching rule, dense form: every gt claims its best anchor
+    (bipartite stage), then remaining anchors match their best gt if IoU
+    exceeds the threshold.  Returns match ∈ {-1, gt index} per anchor."""
+    iou = _corner_iou(anchors, gt_boxes)            # [N, M]
+    iou = jnp.where(gt_valid[None, :], iou, -1.0)
+    best_gt = jnp.argmax(iou, axis=1)               # [N]
+    best_iou = jnp.max(iou, axis=1)
+    match = jnp.where(best_iou >= overlap_threshold, best_gt, -1)
+    # bipartite stage: gt j's best anchor is forced to match j (overrides
+    # the threshold rule, exactly once per valid gt)
+    best_anchor = jnp.argmax(iou, axis=0)           # [M]
+    gt_has_overlap = jnp.max(iou, axis=0) > 0
+    force = gt_valid & gt_has_overlap
+    m = gt_boxes.shape[0]
+    # scatter each valid gt's index onto its best anchor (later gts win on
+    # collision, matching the reference's sequential bipartite pass)
+    forced = jnp.full_like(match, -1)
+    forced = forced.at[best_anchor].set(
+        jnp.where(force, jnp.arange(m), forced[best_anchor]))
+    return jnp.where(forced >= 0, forced, match)
+
+
+@register("contrib_MultiBoxTarget", differentiable=False)
+def multibox_target(anchor, label, cls_pred, overlap_threshold=0.5,
+                    ignore_label=-1.0, negative_mining_ratio=-1.0,
+                    negative_mining_thresh=0.5, minimum_negative_samples=0,
+                    variances=(0.1, 0.1, 0.2, 0.2)):
+    """Anchor → ground-truth assignment for SSD training.
+
+    anchor: [1, N, 4] corner boxes; label: [B, M, 5] rows of
+    (class_id, xmin, ymin, xmax, ymax), padded with -1; cls_pred:
+    [B, num_classes+1, N] (used for hard-negative mining when
+    ``negative_mining_ratio > 0``).
+
+    Returns (box_target [B, N·4], box_mask [B, N·4], cls_target [B, N])
+    where cls_target is gt class + 1 for matched anchors, 0 for
+    background, ``ignore_label`` for mined-out negatives.
+    """
+    anchors = anchor.reshape(-1, 4)
+    n = anchors.shape[0]
+
+    def per_sample(lab, cpred):
+        gt_cls = lab[:, 0]
+        gt_valid = gt_cls >= 0
+        gt_boxes = lab[:, 1:5]
+        match = _match_anchors(anchors, gt_boxes, gt_valid, overlap_threshold)
+        matched = match >= 0
+        safe = jnp.clip(match, 0)
+        targets = _encode_boxes(anchors, gt_boxes[safe], variances)
+        box_target = jnp.where(matched[:, None], targets, 0.0).reshape(-1)
+        box_mask = jnp.where(matched[:, None],
+                             jnp.ones((n, 4), jnp.float32), 0.0).reshape(-1)
+        cls_target = jnp.where(matched, gt_cls[safe].astype(jnp.int32) + 1, 0)
+        if negative_mining_ratio > 0:
+            # hard-negative mining: keep the top (ratio × #pos) background
+            # anchors by background-loss proxy (1 - P(bg)); others → ignore
+            bg_prob = cpred[0]
+            neg_score = jnp.where(matched, -jnp.inf, 1.0 - bg_prob)
+            neg_score = jnp.where(neg_score >= (1.0 - negative_mining_thresh),
+                                  neg_score, -jnp.inf)
+            num_pos = jnp.sum(matched)
+            budget = jnp.maximum(
+                (negative_mining_ratio * num_pos).astype(jnp.int32),
+                jnp.int32(minimum_negative_samples))
+            order = jnp.argsort(-neg_score)
+            rank = jnp.zeros(n, jnp.int32).at[order].set(jnp.arange(n, dtype=jnp.int32))
+            keep_neg = (rank < budget) & jnp.isfinite(neg_score)
+            cls_target = jnp.where(matched | keep_neg, cls_target,
+                                   jnp.int32(ignore_label))
+        return box_target, box_mask, cls_target.astype(jnp.float32)
+
+    return tuple(jax.vmap(per_sample)(label, cls_pred))
+
+
+def _nms_keep(boxes, scores, cls_id, valid, thresh, force_suppress):
+    """Sequential NMS over pre-sorted candidates (descending score).
+    Returns keep mask [K]."""
+    k = boxes.shape[0]
+    iou = _corner_iou(boxes, boxes)
+    same = jnp.ones((k, k), bool) if force_suppress else (
+        cls_id[:, None] == cls_id[None, :])
+    earlier = jnp.arange(k)[:, None] < jnp.arange(k)[None, :]  # j earlier than i
+    sup = (iou > thresh) & same & earlier.T  # sup[i, j]: j can suppress i (j<i)
+
+    def body(i, keep):
+        suppressed = jnp.any(keep & sup[i])
+        return keep.at[i].set(keep[i] & ~suppressed)
+
+    return lax.fori_loop(0, k, body, valid)
+
+
+@register("contrib_MultiBoxDetection", differentiable=False)
+def multibox_detection(cls_prob, loc_pred, anchor, clip=True, threshold=0.01,
+                       background_id=0, nms_threshold=0.5, force_suppress=False,
+                       variances=(0.1, 0.1, 0.2, 0.2), nms_topk=400):
+    """Decode + per-class NMS → [B, N, 6] rows (class_id, score, xmin,
+    ymin, xmax, ymax); suppressed/invalid rows are all -1.
+
+    cls_prob: [B, num_classes+1, N] softmax class probabilities (class
+    ``background_id`` is background), loc_pred: [B, N·4], anchor:
+    [1, N, 4].  ``nms_topk`` bounds the O(K²) NMS candidate count (static
+    shape; the reference's -1 "all" maps to K = min(N, 400) by default).
+    """
+    anchors = anchor.reshape(-1, 4)
+    n = anchors.shape[0]
+    k = n if nms_topk is None or nms_topk <= 0 else min(int(nms_topk), n)
+
+    def per_sample(cprob, lpred):
+        fg = jnp.concatenate([cprob[:background_id], cprob[background_id + 1:]],
+                             axis=0)                       # [C, N]
+        cls_id = jnp.argmax(fg, axis=0).astype(jnp.int32)  # [N]
+        score = jnp.max(fg, axis=0)
+        boxes = _decode_boxes(anchors, lpred.reshape(-1, 4), variances, clip)
+        valid = score > threshold
+        # static top-k prefilter by score
+        order = jnp.argsort(-jnp.where(valid, score, -jnp.inf))[:k]
+        keep = _nms_keep(boxes[order], score[order], cls_id[order],
+                         valid[order], nms_threshold, force_suppress)
+        out = jnp.full((n, 6), -1.0, jnp.float32)
+        rows = jnp.concatenate([
+            cls_id[order][:, None].astype(jnp.float32),
+            score[order][:, None], boxes[order]], axis=1)
+        return out.at[jnp.arange(k)].set(jnp.where(keep[:, None], rows, -1.0))
+
+    return jax.vmap(per_sample)(cls_prob, loc_pred)
+
+
+@register("box_nms", differentiable=False)
+def box_nms(data, overlap_thresh=0.5, valid_thresh=0.0, topk=-1,
+            coord_start=2, score_index=1, id_index=-1, background_id=-1,
+            force_suppress=False, in_format="corner", out_format="corner"):
+    """Generic NMS over [..., N, K] records (parity: ``nd.contrib.box_nms``).
+    Suppressed records are overwritten with -1; shape is unchanged."""
+    shape = data.shape
+    flat = data.reshape((-1,) + shape[-2:])
+    n = shape[-2]
+    k = n if topk is None or topk <= 0 else min(int(topk), n)
+
+    def per_batch(recs):
+        score = recs[:, score_index]
+        boxes = recs[:, coord_start:coord_start + 4]
+        if in_format == "center":
+            boxes = _center_to_corner(boxes)
+        if id_index >= 0:
+            cls_id = recs[:, id_index].astype(jnp.int32)
+            valid = (score > valid_thresh) & (cls_id != background_id)
+        else:
+            cls_id = jnp.zeros(n, jnp.int32)
+            valid = score > valid_thresh
+        order = jnp.argsort(-jnp.where(valid, score, -jnp.inf))[:k]
+        keep = _nms_keep(boxes[order], score[order], cls_id[order],
+                         valid[order], overlap_thresh,
+                         force_suppress or id_index < 0)
+        out = jnp.full_like(recs, -1.0)
+        return out.at[jnp.arange(k)].set(
+            jnp.where(keep[:, None], recs[order], -1.0))
+
+    return jax.vmap(per_batch)(flat).reshape(shape)
+
+
+alias("contrib_box_nms", "box_nms")
+alias("contrib_box_iou", "box_iou")

@@ -79,6 +79,9 @@ def convolution(
     dilate = _tuplize(dilate, n)
     pad = _tuplize(pad if pad is not None else 0, n)
     dn = lax.conv_dimension_numbers(data.shape, weight.shape, _CONV_DIMS[n])
+    # No preferred_element_type: XLA:TPU already accumulates bf16 convs in
+    # fp32 on the MXU, and requesting an f32 output breaks jax's conv
+    # transpose rule under AMP (f32 cotangent paired with bf16 operands).
     out = lax.conv_general_dilated(
         data,
         weight,
@@ -87,10 +90,7 @@ def convolution(
         rhs_dilation=dilate,
         dimension_numbers=dn,
         feature_group_count=num_group,
-        preferred_element_type=jnp.float32 if data.dtype == jnp.bfloat16 else None,
     )
-    if out.dtype != data.dtype:
-        out = out.astype(data.dtype)
     if bias is not None and not no_bias:
         out = out + bias.reshape((1, -1) + (1,) * n)
     return out

@@ -1,0 +1,174 @@
+"""Detection op + SSD tests (parity idioms: the reference's
+test_contrib_* detection tests — numpy-reference checks for anchors,
+target assignment and NMS, plus an end-to-end jitted SSD train step)."""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd
+
+
+def np_iou(a, b):
+    ix1 = np.maximum(a[:, None, 0], b[None, :, 0])
+    iy1 = np.maximum(a[:, None, 1], b[None, :, 1])
+    ix2 = np.minimum(a[:, None, 2], b[None, :, 2])
+    iy2 = np.minimum(a[:, None, 3], b[None, :, 3])
+    inter = np.clip(ix2 - ix1, 0, None) * np.clip(iy2 - iy1, 0, None)
+    aa = (a[:, 2] - a[:, 0]) * (a[:, 3] - a[:, 1])
+    bb = (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+    return inter / np.maximum(aa[:, None] + bb[None, :] - inter, 1e-12)
+
+
+class TestBoxOps:
+    def test_box_iou_matches_numpy(self):
+        rng = np.random.RandomState(0)
+        a = np.sort(rng.rand(7, 2, 2), axis=1).transpose(0, 2, 1).reshape(7, 4).astype(np.float32)
+        b = np.sort(rng.rand(5, 2, 2), axis=1).transpose(0, 2, 1).reshape(5, 4).astype(np.float32)
+        got = nd.contrib.box_iou(mx.nd.array(a), mx.nd.array(b)).asnumpy()
+        np.testing.assert_allclose(got, np_iou(a, b), rtol=1e-5, atol=1e-6)
+
+    def test_multibox_prior_anchors(self):
+        data = mx.nd.zeros((1, 8, 4, 4))
+        anchors = nd.contrib.MultiBoxPrior(data, sizes=(0.5, 0.25), ratios=(1.0, 2.0))
+        # A = 2 + 2 - 1 = 3 anchors per pixel
+        assert anchors.shape == (1, 4 * 4 * 3, 4)
+        a = anchors.asnumpy()[0].reshape(4, 4, 3, 4)
+        # first pixel center is ((0+.5)/4, (0+.5)/4) = (.125, .125)
+        np.testing.assert_allclose(
+            a[0, 0, 0], [0.125 - 0.25, 0.125 - 0.25, 0.125 + 0.25, 0.125 + 0.25],
+            atol=1e-6)
+        # second anchor: size 0.25, ratio 1
+        np.testing.assert_allclose(
+            a[0, 0, 1], [0.125 - 0.125, 0.125 - 0.125, 0.25, 0.25], atol=1e-6)
+        # third anchor: size 0.5, ratio 2 → w = .5·√2, h = .5/√2
+        w, h = 0.5 * np.sqrt(2), 0.5 / np.sqrt(2)
+        np.testing.assert_allclose(
+            a[0, 0, 2], [0.125 - w / 2, 0.125 - h / 2, 0.125 + w / 2, 0.125 + h / 2],
+            atol=1e-6)
+
+    def test_multibox_target_assignment(self):
+        # 3 anchors, 2 gt; anchor0 ↔ gt0 high IoU, anchor2 ↔ gt1 forced
+        anchors = mx.nd.array(np.array([[[0.0, 0.0, 0.4, 0.4],
+                                         [0.3, 0.3, 0.7, 0.7],
+                                         [0.6, 0.6, 1.0, 1.0]]], np.float32))
+        # labels: (cls, x1, y1, x2, y2); second row padding
+        label = mx.nd.array(np.array([[[1, 0.02, 0.02, 0.42, 0.42],
+                                       [0, 0.58, 0.58, 0.98, 0.98]]], np.float32))
+        cls_pred = mx.nd.zeros((1, 3, 3))
+        bt, bm, ct = nd.contrib.MultiBoxTarget(anchors, label, cls_pred,
+                                               overlap_threshold=0.5)
+        ct = ct.asnumpy()[0]
+        assert ct[0] == 2  # gt class 1 → target 2 (bg is 0)
+        assert ct[1] == 0  # background
+        assert ct[2] == 1  # gt class 0 → target 1
+        bm = bm.asnumpy()[0].reshape(3, 4)
+        np.testing.assert_array_equal(bm[0], 1)
+        np.testing.assert_array_equal(bm[1], 0)
+        np.testing.assert_array_equal(bm[2], 1)
+        # encoded offset for a perfectly-centred match is ~0 in cx/cy
+        bt = bt.asnumpy()[0].reshape(3, 4)
+        assert abs(bt[0, 0]) < 1.0 and abs(bt[0, 1]) < 1.0
+
+    def test_box_nms_suppresses_overlaps(self):
+        # records: (cls, score, x1, y1, x2, y2)
+        recs = np.array([[0, 0.9, 0.0, 0.0, 0.5, 0.5],
+                         [0, 0.8, 0.01, 0.01, 0.51, 0.51],   # overlaps 1st
+                         [0, 0.7, 0.6, 0.6, 0.9, 0.9],
+                         [1, 0.6, 0.02, 0.02, 0.52, 0.52]],  # other class
+                        np.float32)[None]
+        out = nd.contrib.box_nms(mx.nd.array(recs), overlap_thresh=0.5,
+                                 coord_start=2, score_index=1, id_index=0).asnumpy()[0]
+        kept = out[out[:, 1] > 0]
+        assert len(kept) == 3
+        np.testing.assert_allclose(sorted(kept[:, 1]), [0.6, 0.7, 0.9], atol=1e-6)
+
+    def test_multibox_detection_decodes_and_nms(self):
+        anchors = mx.nd.array(np.array([[[0.1, 0.1, 0.5, 0.5],
+                                         [0.12, 0.12, 0.52, 0.52],
+                                         [0.6, 0.6, 0.9, 0.9]]], np.float32))
+        # class probs: [B, C+1, N]; anchor0/1 → class 1, anchor2 → class 2
+        cls_prob = mx.nd.array(np.array([[[0.1, 0.2, 0.1],
+                                          [0.8, 0.7, 0.1],
+                                          [0.1, 0.1, 0.8]]], np.float32))
+        loc_pred = mx.nd.zeros((1, 12))
+        out = nd.contrib.MultiBoxDetection(cls_prob, loc_pred, anchors,
+                                           nms_threshold=0.5).asnumpy()[0]
+        valid = out[out[:, 0] >= 0]
+        assert len(valid) == 2  # anchor1 suppressed by anchor0
+        by_cls = {int(r[0]): r for r in valid}
+        assert 0 in by_cls and 1 in by_cls
+        np.testing.assert_allclose(by_cls[0][2:], [0.1, 0.1, 0.5, 0.5], atol=1e-5)
+        assert abs(by_cls[0][1] - 0.8) < 1e-5
+
+    def test_detection_ops_jit(self):
+        """The whole decode+NMS pipeline must compile (static shapes)."""
+        import jax
+        from incubator_mxnet_tpu.ops.detection import multibox_detection
+
+        def fn(cp, lp, an):
+            return multibox_detection(cp, lp, an, nms_topk=8)
+
+        rng = np.random.RandomState(0)
+        cp = jax.nn.softmax(jax.numpy.asarray(rng.rand(2, 4, 8)), axis=1)
+        lp = jax.numpy.asarray(rng.randn(2, 32).astype(np.float32) * 0.1)
+        an = jax.numpy.asarray(
+            np.tile(np.array([[0.1, 0.1, 0.3, 0.3]], np.float32), (8, 1))[None]
+            + np.linspace(0, 0.6, 8, dtype=np.float32)[None, :, None])
+        out = jax.jit(fn)(cp, lp, an)
+        assert out.shape == (2, 8, 6)
+
+
+class TestSSD:
+    def test_ssd_forward_shapes(self):
+        from incubator_mxnet_tpu.gluon.model_zoo.ssd import SSD, SSDAnchorScales
+        from incubator_mxnet_tpu.gluon import nn
+
+        feat = nn.HybridSequential()
+        feat.add(nn.Conv2D(16, kernel_size=3, strides=2, padding=1))
+        feat.add(nn.Activation("relu"))
+        net = SSD(feat, num_classes=3, scales=SSDAnchorScales[:3], channels=32)
+        net.initialize()
+        x = mx.nd.zeros((2, 3, 64, 64))
+        anchors, cls_preds, box_preds = net(x)
+        n = anchors.shape[1]
+        assert anchors.shape == (1, n, 4)
+        assert cls_preds.shape == (2, n, 4)
+        assert box_preds.shape == (2, n * 4)
+
+    def test_ssd_train_step_jits(self):
+        """SSD forward + MultiBoxTarget + CE/L1 loss in one jitted step."""
+        import jax
+        import jax.numpy as jnp
+        from incubator_mxnet_tpu.gluon.model_zoo.ssd import SSD, SSDAnchorScales
+        from incubator_mxnet_tpu.gluon import nn
+        from incubator_mxnet_tpu.parallel import make_mesh, SPMDTrainer
+        from incubator_mxnet_tpu.ndarray.ndarray import NDArray
+        from incubator_mxnet_tpu.ops.detection import multibox_target
+        from incubator_mxnet_tpu.ops.nn import streaming_softmax_ce
+
+        feat = nn.HybridSequential()
+        feat.add(nn.Conv2D(8, kernel_size=3, strides=4, padding=1))
+        feat.add(nn.Activation("relu"))
+        net = SSD(feat, num_classes=2, scales=SSDAnchorScales[:2], channels=16)
+        net.initialize()
+        B = 8
+        x = mx.nd.array(np.random.RandomState(0).rand(B, 3, 32, 32).astype(np.float32))
+        label = np.full((B, 2, 5), -1, np.float32)
+        label[:, 0] = [1, 0.1, 0.1, 0.6, 0.6]
+        label = mx.nd.array(label)
+        net(x)  # materialize deferred shapes
+
+        def ssd_loss(out, lab):
+            anchors, cls_preds, box_preds = out
+            bt, bm, ct = multibox_target(
+                anchors._data, lab._data,
+                jnp.swapaxes(cls_preds._data, 1, 2))
+            ce = streaming_softmax_ce(cls_preds._data, ct).mean(axis=-1)
+            l1 = (jnp.abs(box_preds._data - bt) * bm).mean(axis=-1)
+            return NDArray(ce + l1)
+
+        trainer = SPMDTrainer(net, ssd_loss, "sgd", {"learning_rate": 0.01},
+                              mesh=make_mesh())
+        loss0 = float(trainer.step(x, label).asnumpy())
+        loss1 = float(trainer.step(x, label).asnumpy())
+        assert np.isfinite(loss0) and np.isfinite(loss1)
