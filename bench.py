@@ -30,6 +30,19 @@ BASELINE_SAMPLES_PER_SEC = 100.0
 BASELINE_RESNET50_IMG_PER_SEC = 1400.0
 
 
+def _fence(trainer, loss):
+    """Concrete D2H of the last loss AND one updated parameter.  Under the
+    tunneled axon backend block_until_ready can return before execution
+    completes (measured 27x inflation), and the loss alone doesn't depend
+    on the final optimizer update — fencing a param covers it."""
+    import jax
+    import numpy as np
+
+    float(np.asarray(loss._data))
+    p0 = jax.tree_util.tree_leaves(trainer._param_arrays)[0]
+    np.asarray(p0.addressable_data(0))
+
+
 def bench_resnet50():
     """ResNet-50 training throughput, synthetic ImageNet-shape data (the
     ``--benchmark 1`` mode of the reference's train_imagenet fit loop)."""
@@ -70,14 +83,19 @@ def bench_resnet50():
                           {"learning_rate": 0.1, "momentum": 0.9, "wd": 1e-4},
                           mesh=mesh)
 
+    # pre-stage the synthetic batch on the mesh (the reference's
+    # --benchmark 1 discipline; per-step H2D belongs to the input
+    # pipeline, measured separately)
+    img, labels = trainer.shard_batch(img, labels)
+
     for _ in range(warmup):
-        trainer.step(img, labels)
-    jax.block_until_ready(trainer._param_arrays)
+        loss = trainer.step(img, labels)
+    _fence(trainer, loss)
 
     t0 = time.perf_counter()
     for _ in range(steps):
-        trainer.step(img, labels)
-    jax.block_until_ready(trainer._param_arrays)
+        loss = trainer.step(img, labels)
+    _fence(trainer, loss)
     dt = time.perf_counter() - t0
 
     n_chips = mesh.devices.size
@@ -103,7 +121,12 @@ def main():
     backend = jax.default_backend()
     B = int(os.environ.get("MXNET_TPU_BENCH_BATCH", "64"))
     S, vocab = 128, 30522
-    warmup, steps = (2, 20) if backend != "cpu" else (1, 2)
+    # MLM decodes only the masked positions (GluonNLP masked_positions /
+    # MLPerf max_predictions_per_seq=20 at S=128) — the vocab projection
+    # runs on P=20 tokens, not all 128; MXNET_TPU_BENCH_ALL_POSITIONS=1
+    # restores the decode-everything variant for comparison.
+    P = 0 if os.environ.get("MXNET_TPU_BENCH_ALL_POSITIONS") == "1" else 20
+    warmup, steps = (3, 60) if backend != "cpu" else (1, 2)
 
     # BASELINE.md config 3 is mixed-precision: bf16 matmuls (MXU-native)
     # with fp32 softmax/norms/optimizer state, via the mx.amp op lists.
@@ -120,10 +143,18 @@ def main():
         rng = np.random.RandomState(0)
         tok = mx.nd.array(rng.randint(0, vocab, (B, S)), dtype="int32")
         seg = mx.nd.zeros((B, S), dtype="int32")
-        labels = mx.nd.array(rng.randint(0, vocab, (B, S)), dtype="int32")
+        if P:
+            pos = mx.nd.array(
+                np.sort(np.stack([rng.choice(S, P, replace=False) for _ in range(B)])),
+                dtype="int32")
+            labels = mx.nd.array(rng.randint(0, vocab, (B, P)), dtype="int32")
+        else:
+            pos = None
+            labels = mx.nd.array(rng.randint(0, vocab, (B, S)), dtype="int32")
         # materialize deferred-init shapes with a tiny batch (cheap on the
         # eager CPU path; param shapes are batch-independent)
-        net(mx.nd.zeros((2, S), dtype="int32"), mx.nd.zeros((2, S), dtype="int32"))
+        net(mx.nd.zeros((2, S), dtype="int32"), mx.nd.zeros((2, S), dtype="int32"),
+            mx.nd.zeros((2, P), dtype="int32") if P else None)
 
     def mlm_loss(out, label):
         # Streaming cross-entropy: no [B, S, V] fp32 log-prob tensor is
@@ -136,14 +167,32 @@ def main():
     mesh = make_mesh()  # pure-dp over whatever local devices exist
     trainer = SPMDTrainer(net, mlm_loss, "adam", {"learning_rate": 1e-4}, mesh=mesh)
 
+    # Pre-stage the synthetic batch on the mesh (the reference's
+    # --benchmark 1 mode reuses one device-resident batch the same way:
+    # [U:example/image-classification/common/fit.py]); keeps per-step H2D
+    # off the critical path, as a prefetching input pipeline would.
+    if P:
+        tok, seg, pos, labels = trainer.shard_batch(tok, seg, pos, labels)
+        inputs = (tok, seg, pos)
+    else:
+        tok, seg, labels = trainer.shard_batch(tok, seg, labels)
+        inputs = (tok, seg)
+
     for _ in range(warmup):
-        loss = trainer.step((tok, seg), labels)
-    jax.block_until_ready(trainer._param_arrays)
+        loss = trainer.step(inputs, labels)
+    _fence(trainer, loss)
+
+    prof_dir = os.environ.get("MXNET_TPU_BENCH_PROFILE")
+    if prof_dir:
+        with jax.profiler.trace(prof_dir):
+            for _ in range(5):
+                loss = trainer.step(inputs, labels)
+            _fence(trainer, loss)
 
     t0 = time.perf_counter()
     for _ in range(steps):
-        loss = trainer.step((tok, seg), labels)
-    jax.block_until_ready(trainer._param_arrays)
+        loss = trainer.step(inputs, labels)
+    _fence(trainer, loss)
     dt = time.perf_counter() - t0
 
     n_chips = mesh.devices.size

@@ -17,15 +17,22 @@ TARGET_OPS = {
     "FullyConnected", "fully_connected",
     "Convolution", "Deconvolution",
     "dot", "batch_dot", "linalg_gemm2",
-    "fused_attention",
+    "fused_attention", "fused_qkv_attention", "fused_kv_attention",
     "RNN",
+    # Embedding output feeds the transformer residual stream; emitting it
+    # in the target dtype keeps that stream bf16 end-to-end (the norms
+    # below preserve input dtype), killing the per-sublayer cast copies
+    # the round-2 profile charged ~2-3% MFU to (docs/PERF_NOTES.md).
+    "Embedding",
 }
 
+# softmax/log_softmax/softmin and the norms are NOT fp32-listed: the ops
+# themselves compute exp/statistics in fp32 and return the input dtype
+# (ops/nn.py), which is numerically equivalent to hook-casting but lets
+# the converts fuse into the reduction instead of materializing copies.
 FP32_OPS = {
-    "softmax", "log_softmax", "softmin",
     "SoftmaxOutput", "Softmax", "softmax_cross_entropy",
     "LinearRegressionOutput", "MAERegressionOutput", "LogisticRegressionOutput",
-    "BatchNorm", "LayerNorm", "InstanceNorm", "GroupNorm", "RMSNorm",
     "L2Normalization", "norm",
     "exp", "log", "log2", "log10", "log1p", "expm1",
     "sum", "mean", "prod", "nansum", "nanprod",

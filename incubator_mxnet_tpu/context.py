@@ -88,7 +88,9 @@ _device_lock = threading.Lock()
 def _accelerator_devices():
     import jax
 
-    devs = jax.devices()
+    # process-LOCAL: under multi-process (dist kvstore / launch_local.py)
+    # eager arrays must land on a device this process can address
+    devs = jax.local_devices()
     return [d for d in devs if d.platform not in ("cpu",)] or []
 
 
@@ -102,20 +104,20 @@ def _resolve_jax_device(device_type, device_id):
     dev = None
     if device_type == "cpu" or device_type.startswith("cpu_"):
         try:
-            cpus = jax.devices("cpu")
+            cpus = jax.local_devices(backend="cpu")
         except RuntimeError:
-            cpus = [d for d in jax.devices() if d.platform == "cpu"]
+            cpus = [d for d in jax.local_devices() if d.platform == "cpu"]
         if cpus:
             dev = cpus[min(device_id, len(cpus) - 1)]
         else:
             # CPU platform absent (accelerator-only build): fall back to default
-            dev = jax.devices()[0]
+            dev = jax.local_devices()[0]
     else:
         accel = _accelerator_devices()
         if accel:
             dev = accel[device_id % len(accel)]
         else:
-            dev = jax.devices()[min(device_id, len(jax.devices()) - 1)]
+            dev = jax.local_devices()[min(device_id, len(jax.local_devices()) - 1)]
     with _device_lock:
         _device_cache[key] = dev
     return dev

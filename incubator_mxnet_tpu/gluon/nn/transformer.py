@@ -66,11 +66,10 @@ class MultiHeadAttention(HybridBlock):
                 raise ValueError("cross-attention requires a memory input")
             q = self.q_proj(x)
             kv = self.kv_proj(memory)
-            k, v = F.split(kv, num_outputs=2, axis=-1)
+            out = F.contrib.fused_kv_attention(q, kv, num_heads=H, causal=self._causal)
         else:
             qkv = self.qkv(x)  # [B, S, 3D]
-            q, k, v = F.split(qkv, num_outputs=3, axis=-1)
-        out = F.contrib.fused_attention(q, k, v, num_heads=H, causal=self._causal)
+            out = F.contrib.fused_qkv_attention(qkv, num_heads=H, causal=self._causal)
         out = self.out_proj(out)
         if self._dropout is not None:
             out = self._dropout(out)

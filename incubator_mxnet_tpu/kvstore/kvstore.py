@@ -18,11 +18,17 @@ collapses onto XLA collectives:
   identically-replicated state — same result as server-side updates, no
   server.  'dist_async' is accepted and behaves synchronously (documented
   divergence: async staleness is a PS artifact, not a capability).
-* gradient compression — ``set_gradient_compression`` maps to quantized
-  collectives; current implementation stores the config and applies 2-bit
-  stochastic rounding host-side before cross-process reduction.
+* gradient compression — per-worker gradients are quantized to 2-bit
+  {-t, 0, +t} codes with an error-feedback residual *before* the wire
+  (matching [U:src/kvstore/gradient_compression.cc]'s worker-side
+  compress → push order); the cross-worker reduction then sums int8 codes
+  (4× the wire bytes of fp32; code sums fit int8 for ≤127 workers, which
+  is also the reference's practical regime) and the aggregate is
+  reconstructed as ``sum(codes) · t``.
 """
 from __future__ import annotations
+
+import os as _os
 
 import numpy as _np
 
@@ -83,9 +89,12 @@ class KVStore:
                 self.push(k, v, priority)
             return
         agg = self._aggregate(value)
-        agg = self._reduce_across_workers(agg)
         if self._compression is not None:
-            agg = self._compress(key, agg)
+            # compress BEFORE the wire — the whole point of gradient
+            # compression is what crosses the process boundary
+            agg = self._compressed_reduce(key, agg)
+        else:
+            agg = self._reduce_across_workers(agg)
         if self._updater is not None:
             self._updater(key, agg, self._store[key])
         else:
@@ -109,7 +118,10 @@ class KVStore:
                 self.pushpull(k, value[i], out[i] if out is not None else None, priority)
             return
         agg = self._aggregate(value)
-        agg = self._reduce_across_workers(agg)
+        if self._compression is not None:
+            agg = self._compressed_reduce(key, agg)
+        else:
+            agg = self._reduce_across_workers(agg)
         if self._updater is not None:
             if key not in self._store:
                 self.init(key, agg)
@@ -144,23 +156,32 @@ class KVStore:
     def _reduce_across_workers(self, value):
         return value
 
-    def _compress(self, key, grad):
-        """2-bit gradient compression with error-feedback residual
-        (parity: [U:src/kvstore/gradient_compression.cc])."""
-        threshold = self._compression.get("threshold", 0.5)
+    def _reduce_codes(self, codes):
+        """Cross-worker sum of int8 quantization codes (the wire format).
+        Single-process base: identity.  Returns an int array."""
+        return codes
+
+    def _compressed_reduce(self, key, grad):
+        """2-bit gradient compression with error-feedback residual, applied
+        worker-side BEFORE the cross-worker reduction (parity:
+        [U:src/kvstore/kvstore_dist.cc] compresses, then ZPushes).  The wire
+        carries int8 sign codes; the aggregate is ``sum(codes) · t``."""
+        import jax.numpy as jnp
+
+        threshold = float(self._compression.get("threshold", 0.5))
         res_key = ("__residual__", key)
         residual = self._store.get(res_key)
         if residual is None:
             residual = zeros(grad.shape, dtype=grad.dtype, ctx=grad.context)
-        g = grad + residual
-        import jax.numpy as jnp
-
-        q = jnp.where(g._data > threshold, threshold, jnp.where(g._data < -threshold, -threshold, 0.0))
-        new_res = g._data - q
-        residual._data = new_res
+        g = grad._data + residual._data
+        codes = (jnp.where(g > threshold, 1, 0)
+                 + jnp.where(g < -threshold, -1, 0)).astype(jnp.int8)
+        residual._data = g - codes.astype(g.dtype) * threshold
+        residual._version += 1
         self._store[res_key] = residual
-        out = NDArray(q, ctx=grad.context)
-        return out
+        wire = self._reduce_codes(codes)
+        self._last_wire_dtype = str(codes.dtype)  # test/observability hook
+        return NDArray(wire.astype(grad.dtype) * threshold, ctx=grad.context)
 
     # -- optimizer plumbing ---------------------------------------------
     def set_optimizer(self, optimizer):
@@ -202,15 +223,44 @@ class KVStoreLocal(KVStore):
 
 
 class KVStoreDist(KVStore):
-    """'dist_*': multi-process SPMD aggregation over jax.distributed."""
+    """'dist_*': multi-process SPMD aggregation over jax.distributed.
+
+    Process bootstrap honors the reference launcher's DMLC_* environment
+    (set by ``tools/launch_local.py``, the [U:tools/launch.py] local-mode
+    analog): DMLC_PS_ROOT_URI/DMLC_PS_ROOT_PORT = the jax.distributed
+    coordinator, DMLC_NUM_WORKER = process count, DMLC_WORKER_ID = this
+    process's id.  The scheduler/server roles have no process here — the
+    coordinator thread inside worker 0 plays the scheduler, and there is
+    no server tier (SPMD peers).
+    """
 
     def __init__(self, name):
         super().__init__(name)
         self._initialized_dist = False
+        self._mesh_cache = None
+        self._reduce_fn_cache = None
+        self._ensure_dist()
 
     def _ensure_dist(self):
         if self._initialized_dist:
             return
+        n = int(_os.environ.get("DMLC_NUM_WORKER", "1"))
+        if n > 1:
+            # must run before anything touches the XLA backend — even
+            # jax.process_count() would initialize it single-process
+            import jax
+
+            from ..parallel.mesh import init_distributed
+
+            try:
+                already = jax.distributed.is_initialized()
+            except AttributeError:  # older jax
+                already = getattr(
+                    getattr(getattr(jax, "_src", None), "distributed", None),
+                    "global_state", None) is not None and \
+                    jax._src.distributed.global_state.client is not None
+            if not already:
+                init_distributed()
         self._initialized_dist = True
 
     @property
@@ -225,15 +275,61 @@ class KVStoreDist(KVStore):
 
         return jax.process_count()
 
+    # -- device-side collectives ----------------------------------------
+    def _worker_mesh(self):
+        """One device per process, mesh axis 'w' — the wire the reference's
+        ps-lite ZMQ transport maps onto (XLA collectives over ICI/DCN).
+        Memoized: Mesh identity keys the jit cache."""
+        if self._mesh_cache is None:
+            import jax
+            from jax.sharding import Mesh
+
+            first = {}
+            for d in jax.devices():
+                first.setdefault(d.process_index, d)
+            devs = [first[i] for i in range(jax.process_count())]
+            self._mesh_cache = Mesh(_np.array(devs), ("w",))
+        return self._mesh_cache
+
+    def _allreduce(self, arr):
+        """Sum ``arr`` (host or device value, identical shape on every
+        worker) across processes with an on-device psum — no O(workers)
+        host-side gather, and no D2H round-trip for device-resident
+        gradients.  The jitted reducer is built once; jit's own
+        shape-keyed cache handles per-key shapes."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        mesh = self._worker_mesh()
+        if self._reduce_fn_cache is None:
+            self._reduce_fn_cache = jax.jit(
+                lambda x: jnp.sum(x, axis=0),
+                out_shardings=NamedSharding(mesh, P()),
+            )
+        my_dev = mesh.devices.flat[
+            [d.process_index for d in mesh.devices.flat].index(
+                jax.process_index())]
+        sharding = NamedSharding(mesh, P("w"))
+        local = jax.device_put(jnp.expand_dims(jnp.asarray(arr), 0), my_dev)
+        garr = jax.make_array_from_single_device_arrays(
+            (jax.process_count(),) + tuple(local.shape[1:]), sharding, [local])
+        out = self._reduce_fn_cache(garr)
+        return out.addressable_data(0)
+
     def _reduce_across_workers(self, value):
         import jax
 
         if jax.process_count() == 1:
             return value
-        from jax.experimental import multihost_utils
+        return NDArray(self._allreduce(value._data), ctx=value.context)
 
-        summed = multihost_utils.process_allgather(value._data)
-        return NDArray(summed.sum(axis=0), ctx=value.context)
+    def _reduce_codes(self, codes):
+        import jax
+
+        if jax.process_count() == 1:
+            return codes
+        return self._allreduce(codes)
 
     def barrier(self):
         import jax

@@ -591,6 +591,20 @@ def arange(start, stop=None, step=1.0, repeat=1, ctx=None, dtype="float32"):
 
 
 def waitall():
-    """Parity: ``mx.nd.waitall`` / ``Engine::WaitForAll``.  XLA tracks its own
-    queue; effectively a fence via blocking on a trivial computation."""
-    (jax.device_put(0.0) + 0).block_until_ready()
+    """Parity: ``mx.nd.waitall`` / ``Engine::WaitForAll``.
+
+    TPU/CPU PJRT devices execute their launch queue in order, so a fresh
+    trivial computation completing on a device proves everything enqueued
+    earlier on that device completed.  Fence EVERY local device (the old
+    single-device probe said nothing about the others), then drain any
+    host-side effects."""
+    probes = [
+        (jax.device_put(0.0, d) + 0)  # the add runs on d's compute queue
+        for d in jax.local_devices()
+    ]
+    for p in probes:
+        p.block_until_ready()
+    try:
+        jax.effects_barrier()
+    except Exception:
+        pass

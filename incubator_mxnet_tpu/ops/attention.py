@@ -18,10 +18,18 @@ Three tiers, chosen by :func:`flash_attention`:
 
 Gradients: ``jax.custom_vjp`` — backward recomputes attention probabilities
 from the saved (q, k, v), so no S×S residual is stored *between* fwd and
-bwd.  The backward itself currently materializes the S×S score matrix
-(fine through BERT/WMT-scale sequence lengths; a blockwise Pallas backward
-is the planned long-context upgrade — until then use ring attention /
-sequence parallelism for very long sequences, which never forms S×S).
+bwd.  The backward is seq-length gated (thresholds below): short sequences
+take a rematerialized XLA backward (one fused S×S program — faster when
+S×S fits comfortably), long sequences take the two-pass blockwise Pallas
+backward (`_flash_bwd_pallas`) whose memory stays linear in S.
+
+Layout: :func:`fused_qkv_attention` / :func:`fused_kv_attention` keep the
+``[B, S, H, Dh]`` layout end-to-end on the short-sequence XLA path so the
+head split/merge is a free reshape of the QKV matmul output and XLA folds
+the remaining dimension shuffles into the attention dot_generals — no
+materialized head transposes (docs/PERF_NOTES.md round-3 win).  The Pallas
+kernels want ``[B·H, S, Dh]`` physically, so the long-context path pays
+the two transposes (negligible against O(S²) attention work there).
 """
 from __future__ import annotations
 
@@ -394,16 +402,18 @@ def _pallas_blocks(sq, sk, block_q=128, block_k=128):
 _PALLAS_FWD_MIN_SEQ = int(os.environ.get("MXNET_TPU_FLASH_FWD_MIN_SEQ", "1024"))
 
 
-def _should_use_pallas(q, k):
+def _should_use_pallas(q, k, seq_axis=2):
     """One predicate for the primal AND the VJP forward — custom_vjp needs
     both to pick the same kernel path or eval/train numerics diverge.
-    Returns (use, interpret, blocks)."""
+    ``seq_axis`` lets bshd-layout callers gate without materializing a
+    transpose.  Returns (use, interpret, blocks)."""
+    sq, sk = q.shape[seq_axis], k.shape[seq_axis]
     use, interpret = _use_pallas(q)
     if q.dtype == jnp.float16 and not interpret:
         use = False  # Mosaic has no f16; XLA reference path handles it
-    if use and not interpret and max(q.shape[2], k.shape[2]) < _PALLAS_FWD_MIN_SEQ:
+    if use and not interpret and max(sq, sk) < _PALLAS_FWD_MIN_SEQ:
         use = False
-    blocks = _pallas_blocks(q.shape[2], k.shape[2]) if use and _HAVE_PALLAS else None
+    blocks = _pallas_blocks(sq, sk) if use and _HAVE_PALLAS else None
     return use and _HAVE_PALLAS and blocks is not None, interpret, blocks
 
 
@@ -501,6 +511,89 @@ def flash_attention(q, k, v, causal=False, scale=None):
     return _flash(q, k, v, causal, float(scale))
 
 
+# ---------------------------------------------------------------------------
+# [B, S, H, Dh] layout path — no materialized head transposes (short-seq XLA
+# tier; the layout shuffles live inside the dot_generals where the MXU's
+# layout assignment absorbs them)
+# ---------------------------------------------------------------------------
+
+
+def _causal_mask(s):
+    sq, sk = s.shape[-2], s.shape[-1]
+    mask = jnp.arange(sq)[:, None] >= jnp.arange(sk)[None, :]
+    return jnp.where(mask, s, -jnp.inf)
+
+
+def attention_reference_bshd(q, k, v, causal=False, scale=None):
+    """Plain jnp attention over [B, S, H, Dh] operands (head axis stays in
+    place; same fp32-accumulate / fp32-softmax policy as
+    :func:`attention_reference`)."""
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    prec = (jax.lax.Precision.HIGHEST if q.dtype == jnp.float32
+            else jax.lax.Precision.DEFAULT)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                   preferred_element_type=jnp.float32, precision=prec) * scale
+    if causal:
+        s = _causal_mask(s)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v,
+                      preferred_element_type=jnp.float32,
+                      precision=prec).astype(v.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _flash_bshd(q, k, v, causal, scale):
+    return attention_reference_bshd(q, k, v, causal, scale)
+
+
+def _flash_bshd_fwd(q, k, v, causal, scale):
+    return attention_reference_bshd(q, k, v, causal, scale), (q, k, v)
+
+
+def _flash_bshd_bwd(causal, scale, res, do):
+    """Rematerialized flash-attention gradient algebra in bshd layout —
+    the bshd twin of :func:`_flash_bwd_xla`."""
+    q, k, v = res
+    prec = (jax.lax.Precision.HIGHEST if q.dtype == jnp.float32
+            else jax.lax.Precision.DEFAULT)
+    mm = functools.partial(jnp.einsum, preferred_element_type=jnp.float32,
+                           precision=prec)
+    s = mm("bqhd,bkhd->bhqk", q, k) * scale
+    if causal:
+        s = _causal_mask(s)
+    p = jax.nn.softmax(s, axis=-1)                   # fp32 [B, H, Sq, Sk]
+    pc = p.astype(v.dtype)
+    o = mm("bhqk,bkhd->bqhd", pc, v)                 # fp32 accum [B, Sq, H, D]
+    dv = mm("bhqk,bqhd->bkhd", pc, do)
+    dp = mm("bqhd,bkhd->bhqk", do, v)
+    delta = jnp.sum(do.astype(jnp.float32) * o, axis=-1)       # [B, Sq, H]
+    ds = (p * (dp - delta.transpose(0, 2, 1)[..., None])).astype(q.dtype)
+    dq = mm("bhqk,bkhd->bqhd", ds, k) * scale
+    dk = mm("bhqk,bqhd->bkhd", ds, q) * scale
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_flash_bshd.defvjp(_flash_bshd_fwd, _flash_bshd_bwd)
+
+
+def _attend_bshd(q, k, v, causal, scale):
+    """Dispatch [B, S, H, Dh] attention: bshd XLA path at short sequence
+    lengths, transpose + Pallas flash kernel at long ones (where the two
+    transposes are noise against O(S²) attention)."""
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    # one shared gate with the bhsd path (seq_axis=1 in this layout) so
+    # interpret-mode/f16/threshold behavior cannot drift; transposes only
+    # happen on the Pallas branch
+    use, _, _ = _should_use_pallas(q, k, seq_axis=1)
+    if use:
+        t = lambda x: x.transpose(0, 2, 1, 3)
+        out = _flash(t(q), t(k), t(v), causal, float(scale))
+        return out.transpose(0, 2, 1, 3)
+    return _flash_bshd(q, k, v, causal, float(scale))
+
+
 from .registry import register  # noqa: E402
 
 
@@ -516,7 +609,39 @@ def fused_attention(q, k, v, num_heads=1, causal=False, scale=None):
         raise ValueError(f"feature dim {d} not divisible by num_heads {h}")
 
     def split(x):
-        return x.reshape(b, x.shape[1], h, d // h).transpose(0, 2, 1, 3)
+        return x.reshape(b, x.shape[1], h, d // h)
 
-    out = flash_attention(split(q), split(k), split(v), causal=causal, scale=scale)
-    return out.transpose(0, 2, 1, 3).reshape(b, s, d)
+    out = _attend_bshd(split(q), split(k), split(v), causal, scale)
+    return out.reshape(b, s, d)
+
+
+@register("fused_qkv_attention")
+def fused_qkv_attention(qkv, num_heads=1, causal=False, scale=None):
+    """Self-attention straight from the fused QKV projection output
+    [B, S, 3·D]: the q/k/v split AND the head split are one free reshape
+    ([B, S, 3, H, Dh] decomposes the projection's output columns exactly),
+    and the bshd attention core never materializes a head transpose."""
+    b, s, d3 = qkv.shape
+    h = num_heads
+    d = d3 // 3
+    if d % h or d3 % 3:
+        raise ValueError(f"qkv dim {d3} not divisible into 3 heads×{h}")
+    x = qkv.reshape(b, s, 3, h, d // h)
+    out = _attend_bshd(x[:, :, 0], x[:, :, 1], x[:, :, 2], causal, scale)
+    return out.reshape(b, s, d)
+
+
+@register("fused_kv_attention")
+def fused_kv_attention(q, kv, num_heads=1, causal=False, scale=None):
+    """Cross-attention twin of :func:`fused_qkv_attention`: q [B, Sq, D]
+    from the decoder, kv [B, Sk, 2·D] from the fused KV projection of the
+    encoder memory."""
+    b, sq, d = q.shape
+    h = num_heads
+    if d % h or kv.shape[-1] != 2 * d:
+        raise ValueError(f"kv dim {kv.shape[-1]} must be 2×{d}, heads {h}")
+    dh = d // h
+    x = kv.reshape(b, kv.shape[1], 2, h, dh)
+    out = _attend_bshd(q.reshape(b, sq, h, dh), x[:, :, 0], x[:, :, 1],
+                       causal, scale)
+    return out.reshape(b, sq, d)

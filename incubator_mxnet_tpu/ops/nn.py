@@ -243,58 +243,75 @@ def batch_norm(
     ax = axis % data.ndim
     reduce_axes = tuple(i for i in range(data.ndim) if i != ax)
     bshape = tuple(data.shape[ax] if i == ax else 1 for i in range(data.ndim))
+    x32 = data.astype(jnp.float32)
     if use_global_stats:
-        mean, var = moving_mean, moving_var
+        mean, var = moving_mean.astype(jnp.float32), moving_var.astype(jnp.float32)
     else:
-        mean = jnp.mean(data, axis=reduce_axes)
-        var = jnp.var(data, axis=reduce_axes)
+        # statistics always in fp32 — on bf16 inputs the converts fuse into
+        # the reduction, so this costs nothing while AMP can leave the
+        # activations in bf16 end-to-end (no hook cast copies)
+        mean = jnp.mean(x32, axis=reduce_axes)
+        var = jnp.var(x32, axis=reduce_axes)
     g = jnp.ones_like(gamma) if fix_gamma else gamma
     inv = lax.rsqrt(var + eps)
-    out = (data - mean.reshape(bshape)) * (g * inv).reshape(bshape) + beta.reshape(bshape)
-    return out, mean, var
+    out = ((x32 - mean.reshape(bshape)) * (g.astype(jnp.float32) * inv).reshape(bshape)
+           + beta.astype(jnp.float32).reshape(bshape))
+    return out.astype(data.dtype), mean, var
 
 
 @register("LayerNorm")
 def layer_norm(data, gamma, beta, axis=-1, eps=1e-5):
-    """Parity: [U:src/operator/nn/layer_norm.cc]."""
-    mean = jnp.mean(data, axis=axis, keepdims=True)
-    var = jnp.var(data, axis=axis, keepdims=True)
-    out = (data - mean) * lax.rsqrt(var + eps)
+    """Parity: [U:src/operator/nn/layer_norm.cc].  fp32 statistics with the
+    output in the input dtype: under bf16 AMP the activations never leave
+    bf16 at the op boundary (the internal converts fuse into the reduction
+    and the normalize loop — no materialized cast copies)."""
+    x32 = data.astype(jnp.float32)
+    mean = jnp.mean(x32, axis=axis, keepdims=True)
+    var = jnp.var(x32, axis=axis, keepdims=True)
+    out = (x32 - mean) * lax.rsqrt(var + eps)
     ax = axis % data.ndim
     bshape = tuple(data.shape[ax] if i == ax else 1 for i in range(data.ndim))
-    return out * gamma.reshape(bshape) + beta.reshape(bshape)
+    out = (out * gamma.astype(jnp.float32).reshape(bshape)
+           + beta.astype(jnp.float32).reshape(bshape))
+    return out.astype(data.dtype)
 
 
 @register("GroupNorm")
 def group_norm(data, gamma, beta, num_groups=1, eps=1e-5):
     n, c = data.shape[0], data.shape[1]
     rest = data.shape[2:]
-    x = data.reshape((n, num_groups, c // num_groups) + rest)
+    x = data.astype(jnp.float32).reshape((n, num_groups, c // num_groups) + rest)
     axes = tuple(range(2, x.ndim))
     mean = jnp.mean(x, axis=axes, keepdims=True)
     var = jnp.var(x, axis=axes, keepdims=True)
     x = (x - mean) * lax.rsqrt(var + eps)
     x = x.reshape(data.shape)
     bshape = (1, c) + (1,) * len(rest)
-    return x * gamma.reshape(bshape) + beta.reshape(bshape)
+    out = (x * gamma.astype(jnp.float32).reshape(bshape)
+           + beta.astype(jnp.float32).reshape(bshape))
+    return out.astype(data.dtype)
 
 
 @register("InstanceNorm")
 def instance_norm(data, gamma, beta, eps=1e-3):
     axes = tuple(range(2, data.ndim))
-    mean = jnp.mean(data, axis=axes, keepdims=True)
-    var = jnp.var(data, axis=axes, keepdims=True)
-    x = (data - mean) * lax.rsqrt(var + eps)
+    x32 = data.astype(jnp.float32)
+    mean = jnp.mean(x32, axis=axes, keepdims=True)
+    var = jnp.var(x32, axis=axes, keepdims=True)
+    x = (x32 - mean) * lax.rsqrt(var + eps)
     bshape = (1, data.shape[1]) + (1,) * (data.ndim - 2)
-    return x * gamma.reshape(bshape) + beta.reshape(bshape)
+    out = (x * gamma.astype(jnp.float32).reshape(bshape)
+           + beta.astype(jnp.float32).reshape(bshape))
+    return out.astype(data.dtype)
 
 
 @register("RMSNorm")
 def rms_norm(data, gamma, axis=-1, eps=1e-6):
     """TPU-era extension (not in reference): RMSNorm for LLM blocks."""
-    ms = jnp.mean(jnp.square(data.astype(jnp.float32)), axis=axis, keepdims=True)
-    out = data * lax.rsqrt(ms + eps).astype(data.dtype)
-    return out * gamma
+    x32 = data.astype(jnp.float32)
+    ms = jnp.mean(jnp.square(x32), axis=axis, keepdims=True)
+    out = x32 * lax.rsqrt(ms + eps) * gamma.astype(jnp.float32)
+    return out.astype(data.dtype)
 
 
 # ---------------------------------------------------------------------------
@@ -346,8 +363,9 @@ def leaky_relu(data, gamma=None, act_type="leaky", slope=0.25, lower_bound=0.125
 @register("softmax")
 def softmax(data, axis=-1, temperature=None, length=None):
     """Parity: [U:src/operator/nn/softmax.cc] (with optional temperature and
-    length masking)."""
-    x = data
+    length masking).  Internally fp32 (exp/sum), output in the input dtype —
+    bf16 activations stay bf16 under AMP with no hook cast copies."""
+    x = data.astype(jnp.float32)
     if temperature is not None and temperature != 1.0:
         x = x / temperature
     if length is not None:
@@ -359,19 +377,21 @@ def softmax(data, axis=-1, temperature=None, length=None):
         )
         x = jnp.where(mask, x, -jnp.inf)
         out = jax.nn.softmax(x, axis=axis)
-        return jnp.where(mask, out, 0.0)
-    return jax.nn.softmax(x, axis=axis)
+        return jnp.where(mask, out, 0.0).astype(data.dtype)
+    return jax.nn.softmax(x, axis=axis).astype(data.dtype)
 
 
 @register("log_softmax")
 def log_softmax(data, axis=-1, temperature=None):
-    x = data if temperature in (None, 1.0) else data / temperature
-    return jax.nn.log_softmax(x, axis=axis)
+    x = data.astype(jnp.float32)
+    if temperature not in (None, 1.0):
+        x = x / temperature
+    return jax.nn.log_softmax(x, axis=axis).astype(data.dtype)
 
 
 @register("softmin")
 def softmin(data, axis=-1):
-    return jax.nn.softmax(-data, axis=axis)
+    return jax.nn.softmax(-data.astype(jnp.float32), axis=axis).astype(data.dtype)
 
 
 def streaming_softmax_ce(logits, labels):
@@ -511,8 +531,32 @@ def dropout(data, p=0.5, mode="training", axes=(), key=None, training=None):
         for ax in axes:
             shape[ax] = 1
     keep = 1.0 - p
+    import os as _os
+
+    if _os.environ.get("MXNET_TPU_FAST_DROPOUT", "1") == "1":
+        # 8-bit mask draw: 4× fewer threefry blocks than bernoulli's
+        # uint32-per-element (dropout RNG was 12% of the BERT step —
+        # docs/PERF_NOTES.md).  keep is quantized to n/256 (≤1/512 absolute
+        # error); the rescale uses the quantized keep, so E[out] == data
+        # exactly.  MXNET_TPU_FAST_DROPOUT=0 restores exact-probability
+        # bernoulli.
+        thresh = int(round(keep * 256))
+        if 0 < thresh < 256:
+            bits = jax.random.bits(key, tuple(shape), dtype=jnp.uint8)
+            mask = (bits < thresh).astype(data.dtype)
+            return data * mask * (256.0 / thresh)
     mask = jax.random.bernoulli(key, keep, tuple(shape)).astype(data.dtype)
     return data * mask / keep
+
+
+@register("gather_positions")
+def gather_positions(data, positions):
+    """[B, S, D] × [B, P] int → [B, P, D]: per-batch sequence-position
+    gather.  The MLM masked-position path (parity: GluonNLP BERTModel's
+    ``masked_positions`` — only ~15% of positions reach the vocab
+    projection, which is the workload the reference benchmarks)."""
+    idx = positions.astype(jnp.int32)
+    return jnp.take_along_axis(data, idx[..., None], axis=1)
 
 
 @register("Embedding")

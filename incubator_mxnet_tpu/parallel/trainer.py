@@ -132,8 +132,13 @@ class SPMDTrainer:
         self._param_shardings = [
             param_sharding(self._mesh, p.name, p.shape, self._rules) for p in params
         ]
+        # device_put via a host copy: putting a device-resident array onto a
+        # mesh that CONTAINS its device can alias the source buffer, and the
+        # first donated step would then kill the Parameter's own data
+        # (breaking any later eager use of the block)
         self._param_arrays = [
-            jax.device_put(p._data._data, s) for p, s in zip(params, self._param_shardings)
+            jax.device_put(_np.asarray(p._data._data), s)
+            for p, s in zip(params, self._param_shardings)
         ]
         # Optimizer state: same sharding as its parameter (ZeRO comes from
         # the parameter rule; state simply follows).
@@ -145,7 +150,8 @@ class SPMDTrainer:
             shard = jax.tree_util.tree_map(
                 lambda a: self._sharding_like(a, self._param_shardings[i]), arrs
             )
-            arrs = jax.tree_util.tree_map(lambda a, s: jax.device_put(a, s), arrs, shard)
+            arrs = jax.tree_util.tree_map(
+                lambda a, s: jax.device_put(_np.asarray(a), s), arrs, shard)
             self._opt_states.append(arrs)
             self._state_shardings.append(shard)
 
@@ -196,7 +202,9 @@ class SPMDTrainer:
             a = _np.asarray(a) if not isinstance(a, jax.Array) else a
             spec = batch_pspec(a.ndim, self._sp_axis)
             sharding = NamedSharding(self._mesh, spec)
-            if jax.process_count() > 1:
+            if isinstance(a, jax.Array) and a.sharding == sharding:
+                out.append(a)  # idempotent: already staged on the mesh
+            elif jax.process_count() > 1:
                 out.append(jax.make_array_from_process_local_data(sharding, a))
             else:
                 out.append(jax.device_put(a, sharding))

@@ -84,14 +84,77 @@ def dump(finished=True, profile_process="worker"):
     stop()
 
 
+def iter_xplane_ops(trace_dir):
+    """Yield ``(full_hlo_text, duration_ps)`` for every event on a device
+    plane's "XLA Ops" line in the newest ``.xplane.pb`` under ``trace_dir``
+    (the "Async XLA Ops" line is skipped — its spans overlap compute).
+    Single shared xplane reader — tools/parse_xplane.py presents the same
+    stream differently.  Yields nothing when no trace/proto reader exists."""
+    import glob
+
+    try:
+        from tensorflow.tsl.profiler.protobuf import xplane_pb2  # type: ignore
+    except Exception:
+        return
+    paths = glob.glob(os.path.join(trace_dir, "**", "*.xplane.pb"), recursive=True)
+    if not paths:
+        return
+    xs = xplane_pb2.XSpace()
+    try:
+        with open(max(paths, key=os.path.getmtime), "rb") as f:
+            xs.ParseFromString(f.read())
+    except Exception:
+        return
+    for plane in xs.planes:
+        if "/device:" not in plane.name:
+            continue
+        for line in plane.lines:
+            if line.name != "XLA Ops":
+                continue
+            for ev in line.events:
+                yield plane.event_metadata[ev.metadata_id].name, ev.duration_ps
+
+
+def _device_op_stats(trace_dir, topn=40):
+    """Aggregate per-HLO-op device time from the xprof trace directory —
+    the TPU analog of the reference's per-op aggregate table
+    ([U:src/profiler/aggregate_stats.cc]).  Returns [(name, count, total_s)]
+    sorted by total time, or [] when no device plane was captured."""
+    import re
+    from collections import defaultdict
+
+    op_pat = re.compile(r"%([\w\-\.]+) = ")
+    agg = defaultdict(lambda: [0, 0])
+    for name, ps in iter_xplane_ops(trace_dir):
+        m = op_pat.search(name)
+        inst = m.group(1) if m else name.split(" ")[0].lstrip("%")
+        inst = re.sub(r"\.[0-9]+$", "", inst)
+        a = agg[inst]
+        a[0] += 1
+        a[1] += ps
+    rows = [(k, c, ps / 1e12) for k, (c, ps) in agg.items()]
+    rows.sort(key=lambda r: -r[2])
+    return rows[:topn]
+
+
 def dumps(reset=False):
-    """Aggregate stats string (parity: ``mx.profiler.dumps``).  Python-side
-    marker table; device-op detail lives in the xprof trace directory."""
-    lines = ["Profile Statistics (python markers; device ops in "
-             f"{_state['dir'] or 'trace dir (run start() first)'}):",
+    """Aggregate stats string (parity: ``mx.profiler.dumps``): python-side
+    marker table plus the per-device-op aggregate parsed from the captured
+    xprof trace (run between ``start()``/``stop()`` to populate it)."""
+    lines = ["Profile Statistics (python markers):",
              f"{'Name':<40}{'Count':>8}{'Total(ms)':>12}{'Avg(ms)':>12}"]
     for name, (cnt, tot) in sorted(_agg.items(), key=lambda kv: -kv[1][1]):
         lines.append(f"{name:<40}{cnt:>8}{tot * 1e3:>12.3f}{tot / cnt * 1e3:>12.3f}")
+    if _state["dir"]:
+        dev = _device_op_stats(_state["dir"])
+        if dev:
+            lines.append("")
+            lines.append(f"Device ops ({_state['dir']}):")
+            lines.append(f"{'HLO op':<56}{'Count':>8}{'Total(ms)':>12}")
+            for name, cnt, tot in dev:
+                lines.append(f"{name[:56]:<56}{cnt:>8}{tot * 1e3:>12.3f}")
+        else:
+            lines.append(f"(no device-op detail captured; trace dir: {_state['dir']})")
     if reset:
         _agg.clear()
     return "\n".join(lines)

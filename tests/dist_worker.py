@@ -1,0 +1,118 @@
+"""Worker body for the multi-process distributed tier — the port of the
+reference's [U:tests/nightly/dist_sync_kvstore.py] assertions, run at
+``process_count == 2`` on the CPU backend via ``tools/launch_local.py``.
+
+Every check asserts EXACT aggregated values (deterministic inputs), the
+reference suite's discipline.  Invoked by tests/test_dist.py; exits
+non-zero on any failure.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np
+
+
+def main():
+    import jax
+
+    try:  # drop the tunneled-TPU backend registered by sitecustomize, if any
+        from jax._src import xla_bridge as _xb
+
+        _xb._backend_factories.pop("axon", None)
+    except Exception:
+        pass
+
+    import incubator_mxnet_tpu as mx
+
+    kv = mx.kv.create("dist_sync")
+    rank, nw = kv.rank, kv.num_workers
+    assert nw == 2, f"expected 2 workers, got {nw}"
+    assert jax.process_count() == 2
+
+    # --- exact aggregated push/pull (int and string keys) ---------------
+    kv.init(3, mx.nd.ones((4, 5)))
+    kv.push(3, mx.nd.ones((4, 5)) * (rank + 1))  # 1x + 2x
+    out = mx.nd.zeros((4, 5))
+    kv.pull(3, out=out)
+    np.testing.assert_allclose(out.asnumpy(), 3.0 * np.ones((4, 5)))
+
+    kv.init("weight0", mx.nd.zeros((3,)))
+    kv.push("weight0", mx.nd.array([float(rank), 1.0, -1.0]))
+    out = mx.nd.zeros((3,))
+    kv.pull("weight0", out=out)
+    np.testing.assert_allclose(out.asnumpy(), np.array([1.0, 2.0, -2.0]))
+
+    # list-of-values aggregation first, then cross-worker reduce
+    kv.push(3, [mx.nd.ones((4, 5)), mx.nd.ones((4, 5))])  # each worker: 2
+    out2 = mx.nd.zeros((4, 5))
+    kv.pull(3, out=out2)
+    np.testing.assert_allclose(out2.asnumpy(), 4.0 * np.ones((4, 5)))
+
+    # --- updater on the store (optimizer-on-kvstore parity) -------------
+    kvu = mx.kv.create("dist_sync")
+    kvu.init(11, mx.nd.ones((2, 2)))
+
+    def updater(key, grad, weight):
+        weight += -0.1 * grad
+
+    kvu._set_updater(updater)
+    kvu.push(11, mx.nd.ones((2, 2)))  # agg grad = 2
+    out = mx.nd.zeros((2, 2))
+    kvu.pull(11, out=out)
+    np.testing.assert_allclose(out.asnumpy(), (1.0 - 0.2) * np.ones((2, 2)))
+
+    # --- 2-bit gradient compression: wire dtype + exact quantized values
+    kvc = mx.kv.create("dist_sync")
+    kvc.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    kvc.init(7, mx.nd.zeros((8,)))
+    g = np.array([0.6, -0.7, 0.1, 0.0, 1.2, -0.2, 0.49, -0.51], np.float32)
+    kvc.push(7, mx.nd.array(g))
+    out = mx.nd.zeros((8,))
+    kvc.pull(7, out=out)
+    codes = np.array([1, -1, 0, 0, 1, 0, 0, -1], np.float32)
+    # both workers push the same g → summed codes = 2·codes, ·t = codes·1.0
+    np.testing.assert_allclose(out.asnumpy(), codes * 2 * 0.5)
+    assert kvc._last_wire_dtype == "int8", kvc._last_wire_dtype
+
+    # error feedback: residual carries the quantization error into the next
+    # push (residual[4] = 1.2 - 0.5 = 0.7 > t → fires on a zero gradient)
+    kvc.push(7, mx.nd.zeros((8,)))
+    kvc.pull(7, out=out)
+    expect = np.zeros(8, np.float32)
+    expect[4] = 2 * 0.5
+    np.testing.assert_allclose(out.asnumpy(), expect)
+
+    # --- barrier + SPMDTrainer.shard_batch over the 2-process mesh ------
+    kv.barrier()
+    from incubator_mxnet_tpu.parallel import make_mesh, SPMDTrainer
+    from incubator_mxnet_tpu import gluon
+
+    mx.random.seed(0)
+    net = gluon.nn.Dense(4, flatten=False)
+    net.initialize()
+    net(mx.nd.zeros((2, 8)))  # materialize shapes
+
+    def loss_fn(out, label):
+        return ((out - label) ** 2).mean(axis=-1)
+
+    mesh = make_mesh()  # dp=2 over the two processes' devices
+    assert mesh.devices.size == 2
+    trainer = SPMDTrainer(net, loss_fn, "sgd", {"learning_rate": 0.1}, mesh=mesh)
+    # each process feeds its LOCAL half of the global batch
+    rng = np.random.RandomState(42 + rank)
+    x = mx.nd.array(rng.rand(4, 8).astype(np.float32))
+    y = mx.nd.array(rng.rand(4, 4).astype(np.float32))
+    l0 = float(trainer.step(x, y).asscalar())
+    for _ in range(20):
+        loss = trainer.step(x, y)
+    l1 = float(loss.asscalar())
+    assert np.isfinite(l0) and l1 < l0, (l0, l1)
+
+    print(f"dist_worker rank {rank}/{nw}: all assertions passed", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -34,8 +34,16 @@ class TestAmpCasting:
     def test_fp32_op_casts_up(self):
         amp.init("bfloat16")
         x = mx.nd.ones((4, 8), dtype="bfloat16")
-        out = mx.nd.softmax(x)
+        out = mx.nd.exp(x)
         assert out.dtype == np.float32
+
+    def test_softmax_stays_bf16_with_fp32_internals(self):
+        # softmax/LayerNorm left the FP32 list in round 3: the op computes
+        # exp/stats in fp32 internally and returns the input dtype, so the
+        # bf16 activation stream has no hook cast copies around it.
+        amp.init("bfloat16")
+        x = mx.nd.ones((4, 8), dtype="bfloat16")
+        assert mx.nd.softmax(x).dtype == np.dtype("bfloat16")
 
     def test_widest_op_promotes(self):
         amp.init("bfloat16")

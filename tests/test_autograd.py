@@ -225,4 +225,22 @@ def test_dropout_eval_identity_train_random():
     assert float(d.sum().asscalar()) == 16.0
     with autograd.record():
         d2 = mx.nd.Dropout(mx.nd.ones((200,)), p=0.5)
-    assert float(d2.sum().asscalar()) != 200.0
+    # sum()!=200 is a bad oracle: it trips whenever exactly half the mask
+    # survives (~5.6% of seeds).  Dropped-count > 0 fails with p = 2^-200.
+    assert int((d2.asnumpy() == 0).sum()) > 0
+
+
+def test_dropout_fast_path_unbiased(monkeypatch):
+    """The uint8-bits fast path rescales by its own quantized keep-prob, so
+    surviving values are exactly data/keep_q and the empirical drop rate
+    tracks p to the 1/256 quantization."""
+    monkeypatch.setenv("MXNET_TPU_FAST_DROPOUT", "1")
+    mx.random.seed(7)
+    n = 200_000
+    with autograd.record():
+        out = mx.nd.Dropout(mx.nd.ones((n,)), p=0.1).asnumpy()
+    kept = out[out != 0]
+    thresh = round(0.9 * 256)
+    np.testing.assert_allclose(kept, 256.0 / thresh, rtol=1e-6)
+    drop_rate = 1.0 - len(kept) / n
+    assert abs(drop_rate - (1 - thresh / 256.0)) < 0.01

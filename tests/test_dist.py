@@ -1,0 +1,31 @@
+"""Multi-process distributed tier (SURVEY.md §4 'Distributed (nightly)').
+
+Launches tests/dist_worker.py at process_count=2 through
+tools/launch_local.py — the [U:tools/launch.py] --launcher local analog —
+so KVStoreDist/_allreduce/compression actually execute over
+jax.distributed, which single-process tests cannot cover.
+"""
+import os
+import subprocess
+import sys
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_dist_sync_kvstore_two_workers():
+    env = dict(os.environ)
+    # children must boot their own 1-device CPU backend, not inherit the
+    # pytest 8-device virtual mesh or the tunneled TPU
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "launch_local.py"),
+         "-n", "2", sys.executable, os.path.join(ROOT, "tests", "dist_worker.py")],
+        env=env, capture_output=True, text=True, timeout=280,
+    )
+    sys.stdout.write(proc.stdout[-4000:])
+    sys.stderr.write(proc.stderr[-4000:])
+    assert proc.returncode == 0, f"dist workers failed (rc={proc.returncode})"
+    assert proc.stdout.count("all assertions passed") == 2

@@ -55,6 +55,53 @@ class TestFlashAttention:
         out = att.flash_attention(q, q, q)
         assert out.dtype == jnp.bfloat16
 
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_fused_qkv_matches_split_path(self, causal):
+        """[B,S,H,Dh]-layout self-attention (fused_qkv_attention) must equal
+        the split-heads bhsd path, values AND gradients."""
+        rng = np.random.RandomState(3)
+        b, s, h, dh = 2, 32, 4, 16
+        d = h * dh
+        qkv = jnp.asarray(rng.randn(b, s, 3 * d).astype(np.float32))
+
+        from incubator_mxnet_tpu.ops.attention import fused_qkv_attention
+
+        def split_path(qkv):
+            q, k, v = jnp.split(qkv, 3, axis=-1)
+
+            def sp(x):
+                return x.reshape(b, s, h, dh).transpose(0, 2, 1, 3)
+
+            out = att.attention_reference(sp(q), sp(k), sp(v), causal=causal)
+            return out.transpose(0, 2, 1, 3).reshape(b, s, d)
+
+        out = fused_qkv_attention(qkv, num_heads=h, causal=causal)
+        ref = split_path(qkv)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+        g1 = jax.grad(lambda x: (fused_qkv_attention(x, num_heads=h, causal=causal) ** 2).sum())(qkv)
+        g2 = jax.grad(lambda x: (split_path(x) ** 2).sum())(qkv)
+        np.testing.assert_allclose(np.asarray(g1), np.asarray(g2), rtol=2e-4, atol=2e-5)
+
+    def test_fused_kv_cross_attention(self):
+        rng = np.random.RandomState(4)
+        b, sq, sk, h, dh = 2, 8, 16, 2, 8
+        d = h * dh
+        q = jnp.asarray(rng.randn(b, sq, d).astype(np.float32))
+        kv = jnp.asarray(rng.randn(b, sk, 2 * d).astype(np.float32))
+
+        from incubator_mxnet_tpu.ops.attention import fused_kv_attention
+
+        k, v = jnp.split(kv, 2, axis=-1)
+
+        def sp(x, s):
+            return x.reshape(b, s, h, dh).transpose(0, 2, 1, 3)
+
+        ref = att.attention_reference(sp(q, sq), sp(k, sk), sp(v, sk))
+        ref = ref.transpose(0, 2, 1, 3).reshape(b, sq, d)
+        out = fused_kv_attention(q, kv, num_heads=h)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5)
+
 
 class TestTransformerLayers:
     def test_encoder_cell_shapes_and_grad(self):

@@ -1,0 +1,54 @@
+#!/usr/bin/env python
+"""Aggregate device-op time from an xprof trace directory (.xplane.pb).
+
+Usage: python tools/parse_xplane.py /tmp/trace_dir [topN]
+
+Thin presentation layer over ``incubator_mxnet_tpu.profiler.iter_xplane_ops``
+(the single shared xplane reader): sums event durations per HLO opcode and
+per collapsed instruction name, printing the top-N with % of total device
+time — the same table xprof's op_profile shows, without TensorBoard.
+"""
+import os
+import re
+import sys
+from collections import defaultdict
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    from incubator_mxnet_tpu.profiler import iter_xplane_ops
+
+    trace_dir = sys.argv[1]
+    topn = int(sys.argv[2]) if len(sys.argv) > 2 else 40
+
+    by_opcode = defaultdict(int)
+    by_inst = defaultdict(int)
+    grand = 0
+    # HLO line shape:  %name = f32[8,128,768]{2,1,0} convert(%arg)
+    op_pat = re.compile(r"%([\w\-\.]+) = [^ ]+ ([\w\-]+)\(")
+    for name, ps in iter_xplane_ops(trace_dir):
+        grand += ps
+        m = op_pat.search(name)
+        if m:
+            inst, opcode = m.groups()
+            inst = re.sub(r"\.[0-9]+$", "", inst)
+        else:
+            inst = re.sub(r"\.[0-9]+$", "", name.split(" ")[0].lstrip("%"))
+            opcode = inst
+        by_opcode[opcode] += ps
+        by_inst[inst] += ps
+
+    if not grand:
+        raise SystemExit(f"no device 'XLA Ops' events under {trace_dir}")
+    print(f"total device time: {grand/1e12*1000:.3f} ms over trace")
+    print("== by opcode ==")
+    for name, ps in sorted(by_opcode.items(), key=lambda kv: -kv[1])[:20]:
+        print(f"  {ps/grand*100:5.2f}%  {ps/1e9:10.1f} ms  {name}")
+    print("== by instruction (collapsed) ==")
+    for name, ps in sorted(by_inst.items(), key=lambda kv: -kv[1])[:topn]:
+        print(f"  {ps/grand*100:5.2f}%  {ps/1e9:10.1f} ms  {name[:100]}")
+
+
+if __name__ == "__main__":
+    main()
