@@ -92,6 +92,13 @@ def bench_resnet50():
         loss = trainer.step(img, labels)
     _fence(trainer, loss)
 
+    prof_dir = os.environ.get("MXNET_TPU_BENCH_PROFILE")
+    if prof_dir:
+        with jax.profiler.trace(prof_dir):
+            for _ in range(5):
+                loss = trainer.step(img, labels)
+            _fence(trainer, loss)
+
     t0 = time.perf_counter()
     for _ in range(steps):
         loss = trainer.step(img, labels)

@@ -1,0 +1,182 @@
+"""Preemption-aware checkpointing (SURVEY.md §5 checkpoint/resume plan).
+
+The reference's story is Module ``save_checkpoint`` per epoch plus ps-lite
+re-registration; on TPU pods the failure mode is *preemption* — the pod
+gets SIGTERM'd and rescheduled — so the plan is: save on SIGTERM, write
+asynchronously off the training thread, restart from the latest complete
+checkpoint ([U:python/mxnet/model.py] save_checkpoint is the format
+anchor; Gluon save_parameters/Trainer save_states the per-object APIs).
+
+``CheckpointManager`` wraps any (net, trainer) pair:
+
+* ``save(step)`` — snapshots state to host on the calling thread (a cheap
+  D2H; device buffers keep training) and writes files on a background
+  thread.  Writes are atomic (tmp + ``os.replace``) so a kill mid-write
+  never corrupts the latest checkpoint.
+* SIGTERM triggers a synchronous save of the current step before the
+  process exits (chained to any previously-installed handler).
+* ``restore()`` — loads the newest complete checkpoint into the net (and
+  trainer states when present); returns the step number or None.
+
+Works with ``gluon.Trainer`` and ``parallel.SPMDTrainer`` alike (both
+expose save_states/load_states).
+"""
+from __future__ import annotations
+
+import glob
+import json
+import os
+import signal
+import threading
+
+__all__ = ["CheckpointManager"]
+
+
+class CheckpointManager:
+    def __init__(self, prefix, net=None, trainer=None, save_on_sigterm=True,
+                 async_write=True, keep=3, params_format=None):
+        self._prefix = prefix
+        self._net = net
+        self._trainer = trainer
+        self._async = async_write
+        self._keep = keep
+        self._params_format = params_format  # None → by extension; 'params' → reference binary
+        self._lock = threading.Lock()  # serializes background writes
+        self._last_step = 0
+        self._prev_sigterm = None
+        if save_on_sigterm and threading.current_thread() is threading.main_thread():
+            self._prev_sigterm = signal.signal(signal.SIGTERM, self._on_sigterm)
+
+    # ------------------------------------------------------------------
+    def _paths(self, step):
+        ext = ".params" if self._params_format == "params" else ".npz"
+        return (f"{self._prefix}-{step:07d}{ext}",
+                f"{self._prefix}-{step:07d}.states",
+                f"{self._prefix}-{step:07d}.meta")
+
+    def _snapshot(self):
+        """Host-side copies of everything to persist — called on the
+        training thread so the background writer touches no device state."""
+        import numpy as np
+
+        params = None
+        if self._net is not None:
+            if self._trainer is not None and hasattr(self._trainer, "sync_to_block"):
+                self._trainer.sync_to_block()
+            params = {p.name: np.asarray(p._data._data)
+                      for p in self._net.collect_params().values()
+                      if p._data is not None}
+        states = None
+        if self._trainer is not None and hasattr(self._trainer, "save_states"):
+            states = self._trainer  # serialized inside the writer via save_states
+        return params, states
+
+    def _write(self, step, params, trainer_for_states):
+        from .ndarray import utils as nd_utils
+        from .ndarray.ndarray import array
+
+        with self._lock:
+            pth, sth, mth = self._paths(step)
+            if params is not None:
+                tmp = pth + ".tmp"
+                nd_utils.save(tmp, {k: array(v) for k, v in params.items()},
+                              format=self._params_format)
+                os.replace(tmp, pth)
+            if trainer_for_states is not None:
+                tmp = sth + ".tmp"
+                trainer_for_states.save_states(tmp)
+                os.replace(tmp, sth)
+            tmp = mth + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump({"step": step,
+                           "params": os.path.basename(pth) if params is not None else None,
+                           "states": os.path.basename(sth) if trainer_for_states is not None else None},
+                          f)
+            os.replace(tmp, mth)
+            self._gc(step)
+
+    def _gc(self, newest_step):
+        metas = sorted(glob.glob(f"{self._prefix}-*.meta"))
+        for old in metas[:-self._keep] if self._keep else []:
+            try:
+                with open(old) as f:
+                    meta = json.load(f)
+                base = os.path.dirname(self._prefix) or "."
+                for key in ("params", "states"):
+                    if meta.get(key):
+                        p = os.path.join(base, meta[key])
+                        if os.path.exists(p):
+                            os.remove(p)
+                os.remove(old)
+            except OSError:
+                pass
+
+    # ------------------------------------------------------------------
+    def save(self, step, blocking=False):
+        """Checkpoint at ``step``.  Device→host snapshot happens now;
+        file IO happens on a background thread unless ``blocking``."""
+        self._last_step = step
+        params, trainer = self._snapshot()
+        if self._async and not blocking:
+            t = threading.Thread(target=self._write, args=(step, params, trainer),
+                                 daemon=True)
+            t.start()
+            return t
+        self._write(step, params, trainer)
+        return None
+
+    def _on_sigterm(self, signum, frame):
+        # synchronous: the process is about to die — waits for any
+        # in-flight background write, then persists the current step
+        self.save(self._last_step, blocking=True)
+        if callable(self._prev_sigterm):
+            self._prev_sigterm(signum, frame)
+        else:
+            signal.signal(signal.SIGTERM, signal.SIG_DFL)
+            os.kill(os.getpid(), signal.SIGTERM)
+
+    # ------------------------------------------------------------------
+    def latest_step(self):
+        metas = sorted(glob.glob(f"{self._prefix}-*.meta"))
+        if not metas:
+            return None
+        with open(metas[-1]) as f:
+            return json.load(f)["step"]
+
+    def restore(self):
+        """Load the newest complete checkpoint into net/trainer.  Returns
+        the restored step, or None if no checkpoint exists."""
+        import jax.numpy as jnp
+        import numpy as np
+
+        step = self.latest_step()
+        if step is None:
+            return None
+        pth, sth, mth = self._paths(step)
+        if self._net is not None and os.path.exists(pth):
+            from .ndarray import utils as nd_utils
+
+            loaded = nd_utils.load(pth)
+            for p in self._net.collect_params().values():
+                if p.name in loaded:
+                    src = loaded[p.name]
+                    if p._data is None:
+                        p._load_init(src) if hasattr(p, "_load_init") else None
+                    else:
+                        p._data._data = jnp.asarray(np.asarray(src.asnumpy()),
+                                                    dtype=p._data.dtype)
+                        p._data._version += 1
+        if self._trainer is not None and os.path.exists(sth) and \
+                hasattr(self._trainer, "load_states"):
+            self._trainer.load_states(sth)
+        # SPMDTrainer holds its own device copies — refresh them from the net
+        if self._trainer is not None and hasattr(self._trainer, "_param_arrays") \
+                and self._net is not None:
+            import jax
+
+            self._trainer._param_arrays = [
+                jax.device_put(np.asarray(p._data._data), s)
+                for p, s in zip(self._trainer._params,
+                                self._trainer._param_shardings)
+            ]
+        return step

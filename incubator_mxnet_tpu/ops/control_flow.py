@@ -1,0 +1,154 @@
+"""Control-flow operators — ``foreach`` / ``while_loop`` / ``cond``.
+
+Parity: [U:src/operator/control_flow.cc] (the reference registers them as
+first-class ops carrying nnvm subgraphs; the Python front end is
+``mx.nd.contrib.foreach/while_loop/cond``).  Here the subgraph is simply a
+Python callable over NDArrays, traced by ``lax.scan`` / ``lax.cond`` —
+SURVEY.md §2.1 calls this mapping near-mechanical, and it is.
+
+Each op executes as ONE pure-jax function dispatched through
+``ndarray.invoke``, so the autograd tape records a single node whose vjp
+is jax's own gradient through the loop — gradients flow to the explicit
+``data``/``init_states``/``loop_vars`` inputs in eager ``autograd.record``
+mode.  Arrays only *closed over* by the callable (e.g. weights referenced
+inside ``body``) become trace constants in eager mode and get no eager
+gradient — under ``hybridize``/``SPMDTrainer`` (the performance path) the
+whole step is traced functionally and closure gradients flow exactly.
+This matches the spirit of the reference (its subgraph cut hoists closure
+vars into explicit inputs at symbol-construction time, which an eager
+Python callable cannot express).
+
+TPU-friendliness: ``while_loop`` requires ``max_iterations`` and lowers to
+a fixed-trip ``lax.scan`` with an active-mask — constant shapes and FLOPs
+regardless of the dynamic trip count (results beyond the executed steps
+are zeros, the reference documents them as undefined).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["foreach", "while_loop", "cond"]
+
+
+def _as_list(x):
+    if x is None:
+        return [], False
+    if isinstance(x, (list, tuple)):
+        return list(x), True
+    return [x], False
+
+
+def _arrays(nds):
+    from ..ndarray.ndarray import NDArray
+
+    return [a._data if isinstance(a, NDArray) else jnp.asarray(a) for a in nds]
+
+
+def _paused():
+    from .. import autograd
+
+    return autograd._scope(False, None)
+
+
+def foreach(body, data, init_states):
+    """``body(data_slice, states) -> (out, new_states)`` scanned over axis 0
+    of ``data`` (parity: ``mx.nd.contrib.foreach``).  Returns
+    ``(outputs, final_states)`` with outputs stacked on axis 0."""
+    from ..ndarray.ndarray import NDArray, invoke
+
+    data_list, multi_data = _as_list(data)
+    state_list, multi_state = _as_list(init_states)
+    nd_, ns_ = len(data_list), len(state_list)
+
+    def pure(*arrays):
+        xs = tuple(arrays[:nd_])
+        init = tuple(arrays[nd_:])
+
+        def scan_body(carry, x):
+            with _paused():
+                d = [NDArray(a) for a in x]
+                s = [NDArray(c) for c in carry]
+                out, new_s = body(d if multi_data else d[0],
+                                  s if multi_state else (s[0] if s else []))
+            outs, _ = _as_list(out)
+            new, _ = _as_list(new_s)
+            return tuple(o._data for o in new), tuple(o._data for o in outs)
+
+        carry, ys = lax.scan(scan_body, init, xs)
+        return tuple(ys) + tuple(carry)
+
+    results = invoke(pure, data_list + state_list, {}, name="_foreach")
+    results = results if isinstance(results, list) else [results]
+    n_out = len(results) - ns_
+    outs = results[:n_out]
+    states = results[n_out:]
+    return (outs if (len(outs) != 1) else outs[0],
+            states if multi_state else (states[0] if states else []))
+
+
+def while_loop(cond_fn, func, loop_vars, max_iterations=None):
+    """``while cond_fn(*loop_vars): out, loop_vars = func(*loop_vars)``
+    (parity: ``mx.nd.contrib.while_loop``).  ``max_iterations`` is required
+    (as in the reference); lowered to a fixed-trip scan with an active mask
+    so shapes/FLOPs are static.  Returns ``(outputs, final_loop_vars)``;
+    output rows past the executed step count are zeros."""
+    from ..ndarray.ndarray import NDArray, invoke
+
+    if max_iterations is None:
+        raise ValueError("while_loop requires max_iterations (static shapes on TPU)")
+    var_list, multi_var = _as_list(loop_vars)
+    nv = len(var_list)
+
+    def pure(*arrays):
+        def scan_body(carry, _):
+            active, vars_ = carry
+            with _paused():
+                c = cond_fn(*[NDArray(v) for v in vars_])
+                out, new_vars = func(*[NDArray(v) for v in vars_])
+            pred = jnp.logical_and(active, c._data.astype(bool).reshape(()))
+            outs, _ = _as_list(out)
+            new, _ = _as_list(new_vars)
+            vars_next = tuple(jnp.where(pred, n._data, v)
+                              for n, v in zip(new, vars_))
+            outs_masked = tuple(jnp.where(pred, o._data, jnp.zeros_like(o._data))
+                                for o in outs)
+            return (pred, vars_next), outs_masked
+
+        (_, final_vars), ys = lax.scan(
+            scan_body, (jnp.bool_(True), tuple(arrays)), None,
+            length=int(max_iterations))
+        return tuple(ys) + tuple(final_vars)
+
+    results = invoke(pure, var_list, {}, name="_while_loop")
+    results = results if isinstance(results, list) else [results]
+    n_out = len(results) - nv
+    outs = results[:n_out]
+    states = results[n_out:]
+    return (outs if len(outs) != 1 else outs[0],
+            states if multi_var else states[0])
+
+
+def cond(pred, then_func, else_func):
+    """``then_func() if pred else else_func()`` with both branches traced
+    (parity: ``mx.nd.contrib.cond``).  Branch outputs must match in
+    shape/dtype; branch callables take no arguments and close over their
+    operands."""
+    from ..ndarray.ndarray import NDArray, invoke
+
+    def pure(p):
+        def run(fn):
+            def branch(_):
+                with _paused():
+                    out = fn()
+                outs, _ = _as_list(out)
+                return tuple(o._data for o in outs)
+
+            return branch
+
+        return lax.cond(p.astype(bool).reshape(()), run(then_func),
+                        run(else_func), operand=None)
+
+    results = invoke(pure, [pred], {}, name="_cond")
+    return results if not isinstance(results, list) or len(results) != 1 else results[0]

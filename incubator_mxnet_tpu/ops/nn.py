@@ -249,9 +249,15 @@ def batch_norm(
     else:
         # statistics always in fp32 — on bf16 inputs the converts fuse into
         # the reduction, so this costs nothing while AMP can leave the
-        # activations in bf16 end-to-end (no hook cast copies)
+        # activations in bf16 end-to-end (no hook cast copies).
+        # E[x²]−E[x]² form on purpose: both sums reduce the SAME input, so
+        # XLA fuses them into ONE pass over the activations — jnp.var's
+        # (x−mean)² needs mean first and forces a second full read
+        # (profiled at 38% of the ResNet-50 step, docs/PERF_NOTES.md).
+        # fp32 accumulation keeps the cancellation benign at BN scales.
         mean = jnp.mean(x32, axis=reduce_axes)
-        var = jnp.var(x32, axis=reduce_axes)
+        var = jnp.maximum(jnp.mean(jnp.square(x32), axis=reduce_axes)
+                          - jnp.square(mean), 0.0)
     g = jnp.ones_like(gamma) if fix_gamma else gamma
     inv = lax.rsqrt(var + eps)
     out = ((x32 - mean.reshape(bshape)) * (g.astype(jnp.float32) * inv).reshape(bshape)
@@ -267,7 +273,9 @@ def layer_norm(data, gamma, beta, axis=-1, eps=1e-5):
     and the normalize loop — no materialized cast copies)."""
     x32 = data.astype(jnp.float32)
     mean = jnp.mean(x32, axis=axis, keepdims=True)
-    var = jnp.var(x32, axis=axis, keepdims=True)
+    # one-pass stats: see batch_norm's E[x²]−E[x]² note
+    var = jnp.maximum(jnp.mean(jnp.square(x32), axis=axis, keepdims=True)
+                      - jnp.square(mean), 0.0)
     out = (x32 - mean) * lax.rsqrt(var + eps)
     ax = axis % data.ndim
     bshape = tuple(data.shape[ax] if i == ax else 1 for i in range(data.ndim))
@@ -283,7 +291,8 @@ def group_norm(data, gamma, beta, num_groups=1, eps=1e-5):
     x = data.astype(jnp.float32).reshape((n, num_groups, c // num_groups) + rest)
     axes = tuple(range(2, x.ndim))
     mean = jnp.mean(x, axis=axes, keepdims=True)
-    var = jnp.var(x, axis=axes, keepdims=True)
+    var = jnp.maximum(jnp.mean(jnp.square(x), axis=axes, keepdims=True)
+                      - jnp.square(mean), 0.0)
     x = (x - mean) * lax.rsqrt(var + eps)
     x = x.reshape(data.shape)
     bshape = (1, c) + (1,) * len(rest)
@@ -297,7 +306,8 @@ def instance_norm(data, gamma, beta, eps=1e-3):
     axes = tuple(range(2, data.ndim))
     x32 = data.astype(jnp.float32)
     mean = jnp.mean(x32, axis=axes, keepdims=True)
-    var = jnp.var(x32, axis=axes, keepdims=True)
+    var = jnp.maximum(jnp.mean(jnp.square(x32), axis=axes, keepdims=True)
+                      - jnp.square(mean), 0.0)
     x = (x32 - mean) * lax.rsqrt(var + eps)
     bshape = (1, data.shape[1]) + (1,) * (data.ndim - 2)
     out = (x * gamma.astype(jnp.float32).reshape(bshape)
@@ -539,7 +549,9 @@ def dropout(data, p=0.5, mode="training", axes=(), key=None, training=None):
         # docs/PERF_NOTES.md).  keep is quantized to n/256 (≤1/512 absolute
         # error); the rescale uses the quantized keep, so E[out] == data
         # exactly.  MXNET_TPU_FAST_DROPOUT=0 restores exact-probability
-        # bernoulli.
+        # bernoulli — NOTE the flag is read at TRACE time: flipping it
+        # after a hybridize/jit cache is built requires
+        # base.invalidate_jit_caches() (as amp.init does) to take effect.
         thresh = int(round(keep * 256))
         if 0 < thresh < 256:
             bits = jax.random.bits(key, tuple(shape), dtype=jnp.uint8)

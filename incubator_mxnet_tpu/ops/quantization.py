@@ -1,0 +1,121 @@
+"""INT8 quantization operators.
+
+Parity: [U:src/operator/quantization/] — ``quantize_v2`` / ``dequantize`` /
+``requantize`` and the int8 compute ops (``quantized_fully_connected``,
+``quantized_conv``).  The reference backs these with oneDNN/cuDNN int8
+kernels; on TPU the MXU multiplies int8 natively with int32 accumulation
+(``preferred_element_type=int32``), so the compute ops are one
+``dot_general``/``conv_general_dilated`` with scale bookkeeping.
+
+Scheme: symmetric signed int8 (scale = 127 / max|range|, zero-point 0) —
+the reference's default for weights and its ``quantized_dtype='int8'``
+activation mode.  Ranges travel with the tensors as (min, max) pairs
+exactly like the reference's 3-output convention.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+__all__ = [
+    "quantize_v2", "dequantize", "requantize",
+    "quantized_fully_connected", "quantized_conv",
+]
+
+
+def _scale_from_range(min_r, max_r):
+    amax = jnp.maximum(jnp.abs(min_r), jnp.abs(max_r))
+    return jnp.where(amax > 0, amax / 127.0, 1.0)
+
+
+@register("quantize_v2")
+def quantize_v2(data, min_calib_range=None, max_calib_range=None, out_type="int8"):
+    """float → (int8, min_range, max_range).  With calib ranges given they
+    are used (and saturating-cast applied); otherwise the tensor's own
+    min/max (the reference's in-op minmax mode)."""
+    if out_type != "int8":
+        raise NotImplementedError("TPU path quantizes to int8 (symmetric)")
+    x = data.astype(jnp.float32)
+    if min_calib_range is None or max_calib_range is None:
+        min_r = jnp.minimum(x.min(), 0.0)
+        max_r = jnp.maximum(x.max(), 0.0)
+    else:
+        min_r = jnp.float32(min_calib_range)
+        max_r = jnp.float32(max_calib_range)
+    scale = _scale_from_range(min_r, max_r)
+    q = jnp.clip(jnp.round(x / scale), -127, 127).astype(jnp.int8)
+    return q, min_r.reshape(1), max_r.reshape(1)
+
+
+@register("dequantize")
+def dequantize(data, min_range, max_range, out_type="float32"):
+    scale = _scale_from_range(min_range.reshape(()), max_range.reshape(()))
+    return data.astype(jnp.float32) * scale
+
+
+@register("requantize")
+def requantize(data, min_range, max_range, min_calib_range=None, max_calib_range=None):
+    """int32 accumulator → int8 with recomputed ranges (parity:
+    requantize after quantized matmul).  The int32 range is the product of
+    the two int8 scales."""
+    in_scale = _scale_from_range(min_range.reshape(()), max_range.reshape(()))
+    real = data.astype(jnp.float32) * in_scale
+    if min_calib_range is None or max_calib_range is None:
+        min_r = jnp.minimum(real.min(), 0.0)
+        max_r = jnp.maximum(real.max(), 0.0)
+    else:
+        min_r = jnp.float32(min_calib_range)
+        max_r = jnp.float32(max_calib_range)
+    out_scale = _scale_from_range(min_r, max_r)
+    q = jnp.clip(jnp.round(real / out_scale), -127, 127).astype(jnp.int8)
+    return q, min_r.reshape(1), max_r.reshape(1)
+
+
+@register("quantized_fully_connected")
+def quantized_fully_connected(data, weight, bias,
+                              min_data, max_data, min_weight, max_weight,
+                              num_hidden=0, no_bias=False, flatten=True):
+    """int8 × int8 FC with int32 accumulation on the MXU; float output
+    (already dequantized — the fused requantize-to-float the reference's
+    ``_sg_mkldnn_fully_connected`` performs).  data/weight: int8; bias:
+    float (added post-scale, matching calibrated-graph semantics)."""
+    if flatten and data.ndim > 2:
+        data = data.reshape(data.shape[0], -1)
+    acc = lax.dot_general(
+        data, weight, (((data.ndim - 1,), (1,)), ((), ())),
+        preferred_element_type=jnp.int32)
+    s = (_scale_from_range(min_data.reshape(()), max_data.reshape(()))
+         * _scale_from_range(min_weight.reshape(()), max_weight.reshape(())))
+    out = acc.astype(jnp.float32) * s
+    if bias is not None and not no_bias:
+        out = out + bias.astype(jnp.float32)
+    return out
+
+
+@register("quantized_conv")
+def quantized_conv(data, weight, bias,
+                   min_data, max_data, min_weight, max_weight,
+                   kernel=(1, 1), stride=None, dilate=None, pad=None,
+                   num_filter=0, num_group=1, no_bias=False, layout=None):
+    """int8 NCHW convolution, int32 accumulation, float output."""
+    from .nn import _CONV_DIMS, _tuplize
+
+    n = len(kernel)
+    stride = _tuplize(stride, n)
+    dilate = _tuplize(dilate, n)
+    pad = _tuplize(pad if pad is not None else 0, n)
+    dn = lax.conv_dimension_numbers(data.shape, weight.shape, _CONV_DIMS[n])
+    acc = lax.conv_general_dilated(
+        data, weight, window_strides=stride,
+        padding=[(p, p) for p in pad], rhs_dilation=dilate,
+        dimension_numbers=dn, feature_group_count=num_group,
+        preferred_element_type=jnp.int32)
+    s = (_scale_from_range(min_data.reshape(()), max_data.reshape(()))
+         * _scale_from_range(min_weight.reshape(()), max_weight.reshape(())))
+    out = acc.astype(jnp.float32) * s
+    if bias is not None and not no_bias:
+        out = out + bias.astype(jnp.float32).reshape((1, -1) + (1,) * n)
+    return out

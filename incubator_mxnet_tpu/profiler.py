@@ -115,20 +115,34 @@ def iter_xplane_ops(trace_dir):
                 yield plane.event_metadata[ev.metadata_id].name, ev.duration_ps
 
 
+def collapse_hlo_name(text):
+    """Reduce a full HLO instruction line to its instance-collapsed
+    instruction name (``%fusion.42 = … fusion(…)`` → ``fusion``) and, when
+    parseable, the opcode.  Single shared rule for the ``dumps()`` table
+    and tools/parse_xplane.py so op attribution cannot drift between them.
+    Returns (instruction_name, opcode_or_None)."""
+    import re
+
+    m = re.search(r"%([\w\-\.]+) = [^ ]+ ([\w\-]+)\(", text)
+    if m:
+        inst, opcode = m.groups()
+    else:
+        m2 = re.search(r"%([\w\-\.]+) = ", text)
+        inst = m2.group(1) if m2 else text.split(" ")[0].lstrip("%")
+        opcode = None
+    return re.sub(r"\.[0-9]+$", "", inst), opcode
+
+
 def _device_op_stats(trace_dir, topn=40):
     """Aggregate per-HLO-op device time from the xprof trace directory —
     the TPU analog of the reference's per-op aggregate table
     ([U:src/profiler/aggregate_stats.cc]).  Returns [(name, count, total_s)]
     sorted by total time, or [] when no device plane was captured."""
-    import re
     from collections import defaultdict
 
-    op_pat = re.compile(r"%([\w\-\.]+) = ")
     agg = defaultdict(lambda: [0, 0])
     for name, ps in iter_xplane_ops(trace_dir):
-        m = op_pat.search(name)
-        inst = m.group(1) if m else name.split(" ")[0].lstrip("%")
-        inst = re.sub(r"\.[0-9]+$", "", inst)
+        inst, _ = collapse_hlo_name(name)
         a = agg[inst]
         a[0] += 1
         a[1] += ps

@@ -190,15 +190,22 @@ def check_consistency(fn, inputs, ctx_list=None, rtol=None, atol=None, grad=True
                 a.attach_grad()
             with autograd.record():
                 out = fn(*arrs)
-                loss = out.sum() if out.size > 1 else out
+                outs = out if isinstance(out, (list, tuple)) else [out]
+                loss = outs[0].sum() if outs[0].size > 1 else outs[0]
+                for o in outs[1:]:
+                    loss = loss + (o.sum() if o.size > 1 else o)
             loss.backward()
             grads.append([a.grad.asnumpy() for a in arrs])
-            results.append(out.asnumpy())
+            results.append([o.asnumpy() for o in outs])
         else:
-            results.append(fn(*arrs).asnumpy())
+            out = fn(*arrs)
+            outs = out if isinstance(out, (list, tuple)) else [out]
+            results.append([o.asnumpy() for o in outs])
     ref = results[0]
     for i, res in enumerate(results[1:], 1):
-        assert_almost_equal(res, ref, rtol=rtol, atol=atol, names=(f"ctx[{i}]", "ctx[0]"))
+        for j, (r, r0) in enumerate(zip(res, ref)):
+            assert_almost_equal(r, r0, rtol=rtol, atol=atol,
+                                names=(f"out{j}@ctx[{i}]", f"out{j}@ctx[0]"))
     if grad:
         for i, gs in enumerate(grads[1:], 1):
             for k, (g, g0) in enumerate(zip(gs, grads[0])):

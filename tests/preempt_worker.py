@@ -1,0 +1,89 @@
+"""Worker for the SIGTERM-preemption resume test (tests/test_checkpoint.py).
+
+Modes:
+  uninterrupted  — train TOTAL steps straight through, print "FINAL <loss>"
+  phase1         — train with per-step checkpoints + slow-down sleeps,
+                   printing "TRAINING" once underway; SIGTERM triggers the
+                   manager's synchronous save and kills the process
+  resume         — restore the newest checkpoint, train the remaining
+                   steps, print "FINAL <loss>"
+
+Training is deterministic (fixed data, no dropout), so a resumed run's
+final loss equals the uninterrupted run's bit-for-bit modulo float tol.
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import autograd, gluon
+from incubator_mxnet_tpu.checkpoint import CheckpointManager
+
+TOTAL = 40
+
+
+def build():
+    mx.random.seed(11)
+    net = gluon.nn.Dense(1)
+    net.initialize()
+    rng = np.random.RandomState(0)
+    x = mx.nd.array(rng.rand(16, 4).astype(np.float32))
+    y = mx.nd.array((rng.rand(16, 1) * 2 - 1).astype(np.float32))
+    net(x)  # materialize
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.05, "momentum": 0.9})
+    return net, trainer, x, y
+
+
+def step(net, trainer, x, y):
+    with autograd.record():
+        loss = ((net(x) - y) ** 2).mean()
+    loss.backward()
+    trainer.step(16)
+    return float(loss.asscalar())
+
+
+def main():
+    prefix, mode = sys.argv[1], sys.argv[2]
+    net, trainer, x, y = build()
+
+    if mode == "uninterrupted":
+        for _ in range(TOTAL):
+            l = step(net, trainer, x, y)
+        print("FINAL", l)
+        return
+
+    if mode == "phase1":
+        mgr = CheckpointManager(prefix, net=net, trainer=trainer,
+                                save_on_sigterm=True, async_write=True)
+        for i in range(1, TOTAL + 1):
+            step(net, trainer, x, y)
+            mgr.save(i)
+            if i == 2:
+                print("TRAINING", flush=True)
+            time.sleep(0.12)  # widen the window so SIGTERM lands mid-fit
+        print("FINISHED", flush=True)
+        return
+
+    if mode == "resume":
+        mgr = CheckpointManager(prefix, net=net, trainer=trainer,
+                                save_on_sigterm=False)
+        start = mgr.restore() or 0
+        assert start > 0, "no checkpoint found to resume from"
+        assert start < TOTAL, f"phase1 already finished ({start})"
+        l = None
+        for _ in range(start, TOTAL):
+            l = step(net, trainer, x, y)
+        print("RESUMED_FROM", start, flush=True)
+        print("FINAL", l)
+        return
+
+    raise SystemExit(f"unknown mode {mode}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,164 @@
+"""Control-flow ops (parity: [U:tests/python/unittest/test_contrib_control_flow.py]).
+
+foreach/while_loop/cond over lax.scan/while-masked-scan/cond, including
+autograd through the tape (one recorded node per loop) and the
+RNN-unrolled-via-foreach == fused-lax.scan-RNN equivalence the reference
+suite checks."""
+import numpy as np
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import autograd
+
+
+class TestForeach:
+    def test_cumsum_semantics(self):
+        data = mx.nd.array(np.arange(12, dtype=np.float32).reshape(4, 3))
+        init = mx.nd.zeros((3,))
+
+        def body(x, s):
+            new = s + x
+            return new, new
+
+        outs, final = mx.nd.contrib.foreach(body, data, init)
+        ref = np.cumsum(np.arange(12, dtype=np.float32).reshape(4, 3), axis=0)
+        np.testing.assert_allclose(outs.asnumpy(), ref)
+        np.testing.assert_allclose(final.asnumpy(), ref[-1])
+
+    def test_multi_state_multi_out(self):
+        data = mx.nd.array(np.ones((3, 2), np.float32))
+
+        def body(x, states):
+            a, b = states
+            return [x + a, x * b], [a + 1, b * 2]
+
+        outs, finals = mx.nd.contrib.foreach(body, data, [mx.nd.zeros((2,)), mx.nd.ones((2,))])
+        np.testing.assert_allclose(outs[0].asnumpy(), [[1, 1], [2, 2], [3, 3]])
+        np.testing.assert_allclose(outs[1].asnumpy(), [[1, 1], [2, 2], [4, 4]])
+        np.testing.assert_allclose(finals[0].asnumpy(), [3, 3])
+        np.testing.assert_allclose(finals[1].asnumpy(), [8, 8])
+
+    def test_gradient_through_tape(self):
+        data = mx.nd.array(np.random.RandomState(0).rand(5, 4).astype(np.float32))
+        init = mx.nd.array(np.random.RandomState(1).rand(4).astype(np.float32))
+        data.attach_grad()
+        init.attach_grad()
+
+        def body(x, s):
+            new = mx.nd.tanh(s * x)
+            return new, new
+
+        with autograd.record():
+            outs, final = mx.nd.contrib.foreach(body, data, init)
+            loss = (outs * outs).sum()
+        loss.backward()
+
+        # numeric reference via finite differences on the same computation
+        def f(d, i):
+            s = i.copy()
+            tot = 0.0
+            for t in range(d.shape[0]):
+                s = np.tanh(s * d[t])
+                tot += (s * s).sum()
+            return tot
+
+        d0 = data.asnumpy().astype(np.float64)
+        i0 = init.asnumpy().astype(np.float64)
+        eps = 1e-5
+        num = np.zeros_like(d0)
+        for t in range(d0.shape[0]):
+            for j in range(d0.shape[1]):
+                dp, dm = d0.copy(), d0.copy()
+                dp[t, j] += eps
+                dm[t, j] -= eps
+                num[t, j] = (f(dp, i0) - f(dm, i0)) / (2 * eps)
+        np.testing.assert_allclose(data.grad.asnumpy(), num, rtol=1e-3, atol=1e-4)
+
+    def test_rnn_unrolled_matches_fused_scan(self):
+        """The reference's key control-flow check: an RNN stepped via
+        foreach equals the fused (lax.scan) RNN op."""
+        from incubator_mxnet_tpu import gluon
+
+        T, B, I, H = 6, 2, 3, 5
+        mx.random.seed(0)
+        cell = gluon.rnn.RNNCell(H, input_size=I)
+        cell.initialize()
+        x_tbc = mx.nd.random.normal(shape=(T, B, I))
+        h0 = mx.nd.zeros((B, H))
+
+        def body(x_t, h):
+            out, new_states = cell(x_t, [h])
+            return out, new_states[0]
+
+        outs, h_last = mx.nd.contrib.foreach(body, x_tbc, h0)
+
+        # fused path: unroll the same cell (shares parameters)
+        ref_outs, ref_state = cell.unroll(T, x_tbc, layout="TNC", merge_outputs=True)
+        np.testing.assert_allclose(outs.asnumpy(), ref_outs.asnumpy(), rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(h_last.asnumpy(), ref_state[0].asnumpy(), rtol=1e-5, atol=1e-5)
+
+
+class TestWhileLoop:
+    def test_exact_trip_count_and_padding(self):
+        # sum integers until total >= 10, max_iterations=8
+        def cond_fn(i, total):
+            return total < 10
+
+        def func(i, total):
+            return i, [i + 1, total + i]
+
+        outs, (i_f, total_f) = mx.nd.contrib.while_loop(
+            cond_fn, func, [mx.nd.array([1.0]), mx.nd.array([0.0])], max_iterations=8)
+        # steps: i=1..4 (0+1+2+3+4 = 10 at i=4); outputs rows beyond are zeros
+        np.testing.assert_allclose(total_f.asnumpy(), [10.0])
+        np.testing.assert_allclose(i_f.asnumpy(), [5.0])
+        got = outs.asnumpy().ravel()
+        np.testing.assert_allclose(got[:4], [1, 2, 3, 4])
+        np.testing.assert_allclose(got[4:], 0.0)
+
+    def test_gradient(self):
+        x = mx.nd.array([2.0])
+        x.attach_grad()
+
+        def cond_fn(v, n):
+            return n < 3
+
+        def func(v, n):
+            return v, [v * v, n + 1]
+
+        with autograd.record():
+            outs, (v_f, n_f) = mx.nd.contrib.while_loop(
+                cond_fn, func, [x, mx.nd.array([0.0])], max_iterations=5)
+            loss = v_f.sum()  # ((x^2)^2)^2 = x^8
+        loss.backward()
+        np.testing.assert_allclose(v_f.asnumpy(), [2.0 ** 8])
+        np.testing.assert_allclose(x.grad.asnumpy(), [8 * 2.0 ** 7], rtol=1e-5)
+
+
+class TestCond:
+    def test_branches(self):
+        a = mx.nd.array([1.0, 2.0])
+        b = mx.nd.array([10.0, 20.0])
+        out_t = mx.nd.contrib.cond(mx.nd.array([1.0]), lambda: a + b, lambda: a - b)
+        out_f = mx.nd.contrib.cond(mx.nd.array([0.0]), lambda: a + b, lambda: a - b)
+        np.testing.assert_allclose(out_t.asnumpy(), [11.0, 22.0])
+        np.testing.assert_allclose(out_f.asnumpy(), [-9.0, -18.0])
+
+    def test_gradient_under_functional_trace(self):
+        """cond operands are closure-captured (no explicit array inputs), so
+        eager-tape grads don't apply — but under a functional trace (the
+        hybridize/SPMDTrainer path) jax hoists the captured tracers and
+        gradients flow through the selected branch."""
+        import jax
+        import jax.numpy as jnp
+
+        from incubator_mxnet_tpu.ndarray.ndarray import NDArray
+
+        def f(a):
+            x = NDArray(a)
+            out = mx.nd.contrib.cond(x > 0, lambda: x * x, lambda: -x)
+            return out._data.sum()
+
+        g_pos = jax.grad(f)(jnp.asarray([3.0]))
+        g_neg = jax.grad(f)(jnp.asarray([-3.0]))
+        np.testing.assert_allclose(np.asarray(g_pos), [6.0])
+        np.testing.assert_allclose(np.asarray(g_neg), [-1.0])

@@ -33,15 +33,17 @@ class TestProfiler:
         for _ in range(3):
             ev = line.events.add()
             ev.metadata_id = 1
-            ev.duration_ps = int(2e9)  # 2 us each
+            ev.duration_ps = int(2e9)  # 2 ms each
         d = tmp_path / "t"
         d.mkdir()
         with open(d / "host.xplane.pb", "wb") as f:
             f.write(xs.SerializeToString())
         rows = profiler._device_op_stats(str(d))
-        assert rows == [("fusion", 3, 6e-3 / 1000)] or (
-            rows and rows[0][0] == "fusion" and rows[0][1] == 3
-        )
+        assert len(rows) == 1
+        name, count, total_s = rows[0]
+        assert (name, count) == ("fusion", 3)
+        # 3 events × 2e9 ps = 6e9 ps = 6 ms
+        np.testing.assert_allclose(total_s, 6e-3, rtol=1e-9)
 
     def test_dumps_mentions_device_section_after_start_stop(self, tmp_path):
         profiler.set_config(filename=str(tmp_path / "prof.json"))

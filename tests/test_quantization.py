@@ -1,0 +1,101 @@
+"""INT8 quantization (parity: [U:tests/python/quantization/test_quantization.py]).
+
+quantize_v2/dequantize round-trip, int8 FC/conv vs fp32 tolerance, and the
+quantize_net calibrate-and-swap flow on a small MLP and convnet."""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import gluon
+from incubator_mxnet_tpu.contrib.quantization import quantize_net
+
+RNG = np.random.RandomState(11)
+
+
+class TestQuantizeOps:
+    def test_quantize_dequantize_roundtrip(self):
+        x = mx.nd.array(RNG.randn(6, 8).astype(np.float32) * 3)
+        q, mn, mxr = mx.nd.quantize_v2(x)
+        assert str(q.dtype) == "int8"
+        back = mx.nd.dequantize(q, mn, mxr)
+        amax = np.abs(x.asnumpy()).max()
+        np.testing.assert_allclose(back.asnumpy(), x.asnumpy(),
+                                   atol=amax / 127 + 1e-6)
+
+    def test_quantize_with_calib_range_saturates(self):
+        x = mx.nd.array(np.array([[-5.0, 0.0, 0.5, 5.0]], np.float32))
+        q, mn, mxr = mx.nd.quantize_v2(x, min_calib_range=-1.0, max_calib_range=1.0)
+        qn = q.asnumpy()
+        assert qn[0, 0] == -127 and qn[0, 3] == 127  # saturating cast
+        assert abs(qn[0, 2] - 64) <= 1
+
+    def test_quantized_fc_matches_fp32(self):
+        x = RNG.randn(5, 16).astype(np.float32)
+        w = RNG.randn(8, 16).astype(np.float32)
+        b = RNG.randn(8).astype(np.float32)
+        fp32 = x @ w.T + b
+        xq, xmn, xmx = mx.nd.quantize_v2(mx.nd.array(x))
+        wq, wmn, wmx = mx.nd.quantize_v2(mx.nd.array(w))
+        out = mx.nd.quantized_fully_connected(
+            xq, wq, mx.nd.array(b), xmn, xmx, wmn, wmx, num_hidden=8)
+        scale = np.abs(fp32).max()
+        np.testing.assert_allclose(out.asnumpy(), fp32, atol=scale * 0.05)
+
+    def test_quantized_conv_matches_fp32(self):
+        x = RNG.randn(2, 3, 8, 8).astype(np.float32)
+        w = RNG.randn(4, 3, 3, 3).astype(np.float32)
+        fp32 = mx.nd.Convolution(mx.nd.array(x), mx.nd.array(w), None,
+                                 kernel=(3, 3), num_filter=4, pad=(1, 1),
+                                 no_bias=True).asnumpy()
+        xq, xmn, xmx = mx.nd.quantize_v2(mx.nd.array(x))
+        wq, wmn, wmx = mx.nd.quantize_v2(mx.nd.array(w))
+        out = mx.nd.quantized_conv(xq, wq, None, xmn, xmx, wmn, wmx,
+                                   kernel=(3, 3), num_filter=4, pad=(1, 1),
+                                   no_bias=True)
+        scale = np.abs(fp32).max()
+        np.testing.assert_allclose(out.asnumpy(), fp32, atol=scale * 0.05)
+
+    def test_requantize(self):
+        acc = mx.nd.array(np.array([[1000, -2000, 30000]], np.float32)).astype("int32")
+        mn, mxr = mx.nd.array([-1.0]), mx.nd.array([1.0])
+        q, qmn, qmx = mx.nd.requantize(acc, mn, mxr)
+        assert str(q.dtype) == "int8"
+        real = acc.asnumpy().astype(np.float32) * (1.0 / 127)
+        back = q.asnumpy().astype(np.float32) * (
+            max(abs(float(qmn.asnumpy()[0])), abs(float(qmx.asnumpy()[0]))) / 127)
+        np.testing.assert_allclose(back, real, rtol=0.02, atol=np.abs(real).max() / 100)
+
+
+class TestQuantizeNet:
+    def test_mlp_within_tolerance(self):
+        mx.random.seed(0)
+        net = gluon.nn.HybridSequential()
+        net.add(gluon.nn.Dense(32, activation="relu"),
+                gluon.nn.Dense(10))
+        net.initialize()
+        calib = [mx.nd.array(RNG.rand(8, 16).astype(np.float32)) for _ in range(4)]
+        ref = net(calib[0]).asnumpy()
+        quantize_net(net, calib)
+        out = net(calib[0]).asnumpy()
+        assert getattr(net._children["0"], "_quantized", False)
+        scale = np.abs(ref).max()
+        np.testing.assert_allclose(out, ref, atol=scale * 0.06)
+        # argmax preserved on most rows (classification survives int8)
+        agree = (out.argmax(1) == ref.argmax(1)).mean()
+        assert agree >= 0.75, agree
+
+    def test_convnet_and_exclusion(self):
+        mx.random.seed(1)
+        net = gluon.nn.HybridSequential()
+        net.add(gluon.nn.Conv2D(8, kernel_size=3, padding=1, activation="relu"),
+                gluon.nn.Conv2D(4, kernel_size=3, padding=1))
+        net.initialize()
+        calib = [mx.nd.array(RNG.rand(2, 3, 8, 8).astype(np.float32)) for _ in range(2)]
+        ref = net(calib[0]).asnumpy()
+        first_name = net._children["0"].name
+        quantize_net(net, calib, excluded_layers=(first_name,))
+        assert not getattr(net._children["0"], "_quantized", False)
+        assert getattr(net._children["1"], "_quantized", False)
+        out = net(calib[0]).asnumpy()
+        scale = np.abs(ref).max()
+        np.testing.assert_allclose(out, ref, atol=scale * 0.06)

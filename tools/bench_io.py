@@ -1,0 +1,62 @@
+#!/usr/bin/env python
+"""Standalone native data-pipeline benchmark: RecordIO pack → C++ decode/
+augment pool → batches, reported as img/s/host (the number that must beat
+the chip's consumption rate for input overlap — SURVEY.md hard-part #5).
+
+Usage: python tools/bench_io.py [n_images] [batch_size] [threads]
+"""
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 512
+    batch = int(sys.argv[2]) if len(sys.argv) > 2 else 64
+    threads = int(sys.argv[3]) if len(sys.argv) > 3 else os.cpu_count()
+
+    import numpy as np
+    from PIL import Image
+
+    root = tempfile.mkdtemp(prefix="mxtpu_io_bench_")
+    img_dir = os.path.join(root, "imgs", "cls0")
+    os.makedirs(img_dir)
+    rng = np.random.RandomState(0)
+    # realistic ImageNet-ish JPEG sizes
+    for i in range(64):
+        arr = rng.randint(0, 255, (360, 480, 3), dtype=np.uint8)
+        Image.fromarray(arr).save(os.path.join(img_dir, f"i{i}.jpg"), quality=85)
+    prefix = os.path.join(root, "pack")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    subprocess.run([sys.executable, os.path.join(repo, "tools", "im2rec.py"),
+                    prefix, os.path.join(root, "imgs")],
+                   check=True, capture_output=True)
+
+    from incubator_mxnet_tpu.io.record_iter import ImageRecordIter
+
+    def run(epochs):
+        it = ImageRecordIter(
+            path_imgrec=prefix + ".rec", path_imgidx=prefix + ".idx",
+            batch_size=batch, data_shape=(3, 224, 224), shuffle=True,
+            preprocess_threads=threads, rand_crop=True, rand_mirror=True)
+        seen = 0
+        for _ in range(epochs):
+            it.reset()
+            for b in it:
+                seen += b.data[0].shape[0]
+        return seen
+
+    run(1)  # warm the pool / page cache
+    t0 = time.perf_counter()
+    seen = run(max(1, n // 64))
+    dt = time.perf_counter() - t0
+    print(f"native pipeline: {seen} imgs in {dt:.2f}s -> {seen/dt:.0f} img/s/host "
+          f"({threads} decode threads, 224x224 crops from 480x360 JPEGs)")
+
+
+if __name__ == "__main__":
+    main()

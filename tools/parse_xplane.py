@@ -9,7 +9,7 @@ per collapsed instruction name, printing the top-N with % of total device
 time — the same table xprof's op_profile shows, without TensorBoard.
 """
 import os
-import re
+
 import sys
 from collections import defaultdict
 
@@ -17,7 +17,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def main():
-    from incubator_mxnet_tpu.profiler import iter_xplane_ops
+    from incubator_mxnet_tpu.profiler import collapse_hlo_name, iter_xplane_ops
 
     trace_dir = sys.argv[1]
     topn = int(sys.argv[2]) if len(sys.argv) > 2 else 40
@@ -25,18 +25,10 @@ def main():
     by_opcode = defaultdict(int)
     by_inst = defaultdict(int)
     grand = 0
-    # HLO line shape:  %name = f32[8,128,768]{2,1,0} convert(%arg)
-    op_pat = re.compile(r"%([\w\-\.]+) = [^ ]+ ([\w\-]+)\(")
     for name, ps in iter_xplane_ops(trace_dir):
         grand += ps
-        m = op_pat.search(name)
-        if m:
-            inst, opcode = m.groups()
-            inst = re.sub(r"\.[0-9]+$", "", inst)
-        else:
-            inst = re.sub(r"\.[0-9]+$", "", name.split(" ")[0].lstrip("%"))
-            opcode = inst
-        by_opcode[opcode] += ps
+        inst, opcode = collapse_hlo_name(name)
+        by_opcode[opcode or inst] += ps
         by_inst[inst] += ps
 
     if not grand:
