@@ -62,6 +62,10 @@ from . import contrib
 from . import profiler
 from . import operator
 from . import checkpoint
+from . import library
+from . import config
+from . import predictor
+config.apply_env()
 from .util import np_shape, np_array, is_np_shape, is_np_array, set_np, reset_np
 from . import numpy_ns as np  # mx.np numpy-compat namespace
 from .utils import test_utils

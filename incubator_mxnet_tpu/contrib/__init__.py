@@ -7,5 +7,6 @@ in ops/quantization.py).
 """
 from .. import amp  # noqa: F401  (reference path: mx.contrib.amp)
 from . import quantization  # noqa: F401
+from . import onnx  # noqa: F401
 
-__all__ = ["amp", "quantization"]
+__all__ = ["amp", "quantization", "onnx"]

@@ -1,0 +1,364 @@
+"""ONNX export/import (parity: [U:python/mxnet/contrib/onnx/] — the
+``mx2onnx`` op-converter registry and ``onnx2mx`` import path).
+
+The environment ships no ``onnx`` package, so serialization goes through
+the wire-level codec in ``_proto.py`` (validated against
+``protoc --decode_raw``).  Converters cover the Symbol-API op set the
+five baseline workloads use: FullyConnected/Gemm, Convolution/Conv,
+Pooling/{Max,Average,GlobalAverage}Pool, BatchNorm/BatchNormalization,
+Activation+LeakyReLU/Relu..., softmax, Flatten, Reshape, Concat, Dropout,
+elementwise add/sub/mul/div, dot/MatMul, Embedding/Gather.
+
+API (reference signatures):
+    export_model(sym, params, input_shape, onnx_file_path) -> path
+    import_model(onnx_file_path) -> (sym, arg_params, aux_params)
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from . import _proto as P
+
+__all__ = ["export_model", "import_model", "get_model_metadata"]
+
+
+# ---------------------------------------------------------------------------
+# export: mx Symbol graph -> ONNX
+# ---------------------------------------------------------------------------
+
+
+def _attr_i(name, v):
+    return {"name": name, "type": P.ATTR_INT, "i": int(v)}
+
+
+def _attr_f(name, v):
+    return {"name": name, "type": P.ATTR_FLOAT, "f": float(v)}
+
+
+def _attr_ints(name, vs):
+    return {"name": name, "type": P.ATTR_INTS, "ints": [int(v) for v in vs]}
+
+
+def _attr_s(name, v):
+    return {"name": name, "type": P.ATTR_STRING, "s": v}
+
+
+def _tuplize(v, n=2):
+    if v is None:
+        return (1,) * n
+    if isinstance(v, int):
+        return (v,) * n
+    return tuple(v)
+
+
+_ACT_MAP = {"relu": "Relu", "sigmoid": "Sigmoid", "tanh": "Tanh",
+            "softrelu": "Softplus", "softsign": "Softsign"}
+_ELEMWISE = {"elemwise_add": "Add", "broadcast_add": "Add", "_plus": "Add",
+             "elemwise_sub": "Sub", "broadcast_sub": "Sub", "_sub": "Sub",
+             "elemwise_mul": "Mul", "broadcast_mul": "Mul", "_mul": "Mul",
+             "elemwise_div": "Div", "broadcast_div": "Div", "_div": "Div"}
+
+
+def _export_node(node, in_names, out_name, extra_inits):
+    """One mx graph node -> list of ONNX node dicts."""
+    op = node.op
+    a = node.attrs
+    nm = node.name
+    if op in ("FullyConnected", "fully_connected"):
+        flatten = a.get("flatten", True)
+        nodes = []
+        x = in_names[0]
+        if flatten:
+            nodes.append({"op_type": "Flatten", "name": nm + "_flatten",
+                          "input": [x], "output": [nm + "_flat"],
+                          "attribute": [_attr_i("axis", 1)]})
+            x = nm + "_flat"
+        gemm_in = [x] + in_names[1:]
+        nodes.append({"op_type": "Gemm", "name": nm, "input": gemm_in,
+                      "output": [out_name],
+                      "attribute": [_attr_f("alpha", 1.0), _attr_f("beta", 1.0),
+                                    _attr_i("transB", 1)]})
+        return nodes
+    if op == "Convolution":
+        kernel = _tuplize(a.get("kernel", (1, 1)))
+        pad = _tuplize(a.get("pad", 0), len(kernel))
+        stride = _tuplize(a.get("stride", 1), len(kernel))
+        dilate = _tuplize(a.get("dilate", 1), len(kernel))
+        return [{"op_type": "Conv", "name": nm, "input": in_names,
+                 "output": [out_name],
+                 "attribute": [_attr_ints("kernel_shape", kernel),
+                               _attr_ints("pads", tuple(pad) * 2),
+                               _attr_ints("strides", stride),
+                               _attr_ints("dilations", dilate),
+                               _attr_i("group", a.get("num_group", 1))]}]
+    if op == "Pooling":
+        if a.get("global_pool", False):
+            op_type = ("GlobalAveragePool" if a.get("pool_type", "max") == "avg"
+                       else "GlobalMaxPool")
+            return [{"op_type": op_type, "name": nm, "input": in_names,
+                     "output": [out_name], "attribute": []}]
+        kernel = _tuplize(a.get("kernel", (2, 2)))
+        stride = _tuplize(a.get("stride", kernel), len(kernel))
+        pad = _tuplize(a.get("pad", 0), len(kernel))
+        op_type = "AveragePool" if a.get("pool_type", "max") == "avg" else "MaxPool"
+        return [{"op_type": op_type, "name": nm, "input": in_names,
+                 "output": [out_name],
+                 "attribute": [_attr_ints("kernel_shape", kernel),
+                               _attr_ints("strides", stride),
+                               _attr_ints("pads", tuple(pad) * 2)]}]
+    if op == "BatchNorm":
+        return [{"op_type": "BatchNormalization", "name": nm,
+                 # mx order: data,gamma,beta,moving_mean,moving_var == onnx
+                 "input": in_names, "output": [out_name],
+                 "attribute": [_attr_f("epsilon", a.get("eps", 1e-5)),
+                               _attr_f("momentum", a.get("momentum", 0.9))]}]
+    if op == "Activation":
+        return [{"op_type": _ACT_MAP[a.get("act_type", "relu")], "name": nm,
+                 "input": in_names, "output": [out_name], "attribute": []}]
+    if op == "LeakyReLU":
+        act = a.get("act_type", "leaky")
+        if act == "leaky":
+            return [{"op_type": "LeakyRelu", "name": nm, "input": in_names,
+                     "output": [out_name],
+                     "attribute": [_attr_f("alpha", a.get("slope", 0.25))]}]
+        if act == "elu":
+            return [{"op_type": "Elu", "name": nm, "input": in_names,
+                     "output": [out_name],
+                     "attribute": [_attr_f("alpha", a.get("slope", 0.25))]}]
+        if act == "gelu":
+            return [{"op_type": "Gelu", "name": nm, "input": in_names,
+                     "output": [out_name], "attribute": []}]
+        raise NotImplementedError(f"LeakyReLU act_type={act} for ONNX")
+    if op in ("softmax", "Softmax"):
+        return [{"op_type": "Softmax", "name": nm, "input": in_names,
+                 "output": [out_name],
+                 "attribute": [_attr_i("axis", a.get("axis", -1))]}]
+    if op == "Flatten":
+        return [{"op_type": "Flatten", "name": nm, "input": in_names,
+                 "output": [out_name], "attribute": [_attr_i("axis", 1)]}]
+    if op in ("Reshape", "reshape"):
+        shape = tuple(a.get("shape", ()))
+        sh_name = nm + "_shape"
+        extra_inits.append({"name": sh_name, "dims": (len(shape),),
+                            "data_type": P.TP_INT64,
+                            "raw": _np.asarray(shape, _np.int64).tobytes()})
+        return [{"op_type": "Reshape", "name": nm,
+                 "input": in_names + [sh_name], "output": [out_name],
+                 "attribute": []}]
+    if op in ("Concat", "concat"):
+        return [{"op_type": "Concat", "name": nm, "input": in_names,
+                 "output": [out_name],
+                 "attribute": [_attr_i("axis", a.get("dim", 1))]}]
+    if op == "Dropout":
+        return [{"op_type": "Dropout", "name": nm, "input": in_names,
+                 "output": [out_name], "attribute": []}]
+    if op in _ELEMWISE:
+        return [{"op_type": _ELEMWISE[op], "name": nm, "input": in_names,
+                 "output": [out_name], "attribute": []}]
+    if op == "dot":
+        return [{"op_type": "MatMul", "name": nm, "input": in_names,
+                 "output": [out_name], "attribute": []}]
+    if op == "Embedding":
+        # mx: (indices, weight) -> onnx Gather(weight, indices)
+        return [{"op_type": "Gather", "name": nm,
+                 "input": [in_names[1], in_names[0]], "output": [out_name],
+                 "attribute": [_attr_i("axis", 0)]}]
+    raise NotImplementedError(f"no ONNX converter for op {op!r}")
+
+
+def export_model(sym, params, input_shape=None, input_type=_np.float32,
+                 onnx_file_path="model.onnx", opset_version=13):
+    """Export a Symbol + params dict to an ONNX file.  ``params`` may use
+    the reference's ``arg:``/``aux:`` key prefixes or bare names."""
+    flat = {}
+    for k, v in (params or {}).items():
+        name = k.split(":", 1)[1] if ":" in k else k
+        flat[name] = _np.asarray(v.asnumpy() if hasattr(v, "asnumpy") else v)
+
+    nodes, inits, inputs = [], [], []
+    out_of = {}  # (id(node), idx) -> onnx name
+    order = sym._topo()
+    data_inputs = [n for n in order if n.op is None and n.name not in flat]
+    shapes = {}
+    if input_shape is not None:
+        shp_list = ([input_shape] if isinstance(input_shape, tuple)
+                    else list(input_shape))
+        for n, s in zip(data_inputs, shp_list):
+            shapes[n.name] = s
+    for node in order:
+        if node.op is None:
+            out_of[(id(node), 0)] = node.name
+            if node.name in flat:
+                arr = flat[node.name]
+                inits.append({"name": node.name, "dims": arr.shape,
+                              "data_type": P.DTYPE_TO_TP[_np.dtype(arr.dtype)],
+                              "raw": _np.ascontiguousarray(arr).tobytes()})
+            else:
+                inputs.append({"name": node.name, "elem_type": P.TP_FLOAT,
+                               "shape": shapes.get(node.name, ())})
+            continue
+        in_names = [out_of[(id(n), i)] for n, i in node.inputs]
+        out_name = node.name + "_out"
+        nodes.extend(_export_node(node, in_names, out_name, inits))
+        out_of[(id(node), 0)] = out_name
+
+    outputs = [{"name": out_of[(id(n), i)], "elem_type": P.TP_FLOAT, "shape": ()}
+               for n, i in sym._outputs]
+    model = {"ir_version": 8, "opset": opset_version,
+             "graph": {"node": nodes, "name": "mxtpu", "initializer": inits,
+                       "input": inputs, "output": outputs}}
+    with open(onnx_file_path, "wb") as f:
+        f.write(P.enc_model(model))
+    return onnx_file_path
+
+
+# ---------------------------------------------------------------------------
+# import: ONNX -> mx Symbol + params
+# ---------------------------------------------------------------------------
+
+
+def _get_attr(node, name, default=None):
+    for a in node["attribute"]:
+        if a["name"] == name:
+            t = a["type"]
+            if t == P.ATTR_INT:
+                return a.get("i", default)
+            if t == P.ATTR_FLOAT:
+                return a.get("f", default)
+            if t == P.ATTR_INTS:
+                return a["ints"]
+            if t == P.ATTR_FLOATS:
+                return a["floats"]
+            if t == P.ATTR_STRING:
+                return a["s"]
+            if t == P.ATTR_TENSOR:
+                return a["t"]
+    return default
+
+
+def import_model(model_file):
+    """ONNX file → (sym, arg_params, aux_params) (reference signature)."""
+    from ... import ndarray as nd
+    from ... import symbol as S
+
+    with open(model_file, "rb") as f:
+        model = P.dec_model(f.read())
+    g = model["graph"]
+    inits = {t["name"]: P.tensor_to_numpy(t) for t in g["initializer"]}
+    env = {}
+    arg_params, aux_params = {}, {}
+
+    for vi in g["input"]:
+        if vi["name"] not in inits:
+            env[vi["name"]] = S.var(vi["name"])
+    for name, arr in inits.items():
+        env[name] = S.var(name)
+
+    rev_act = {v: k for k, v in _ACT_MAP.items()}
+    rev_elem = {"Add": "broadcast_add", "Sub": "broadcast_sub",
+                "Mul": "broadcast_mul", "Div": "broadcast_div"}
+
+    import incubator_mxnet_tpu.symbol as sym_mod
+
+    for node in g["node"]:
+        op = node["op_type"]
+        nm = node["name"] or node["output"][0]
+        if op == "Gemm":
+            x, w = env[node["input"][0]], env[node["input"][1]]
+            b = env[node["input"][2]] if len(node["input"]) > 2 else None
+            num_hidden = inits[node["input"][1]].shape[0]
+            out = sym_mod.FullyConnected(x, w, b, num_hidden=num_hidden,
+                                         no_bias=b is None, flatten=False,
+                                         name=nm)
+        elif op == "Flatten":
+            out = sym_mod.Flatten(env[node["input"][0]], name=nm)
+        elif op == "Conv":
+            kernel = tuple(_get_attr(node, "kernel_shape"))
+            pads = _get_attr(node, "pads", [0] * len(kernel) * 2)
+            strides = tuple(_get_attr(node, "strides", (1,) * len(kernel)))
+            dil = tuple(_get_attr(node, "dilations", (1,) * len(kernel)))
+            grp = _get_attr(node, "group", 1)
+            w = inits[node["input"][1]]
+            b = env[node["input"][2]] if len(node["input"]) > 2 else None
+            out = sym_mod.Convolution(
+                env[node["input"][0]], env[node["input"][1]], b,
+                kernel=kernel, pad=tuple(pads[: len(kernel)]), stride=strides,
+                dilate=dil, num_filter=w.shape[0], num_group=grp,
+                no_bias=b is None, name=nm)
+        elif op in ("MaxPool", "AveragePool", "GlobalMaxPool", "GlobalAveragePool"):
+            if op.startswith("Global"):
+                out = sym_mod.Pooling(
+                    env[node["input"][0]], global_pool=True,
+                    pool_type="avg" if "Average" in op else "max", name=nm)
+            else:
+                kernel = tuple(_get_attr(node, "kernel_shape"))
+                out = sym_mod.Pooling(
+                    env[node["input"][0]], kernel=kernel,
+                    stride=tuple(_get_attr(node, "strides", kernel)),
+                    pad=tuple(_get_attr(node, "pads", (0,) * len(kernel) * 2)[: len(kernel)]),
+                    pool_type="avg" if op == "AveragePool" else "max", name=nm)
+        elif op == "BatchNormalization":
+            out = sym_mod.BatchNorm(
+                *[env[i] for i in node["input"]],
+                eps=_get_attr(node, "epsilon", 1e-5),
+                momentum=_get_attr(node, "momentum", 0.9),
+                fix_gamma=False, name=nm)
+        elif op in rev_act:
+            out = sym_mod.Activation(env[node["input"][0]],
+                                     act_type=rev_act[op], name=nm)
+        elif op == "LeakyRelu":
+            out = sym_mod.LeakyReLU(env[node["input"][0]], act_type="leaky",
+                                    slope=_get_attr(node, "alpha", 0.01), name=nm)
+        elif op == "Elu":
+            out = sym_mod.LeakyReLU(env[node["input"][0]], act_type="elu",
+                                    slope=_get_attr(node, "alpha", 1.0), name=nm)
+        elif op == "Gelu":
+            out = sym_mod.LeakyReLU(env[node["input"][0]], act_type="gelu", name=nm)
+        elif op == "Softmax":
+            out = sym_mod.softmax(env[node["input"][0]],
+                                  axis=_get_attr(node, "axis", -1), name=nm)
+        elif op == "Reshape":
+            shape = tuple(int(x) for x in inits[node["input"][1]])
+            out = sym_mod.reshape(env[node["input"][0]], shape=shape, name=nm)
+            inits.pop(node["input"][1], None)
+            env.pop(node["input"][1], None)
+        elif op == "Concat":
+            out = sym_mod.concat(*[env[i] for i in node["input"]],
+                                 dim=_get_attr(node, "axis", 1), name=nm)
+        elif op == "Dropout":
+            out = sym_mod.Dropout(env[node["input"][0]], name=nm)
+        elif op in rev_elem:
+            out = getattr(sym_mod, rev_elem[op])(
+                env[node["input"][0]], env[node["input"][1]], name=nm)
+        elif op == "MatMul":
+            out = sym_mod.dot(env[node["input"][0]], env[node["input"][1]], name=nm)
+        elif op == "Gather":
+            w_name = node["input"][0]
+            w = inits[w_name]
+            out = sym_mod.Embedding(env[node["input"][1]], env[w_name],
+                                    input_dim=w.shape[0], output_dim=w.shape[1],
+                                    name=nm)
+        else:
+            raise NotImplementedError(f"no import converter for ONNX op {op!r}")
+        env[node["output"][0]] = out
+
+    from ...symbol.symbol import is_aux_name
+
+    for name, arr in inits.items():
+        target = aux_params if is_aux_name(name) else arg_params
+        target[name] = nd.array(arr)
+    outs = [env[o["name"]] for o in g["output"]]
+    import incubator_mxnet_tpu.symbol as sym_mod
+    sym = outs[0] if len(outs) == 1 else sym_mod.Group(outs)
+    return sym, arg_params, aux_params
+
+
+def get_model_metadata(model_file):
+    with open(model_file, "rb") as f:
+        model = P.dec_model(f.read())
+    g = model["graph"]
+    return {
+        "input_tensor_data": [(v["name"], tuple(v["shape"])) for v in g["input"]
+                              if v["name"] not in {t["name"] for t in g["initializer"]}],
+        "output_tensor_data": [(v["name"], tuple(v["shape"])) for v in g["output"]],
+    }

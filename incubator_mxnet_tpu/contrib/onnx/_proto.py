@@ -1,0 +1,366 @@
+"""Minimal ONNX protobuf wire codec — no ``onnx``/``protobuf`` dependency.
+
+This environment ships no onnx package, so the ModelProto subset the
+import/export front end needs is encoded/decoded directly at the protobuf
+wire level (the format is just varint-tagged fields; validated against
+``protoc --decode_raw`` in tests/test_onnx.py).  Field numbers follow the
+public onnx.proto3 schema.
+
+Messages are plain dicts; only the fields the converters use exist.
+"""
+from __future__ import annotations
+
+import struct
+
+# wire types
+_VARINT, _I64, _LEN, _I32 = 0, 1, 2, 5
+
+# AttributeProto.AttributeType
+ATTR_FLOAT, ATTR_INT, ATTR_STRING, ATTR_TENSOR = 1, 2, 3, 4
+ATTR_FLOATS, ATTR_INTS, ATTR_STRINGS = 6, 7, 8
+
+# TensorProto.DataType
+TP_FLOAT, TP_UINT8, TP_INT8, TP_INT32, TP_INT64 = 1, 2, 3, 6, 7
+TP_BOOL, TP_FLOAT16, TP_DOUBLE = 9, 10, 11
+
+import numpy as _np
+
+DTYPE_TO_TP = {
+    _np.dtype("float32"): TP_FLOAT, _np.dtype("uint8"): TP_UINT8,
+    _np.dtype("int8"): TP_INT8, _np.dtype("int32"): TP_INT32,
+    _np.dtype("int64"): TP_INT64, _np.dtype("bool"): TP_BOOL,
+    _np.dtype("float16"): TP_FLOAT16, _np.dtype("float64"): TP_DOUBLE,
+}
+TP_TO_DTYPE = {v: k for k, v in DTYPE_TO_TP.items()}
+
+
+# ---------------------------------------------------------------------------
+# primitive writers
+# ---------------------------------------------------------------------------
+
+
+def _varint(n):
+    out = bytearray()
+    n &= (1 << 64) - 1
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _key(field, wt):
+    return _varint((field << 3) | wt)
+
+
+def _f_varint(field, value):
+    return _key(field, _VARINT) + _varint(int(value))
+
+
+def _f_bytes(field, data):
+    if isinstance(data, str):
+        data = data.encode("utf-8")
+    return _key(field, _LEN) + _varint(len(data)) + data
+
+
+def _f_float(field, value):
+    return _key(field, _I32) + struct.pack("<f", value)
+
+
+# ---------------------------------------------------------------------------
+# message writers (field numbers per onnx.proto3)
+# ---------------------------------------------------------------------------
+
+
+def enc_tensor(t):
+    """t: {name, dims, data_type, raw: bytes}"""
+    out = bytearray()
+    for d in t.get("dims", ()):
+        out += _f_varint(1, d)
+    out += _f_varint(2, t["data_type"])
+    if t.get("name"):
+        out += _f_bytes(8, t["name"])
+    out += _f_bytes(9, t.get("raw", b""))
+    return bytes(out)
+
+
+def enc_attribute(a):
+    """a: {name, type, and one of i/f/s/ints/floats/t}"""
+    out = bytearray(_f_bytes(1, a["name"]))
+    typ = a["type"]
+    if typ == ATTR_FLOAT:
+        out += _f_float(2, a["f"])
+    elif typ == ATTR_INT:
+        out += _f_varint(3, a["i"])
+    elif typ == ATTR_STRING:
+        out += _f_bytes(4, a["s"])
+    elif typ == ATTR_TENSOR:
+        out += _f_bytes(5, enc_tensor(a["t"]))
+    elif typ == ATTR_FLOATS:
+        for v in a["floats"]:
+            out += _f_float(7, v)
+    elif typ == ATTR_INTS:
+        for v in a["ints"]:
+            out += _f_varint(8, v)
+    elif typ == ATTR_STRINGS:
+        for v in a["strings"]:
+            out += _f_bytes(9, v)
+    out += _f_varint(20, typ)
+    return bytes(out)
+
+
+def enc_node(n):
+    out = bytearray()
+    for i in n.get("input", ()):
+        out += _f_bytes(1, i)
+    for o in n.get("output", ()):
+        out += _f_bytes(2, o)
+    if n.get("name"):
+        out += _f_bytes(3, n["name"])
+    out += _f_bytes(4, n["op_type"])
+    for a in n.get("attribute", ()):
+        out += _f_bytes(5, enc_attribute(a))
+    return bytes(out)
+
+
+def enc_value_info(v):
+    """v: {name, elem_type, shape: tuple[int]}"""
+    shape = bytearray()
+    for d in v.get("shape", ()):
+        shape += _f_bytes(1, _f_varint(1, d))        # Dim{dim_value}
+    tensor_type = (_f_varint(1, v.get("elem_type", TP_FLOAT))
+                   + _f_bytes(2, bytes(shape)))      # TensorShapeProto
+    type_proto = _f_bytes(1, tensor_type)            # TypeProto{tensor_type}
+    return _f_bytes(1, v["name"]) + _f_bytes(2, type_proto)
+
+
+def enc_graph(g):
+    out = bytearray()
+    for n in g.get("node", ()):
+        out += _f_bytes(1, enc_node(n))
+    if g.get("name"):
+        out += _f_bytes(2, g["name"])
+    for t in g.get("initializer", ()):
+        out += _f_bytes(5, enc_tensor(t))
+    for v in g.get("input", ()):
+        out += _f_bytes(11, enc_value_info(v))
+    for v in g.get("output", ()):
+        out += _f_bytes(12, enc_value_info(v))
+    return bytes(out)
+
+
+def enc_model(m):
+    out = bytearray(_f_varint(1, m.get("ir_version", 8)))
+    out += _f_bytes(2, m.get("producer_name", "incubator_mxnet_tpu"))
+    out += _f_bytes(7, enc_graph(m["graph"]))
+    # opset_import: OperatorSetIdProto{domain="", version}
+    opset = _f_bytes(1, "") + _f_varint(2, m.get("opset", 13))
+    out += _f_bytes(8, opset)
+    return bytes(out)
+
+
+# ---------------------------------------------------------------------------
+# reader
+# ---------------------------------------------------------------------------
+
+
+def _read_varint(buf, pos):
+    result, shift = 0, 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+
+
+def iter_fields(buf):
+    """Yield (field_number, wire_type, value) — value is int for varint,
+    bytes for length-delimited, raw 4/8 bytes for fixed."""
+    pos = 0
+    n = len(buf)
+    while pos < n:
+        key, pos = _read_varint(buf, pos)
+        field, wt = key >> 3, key & 7
+        if wt == _VARINT:
+            v, pos = _read_varint(buf, pos)
+        elif wt == _LEN:
+            ln, pos = _read_varint(buf, pos)
+            v = buf[pos:pos + ln]
+            pos += ln
+        elif wt == _I32:
+            v = buf[pos:pos + 4]
+            pos += 4
+        elif wt == _I64:
+            v = buf[pos:pos + 8]
+            pos += 8
+        else:
+            raise ValueError(f"unsupported wire type {wt}")
+        yield field, wt, v
+
+
+def dec_tensor(buf):
+    t = {"dims": [], "data_type": TP_FLOAT, "name": "", "raw": b"",
+         "float_data": [], "int64_data": [], "int32_data": []}
+    for f, wt, v in iter_fields(buf):
+        if f == 1:
+            if wt == _VARINT:
+                t["dims"].append(v)
+            else:  # packed
+                p = 0
+                while p < len(v):
+                    d, p = _read_varint(v, p)
+                    t["dims"].append(d)
+        elif f == 2:
+            t["data_type"] = v
+        elif f == 4:  # float_data (packed or not)
+            if wt == _I32:
+                t["float_data"].append(struct.unpack("<f", v)[0])
+            else:
+                t["float_data"].extend(
+                    struct.unpack(f"<{len(v)//4}f", v))
+        elif f == 5:
+            if wt == _VARINT:
+                t["int32_data"].append(v)
+            else:
+                p = 0
+                while p < len(v):
+                    d, p = _read_varint(v, p)
+                    t["int32_data"].append(d)
+        elif f == 7:
+            if wt == _VARINT:
+                t["int64_data"].append(v)
+            else:
+                p = 0
+                while p < len(v):
+                    d, p = _read_varint(v, p)
+                    t["int64_data"].append(d)
+        elif f == 8:
+            t["name"] = v.decode("utf-8")
+        elif f == 9:
+            t["raw"] = v
+    return t
+
+
+def tensor_to_numpy(t):
+    dtype = TP_TO_DTYPE.get(t["data_type"], _np.dtype("float32"))
+    dims = tuple(t["dims"])
+    if t["raw"]:
+        return _np.frombuffer(t["raw"], dtype=dtype).reshape(dims)
+    if t["float_data"]:
+        return _np.asarray(t["float_data"], dtype).reshape(dims)
+    if t["int64_data"]:
+        return _np.asarray(t["int64_data"], dtype).reshape(dims)
+    if t["int32_data"]:
+        return _np.asarray(t["int32_data"], dtype).reshape(dims)
+    return _np.zeros(dims, dtype)
+
+
+def dec_attribute(buf):
+    a = {"name": "", "type": 0, "ints": [], "floats": [], "strings": []}
+    for f, wt, v in iter_fields(buf):
+        if f == 1:
+            a["name"] = v.decode("utf-8")
+        elif f == 2:
+            a["f"] = struct.unpack("<f", v)[0]
+        elif f == 3:
+            a["i"] = _signed(v)
+        elif f == 4:
+            a["s"] = v
+        elif f == 5:
+            a["t"] = dec_tensor(v)
+        elif f == 7:
+            if wt == _I32:
+                a["floats"].append(struct.unpack("<f", v)[0])
+            else:
+                a["floats"].extend(struct.unpack(f"<{len(v)//4}f", v))
+        elif f == 8:
+            if wt == _VARINT:
+                a["ints"].append(_signed(v))
+            else:
+                p = 0
+                while p < len(v):
+                    d, p = _read_varint(v, p)
+                    a["ints"].append(_signed(d))
+        elif f == 9:
+            a["strings"].append(v)
+        elif f == 20:
+            a["type"] = v
+    return a
+
+
+def _signed(v):
+    """protobuf int64 stores negatives as 2^64 complements."""
+    return v - (1 << 64) if v >= (1 << 63) else v
+
+
+def dec_node(buf):
+    n = {"input": [], "output": [], "name": "", "op_type": "", "attribute": []}
+    for f, _, v in iter_fields(buf):
+        if f == 1:
+            n["input"].append(v.decode("utf-8"))
+        elif f == 2:
+            n["output"].append(v.decode("utf-8"))
+        elif f == 3:
+            n["name"] = v.decode("utf-8")
+        elif f == 4:
+            n["op_type"] = v.decode("utf-8")
+        elif f == 5:
+            n["attribute"].append(dec_attribute(v))
+    return n
+
+
+def dec_value_info(buf):
+    out = {"name": "", "elem_type": TP_FLOAT, "shape": []}
+    for f, _, v in iter_fields(buf):
+        if f == 1:
+            out["name"] = v.decode("utf-8")
+        elif f == 2:  # TypeProto
+            for f2, _, v2 in iter_fields(v):
+                if f2 == 1:  # tensor_type
+                    for f3, _, v3 in iter_fields(v2):
+                        if f3 == 1:
+                            out["elem_type"] = v3
+                        elif f3 == 2:  # shape
+                            for f4, _, v4 in iter_fields(v3):
+                                if f4 == 1:  # dim
+                                    dim_val = 0
+                                    for f5, _, v5 in iter_fields(v4):
+                                        if f5 == 1:
+                                            dim_val = v5
+                                    out["shape"].append(dim_val)
+    return out
+
+
+def dec_graph(buf):
+    g = {"node": [], "name": "", "initializer": [], "input": [], "output": []}
+    for f, _, v in iter_fields(buf):
+        if f == 1:
+            g["node"].append(dec_node(v))
+        elif f == 2:
+            g["name"] = v.decode("utf-8")
+        elif f == 5:
+            g["initializer"].append(dec_tensor(v))
+        elif f == 11:
+            g["input"].append(dec_value_info(v))
+        elif f == 12:
+            g["output"].append(dec_value_info(v))
+    return g
+
+
+def dec_model(buf):
+    m = {"ir_version": 0, "graph": None, "opset": 13}
+    for f, _, v in iter_fields(buf):
+        if f == 1:
+            m["ir_version"] = v
+        elif f == 7:
+            m["graph"] = dec_graph(v)
+        elif f == 8:
+            for f2, _, v2 in iter_fields(v):
+                if f2 == 2:
+                    m["opset"] = v2
+    return m

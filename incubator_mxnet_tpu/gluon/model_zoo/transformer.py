@@ -110,8 +110,16 @@ def transformer_sharding_rules(fsdp=False):
 
 
 # ---------------------------------------------------------------------------
-# Search (greedy + beam) — bucketed-prefix jit discipline
+# Search (greedy + beam) — bucketed KV-cache decode
 # ---------------------------------------------------------------------------
+#
+# Decode is O(T) per step: each decoder layer keeps an append-only K/V
+# cache padded to a bucket length (static shapes — the BucketingModule
+# discipline), the new position is written with dynamic_update_slice, and
+# attention runs one query row against the cache.  One jitted program per
+# bucket; cache buffers are donated so steady-state HBM holds one copy.
+# The pre-round-3 re-run-the-prefix path (O(T²)/step) remains as
+# ``use_cache=False`` and for post-norm decoders.
 
 
 def _bucket(n, max_len):
@@ -119,6 +127,210 @@ def _bucket(n, max_len):
     while b < n:
         b *= 2
     return min(b, max_len)
+
+
+class _KVCacheDecoder:
+    """Incremental decoder over bucketed K/V caches.
+
+    Exceeds-reference area (the reference has no fused attention or
+    incremental decode at all); the TPU discipline is constant shapes:
+    caches live at bucket lengths, growing by re-padding + retracing at
+    powers of two."""
+
+    def __init__(self, model, memory, batch, max_length, dtype=None):
+        import jax.numpy as jnp
+
+        from ... import autograd  # noqa: F401  (scope import parity)
+
+        cells = model.decoder._layers
+        if not all(c._pre_norm for c in cells):
+            raise NotImplementedError("KV-cache decode requires pre-norm cells")
+        self._model = model
+        self._cells = cells
+        self._units = model._units
+        self._heads = cells[0].self_attention._num_heads
+        self._dh = self._units // self._heads
+        self._max_length = max_length
+        self._params = sorted(model.collect_params().values(), key=lambda p: p.name)
+        if any(p._data is None for p in self._params):
+            # deferred shapes: one [B,1] decode materializes every weight
+            from ... import ndarray as _ndm
+
+            model.decode(_ndm.zeros((batch, 1), dtype="int32"),
+                         memory if hasattr(memory, "_data") else _nd_wrap(memory))
+        self._param_arrays = [p._data._data for p in self._params]
+        self._mem = memory._data if hasattr(memory, "_data") else memory
+        self._dtype = dtype or self._mem.dtype
+        self._bucket = _bucket(1, max_length)
+        L, B, H, dh = len(cells), batch, self._heads, self._dh
+        self._self_k = jnp.zeros((L, B, self._bucket, H, dh), self._dtype)
+        self._self_v = jnp.zeros_like(self._self_k)
+        # cross-attention K/V depend only on the encoder memory: computed
+        # once per layer through the cells' own kv projections
+        mem_kv = []
+        for cell in cells:
+            kv = cell.cross_attention.kv_proj(
+                memory if hasattr(memory, "_data") else _nd_wrap(memory))
+            arr = kv._data
+            S = arr.shape[1]
+            mem_kv.append(arr.reshape(B, S, 2, H, dh))
+        self._mem_k = jnp.stack([a[:, :, 0] for a in mem_kv])  # [L, B, S, H, dh]
+        self._mem_v = jnp.stack([a[:, :, 1] for a in mem_kv])
+        self._step_cache = {}
+
+    # -- cache maintenance ----------------------------------------------
+    def _grow(self, needed):
+        import jax.numpy as jnp
+
+        while self._bucket < needed:
+            new_b = min(self._bucket * 2, self._max_length)
+            pad = new_b - self._bucket
+            self._self_k = jnp.pad(self._self_k, ((0, 0), (0, 0), (0, pad), (0, 0), (0, 0)))
+            self._self_v = jnp.pad(self._self_v, ((0, 0), (0, 0), (0, pad), (0, 0), (0, 0)))
+            self._bucket = new_b
+
+    def reorder(self, flat_indices):
+        """Beam bookkeeping: permute the batch axis of the caches."""
+        import jax.numpy as jnp
+
+        idx = jnp.asarray(flat_indices)
+        self._self_k = jnp.take(self._self_k, idx, axis=1)
+        self._self_v = jnp.take(self._self_v, idx, axis=1)
+
+    # -- the jitted step -------------------------------------------------
+    def _make_step(self, bucket):
+        import functools
+
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        from ... import autograd
+        from ...gluon.block import _aux_stack, _tls as _block_tls
+        from ...ndarray.ndarray import NDArray
+        from ...random import push_traced_key, pop_traced_key
+
+        model = self._model
+        cells = self._cells
+        params = self._params
+        H, dh, units = self._heads, self._dh, self._units
+        scale = 1.0 / math.sqrt(dh)
+        pos_table = model.pos_enc._table  # numpy [max_len, units]
+
+        def attend(q, k, v, mask):
+            # q [B,1,H,dh]; k/v [B,Tb,H,dh]; mask [Tb] bool (valid positions)
+            s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                           preferred_element_type=jnp.float32) * scale
+            s = jnp.where(mask[None, None, None, :], s, -jnp.inf)
+            p = jax.nn.softmax(s, axis=-1)
+            return jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v,
+                              preferred_element_type=jnp.float32).astype(v.dtype)
+
+        # Parameters are FROZEN during decode, so they are baked into the
+        # compiled program as captured constants instead of being passed as
+        # ~hundreds of jit arguments — per-leaf argument processing cost
+        # ~0.5 ms/arg on slow hosts (measured 340 ms/step of pure dispatch
+        # for a 2.6 ms compute).  The price is one baked copy per bucket
+        # program; decode uses a handful of buckets.
+        param_arrays = list(self._param_arrays)
+
+        def pure(tok, t, self_k, self_v, mem_k, mem_v):
+            saved = []
+            for p, a in zip(params, param_arrays):
+                saved.append(getattr(p, "_traced_data", None))
+                p._traced_data = NDArray(a)
+            push_traced_key(jax.random.PRNGKey(0))
+            _aux_stack().append([])
+            prev = getattr(_block_tls, "tracing", 0)
+            _block_tls.tracing = prev + 1
+            try:
+                with autograd._scope(False, False):  # eval mode
+                    B = tok.shape[0]
+                    x = model.embed(NDArray(tok))._data * math.sqrt(units)
+                    x = x + lax.dynamic_slice_in_dim(
+                        jnp.asarray(pos_table), t, 1, 0).astype(x.dtype)
+                    valid = jnp.arange(bucket) <= t
+                    new_k, new_v = [], []
+                    for l, cell in enumerate(cells):
+                        h = cell.ln_self(NDArray(x))._data
+                        qkv = cell.self_attention.qkv(NDArray(h))._data
+                        qkv = qkv.reshape(B, 1, 3, H, dh)
+                        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+                        ck = lax.dynamic_update_slice(
+                            self_k[l], k.astype(self_k.dtype), (0, t, 0, 0))
+                        cv = lax.dynamic_update_slice(
+                            self_v[l], v.astype(self_v.dtype), (0, t, 0, 0))
+                        new_k.append(ck)
+                        new_v.append(cv)
+                        out = attend(q, ck, cv, valid).reshape(B, 1, units)
+                        x = x + cell.self_attention.out_proj(NDArray(out))._data
+                        h = cell.ln_cross(NDArray(x))._data
+                        q2 = cell.cross_attention.q_proj(NDArray(h))._data
+                        q2 = q2.reshape(B, 1, H, dh)
+                        S = mem_k.shape[2]
+                        out2 = attend(q2, mem_k[l], mem_v[l],
+                                      jnp.ones((S,), bool)).reshape(B, 1, units)
+                        x = x + cell.cross_attention.out_proj(NDArray(out2))._data
+                        h = cell.ln_ffn(NDArray(x))._data
+                        x = x + cell.ffn(NDArray(h))._data
+                    if model._tie:
+                        logits = jnp.einsum(
+                            "bqd,vd->bqv", x,
+                            model.embed.weight.data()._data.astype(x.dtype))
+                    else:
+                        logits = model.proj(NDArray(x))._data
+            finally:
+                _block_tls.tracing = prev
+                _aux_stack().pop()
+                pop_traced_key()
+                for p, s in zip(params, saved):
+                    p._traced_data = s
+            return logits[:, 0], jnp.stack(new_k), jnp.stack(new_v)
+
+        return jax.jit(pure, donate_argnums=(2, 3))
+
+    _CACHE_LIMIT = 8  # programs; each bakes a full parameter copy
+
+    def _step_key(self, bucket):
+        # params baked as constants → the compiled program is only valid
+        # for these exact arrays; id() changes whenever training updates them
+        return (bucket, self._self_k.shape[1], self._mem_k.shape,
+                str(self._dtype), tuple(id(a) for a in self._param_arrays))
+
+    def step(self, tok_np, t):
+        """tok_np: [B] int32 tokens at position t → logits [B, V] (numpy)."""
+        import jax.numpy as jnp
+        import numpy as np
+
+        self._grow(t + 1)
+        # compiled steps cached on the MODEL (bounded LRU: every program
+        # bakes a full parameter copy, and training invalidates the key,
+        # so an unbounded cache would pin stale parameter sets forever)
+        from collections import OrderedDict
+
+        model_cache = getattr(self._model, "_decode_step_cache", None)
+        if model_cache is None:
+            model_cache = self._model._decode_step_cache = OrderedDict()
+        key = self._step_key(self._bucket)
+        fn = model_cache.get(key)
+        if fn is None:
+            fn = self._make_step(self._bucket)
+            model_cache[key] = fn
+            while len(model_cache) > self._CACHE_LIMIT:
+                model_cache.popitem(last=False)
+        else:
+            model_cache.move_to_end(key)
+        logits, self._self_k, self._self_v = fn(
+            jnp.asarray(tok_np.reshape(-1, 1)),
+            jnp.int32(t), self._self_k, self._self_v,
+            self._mem_k, self._mem_v)
+        return np.asarray(logits)
+
+
+def _nd_wrap(arr):
+    from ...ndarray.ndarray import NDArray
+
+    return NDArray(arr)
 
 
 def _step_logits(model, tgt_padded, memory, t):
@@ -129,23 +341,36 @@ def _step_logits(model, tgt_padded, memory, t):
     return logits[:, t]
 
 
-def greedy_search(model, src, bos, eos, max_length=64):
-    """Greedy decode → (tokens [B, max_length], lengths [B])."""
+def greedy_search(model, src, bos, eos, max_length=64, use_cache=True):
+    """Greedy decode → (tokens [B, max_length], lengths [B]).
+
+    ``use_cache=True`` (default) decodes O(T) per step via the bucketed
+    KV cache; ``False`` re-runs the causal prefix (the round-2 path, kept
+    as the oracle and for post-norm decoders)."""
     import numpy as np
 
     from ... import ndarray as nd
 
     memory = model.encode(src)
     B = src.shape[0]
+    cache = None
+    if use_cache:
+        try:
+            cache = _KVCacheDecoder(model, memory, B, max_length)
+        except NotImplementedError:
+            cache = None
     tokens = np.full((B, max_length), eos, np.int32)
     tokens[:, 0] = bos
     lengths = np.full(B, max_length, np.int32)
     done = np.zeros(B, bool)
     for t in range(max_length - 1):
-        tb = _bucket(t + 1, max_length)
-        logits = _step_logits(model, nd.array(tokens[:, :tb], dtype="int32"),
-                              memory, t)
-        nxt = logits.asnumpy().argmax(axis=-1).astype(np.int32)
+        if cache is not None:
+            logits_np = cache.step(tokens[:, t], t)
+        else:
+            tb = _bucket(t + 1, max_length)
+            logits_np = _step_logits(model, nd.array(tokens[:, :tb], dtype="int32"),
+                                     memory, t).asnumpy()
+        nxt = logits_np.argmax(axis=-1).astype(np.int32)
         nxt = np.where(done, eos, nxt)
         tokens[:, t + 1] = nxt
         newly = (~done) & (nxt == eos)
@@ -156,12 +381,15 @@ def greedy_search(model, src, bos, eos, max_length=64):
     return tokens, lengths
 
 
-def beam_search(model, src, bos, eos, beam_size=4, max_length=64, alpha=0.6):
+def beam_search(model, src, bos, eos, beam_size=4, max_length=64, alpha=0.6,
+                use_cache=True):
     """Length-penalized beam search (GNMT penalty ((5+len)/6)^alpha).
 
     Returns (tokens [B, K, max_length], scores [B, K]) sorted best-first.
-    The per-step network call is one jitted decode over [B·K, Tb]; beam
-    bookkeeping is host-side numpy (cheap: K·V topk per step).
+    The per-step network call is one jitted decode over the [B·K] beam
+    batch (O(T) per step through the KV cache; beam reorders permute the
+    cache batch axis); beam bookkeeping is host-side numpy (cheap: K·V
+    topk per step).
     """
     import numpy as np
 
@@ -170,6 +398,12 @@ def beam_search(model, src, bos, eos, beam_size=4, max_length=64, alpha=0.6):
     memory = model.encode(src)          # [B, S, D]
     B, K = src.shape[0], beam_size
     mem = nd.array(np.repeat(memory.asnumpy(), K, axis=0))  # [B·K, S, D]
+    cache = None
+    if use_cache:
+        try:
+            cache = _KVCacheDecoder(model, mem, B * K, max_length)
+        except NotImplementedError:
+            cache = None
 
     tokens = np.full((B, K, max_length), eos, np.int32)
     tokens[:, :, 0] = bos
@@ -178,10 +412,14 @@ def beam_search(model, src, bos, eos, beam_size=4, max_length=64, alpha=0.6):
     done = np.zeros((B, K), bool)
 
     for t in range(max_length - 1):
-        tb = _bucket(t + 1, max_length)
-        flat = tokens[:, :, :tb].reshape(B * K, tb)
-        logits = _step_logits(model, nd.array(flat, dtype="int32"), mem, t)
-        logp = _log_softmax_np(logits.asnumpy().astype(np.float64))  # [B·K, V]
+        if cache is not None:
+            logits_np = cache.step(tokens[:, :, t].reshape(B * K), t)
+        else:
+            tb = _bucket(t + 1, max_length)
+            flat = tokens[:, :, :tb].reshape(B * K, tb)
+            logits_np = _step_logits(model, nd.array(flat, dtype="int32"),
+                                     mem, t).asnumpy()
+        logp = _log_softmax_np(logits_np.astype(np.float64))  # [B·K, V]
         V = logp.shape[-1]
         logp = logp.reshape(B, K, V)
         # finished beams only extend with eos at zero cost
@@ -200,6 +438,10 @@ def beam_search(model, src, bos, eos, beam_size=4, max_length=64, alpha=0.6):
         tokens[:, :, t + 1] = nxt_tok
         done = np.take_along_axis(done, src_beam, axis=1) | (nxt_tok == eos)
         scores = new_scores
+        if cache is not None:
+            # permute the cache batch to follow the surviving beams
+            flat_src = (np.arange(B)[:, None] * K + src_beam).reshape(-1)
+            cache.reorder(flat_src)
         if done.all():
             break
 

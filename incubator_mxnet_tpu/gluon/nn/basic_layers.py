@@ -367,8 +367,11 @@ class InstanceNorm(HybridBlock):
 
 
 class Embedding(HybridBlock):
-    """Parity: ``nn.Embedding`` (gather from the table; ``sparse_grad`` is
-    accepted but dense on TPU — documented divergence)."""
+    """Parity: ``nn.Embedding``.  ``sparse_grad=True`` marks the weight
+    ``row_sparse`` — gradients are stored densely on TPU (static shapes),
+    but SGD/Adam then apply the reference's LAZY row semantics: rows not
+    touched by a batch skip momentum decay / weight decay entirely
+    (ops/optimizer_ops.py ``*_lazy_update``)."""
 
     def __init__(self, input_dim, output_dim, dtype="float32", weight_initializer=None,
                  sparse_grad=False, prefix=None, params=None):
@@ -377,7 +380,8 @@ class Embedding(HybridBlock):
         self._output_dim = output_dim
         with self.name_scope():
             self.weight = self.params.get(
-                "weight", shape=(input_dim, output_dim), dtype=dtype, init=weight_initializer
+                "weight", shape=(input_dim, output_dim), dtype=dtype, init=weight_initializer,
+                stype="row_sparse" if sparse_grad else "default",
             )
 
     def hybrid_forward(self, F, x, weight):

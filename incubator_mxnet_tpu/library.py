@@ -1,0 +1,72 @@
+"""``mx.library`` — load external operator libraries.
+
+Parity: [U:python/mxnet/library.py] ``load()`` → ``MXLoadLib``
+([U:include/mxnet/lib_api.h]): the reference dlopens a user .so with a
+stable ABI and registers its ops into the NNVM registry.  TPU-native
+equivalent: the library exports **XLA FFI handlers** (the stable custom-
+call ABI that XLA itself defines — see native/mxtpu_custom_op.cpp for the
+authoring side) plus a ``mxtpu_op_list()`` manifest; ``load()`` registers
+each handler with ``jax.ffi`` and exposes the op through the normal op
+registry, so ``mx.nd.<name>`` and jitted graphs reach it like any
+built-in operator.
+
+Contract v1: elementwise f32 — one buffer in, one buffer out, same shape
+(covers the reference's lib_custom_op examples; richer signatures can
+register explicit shape functions later).
+"""
+from __future__ import annotations
+
+import ctypes
+
+__all__ = ["load", "loaded_ops"]
+
+_LOADED = {}
+
+
+def load(path, verbose=True):
+    """Load an external op library; returns the list of registered op
+    names."""
+    import jax
+
+    from .ops.registry import register
+
+    lib = ctypes.CDLL(path)
+    lib.mxtpu_op_list.restype = ctypes.c_char_p
+    manifest = lib.mxtpu_op_list().decode("utf-8")
+    names = []
+    for pair in manifest.split(";"):
+        if not pair:
+            continue
+        opname, symbol = pair.split("=")
+        if opname in _LOADED:  # idempotent reload (same ABI contract)
+            names.append(opname)
+            continue
+        handler = getattr(lib, symbol)
+        target = f"mxtpu.{opname}"
+        jax.ffi.register_ffi_target(target, jax.ffi.pycapsule(handler),
+                                    platform="cpu")
+
+        def make_fn(tgt):
+            def fn(data):
+                import jax as _jax
+                import jax.numpy as jnp
+
+                x = jnp.asarray(data, jnp.float32)
+                call = _jax.ffi.ffi_call(
+                    tgt, _jax.ShapeDtypeStruct(x.shape, x.dtype))
+                return call(x)
+
+            return fn
+
+        # ffi_call has no differentiation rule: register non-differentiable
+        # so autograd gives the framework's clean error, not a raw JAX one
+        register(opname, differentiable=False)(make_fn(target))
+        _LOADED[opname] = path
+        names.append(opname)
+    if verbose:
+        print(f"loaded library {path}: ops {names}")
+    return names
+
+
+def loaded_ops():
+    return dict(_LOADED)

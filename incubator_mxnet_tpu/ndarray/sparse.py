@@ -9,6 +9,13 @@ compute either stays structured (``sparse.dot`` via segment-sum /
 gather-matmul, ``retain``) or densifies explicitly (``tostype('default')``).
 The number of stored rows/nonzeros is static per array instance, which is
 exactly the contract jit needs.
+
+The optimizer side of row_sparse — the reference's LAZY per-row
+sgd_mom/adam updates for embedding-style parameters — lives in
+ops/optimizer_ops.py (``sgd_mom_lazy_update``/``adam_lazy_update``,
+row-masked with static shapes) and activates through ``Parameter(stype=
+'row_sparse')`` / ``nn.Embedding(sparse_grad=True)`` via the Trainer's
+param_dict (tests/test_sparse.py::TestRowSparseLazyUpdate).
 """
 from __future__ import annotations
 

@@ -32,6 +32,57 @@ def sgd_mom_update(weight, grad, mom, lr, wd, rescale, clip, momentum):
     return (weight.astype(jnp.float32) + new_mom).astype(weight.dtype), new_mom
 
 
+def _row_active(grad):
+    """Mask of rows touched by the gradient — the TPU-native stand-in for
+    the reference's row_sparse index list ([U:src/operator/optimizer_op.cc]
+    sparse variants): lazy SEMANTICS (untouched rows skip state decay /
+    wd), dense compute (static shapes, no gather of dynamic row sets)."""
+    active = jnp.any(grad != 0, axis=tuple(range(1, grad.ndim)))
+    return active.reshape((-1,) + (1,) * (grad.ndim - 1))
+
+
+@jax.jit
+def sgd_lazy_update(weight, grad, lr, wd, rescale, clip):
+    a = _row_active(grad)
+    g = _prep(grad, rescale, clip, wd, weight)
+    new_w = (weight.astype(jnp.float32) - lr * g).astype(weight.dtype)
+    return jnp.where(a, new_w, weight)
+
+
+@jax.jit
+def mp_sgd_mom_lazy_update(weight, grad, mom, weight32, lr, wd, rescale, clip, momentum):
+    a = _row_active(grad)
+    g = jnp.clip(grad.astype(jnp.float32) * rescale, -clip, clip) + wd * weight32
+    new_mom = momentum * mom - lr * g
+    new_w32 = weight32 + new_mom
+    return (jnp.where(a, new_w32.astype(weight.dtype), weight),
+            jnp.where(a, new_mom, mom), jnp.where(a, new_w32, weight32))
+
+
+@jax.jit
+def sgd_mom_lazy_update(weight, grad, mom, lr, wd, rescale, clip, momentum):
+    a = _row_active(grad)
+    g = _prep(grad, rescale, clip, wd, weight)
+    new_mom = momentum * mom - lr * g
+    new_w = (weight.astype(jnp.float32) + new_mom).astype(weight.dtype)
+    return jnp.where(a, new_w, weight), jnp.where(a, new_mom, mom)
+
+
+@jax.jit
+def adam_lazy_update(weight, grad, mean, var, lr, wd, rescale, clip, beta1, beta2, eps, t):
+    a = _row_active(grad)
+    g = _prep(grad, rescale, clip, wd, weight)
+    new_mean = beta1 * mean + (1 - beta1) * g
+    new_var = beta2 * var + (1 - beta2) * jnp.square(g)
+    coef1 = 1 - beta1 ** t
+    coef2 = 1 - beta2 ** t
+    lr_t = lr * jnp.sqrt(coef2) / coef1
+    upd = lr_t * new_mean / (jnp.sqrt(new_var) + eps)
+    new_w = (weight.astype(jnp.float32) - upd).astype(weight.dtype)
+    return (jnp.where(a, new_w, weight), jnp.where(a, new_mean, mean),
+            jnp.where(a, new_var, var))
+
+
 @jax.jit
 def nag_mom_update(weight, grad, mom, lr, wd, rescale, clip, momentum):
     g = _prep(grad, rescale, clip, wd, weight)

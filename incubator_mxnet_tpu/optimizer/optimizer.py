@@ -173,9 +173,16 @@ class SGD(Optimizer):
     """SGD with momentum + optional multi-precision
     (parity: sgd_update/sgd_mom_update/mp_sgd_mom_update)."""
 
-    def __init__(self, momentum=0.0, lazy_update=False, **kwargs):
+    def __init__(self, momentum=0.0, lazy_update=True, **kwargs):
+        # lazy_update default True as in the reference: it only changes
+        # behavior for row_sparse parameters (per-row lazy state updates)
         super().__init__(**kwargs)
         self.momentum = momentum
+        self.lazy_update = lazy_update
+
+    def _lazy_for(self, index):
+        p = self.param_dict.get(index)
+        return self.lazy_update and getattr(p, "stype", "default") == "row_sparse"
 
     def create_state(self, index, weight):
         if self.momentum == 0.0:
@@ -186,14 +193,16 @@ class SGD(Optimizer):
         self._update_count(index)
         lr, wd = self._get_lr(index), self._get_wd(index)
         if state is None:
+            kernel = K.sgd_lazy_update if self._lazy_for(index) else K.sgd_update
             _swap(
                 weight,
-                K.sgd_update(
+                kernel(
                     weight._data, grad._data, _f32(lr), _f32(wd), _f32(self.rescale_grad), _f32(self.clip_gradient)
                 ),
             )
         else:
-            new_w, new_mom = K.sgd_mom_update(
+            kernel = K.sgd_mom_lazy_update if self._lazy_for(index) else K.sgd_mom_update
+            new_w, new_mom = kernel(
                 weight._data,
                 grad._data,
                 state._data,
@@ -211,7 +220,9 @@ class SGD(Optimizer):
             mom, w32 = state
             self._update_count(index)
             lr, wd = self._get_lr(index), self._get_wd(index)
-            new_w, new_mom, new_w32 = K.mp_sgd_mom_update(
+            mp_kernel = (K.mp_sgd_mom_lazy_update if self._lazy_for(index)
+                         else K.mp_sgd_mom_update)
+            new_w, new_mom, new_w32 = mp_kernel(
                 weight._data,
                 grad._data,
                 mom._data,
@@ -267,9 +278,15 @@ class NAG(Optimizer):
 
 @register
 class Adam(Optimizer):
-    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8, **kwargs):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 lazy_update=True, **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)
         self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self.lazy_update = lazy_update
+
+    def _lazy_for(self, index):
+        p = self.param_dict.get(index)
+        return self.lazy_update and getattr(p, "stype", "default") == "row_sparse"
 
     def create_state(self, index, weight):
         return (
@@ -282,7 +299,8 @@ class Adam(Optimizer):
         lr, wd = self._get_lr(index), self._get_wd(index)
         t = self._index_update_count[index]
         mean, var = state
-        new_w, new_mean, new_var = K.adam_update(
+        kernel = K.adam_lazy_update if self._lazy_for(index) else K.adam_update
+        new_w, new_mean, new_var = kernel(
             weight._data,
             grad._data,
             mean._data,

@@ -39,6 +39,7 @@ from .sharding import (
 )
 from .trainer import SPMDTrainer
 from .ring import ring_attention, ring_attention_sharded
+from .pipeline import pipeline_apply, stack_stage_params
 
 __all__ = [
     "MeshConfig",
@@ -54,6 +55,8 @@ __all__ = [
     "shard_array",
     "replicate",
     "SPMDTrainer",
+    "pipeline_apply",
+    "stack_stage_params",
     "ring_attention",
     "ring_attention_sharded",
 ]

@@ -1,0 +1,86 @@
+"""``mx.predictor`` — standalone inference API.
+
+Parity: [U:src/c_api/c_predict_api.cc] (``MXPredCreate`` / SetInput /
+Forward / GetOutput) — the embedding-oriented predict surface that loads a
+``-symbol.json`` + ``.params`` checkpoint and runs forward-only.  Here the
+bound program is one ``jax.jit``-compiled XLA executable (donated inputs,
+no autograd machinery), the deployment analog of ``Block.export``.
+"""
+from __future__ import annotations
+
+import json as _json
+
+import numpy as _np
+
+__all__ = ["Predictor"]
+
+
+class Predictor:
+    """forward-only executor over (symbol json, params file).
+
+    Parameters
+    ----------
+    symbol_file : path to ``*-symbol.json`` (or a Symbol instance)
+    param_file : path to ``.params``/``.npz`` (or a dict of NDArrays)
+    input_shapes : dict name -> shape
+    """
+
+    def __init__(self, symbol_file, param_file, input_shapes, dev_type="cpu",
+                 dev_id=0):
+        from . import context as ctx_mod
+        from . import symbol as sym_mod
+        from .ndarray import utils as nd_utils
+
+        if isinstance(symbol_file, str):
+            self._sym = sym_mod.load(symbol_file)
+        else:
+            self._sym = symbol_file
+        if isinstance(param_file, str):
+            loaded = nd_utils.load(param_file)
+        else:
+            loaded = param_file
+        self._params = {}
+        for k, v in loaded.items():
+            name = k.split(":", 1)[1] if ":" in k else k
+            self._params[name] = v
+        self._input_shapes = dict(input_shapes)
+        self._ctx = ctx_mod.Context(dev_type, dev_id)
+        self._inputs = {k: None for k in input_shapes}
+        self._outputs = None
+        self._exe = self._bind()
+
+    def _bind(self):
+        exe = self._sym.simple_bind(**self._input_shapes)
+        for name, arr in self._params.items():
+            if name in exe.arg_dict:
+                exe.arg_dict[name][:] = arr
+            elif name in exe.aux_dict:
+                exe.aux_dict[name][:] = arr
+        return exe
+
+    # -- c_predict-style surface ----------------------------------------
+    def set_input(self, name, value):
+        """``MXPredSetInput``."""
+        from .ndarray.ndarray import array
+
+        if name not in self._input_shapes:
+            raise KeyError(f"unknown input {name!r}")
+        self._exe.arg_dict[name][:] = _np.asarray(
+            value.asnumpy() if hasattr(value, "asnumpy") else value)
+
+    def forward(self):
+        """``MXPredForward`` — runs the compiled program (is_train=False)."""
+        self._outputs = self._exe.forward(is_train=False)
+        return self
+
+    def get_output(self, index=0):
+        """``MXPredGetOutput`` — numpy copy of output ``index``."""
+        if self._outputs is None:
+            raise RuntimeError("call forward() first")
+        return self._outputs[index].asnumpy()
+
+    def predict(self, **inputs):
+        """Convenience: set all inputs, forward, return output 0."""
+        for k, v in inputs.items():
+            self.set_input(k, v)
+        return self.forward().get_output(0)

@@ -1,0 +1,55 @@
+// Example external operator library — the TPU-native analog of the
+// reference's lib_api custom-op libraries ([U:include/mxnet/lib_api.h],
+// [U:example/extensions/lib_custom_op/]).  Ops are XLA FFI handlers; the
+// loader (incubator_mxnet_tpu.library.load) dlopens this .so, reads the
+// manifest from mxtpu_op_list(), registers each handler with
+// jax.ffi.register_ffi_target, and exposes the op through the normal
+// registry so `mx.nd.<name>` reaches it.
+//
+// Contract v1 (documented in library.py): elementwise f32 ops —
+// one f32 buffer in, one f32 buffer out, same shape.
+//
+// Build: make -C native libmxtpu_custom_op.so
+//   (needs the XLA FFI headers bundled with jaxlib: make
+//    XLA_FFI_INCLUDE=$(python -c 'import jax.ffi; print(jax.ffi.include_dir())'))
+
+#include <cmath>
+#include <cstdint>
+
+#include "xla/ffi/api/ffi.h"
+
+namespace ffi = xla::ffi;
+
+static ffi::Error SquareImpl(ffi::Buffer<ffi::F32> x,
+                             ffi::ResultBuffer<ffi::F32> y) {
+  const float* in = x.typed_data();
+  float* out = y->typed_data();
+  const size_t n = x.element_count();
+  for (size_t i = 0; i < n; ++i) out[i] = in[i] * in[i];
+  return ffi::Error::Success();
+}
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(
+    mxtpu_square_handler, SquareImpl,
+    ffi::Ffi::Bind().Arg<ffi::Buffer<ffi::F32>>().Ret<ffi::Buffer<ffi::F32>>());
+
+static ffi::Error SoftSignImpl(ffi::Buffer<ffi::F32> x,
+                               ffi::ResultBuffer<ffi::F32> y) {
+  const float* in = x.typed_data();
+  float* out = y->typed_data();
+  const size_t n = x.element_count();
+  for (size_t i = 0; i < n; ++i) out[i] = in[i] / (1.0f + std::fabs(in[i]));
+  return ffi::Error::Success();
+}
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(
+    mxtpu_softsign_handler, SoftSignImpl,
+    ffi::Ffi::Bind().Arg<ffi::Buffer<ffi::F32>>().Ret<ffi::Buffer<ffi::F32>>());
+
+extern "C" {
+// Manifest: "opname=handler_symbol" pairs, ';'-separated.  The loader
+// resolves each handler symbol via dlsym and registers it.
+const char* mxtpu_op_list() {
+  return "ext_square=mxtpu_square_handler;ext_softsign=mxtpu_softsign_handler";
+}
+}

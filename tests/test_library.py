@@ -1,0 +1,49 @@
+"""External op libraries over XLA FFI (lib_api parity:
+[U:example/extensions/lib_custom_op/] loaded via mx.library.load)."""
+import os
+import subprocess
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LIB = os.path.join(ROOT, "native", "libmxtpu_custom_op.so")
+
+
+@pytest.fixture(scope="module")
+def custom_lib():
+    if not os.path.exists(LIB):
+        r = subprocess.run(["make", "-C", os.path.join(ROOT, "native"),
+                            "libmxtpu_custom_op.so"], capture_output=True, text=True)
+        if r.returncode != 0:
+            pytest.skip(f"cannot build custom op lib: {r.stderr[-300:]}")
+    return LIB
+
+
+def test_load_and_dispatch(custom_lib):
+    names = mx.library.load(custom_lib, verbose=False)
+    assert set(names) >= {"ext_square", "ext_softsign"}
+    x = mx.nd.array(np.array([-2.0, 0.5, 3.0], np.float32))
+    np.testing.assert_allclose(mx.nd.ext_square(x).asnumpy(), [4.0, 0.25, 9.0])
+    np.testing.assert_allclose(
+        mx.nd.ext_softsign(x).asnumpy(),
+        [-2 / 3, 0.5 / 1.5, 3 / 4], rtol=1e-6)
+
+
+def test_works_under_jit(custom_lib):
+    import jax
+    import jax.numpy as jnp
+
+    mx.library.load(custom_lib, verbose=False)
+    from incubator_mxnet_tpu.ops.registry import get_op
+
+    fn = get_op("ext_square").fn
+
+    @jax.jit
+    def f(x):
+        return fn(x) + 1.0
+
+    out = f(jnp.asarray([3.0], jnp.float32))
+    np.testing.assert_allclose(np.asarray(out), [10.0])

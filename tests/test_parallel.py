@@ -211,3 +211,69 @@ class TestRingAttention:
 
         out = f(q)
         assert out.shape == (B, H, S, D)
+
+
+class TestPipelineParallel:
+    """GPipe-style pipeline over the 'pp' axis (capability absent in the
+    reference; 'pp' mesh axis finally exercised)."""
+
+    def _setup(self, pp=4, dp=1):
+        import jax.numpy as jnp
+        from incubator_mxnet_tpu.parallel import (make_mesh, pipeline_apply,
+                                                  stack_stage_params)
+
+        mesh = make_mesh(pp=pp)
+        rng = np.random.RandomState(0)
+        D = 8
+        stages = [
+            {"w": jnp.asarray(rng.randn(D, D).astype(np.float32) * 0.3),
+             "b": jnp.asarray(rng.randn(D).astype(np.float32) * 0.1)}
+            for _ in range(pp)
+        ]
+        params = stack_stage_params(stages, mesh)
+        x = jnp.asarray(rng.randn(16, D).astype(np.float32))
+
+        def stage_fn(p, h):
+            import jax
+            return jax.nn.tanh(h @ p["w"] + p["b"])
+
+        return mesh, stages, params, x, stage_fn, pipeline_apply
+
+    def test_matches_sequential(self):
+        import jax
+        mesh, stages, params, x, stage_fn, pipeline_apply = self._setup()
+        out = pipeline_apply(stage_fn, params, x, mesh, n_microbatches=4)
+        ref = x
+        for s in stages:
+            ref = stage_fn(s, ref)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_gradients_flow_through_pipeline(self):
+        import jax
+        import jax.numpy as jnp
+        mesh, stages, params, x, stage_fn, pipeline_apply = self._setup()
+
+        def loss_pipe(p, x):
+            return (pipeline_apply(stage_fn, p, x, mesh, n_microbatches=4) ** 2).sum()
+
+        def loss_seq(stage_list, x):
+            h = x
+            for s in stage_list:
+                h = stage_fn(s, h)
+            return (h ** 2).sum()
+
+        g_pipe = jax.grad(loss_pipe)(params, x)
+        g_seq = jax.grad(loss_seq)(stages, x)
+        for i in range(len(stages)):
+            np.testing.assert_allclose(np.asarray(g_pipe["w"][i]),
+                                       np.asarray(g_seq[i]["w"]),
+                                       rtol=1e-4, atol=1e-5)
+
+    def test_jit_compiles_once(self):
+        import jax
+        mesh, stages, params, x, stage_fn, pipeline_apply = self._setup(pp=2)
+        fn = jax.jit(lambda p, x: pipeline_apply(stage_fn, p, x, mesh, 4))
+        o1 = fn(params, x)
+        o2 = fn(params, x)
+        np.testing.assert_allclose(np.asarray(o1), np.asarray(o2))

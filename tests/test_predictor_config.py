@@ -1,0 +1,58 @@
+"""Predictor (c_predict parity) + mx.config env surface."""
+import numpy as np
+
+import incubator_mxnet_tpu as mx
+import incubator_mxnet_tpu.symbol as S
+from incubator_mxnet_tpu.predictor import Predictor
+
+
+class TestPredictor:
+    def _checkpoint(self, tmp_path):
+        S.symbol._reset_naming()
+        data = S.var("data")
+        fc = S.FullyConnected(data, num_hidden=4, name="fc1")
+        sym = S.Activation(fc, act_type="tanh", name="t1")
+        rng = np.random.RandomState(0)
+        shapes, _, _ = sym.infer_shape(data=(2, 3))
+        params = {}
+        for name, shp in zip(sym.list_arguments(), shapes):
+            if name != "data":
+                params["arg:" + name] = mx.nd.array(rng.randn(*shp).astype(np.float32))
+        sym_path = str(tmp_path / "m-symbol.json")
+        with open(sym_path, "w") as f:
+            f.write(sym.tojson())
+        par_path = str(tmp_path / "m-0000.params")
+        mx.nd.save(par_path, params)
+        return sym, params, sym_path, par_path
+
+    def test_predict_matches_bind(self, tmp_path):
+        sym, params, sym_path, par_path = self._checkpoint(tmp_path)
+        pred = Predictor(sym_path, par_path, {"data": (2, 3)})
+        x = np.random.RandomState(1).rand(2, 3).astype(np.float32)
+        out = pred.predict(data=x)
+
+        exe = sym.simple_bind(data=(2, 3))
+        exe.arg_dict["data"][:] = x
+        for k, v in params.items():
+            exe.arg_dict[k.split(":", 1)[1]][:] = v.asnumpy()
+        ref = exe.forward(is_train=False)[0].asnumpy()
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+    def test_set_input_forward_get_output(self, tmp_path):
+        _, _, sym_path, par_path = self._checkpoint(tmp_path)
+        pred = Predictor(sym_path, par_path, {"data": (2, 3)})
+        pred.set_input("data", np.ones((2, 3), np.float32))
+        pred.forward()
+        assert pred.get_output(0).shape == (2, 4)
+
+
+class TestConfig:
+    def test_describe_lists_vars(self):
+        s = mx.config.describe()
+        assert "MXNET_ENGINE_TYPE" in s and "MXNET_TPU_FLASH" in s
+
+    def test_memory_info_shape(self):
+        info = mx.config.memory_info()
+        assert isinstance(info, dict) and len(info) >= 1
+        first = next(iter(info.values()))
+        assert set(first) == {"bytes_in_use", "peak_bytes_in_use", "bytes_limit"}

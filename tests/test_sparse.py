@@ -104,3 +104,84 @@ class TestCSR:
         out = sparse.dot(cs, mx.nd.array(w), transpose_b=True)
         assert out.shape == (5, 3)
         np.testing.assert_allclose(out.asnumpy(), d @ w.T, rtol=1e-5, atol=1e-5)
+
+
+class TestRowSparseLazyUpdate:
+    """Lazy row_sparse optimizer semantics (parity:
+    [U:src/operator/optimizer_op.cc] sparse sgd_mom/adam): rows untouched
+    by a batch skip momentum decay and weight decay entirely."""
+
+    def _embed_net(self, sparse_grad):
+        from incubator_mxnet_tpu import gluon
+
+        mx.random.seed(0)
+        net = gluon.nn.Embedding(10, 4, sparse_grad=sparse_grad)
+        net.initialize()
+        net(mx.nd.array([[0]], dtype="int32"))  # materialize
+        return net
+
+    def _one_step(self, net, trainer, rows):
+        from incubator_mxnet_tpu import autograd
+
+        with autograd.record():
+            out = net(mx.nd.array([rows], dtype="int32"))
+            loss = (out * out).sum()
+        loss.backward()
+        trainer.step(1)
+
+    def test_sgd_momentum_skips_untouched_rows(self):
+        from incubator_mxnet_tpu import gluon
+
+        net = self._embed_net(sparse_grad=True)
+        assert net.weight.stype == "row_sparse"
+        trainer = gluon.Trainer(net.collect_params(), "sgd",
+                                {"learning_rate": 0.1, "momentum": 0.9, "wd": 0.01})
+        self._one_step(net, trainer, [1, 3])   # builds momentum on rows 1,3
+        w_after1 = net.weight.data().asnumpy().copy()
+        self._one_step(net, trainer, [2])      # touches only row 2
+        w_after2 = net.weight.data().asnumpy()
+        # rows 1,3 carry momentum but were NOT touched: lazy keeps them fixed
+        np.testing.assert_array_equal(w_after2[1], w_after1[1])
+        np.testing.assert_array_equal(w_after2[3], w_after1[3])
+        assert np.abs(w_after2[2] - w_after1[2]).max() > 0
+
+    def test_dense_counterpart_does_update_untouched_rows(self):
+        from incubator_mxnet_tpu import gluon
+
+        net = self._embed_net(sparse_grad=False)
+        trainer = gluon.Trainer(net.collect_params(), "sgd",
+                                {"learning_rate": 0.1, "momentum": 0.9, "wd": 0.01})
+        self._one_step(net, trainer, [1, 3])
+        w_after1 = net.weight.data().asnumpy().copy()
+        self._one_step(net, trainer, [2])
+        w_after2 = net.weight.data().asnumpy()
+        # dense momentum+wd keep moving rows 1,3 even with zero grad
+        assert np.abs(w_after2[1] - w_after1[1]).max() > 0
+
+    def test_adam_lazy_state(self):
+        from incubator_mxnet_tpu import gluon
+
+        net = self._embed_net(sparse_grad=True)
+        trainer = gluon.Trainer(net.collect_params(), "adam",
+                                {"learning_rate": 0.01})
+        self._one_step(net, trainer, [1, 3])
+        w_after1 = net.weight.data().asnumpy().copy()
+        self._one_step(net, trainer, [2])
+        w_after2 = net.weight.data().asnumpy()
+        np.testing.assert_array_equal(w_after2[1], w_after1[1])
+        assert np.abs(w_after2[2] - w_after1[2]).max() > 0
+
+    def test_sgd_no_momentum_skips_wd_on_untouched_rows(self):
+        """The review-caught gap: plain SGD (momentum=0) with weight decay
+        must also honor lazy semantics for row_sparse params."""
+        from incubator_mxnet_tpu import gluon
+
+        net = self._embed_net(sparse_grad=True)
+        trainer = gluon.Trainer(net.collect_params(), "sgd",
+                                {"learning_rate": 0.1, "wd": 0.1})
+        self._one_step(net, trainer, [1])
+        w1 = net.weight.data().asnumpy().copy()
+        self._one_step(net, trainer, [2])
+        w2 = net.weight.data().asnumpy()
+        np.testing.assert_array_equal(w2[1], w1[1])  # no wd decay on row 1
+        assert np.abs(w2[2] - w1[2]).max() > 0
