@@ -1,0 +1,46 @@
+#!/usr/bin/env python
+"""Multi-process data-parallel training ([U:example/image-classification/]
+`--kv-store dist_sync` analog).  Launch with:
+
+    python tools/launch_local.py -n 2 python example/dist_train.py
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def main():
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import gluon
+    from incubator_mxnet_tpu.ndarray.ndarray import NDArray
+    from incubator_mxnet_tpu.ops.nn import streaming_softmax_ce
+    from incubator_mxnet_tpu.parallel import make_mesh, SPMDTrainer
+
+    kv = mx.kv.create("dist_sync")
+    rank, nw = kv.rank, kv.num_workers
+
+    mx.random.seed(0)
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(64, activation="relu"), gluon.nn.Dense(10))
+    net.initialize()
+    net(mx.nd.zeros((2, 32)))
+
+    def loss_fn(out, label):
+        logits = out._data if hasattr(out, "_data") else out[0]._data
+        return NDArray(streaming_softmax_ce(logits, label._data))
+
+    trainer = SPMDTrainer(net, loss_fn, "sgd", {"learning_rate": 0.1},
+                          mesh=make_mesh())
+    rng = np.random.RandomState(100 + rank)  # each worker's LOCAL shard
+    for step in range(20):
+        x = rng.rand(64, 32).astype(np.float32)
+        y = rng.randint(0, 10, (64,)).astype(np.int32)
+        loss = trainer.step(*trainer.shard_batch(x, y))
+    print(f"worker {rank}/{nw} final loss {float(np.asarray(loss._data)):.4f}")
+
+
+if __name__ == "__main__":
+    main()

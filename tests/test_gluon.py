@@ -262,3 +262,28 @@ def test_activations_block():
     assert_almost_equal(prelu(x), np.array([[-0.25, 0.0, 1.0]]), rtol=1e-5, atol=1e-6)
     selu = nn.SELU()(x).asnumpy()
     assert selu[0, 2] == pytest.approx(1.0507, rel=1e-3)
+
+
+class _Squares:
+    """Module-level so spawn workers can pickle it."""
+
+    def __len__(self):
+        return 12
+
+    def __getitem__(self, i):
+        return np.full((3,), i * i, np.float32), np.int32(i)
+
+
+def test_dataloader_process_workers():
+    """Process-worker path (the reference's default worker model): spawn
+    workers return numpy batches the parent re-wraps; order preserved."""
+    from incubator_mxnet_tpu.gluon.data import dataloader as dl_mod
+
+    loader = dl_mod.DataLoader(_Squares(), batch_size=4, num_workers=1)
+    seen = []
+    for data, label in loader:
+        assert data.shape == (4, 3)
+        seen.extend(label.asnumpy().tolist())
+    assert seen == list(range(12))
+    # second epoch reuses the pool
+    assert sum(1 for _ in loader) == 3
