@@ -264,10 +264,43 @@ def import_model(model_file):
         op = node["op_type"]
         nm = node["name"] or node["output"][0]
         if op == "Gemm":
-            x, w = env[node["input"][0]], env[node["input"][1]]
-            b = env[node["input"][2]] if len(node["input"]) > 2 else None
-            num_hidden = inits[node["input"][1]].shape[0]
-            out = sym_mod.FullyConnected(x, w, b, num_hidden=num_hidden,
+            x = env[node["input"][0]]
+            w_name = node["input"][1]
+            # foreign models may use transB=0 / alpha≠1: normalize to
+            # FullyConnected's (out, in)·α convention — under a FRESH
+            # per-node name, never by mutating the shared initializer
+            # (it may feed other nodes, e.g. tied embeddings)
+            if _get_attr(node, "transA", 0):
+                raise NotImplementedError("Gemm with transA=1")
+            if w_name not in inits:
+                raise NotImplementedError("Gemm weight must be an initializer")
+            alpha = _get_attr(node, "alpha", 1.0)
+            beta = _get_attr(node, "beta", 1.0)
+            w_arr = inits[w_name]
+            if not _get_attr(node, "transB", 0):
+                w_arr = _np.ascontiguousarray(w_arr.T)
+            if alpha != 1.0:
+                w_arr = w_arr * alpha
+            w_key = w_name
+            if w_arr is not inits[w_name]:
+                w_key = f"{nm}_weight_norm"
+                inits[w_key] = w_arr
+                env[w_key] = S.var(w_key)
+            b = None
+            if len(node["input"]) > 2:
+                b_name = node["input"][2]
+                if beta != 1.0:
+                    if b_name not in inits:
+                        raise NotImplementedError(
+                            "Gemm beta!=1 with non-initializer bias input")
+                    b_key = f"{nm}_bias_norm"
+                    inits[b_key] = inits[b_name] * beta
+                    env[b_key] = S.var(b_key)
+                else:
+                    b_key = b_name
+                b = env[b_key]
+            out = sym_mod.FullyConnected(x, env[w_key], b,
+                                         num_hidden=w_arr.shape[0],
                                          no_bias=b is None, flatten=False,
                                          name=nm)
         elif op == "Flatten":

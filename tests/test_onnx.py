@@ -112,3 +112,36 @@ class TestOnnxRoundtrip:
         assert proc.returncode == 0, proc.stderr[-500:]
         # field 7 = GraphProto must appear in the decode
         assert "7 {" in proc.stdout
+
+
+def test_import_foreign_gemm_transB0(tmp_path):
+    """Foreign models use Gemm(transB=0, alpha): the importer must
+    normalize the weight to FullyConnected's (out, in) convention."""
+    from incubator_mxnet_tpu.contrib.onnx import _proto as P
+
+    rng = np.random.RandomState(3)
+    w = rng.randn(5, 4).astype(np.float32)   # (in=5, out=4): transB=0 layout
+    b = rng.randn(4).astype(np.float32)
+    model = {"ir_version": 8, "opset": 13, "graph": {
+        "name": "g", "node": [{
+            "op_type": "Gemm", "name": "g1", "input": ["data", "W", "B"],
+            "output": ["y"],
+            "attribute": [
+                {"name": "alpha", "type": P.ATTR_FLOAT, "f": 2.0},
+                {"name": "beta", "type": P.ATTR_FLOAT, "f": 0.5},
+                {"name": "transB", "type": P.ATTR_INT, "i": 0},
+            ]}],
+        "initializer": [
+            {"name": "W", "dims": w.shape, "data_type": P.TP_FLOAT, "raw": w.tobytes()},
+            {"name": "B", "dims": b.shape, "data_type": P.TP_FLOAT, "raw": b.tobytes()},
+        ],
+        "input": [{"name": "data", "elem_type": P.TP_FLOAT, "shape": (2, 5)}],
+        "output": [{"name": "y", "elem_type": P.TP_FLOAT, "shape": ()}],
+    }}
+    f = str(tmp_path / "foreign.onnx")
+    with open(f, "wb") as fh:
+        fh.write(P.enc_model(model))
+    sym, args, aux = onnx_mxnet.import_model(f)
+    x = np.random.RandomState(4).rand(2, 5).astype(np.float32)
+    out = _bind_forward(sym, args, x)
+    np.testing.assert_allclose(out, 2.0 * (x @ w) + 0.5 * b, rtol=1e-5, atol=1e-6)
