@@ -29,7 +29,139 @@ import os
 import signal
 import threading
 
-__all__ = ["CheckpointManager"]
+__all__ = ["CheckpointManager", "save_sharded", "restore_sharded"]
+
+
+# ---------------------------------------------------------------------------
+# Sharded checkpoint (SURVEY §5 "orbax-style sharded async checkpoint"):
+# every process writes ONLY its addressable shards — no global gather, no
+# O(model) host memory on any single host.  Layout:
+#   {prefix}-{step:07d}.shard{proc}.npz   (this process's shard data)
+#   {prefix}-{step:07d}.shmeta            (json: shapes/dtypes/specs)
+# Restore rebuilds jax Arrays from local shard files with
+# make_array_from_single_device_arrays against the trainer's shardings.
+# ---------------------------------------------------------------------------
+
+
+def _flatten_state(trainer):
+    """[(key, jax.Array, sharding)] over params + optimizer state."""
+    import jax
+
+    out = []
+    for i, (arr, sh) in enumerate(zip(trainer._param_arrays,
+                                      trainer._param_shardings)):
+        out.append((f"p{i}", arr, sh))
+    for slot, st in enumerate(trainer._opt_states):
+        leaves = jax.tree_util.tree_leaves(st)
+        shl = jax.tree_util.tree_leaves(trainer._state_shardings[slot])
+        for j, (leaf, s) in enumerate(zip(leaves, shl)):
+            out.append((f"s{slot}_{j}", leaf, s))
+    return out
+
+
+def _index_key(index, shape):
+    """Canonical string for a shard's slice tuple, e.g. '0:8,0:32' — the
+    npz key suffix that lets restore match data to the CURRENT layout's
+    shards regardless of device enumeration order, and lets replicated
+    entries (every device holds the same slice) deduplicate to one copy."""
+    parts = []
+    for sl, dim in zip(index, shape):
+        start = sl.start or 0
+        stop = sl.stop if sl.stop is not None else dim
+        parts.append(f"{start}:{stop}")
+    return ",".join(parts) if parts else "scalar"
+
+
+def save_sharded(prefix, step, trainer, blocking=True):
+    """Write this process's UNIQUE shards of the trainer's params +
+    optimizer state (replicated entries — every local device holding the
+    same slice — are written once, so the per-host footprint is the
+    addressable fraction of the model, not devices× it).  Call on EVERY
+    process; atomic per file."""
+    import jax
+    import numpy as np
+
+    entries = _flatten_state(trainer)
+    proc = jax.process_index()
+    payload = {}
+    meta = {"step": step, "num_update": getattr(trainer, "_t", 0), "entries": {}}
+    for key, arr, _sh in entries:
+        meta["entries"][key] = {"shape": list(arr.shape)}
+        for shard in arr.addressable_shards:
+            k = f"{key}|{_index_key(shard.index, arr.shape)}"
+            if k not in payload:
+                payload[k] = np.asarray(shard.data)
+
+    def write():
+        shard_path = f"{prefix}-{step:07d}.shard{proc}.npz"
+        tmp = shard_path + ".tmp"
+        with open(tmp, "wb") as f:
+            np.savez(f, **payload)
+        os.replace(tmp, shard_path)
+        if proc == 0:
+            mpath = f"{prefix}-{step:07d}.shmeta"
+            with open(mpath + ".tmp", "w") as f:
+                json.dump(meta, f)
+            os.replace(mpath + ".tmp", mpath)
+
+    if blocking:
+        write()
+        return None
+    t = threading.Thread(target=write, daemon=True)
+    t.start()
+    return t
+
+
+def restore_sharded(prefix, trainer, step=None):
+    """Rebuild the trainer's sharded params + optimizer state (and the
+    update counter) from this process's shard file, then sync the Gluon
+    block's Parameters.  Falls back to the newest COMPLETE checkpoint when
+    the latest one is missing this process's shard (a preemption landed
+    mid-write).  Returns the restored step or None."""
+    import glob as _glob
+
+    import jax
+    import numpy as np
+
+    if step is not None:
+        candidates = [f"{prefix}-{step:07d}.shmeta"]
+    else:
+        candidates = sorted(_glob.glob(f"{prefix}-*.shmeta"), reverse=True)
+    proc = jax.process_index()
+    for mpath in candidates:
+        try:
+            with open(mpath) as f:
+                meta = json.load(f)
+            z = np.load(f"{prefix}-{meta['step']:07d}.shard{proc}.npz")
+        except (OSError, ValueError, json.JSONDecodeError):
+            continue  # incomplete checkpoint: try the next older one
+        with z:
+            entries = _flatten_state(trainer)
+            rebuilt = {}
+            for key, arr, sh in entries:
+                shards = []
+                for shard in arr.addressable_shards:
+                    data = z[f"{key}|{_index_key(shard.index, arr.shape)}"]
+                    shards.append(jax.device_put(data, shard.device))
+                rebuilt[key] = jax.make_array_from_single_device_arrays(
+                    tuple(meta["entries"][key]["shape"]), sh, shards)
+        n_params = len(trainer._param_arrays)
+        trainer._param_arrays = [rebuilt[f"p{i}"] for i in range(n_params)]
+        new_states = []
+        for slot, st in enumerate(trainer._opt_states):
+            leaves = jax.tree_util.tree_leaves(st)
+            treedef = jax.tree_util.tree_structure(st)
+            new_leaves = [rebuilt[f"s{slot}_{j}"] for j in range(len(leaves))]
+            new_states.append(jax.tree_util.tree_unflatten(treedef, new_leaves))
+        trainer._opt_states = new_states
+        # Adam/LAMB bias correction and lr schedules key off the update
+        # count — restore it (load_states parity)
+        trainer._t = meta.get("num_update", meta["step"])
+        trainer._optimizer.num_update = trainer._t
+        if hasattr(trainer, "sync_to_block"):
+            trainer.sync_to_block()  # keep eager Parameters consistent
+        return meta["step"]
+    return None
 
 
 class CheckpointManager:
