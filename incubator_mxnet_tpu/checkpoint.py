@@ -72,17 +72,28 @@ def _index_key(index, shape):
     return ",".join(parts) if parts else "scalar"
 
 
-def save_sharded(prefix, step, trainer, blocking=True):
+def save_sharded(prefix, step, trainer, blocking=True, keep=None):
     """Write this process's UNIQUE shards of the trainer's params +
     optimizer state (replicated entries — every local device holding the
     same slice — are written once, so the per-host footprint is the
     addressable fraction of the model, not devices× it).  Call on EVERY
-    process; atomic per file."""
+    process; atomic per file.
+
+    Multi-process: in blocking mode a cross-process barrier runs after the
+    shard writes and BEFORE process 0 writes the ``.shmeta`` marker, so a
+    meta file implies every process's shard landed.  ``blocking=False``
+    skips the barrier (collectives cannot run on a background thread while
+    training collectives are in flight) — use it single-process, or accept
+    that restore falls back to the newest *agreed* step.
+
+    ``keep=N`` retains only the newest N checkpoints (each process prunes
+    its own shard files; process 0 prunes metas)."""
     import jax
     import numpy as np
 
     entries = _flatten_state(trainer)
     proc = jax.process_index()
+    multiproc = jax.process_count() > 1
     payload = {}
     meta = {"step": step, "num_update": getattr(trainer, "_t", 0), "entries": {}}
     for key, arr, _sh in entries:
@@ -92,22 +103,39 @@ def save_sharded(prefix, step, trainer, blocking=True):
             if k not in payload:
                 payload[k] = np.asarray(shard.data)
 
-    def write():
+    def write(barrier):
         shard_path = f"{prefix}-{step:07d}.shard{proc}.npz"
         tmp = shard_path + ".tmp"
         with open(tmp, "wb") as f:
             np.savez(f, **payload)
         os.replace(tmp, shard_path)
+        if barrier:
+            from jax.experimental import multihost_utils
+
+            multihost_utils.sync_global_devices(f"ckpt_save_{step}")
         if proc == 0:
             mpath = f"{prefix}-{step:07d}.shmeta"
             with open(mpath + ".tmp", "w") as f:
                 json.dump(meta, f)
             os.replace(mpath + ".tmp", mpath)
+        if keep:
+            my_shards = sorted(glob.glob(f"{prefix}-*.shard{proc}.npz"))
+            for old in my_shards[:-keep]:
+                try:
+                    os.remove(old)
+                except OSError:
+                    pass
+            if proc == 0:
+                for old in sorted(glob.glob(f"{prefix}-*.shmeta"))[:-keep]:
+                    try:
+                        os.remove(old)
+                    except OSError:
+                        pass
 
     if blocking:
-        write()
+        write(barrier=multiproc)
         return None
-    t = threading.Thread(target=write, daemon=True)
+    t = threading.Thread(target=write, args=(False,), daemon=True)
     t.start()
     return t
 
@@ -117,17 +145,45 @@ def restore_sharded(prefix, trainer, step=None):
     update counter) from this process's shard file, then sync the Gluon
     block's Parameters.  Falls back to the newest COMPLETE checkpoint when
     the latest one is missing this process's shard (a preemption landed
-    mid-write).  Returns the restored step or None."""
-    import glob as _glob
+    mid-write); in multi-process runs all processes first AGREE on the
+    newest step every one of them can read, so no process restores a
+    different step than its peers.  Returns the restored step or None.
 
+    A saved-vs-current sharding-layout mismatch raises ValueError (restore
+    cannot proceed: the shard slices on disk don't tile the current mesh).
+    """
     import jax
     import numpy as np
+
+    proc = jax.process_index()
+
+    def my_steps():
+        out = []
+        for mpath in sorted(glob.glob(f"{prefix}-*.shmeta"), reverse=True):
+            try:
+                with open(mpath) as f:
+                    s = json.load(f)["step"]
+            except (OSError, ValueError, json.JSONDecodeError):
+                continue
+            if os.path.exists(f"{prefix}-{s:07d}.shard{proc}.npz"):
+                out.append(s)
+        return out
 
     if step is not None:
         candidates = [f"{prefix}-{step:07d}.shmeta"]
     else:
-        candidates = sorted(_glob.glob(f"{prefix}-*.shmeta"), reverse=True)
-    proc = jax.process_index()
+        steps = my_steps()
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            # newest step EVERY process can read: min over processes of
+            # each one's newest available (ties to the common prefix since
+            # saves are ordered)
+            mine = steps[0] if steps else -1
+            all_newest = multihost_utils.process_allgather(np.int64(mine))
+            agreed = int(np.min(all_newest))
+            steps = [s for s in steps if s <= agreed]
+        candidates = [f"{prefix}-{s:07d}.shmeta" for s in steps]
     for mpath in candidates:
         try:
             with open(mpath) as f:
@@ -141,8 +197,15 @@ def restore_sharded(prefix, trainer, step=None):
             for key, arr, sh in entries:
                 shards = []
                 for shard in arr.addressable_shards:
-                    data = z[f"{key}|{_index_key(shard.index, arr.shape)}"]
-                    shards.append(jax.device_put(data, shard.device))
+                    want = f"{key}|{_index_key(shard.index, arr.shape)}"
+                    if want not in z:
+                        have = [k for k in z.files if k.startswith(key + "|")]
+                        raise ValueError(
+                            f"sharding layout mismatch restoring {mpath}: "
+                            f"current mesh needs slice {want!r} but the "
+                            f"checkpoint holds {have} — restore with the "
+                            f"save-time mesh/ShardingRules")
+                    shards.append(jax.device_put(z[want], shard.device))
                 rebuilt[key] = jax.make_array_from_single_device_arrays(
                     tuple(meta["entries"][key]["shape"]), sh, shards)
         n_params = len(trainer._param_arrays)

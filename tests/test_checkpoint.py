@@ -154,8 +154,6 @@ class TestShardedCheckpoint:
 
         from incubator_mxnet_tpu import gluon
         from incubator_mxnet_tpu.checkpoint import restore_sharded, save_sharded
-        from incubator_mxnet_tpu.gluon.model_zoo.bert import bert_sharding_rules
-        from incubator_mxnet_tpu.ndarray.ndarray import NDArray
         from incubator_mxnet_tpu.parallel import make_mesh, SPMDTrainer
 
         mx.random.seed(0)
@@ -221,3 +219,48 @@ class TestShardedCheckpoint:
             assert len(weight_keys) == 8
             bias_keys = [k for k in z.files if z[k].shape == (16,) and k.startswith("p")]
             assert len(bias_keys) == 1
+
+    def test_layout_mismatch_raises_clearly(self, tmp_path):
+        import numpy as np_
+        import pytest as pytest_
+
+        from incubator_mxnet_tpu import gluon
+        from incubator_mxnet_tpu.checkpoint import restore_sharded, save_sharded
+        from incubator_mxnet_tpu.parallel import make_mesh, SPMDTrainer
+        from incubator_mxnet_tpu.parallel.sharding import ShardingRules
+        from jax.sharding import PartitionSpec as P
+
+        def build(fsdp):
+            mx.random.seed(2)
+            net = gluon.nn.Dense(16, flatten=False)
+            net.initialize()
+            net(mx.nd.zeros((2, 32)))
+            rules = ShardingRules([(r".*weight$", P("fsdp", None))], default=P())
+            return SPMDTrainer(net, lambda o, l: ((o - l) ** 2).mean(axis=-1),
+                               "sgd", {"learning_rate": 0.1},
+                               mesh=make_mesh(fsdp=fsdp), rules=rules)
+
+        prefix = str(tmp_path / "mm")
+        save_sharded(prefix, 1, build(fsdp=8))
+        with pytest_.raises(ValueError, match="layout mismatch"):
+            restore_sharded(prefix, build(fsdp=4))
+
+    def test_keep_retention(self, tmp_path):
+        import os as os_
+
+        from incubator_mxnet_tpu import gluon
+        from incubator_mxnet_tpu.checkpoint import save_sharded
+        from incubator_mxnet_tpu.parallel import make_mesh, SPMDTrainer
+
+        mx.random.seed(3)
+        net = gluon.nn.Dense(4, flatten=False)
+        net.initialize()
+        net(mx.nd.zeros((2, 4)))
+        trainer = SPMDTrainer(net, lambda o, l: ((o - l) ** 2).mean(axis=-1),
+                              "sgd", {"learning_rate": 0.1}, mesh=make_mesh())
+        prefix = str(tmp_path / "gc")
+        for s in (1, 2, 3, 4):
+            save_sharded(prefix, s, trainer, keep=2)
+        metas = [p for p in os_.listdir(tmp_path) if p.endswith(".shmeta")]
+        shards = [p for p in os_.listdir(tmp_path) if ".shard" in p]
+        assert len(metas) == 2 and len(shards) == 2
