@@ -1,3 +1,12 @@
 """``mx.image`` namespace (parity: [U:python/mxnet/image/])."""
 from .image import *  # noqa: F401,F403
-from .image import __all__  # noqa: F401
+from .image import __all__ as _image_all
+from .detection import (  # noqa: F401
+    DetAugmenter, DetBorrowAug, DetHorizontalFlipAug, DetRandomCropAug,
+    CreateDetAugmenter, ImageDetIter,
+)
+
+__all__ = list(_image_all) + [
+    "DetAugmenter", "DetBorrowAug", "DetHorizontalFlipAug",
+    "DetRandomCropAug", "CreateDetAugmenter", "ImageDetIter",
+]

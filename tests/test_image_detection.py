@@ -1,0 +1,101 @@
+"""Detection image pipeline (parity: [U:python/mxnet/image/detection.py]
+tests — augmenters must transform images and boxes TOGETHER)."""
+import numpy as np
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu.image import (CreateDetAugmenter,
+                                       DetHorizontalFlipAug,
+                                       DetRandomCropAug, ImageDetIter)
+
+
+def _sample(seed=0, h=60, w=80):
+    rng = np.random.RandomState(seed)
+    img = rng.randint(0, 255, (h, w, 3), np.uint8)
+    label = np.full((4, 5), -1.0, np.float32)
+    label[0] = [1, 0.10, 0.20, 0.50, 0.60]
+    label[1] = [3, 0.55, 0.30, 0.90, 0.80]
+    return img, label
+
+
+class TestDetAugmenters:
+    def test_flip_mirrors_boxes(self):
+        img, label = _sample()
+        aug = DetHorizontalFlipAug(p=1.0)
+        out, lab = aug(img, label)
+        np.testing.assert_array_equal(np.asarray(out), img[:, ::-1])
+        np.testing.assert_allclose(lab[0, 1:5], [0.50, 0.20, 0.90, 0.60], atol=1e-6)
+        assert lab[2, 0] == -1  # padding untouched
+
+    def test_flip_identity_at_p0(self):
+        img, label = _sample()
+        out, lab = DetHorizontalFlipAug(p=0.0)(img, label)
+        np.testing.assert_array_equal(np.asarray(out), img)
+        np.testing.assert_array_equal(lab, label)
+
+    def test_random_crop_keeps_covered_boxes_normalized(self):
+        np.random.seed(7)
+        img, label = _sample()
+        aug = DetRandomCropAug(min_object_covered=0.5, area_range=(0.5, 0.9))
+        out, lab = aug(img, label)
+        valid = lab[lab[:, 0] >= 0]
+        assert len(valid) >= 1
+        assert (valid[:, 1:5] >= 0).all() and (valid[:, 1:5] <= 1).all()
+        assert (valid[:, 3] > valid[:, 1]).all() and (valid[:, 4] > valid[:, 2]).all()
+
+
+class TestImageDetIter:
+    def test_batches_and_shapes(self):
+        samples = []
+        for i in range(6):
+            img, label = _sample(seed=i)
+            samples.append((label, img))
+        it = ImageDetIter(samples, batch_size=3, data_shape=(3, 32, 32),
+                          max_objects=4, rand_mirror=True, rand_crop=1,
+                          mean=np.array([0.5, 0.5, 0.5], np.float32))
+        batches = list(it)
+        assert len(batches) == 2
+        b = batches[0]
+        assert b.data[0].shape == (3, 3, 32, 32)
+        assert b.label[0].shape == (3, 4, 5)
+        lab = b.label[0].asnumpy()
+        valid = lab[lab[:, :, 0] >= 0]
+        assert (valid[:, 1:5] >= 0).all() and (valid[:, 1:5] <= 1).all()
+
+    def test_feeds_multibox_target(self):
+        """The det pipeline must compose with the SSD target op."""
+        import jax.numpy as jnp
+
+        from incubator_mxnet_tpu.ops.detection import (multibox_prior,
+                                                       multibox_target)
+
+        samples = [(np.array([[1, 0.1, 0.1, 0.6, 0.6]], np.float32),
+                    _sample(seed=9)[0]) for _ in range(2)]
+        it = ImageDetIter(samples, batch_size=2, data_shape=(3, 32, 32),
+                          max_objects=4)
+        batch = next(iter(it))
+        anchors = multibox_prior(jnp.zeros((1, 3, 8, 8)),
+                                 sizes=(0.5,), ratios=(1.0,))
+        cls_preds = jnp.zeros((2, 3, anchors.shape[1]))  # [B, C+1, N]
+        bt, bm, ct = multibox_target(anchors, batch.label[0]._data, cls_preds)
+        assert np.isfinite(np.asarray(bt)).all()
+        assert int(np.asarray((ct > 0).sum())) > 0  # some anchors matched
+
+    def test_empty_label_and_partial_batch(self):
+        """Background-only samples (zero boxes) and a trailing partial
+        batch must both work (review-caught: empty-list crash + silent
+        batch drop)."""
+        rng = np.random.RandomState(1)
+        samples = [([], rng.randint(0, 255, (40, 40, 3), np.uint8))
+                   for _ in range(5)]
+        it = ImageDetIter(samples, batch_size=2, data_shape=(3, 16, 16),
+                          max_objects=3)
+        batches = list(it)
+        assert len(batches) == 3  # 2+2+1(padded), not 2 dropped-batches
+        assert (batches[-1].label[0].asnumpy()[:, :, 0] == -1).all()
+
+    def test_batch_larger_than_dataset_raises(self):
+        import pytest as pytest_
+
+        img, label = _sample()
+        with pytest_.raises(ValueError, match="exceeds dataset size"):
+            ImageDetIter([(label, img)], batch_size=4, data_shape=(3, 16, 16))
