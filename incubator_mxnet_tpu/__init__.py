@@ -65,6 +65,10 @@ from . import checkpoint
 from . import library
 from . import config
 from . import predictor
+from . import monitor
+from .monitor import Monitor
+from . import visualization
+from . import visualization as viz
 config.apply_env()
 from .util import np_shape, np_array, is_np_shape, is_np_array, set_np, reset_np
 from . import numpy_ns as np  # mx.np numpy-compat namespace

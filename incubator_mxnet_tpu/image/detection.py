@@ -185,9 +185,10 @@ class ImageDetIter:
         data = _np.zeros((self._batch, h, w, c), _np.float32)
         labels = _np.full((self._batch, self._max_objects, 5), -1.0, _np.float32)
         for i in range(self._batch):
-            # pad the trailing partial batch by wrapping (upstream ImageDetIter
-            # pads the final batch rather than dropping it)
-            j = min(self._cursor + i, len(self._samples) - 1)
+            # pad the trailing partial batch by wrapping around to the
+            # epoch's start (upstream ImageDetIter pads the final batch
+            # rather than dropping it)
+            j = (self._cursor + i) % len(self._samples)
             lab, img = self._samples[self._order[j]]
             lab = _np.asarray(lab, _np.float32).reshape(-1, 5)
             lab_pad = _np.full((self._max_objects, 5), -1.0, _np.float32)

@@ -1,0 +1,117 @@
+"""``mx.monitor`` — training-time tensor monitor.
+
+Parity: [U:python/mxnet/monitor.py] (``Monitor`` with interval/stat_func/
+pattern, ``tic``/``toc``/``toc_print``, ``install``).  Divergence, by
+design: the reference hooks every executor op output via the engine's
+monitor callback; under XLA the op schedule belongs to the compiler, so
+the observable boundary is the BLOCK — ``install(block)`` attaches
+forward hooks on every (nested) child whose name matches ``pattern`` and
+records ``stat_func`` of each output, plus parameters/gradients when
+``monitor_all`` is set.  Same control surface, block-level granularity.
+"""
+from __future__ import annotations
+
+import re
+
+import numpy as _np
+
+__all__ = ["Monitor"]
+
+
+def _default_stat(arr):
+    return float(_np.abs(arr).mean())
+
+
+class Monitor:
+    def __init__(self, interval, stat_func=None, pattern=".*", sort=False,
+                 monitor_all=False):
+        self.interval = int(interval)
+        self.stat_func = stat_func or _default_stat
+        self.re_pattern = re.compile(pattern)
+        self.sort = sort
+        self.monitor_all = monitor_all
+        self.step = 0
+        self.activated = False
+        self.queue = []
+        self._installed = []  # (block, hook) pairs
+
+    # -- installation ----------------------------------------------------
+    def install(self, block):
+        """Attach to a Gluon block tree (analog of passing the monitor to
+        ``Module.bind``/``executor.set_monitor_callback``).  Blocks are
+        matched and labeled by their NAME (``dense0`` style), and the root
+        block itself is hooked too."""
+        from .gluon.block import Block, _tls as _block_tls
+
+        def make_hook(name):
+            def hook(blk, inputs, output):
+                if not self.activated:
+                    return
+                # never touch values inside a hybridize/jit trace — they
+                # are tracers, not data (asnumpy would raise)
+                if getattr(_block_tls, "tracing", 0):
+                    return
+                outs = output if isinstance(output, (list, tuple)) else [output]
+                for i, o in enumerate(outs):
+                    arr = getattr(o, "asnumpy", lambda: _np.asarray(o))()
+                    suffix = f"_output{i}" if len(outs) > 1 else "_output"
+                    self.queue.append((self.step, name + suffix,
+                                       self.stat_func(_np.asarray(arr))))
+
+            return hook
+
+        def attach(blk, name):
+            if self.re_pattern.match(name):
+                h = make_hook(name)
+                blk._forward_hooks.append(h)
+                self._installed.append((blk, h))
+
+        def walk(blk):
+            for child in blk._children.values():
+                attach(child, child.name)
+                walk(child)
+
+        if isinstance(block, Block):
+            attach(block, block.name)
+            walk(block)
+        self._block = block
+        return self
+
+    def uninstall(self):
+        for blk, h in self._installed:
+            if h in blk._forward_hooks:
+                blk._forward_hooks.remove(h)
+        self._installed = []
+        self._block = None
+
+    # -- reference control surface ---------------------------------------
+    def tic(self):
+        """Start collecting for this step if the interval has elapsed."""
+        if self.step % self.interval == 0:
+            self.queue = []
+            self.activated = True
+        self.step += 1
+
+    def toc(self):
+        """Stop collecting; returns [(step, name, stat_string)]."""
+        if not self.activated:
+            return []
+        self.activated = False
+        if self.monitor_all and getattr(self, "_block", None) is not None:
+            for name, p in self._block.collect_params().items():
+                if not self.re_pattern.match(name) or p._data is None:
+                    continue
+                self.queue.append((self.step, name,
+                                   self.stat_func(p.data().asnumpy())))
+                g = p.grad() if p.grad_req != "null" else None
+                if g is not None:
+                    self.queue.append((self.step, name + "_grad",
+                                       self.stat_func(g.asnumpy())))
+        res = [(s, n, str(v)) for s, n, v in
+               (sorted(self.queue, key=lambda q: q[1]) if self.sort else self.queue)]
+        self.queue = []
+        return res
+
+    def toc_print(self):
+        for step, name, val in self.toc():
+            print(f"Batch: {step:7d} {name:30s} {val}")

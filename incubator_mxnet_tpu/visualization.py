@@ -1,0 +1,107 @@
+"""``mx.viz`` — network visualization.
+
+Parity: [U:python/mxnet/visualization.py]: ``print_summary`` (the layer
+table with output shapes and parameter counts) and ``plot_network``
+(graphviz DOT).  ``plot_network`` returns the DOT source string (and
+renders via the ``graphviz`` package when available — not present in this
+environment, so the source IS the artifact).
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+__all__ = ["print_summary", "plot_network"]
+
+
+def print_summary(symbol, shape=None, line_length=96):
+    """Print a Keras-style layer table for a Symbol graph (parity:
+    ``mx.viz.print_summary``).  ``shape``: dict of input name -> shape for
+    shape inference."""
+    if shape:
+        arg_shapes, _, _ = symbol.infer_shape(**shape)
+        arg_shape = dict(zip(symbol.list_arguments(), arg_shapes))
+    else:
+        arg_shape = {}
+
+    order = symbol._topo()
+    total_params = 0
+    sep = "=" * line_length
+    print(sep)
+    print(f"{'Layer (type)':<40}{'Output Shape':<24}{'Param #':<12}Previous")
+    print(sep)
+    for node in order:
+        if node.op is None:
+            if node.name in arg_shape and shape and node.name in shape:
+                print(f"{node.name + ' (input)':<40}"
+                      f"{str(arg_shape.get(node.name, '')):<24}{0:<12}")
+            continue
+        n_params = 0
+        for inp, _ in node.inputs:
+            if inp.op is None and inp.name in arg_shape and inp.name not in (shape or {}):
+                n_params += int(_np.prod(arg_shape[inp.name]))
+        total_params += n_params
+        prev = ",".join(i.name for i, _ in node.inputs if i.op is not None) or \
+            ",".join(i.name for i, _ in node.inputs)
+        out_shape = ""
+        if shape:
+            try:
+                from .symbol.symbol import Symbol
+
+                sub = Symbol([(node, 0)])
+                needed = {k: v for k, v in shape.items()
+                          if k in sub.list_arguments()}
+                _, outs, _ = sub.infer_shape(**needed)
+                out_shape = str(outs[0])
+            except Exception:
+                out_shape = "?"
+        print(f"{node.name + f' ({node.op})':<40}{out_shape:<24}"
+              f"{n_params:<12}{prev[:30]}")
+    print(sep)
+    print(f"Total params: {total_params}")
+    print(sep)
+    return total_params
+
+
+_NODE_STYLE = {
+    "Convolution": "fillcolor=\"#fb8072\"", "FullyConnected": "fillcolor=\"#fb8072\"",
+    "Activation": "fillcolor=\"#ffffb3\"", "LeakyReLU": "fillcolor=\"#ffffb3\"",
+    "Pooling": "fillcolor=\"#80b1d3\"", "BatchNorm": "fillcolor=\"#bebada\"",
+    "softmax": "fillcolor=\"#fccde5\"", "SoftmaxOutput": "fillcolor=\"#fccde5\"",
+}
+
+
+def plot_network(symbol, title="plot", shape=None, hide_weights=True):
+    """Build graphviz DOT for a Symbol graph (parity: ``plot_network``).
+    Returns the DOT source string; if the ``graphviz`` package is
+    importable, returns a ``graphviz.Source`` instead (render-capable)."""
+    lines = [f'digraph "{title}" {{', "  node [shape=box style=filled];"]
+    seen = {}
+    for node in symbol._topo():
+        nid = f"n{len(seen)}"
+        if node.op is None:
+            if hide_weights and node.name != "data" and (
+                    node.name.endswith(("weight", "bias", "gamma", "beta"))
+                    or "moving_" in node.name or "running_" in node.name):
+                seen[id(node)] = None  # hidden: declared nowhere, no edges
+                continue
+            seen[id(node)] = nid
+            lines.append(f'  {nid} [label="{node.name}" fillcolor="#8dd3c7"];')
+        else:
+            seen[id(node)] = nid
+            style = _NODE_STYLE.get(node.op, 'fillcolor="#d9d9d9"')
+            lines.append(f'  {nid} [label="{node.name}\\n{node.op}" {style}];')
+    for node in symbol._topo():
+        if node.op is None:
+            continue
+        for inp, _ in node.inputs:
+            src = seen.get(id(inp))
+            if src is not None:
+                lines.append(f"  {src} -> {seen[id(node)]};")
+    lines.append("}")
+    dot = "\n".join(lines)
+    try:
+        import graphviz  # pragma: no cover
+
+        return graphviz.Source(dot)
+    except ImportError:
+        return dot

@@ -1,0 +1,114 @@
+"""Monitor + visualization (parity: [U:python/mxnet/monitor.py],
+[U:python/mxnet/visualization.py])."""
+import numpy as np
+
+import incubator_mxnet_tpu as mx
+import incubator_mxnet_tpu.symbol as S
+from incubator_mxnet_tpu import gluon
+
+
+class TestMonitor:
+    def test_block_outputs_collected_on_interval(self):
+        mx.random.seed(0)
+        net = gluon.nn.HybridSequential()
+        net.add(gluon.nn.Dense(8, activation="relu"), gluon.nn.Dense(4))
+        net.initialize()
+        net(mx.nd.zeros((2, 6)))
+
+        mon = mx.Monitor(interval=2, pattern=".*")
+        mon.install(net)
+        x = mx.nd.ones((2, 6))
+        stats = []
+        for _ in range(4):
+            mon.tic()
+            net(x)
+            stats.append(mon.toc())
+        # interval=2: steps 0 and 2 collect, 1 and 3 don't
+        assert len(stats[0]) > 0 and len(stats[2]) > 0
+        assert stats[1] == [] and stats[3] == []
+        names = [n for _, n, _ in stats[0]]
+        assert any("output" in n for n in names)
+        mon.uninstall()
+        mon.tic()
+        net(x)
+        assert mon.toc() == []  # hooks removed
+
+    def test_monitor_all_includes_params_and_grads(self):
+        from incubator_mxnet_tpu import autograd
+
+        net = gluon.nn.Dense(3)
+        net.initialize()
+        net(mx.nd.zeros((1, 4)))
+        mon = mx.Monitor(interval=1, monitor_all=True, sort=True)
+        mon.install(net)
+        mon.tic()
+        with autograd.record():
+            out = net(mx.nd.ones((2, 4)))
+            out.sum().backward()
+        res = mon.toc()
+        names = [n for _, n, _ in res]
+        assert any(n.endswith("weight") for n in names)
+        assert any(n.endswith("weight_grad") for n in names)
+
+
+class TestVisualization:
+    def _sym(self):
+        S.symbol._reset_naming()
+        data = S.var("data")
+        fc = S.FullyConnected(data, num_hidden=8, name="fc1")
+        act = S.Activation(fc, act_type="relu", name="relu1")
+        return S.FullyConnected(act, num_hidden=2, name="fc2")
+
+    def test_print_summary_counts_params(self, capsys):
+        sym = self._sym()
+        total = mx.viz.print_summary(sym, shape={"data": (1, 4)})
+        out = capsys.readouterr().out
+        # fc1: 4*8+8 = 40; fc2: 8*2+2 = 18
+        assert total == 58
+        assert "fc1 (FullyConnected)" in out and "Total params: 58" in out
+
+    def test_plot_network_dot(self):
+        dot = mx.viz.plot_network(self._sym(), shape={"data": (1, 4)})
+        src = dot if isinstance(dot, str) else dot.source
+        assert src.startswith("digraph")
+        assert "fc1" in src and "->" in src
+        # hidden weight variables are not drawn
+        assert "fc1_weight" not in src
+
+    def test_hybridized_net_does_not_crash(self):
+        """Hooks must skip tracer values inside hybridize traces."""
+        net = gluon.nn.HybridSequential()
+        net.add(gluon.nn.Dense(4))
+        net.initialize()
+        net(mx.nd.zeros((1, 3)))
+        net.hybridize()
+        mon = mx.Monitor(interval=1)
+        mon.install(net)
+        mon.tic()
+        out = net(mx.nd.ones((2, 3)))  # traces + executes without crashing
+        assert out.shape == (2, 4)
+        mon.toc()
+
+    def test_name_pattern_and_leaf_block(self):
+        """Patterns match block NAMES (dense0 style), and a childless block
+        gets hooked itself."""
+        d = gluon.nn.Dense(3)
+        d.initialize()
+        d(mx.nd.zeros((1, 2)))
+        mon = mx.Monitor(interval=1, pattern="dense.*")
+        mon.install(d)
+        mon.tic()
+        d(mx.nd.ones((1, 2)))
+        rows = mon.toc()
+        assert rows and rows[0][1].startswith("dense")
+
+    def test_uninstall_stops_monitor_all(self):
+        net = gluon.nn.Dense(3)
+        net.initialize()
+        net(mx.nd.zeros((1, 2)))
+        mon = mx.Monitor(interval=1, monitor_all=True)
+        mon.install(net)
+        mon.uninstall()
+        mon.tic()
+        net(mx.nd.ones((1, 2)))
+        assert mon.toc() == []
