@@ -8,5 +8,6 @@ in ops/quantization.py).
 from .. import amp  # noqa: F401  (reference path: mx.contrib.amp)
 from . import quantization  # noqa: F401
 from . import onnx  # noqa: F401
+from . import text  # noqa: F401
 
-__all__ = ["amp", "quantization", "onnx"]
+__all__ = ["amp", "quantization", "onnx", "text"]

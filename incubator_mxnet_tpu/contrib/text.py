@@ -1,0 +1,152 @@
+"""``mx.contrib.text`` — vocabulary and pretrained-embedding utilities.
+
+Parity: [U:python/mxnet/contrib/text/] (``utils.count_tokens_from_str``,
+``vocab.Vocabulary``, ``embedding.CustomEmbedding`` and the
+token→vector surface).  The hosted glove/fasttext downloads need network
+(absent here): ``get_pretrained_file_names`` lists the reference's names
+and loading one raises with a pointer to ``CustomEmbedding`` over a local
+file — same file format (``token<delim>v1<delim>v2 ...`` per line).
+"""
+from __future__ import annotations
+
+import collections
+
+import numpy as _np
+
+__all__ = ["count_tokens_from_str", "Vocabulary", "CustomEmbedding",
+           "get_pretrained_file_names"]
+
+
+def count_tokens_from_str(source_str, token_delim=" ", seq_delim="\n",
+                          to_lower=False, counter_to_update=None):
+    """Token frequency counter (parity: ``utils.count_tokens_from_str``)."""
+    src = source_str.lower() if to_lower else source_str
+    tokens = [t for seq in src.split(seq_delim) for t in seq.split(token_delim) if t]
+    counter = counter_to_update if counter_to_update is not None else collections.Counter()
+    counter.update(tokens)
+    return counter
+
+
+class Vocabulary:
+    """Indexed vocabulary (parity: ``vocab.Vocabulary``): index 0 is the
+    unknown token, then reserved tokens, then corpus tokens sorted by
+    frequency (ties broken alphabetically)."""
+
+    def __init__(self, counter=None, most_freq_count=None, min_freq=1,
+                 unknown_token="<unk>", reserved_tokens=None):
+        if min_freq < 1:
+            raise ValueError("min_freq must be >= 1")
+        reserved_tokens = list(reserved_tokens or [])
+        if unknown_token in reserved_tokens or len(set(reserved_tokens)) != len(reserved_tokens):
+            raise ValueError("reserved tokens must be unique and exclude unknown_token")
+        self.unknown_token = unknown_token
+        self.reserved_tokens = reserved_tokens
+        self.idx_to_token = [unknown_token] + reserved_tokens
+        if counter:
+            pairs = sorted(counter.items(), key=lambda kv: (-kv[1], kv[0]))
+            skip = {unknown_token, *reserved_tokens}
+            taken = 0
+            for tok, freq in pairs:
+                if most_freq_count is not None and taken >= most_freq_count:
+                    break
+                # reserved/unknown tokens in the corpus must not consume
+                # cap slots (reference semantics: the cap counts tokens
+                # actually indexed)
+                if freq >= min_freq and tok not in skip:
+                    self.idx_to_token.append(tok)
+                    taken += 1
+        self.token_to_idx = {t: i for i, t in enumerate(self.idx_to_token)}
+
+    def __len__(self):
+        return len(self.idx_to_token)
+
+    def to_indices(self, tokens):
+        single = isinstance(tokens, str)
+        toks = [tokens] if single else tokens
+        idx = [self.token_to_idx.get(t, 0) for t in toks]
+        return idx[0] if single else idx
+
+    def to_tokens(self, indices):
+        single = isinstance(indices, (int, _np.integer))
+        idxs = [int(indices)] if single else [int(i) for i in indices]
+        for i in idxs:
+            if not 0 <= i < len(self):
+                raise ValueError(f"index {i} out of vocabulary range")
+        toks = [self.idx_to_token[i] for i in idxs]
+        return toks[0] if single else toks
+
+
+class CustomEmbedding:
+    """Load embeddings from a local text file — ``token v1 v2 ...`` per
+    line (parity: ``embedding.CustomEmbedding``).  With a ``vocabulary``
+    the table is laid out vocab-indexed (unknown/missing rows = init
+    vector, default zeros) ready for ``nn.Embedding`` weight assignment.
+    """
+
+    def __init__(self, pretrained_file_path, elem_delim=" ", encoding="utf8",
+                 vocabulary=None):
+        vecs = {}
+        dim = None
+        with open(pretrained_file_path, encoding=encoding) as f:
+            for line_num, line in enumerate(f):
+                parts = [p for p in line.rstrip().split(elem_delim) if p]
+                if len(parts) < 2:
+                    continue
+                tok, vals = parts[0], parts[1:]
+                try:
+                    vec = _np.asarray([float(v) for v in vals], _np.float32)
+                except ValueError:
+                    raise ValueError(
+                        f"non-numeric embedding value on line {line_num + 1}")
+                if dim is None:
+                    dim = len(vec)
+                elif len(vec) != dim:
+                    raise ValueError(
+                        f"inconsistent embedding dim on line {line_num + 1}: "
+                        f"{len(vec)} != {dim}")
+                vecs[tok] = vec
+        if dim is None:
+            raise ValueError(f"no embeddings found in {pretrained_file_path}")
+        self.vec_len = dim
+        self._vecs = vecs
+        self.vocabulary = vocabulary
+        if vocabulary is not None:
+            table = _np.zeros((len(vocabulary), dim), _np.float32)
+            for i, tok in enumerate(vocabulary.idx_to_token):
+                if tok in vecs:
+                    table[i] = vecs[tok]
+            self.idx_to_vec = table
+
+    def get_vecs_by_tokens(self, tokens):
+        """token(s) → vector(s); unknown tokens get zeros (parity)."""
+        single = isinstance(tokens, str)
+        toks = [tokens] if single else tokens
+        out = _np.stack([self._vecs.get(t, _np.zeros(self.vec_len, _np.float32))
+                         for t in toks])
+        from ..ndarray.ndarray import array
+
+        res = array(out)
+        return res[0] if single else res
+
+    def __contains__(self, token):
+        return token in self._vecs
+
+    def __len__(self):
+        return len(self._vecs)
+
+
+def get_pretrained_file_names(embedding_name=None):
+    """The reference's hosted pretrained sets (parity listing).  Loading
+    them needs network access — use :class:`CustomEmbedding` with a local
+    copy of the file instead."""
+    names = {
+        "glove": ["glove.6B.50d.txt", "glove.6B.100d.txt", "glove.6B.200d.txt",
+                  "glove.6B.300d.txt", "glove.42B.300d.txt", "glove.840B.300d.txt"],
+        "fasttext": ["wiki.en.vec", "wiki.simple.vec"],
+    }
+    if embedding_name is None:
+        return names
+    if embedding_name not in names:
+        raise KeyError(f"unknown embedding {embedding_name!r}; "
+                       f"choose from {sorted(names)}")
+    return names[embedding_name]

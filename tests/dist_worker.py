@@ -85,6 +85,15 @@ def main():
     expect[4] = 2 * 0.5
     np.testing.assert_allclose(out.asnumpy(), expect)
 
+    # pushpull must take the same compressed wire path as push
+    kvp = mx.kv.create("dist_sync")
+    kvp.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    kvp.init(9, mx.nd.zeros((4,)))
+    outp = mx.nd.zeros((4,))
+    kvp.pushpull(9, mx.nd.array([0.6, -0.7, 0.1, 0.0]), out=outp)
+    np.testing.assert_allclose(outp.asnumpy(), np.array([1, -1, 0, 0]) * 2 * 0.5)
+    assert kvp._last_wire_dtype == "int8", kvp._last_wire_dtype
+
     # --- barrier + SPMDTrainer.shard_batch over the 2-process mesh ------
     kv.barrier()
     from incubator_mxnet_tpu.parallel import make_mesh, SPMDTrainer

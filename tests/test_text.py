@@ -1,0 +1,84 @@
+"""contrib.text (parity: [U:tests/python/unittest/test_contrib_text.py]):
+vocabulary indexing + embedding file loading."""
+import collections
+
+import numpy as np
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu.contrib import text
+
+
+class TestVocabulary:
+    def test_count_and_index(self):
+        counter = text.count_tokens_from_str("a b b c c c\nd d d d", to_lower=True)
+        assert counter == collections.Counter({"d": 4, "c": 3, "b": 2, "a": 1})
+        v = text.Vocabulary(counter, min_freq=2, reserved_tokens=["<pad>"])
+        # <unk>, <pad>, then d,c,b by frequency (a dropped: freq 1)
+        assert v.idx_to_token == ["<unk>", "<pad>", "d", "c", "b"]
+        assert v.to_indices(["d", "b", "zzz"]) == [2, 4, 0]
+        assert v.to_tokens([2, 0]) == ["d", "<unk>"]
+        assert len(v) == 5
+
+    def test_most_freq_count(self):
+        v = text.Vocabulary(collections.Counter("aaabbc"), most_freq_count=2)
+        assert v.idx_to_token == ["<unk>", "a", "b"]
+
+
+class TestCustomEmbedding:
+    def _file(self, tmp_path):
+        p = tmp_path / "emb.txt"
+        p.write_text("hello 1.0 2.0 3.0\nworld 4.0 5.0 6.0\n")
+        return str(p)
+
+    def test_load_and_lookup(self, tmp_path):
+        emb = text.CustomEmbedding(self._file(tmp_path))
+        assert emb.vec_len == 3 and len(emb) == 2 and "hello" in emb
+        vecs = emb.get_vecs_by_tokens(["world", "missing"])
+        np.testing.assert_allclose(vecs.asnumpy(), [[4, 5, 6], [0, 0, 0]])
+
+    def test_vocab_indexed_table_feeds_embedding_layer(self, tmp_path):
+        from incubator_mxnet_tpu import gluon
+
+        v = text.Vocabulary(collections.Counter({"hello": 2, "world": 1}))
+        emb = text.CustomEmbedding(self._file(tmp_path), vocabulary=v)
+        assert emb.idx_to_vec.shape == (3, 3)
+        layer = gluon.nn.Embedding(len(v), emb.vec_len)
+        layer.initialize()
+        layer(mx.nd.zeros((1, 1), dtype="int32"))
+        layer.weight.set_data(mx.nd.array(emb.idx_to_vec))
+        out = layer(mx.nd.array([[v.to_indices("hello")]], dtype="int32"))
+        np.testing.assert_allclose(out.asnumpy()[0, 0], [1, 2, 3])
+
+    def test_bad_file_raises(self, tmp_path):
+        import pytest
+
+        p = tmp_path / "bad.txt"
+        p.write_text("tok 1.0 2.0\nother 1.0\n")
+        with pytest.raises(ValueError, match="inconsistent"):
+            text.CustomEmbedding(str(p))
+
+    def test_pretrained_listing(self):
+        import pytest
+
+        assert "glove.6B.300d.txt" in text.get_pretrained_file_names("glove")
+        with pytest.raises(KeyError):
+            text.get_pretrained_file_names("nope")
+
+
+class TestReviewRegressions:
+    def test_cap_excludes_reserved_tokens(self):
+        c = collections.Counter({"<pad>": 5, "a": 3, "b": 2})
+        v = text.Vocabulary(c, most_freq_count=2, reserved_tokens=["<pad>"])
+        assert v.idx_to_token == ["<unk>", "<pad>", "a", "b"]
+
+    def test_numpy_integer_index(self):
+        v = text.Vocabulary(collections.Counter("aab"))
+        assert v.to_tokens(np.int64(1)) == "a"
+        assert v.to_tokens(np.asarray([1, 0], np.int32)) == ["a", "<unk>"]
+
+    def test_trailing_whitespace_lines(self, tmp_path):
+        p = tmp_path / "ws.txt"
+        p.write_text("hello 1.0 2.0 \nworld 3.0 4.0\t\n")
+        emb = text.CustomEmbedding(str(p))
+        np.testing.assert_allclose(
+            emb.get_vecs_by_tokens("hello").asnumpy(), [1, 2])
