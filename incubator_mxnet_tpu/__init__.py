@@ -72,11 +72,13 @@ from . import visualization as viz
 config.apply_env()
 from .util import np_shape, np_array, is_np_shape, is_np_array, set_np, reset_np
 from . import numpy_ns as np  # mx.np numpy-compat namespace
+from . import npx  # mx.npx numpy-extension ops
 from .utils import test_utils
 
 __all__ = [
     "nd",
     "np",
+    "npx",
     "sym",
     "symbol",
     "Executor",

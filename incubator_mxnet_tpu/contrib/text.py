@@ -19,9 +19,13 @@ __all__ = ["count_tokens_from_str", "Vocabulary", "CustomEmbedding",
 
 def count_tokens_from_str(source_str, token_delim=" ", seq_delim="\n",
                           to_lower=False, counter_to_update=None):
-    """Token frequency counter (parity: ``utils.count_tokens_from_str``)."""
+    """Token frequency counter (parity: ``utils.count_tokens_from_str`` —
+    like the reference, the delimiters are REGEX patterns, split as
+    ``token_delim|seq_delim``)."""
+    import re
+
     src = source_str.lower() if to_lower else source_str
-    tokens = [t for seq in src.split(seq_delim) for t in seq.split(token_delim) if t]
+    tokens = [t for t in re.split(f"{token_delim}|{seq_delim}", src) if t]
     counter = counter_to_update if counter_to_update is not None else collections.Counter()
     counter.update(tokens)
     return counter
@@ -111,11 +115,16 @@ class CustomEmbedding:
         self._vecs = vecs
         self.vocabulary = vocabulary
         if vocabulary is not None:
-            table = _np.zeros((len(vocabulary), dim), _np.float32)
-            for i, tok in enumerate(vocabulary.idx_to_token):
-                if tok in vecs:
-                    table[i] = vecs[tok]
-            self.idx_to_vec = table
+            self.idx_to_token = list(vocabulary.idx_to_token)
+        else:
+            # reference parity: idx_to_vec always exists — without a
+            # vocabulary, row 0 is the unknown token, then file order
+            self.idx_to_token = ["<unk>"] + list(vecs)
+        table = _np.zeros((len(self.idx_to_token), dim), _np.float32)
+        for i, tok in enumerate(self.idx_to_token):
+            if tok in vecs:
+                table[i] = vecs[tok]
+        self.idx_to_vec = table
 
     def get_vecs_by_tokens(self, tokens):
         """token(s) → vector(s); unknown tokens get zeros (parity)."""

@@ -1,0 +1,57 @@
+"""``mx.npx`` — the numpy-extension operator namespace.
+
+Parity: [U:python/mxnet/_numpy_op_doc.py] / the deep-numpy ``npx``
+namespace (1.6+): neural-network and framework ops that have no NumPy
+equivalent, exposed alongside ``mx.np`` — ``npx.relu``, ``npx.softmax``,
+``npx.batch_norm``, ``npx.convolution``, ``npx.pick``, ``npx.reshape_like``
+etc., plus ``set_np()``/``reset_np()`` re-exported.  Names resolve through
+the SAME op registry as ``mx.nd`` (one kernel set, two calling
+conventions), so everything registered is reachable here.
+"""
+from __future__ import annotations
+
+from .ops.registry import get_op
+from .util import is_np_array, is_np_shape, reset_np, set_np  # noqa: F401
+
+__all__ = ["set_np", "reset_np", "is_np_array", "is_np_shape"]
+
+# npx spells several ops in snake_case where the legacy registry uses
+# CamelCase (the reference keeps both registries; here it's one table
+# with aliases)
+_ALIASES = {
+    "activation": "Activation",
+    "batch_norm": "BatchNorm",
+    "layer_norm": "LayerNorm",
+    "group_norm": "GroupNorm",
+    "instance_norm": "InstanceNorm",
+    "convolution": "Convolution",
+    "deconvolution": "Deconvolution",
+    "pooling": "Pooling",
+    "fully_connected": "FullyConnected",
+    "dropout": "Dropout",
+    "embedding": "Embedding",
+    "leaky_relu": "LeakyReLU",
+    "one_hot": "one_hot",
+    "pick": "pick",
+    "topk": "topk",
+    "relu": "relu",
+    "sigmoid": "sigmoid",
+    "softmax": "softmax",
+    "log_softmax": "log_softmax",
+    "sequence_mask": "SequenceMask",
+    "reshape_like": "reshape_like",
+    "gamma": "gamma",
+}
+
+def __getattr__(name):
+    if name.startswith("__"):
+        raise AttributeError(name)
+    target = _ALIASES.get(name, name)
+    try:
+        get_op(target)
+    except KeyError:
+        raise AttributeError(f"npx has no op {name!r}") from None
+    # delegate to the nd wrapper: one factory, shared cache, out= support
+    from . import ndarray as nd_ns
+
+    return getattr(nd_ns, target)

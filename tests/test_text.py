@@ -82,3 +82,14 @@ class TestReviewRegressions:
         emb = text.CustomEmbedding(str(p))
         np.testing.assert_allclose(
             emb.get_vecs_by_tokens("hello").asnumpy(), [1, 2])
+
+    def test_regex_delims(self):
+        c = text.count_tokens_from_str("a,b  c", token_delim="[ ,]")
+        assert c == collections.Counter({"a": 1, "b": 1, "c": 1})
+
+    def test_idx_to_vec_without_vocab(self, tmp_path):
+        p = tmp_path / "emb2.txt"
+        p.write_text("hello 1.0 2.0 3.0\nworld 4.0 5.0 6.0\n")
+        emb = text.CustomEmbedding(str(p))
+        assert emb.idx_to_vec.shape == (3, 3)  # <unk> + 2 tokens
+        np.testing.assert_allclose(emb.idx_to_vec[0], 0.0)
