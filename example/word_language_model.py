@@ -1,0 +1,108 @@
+#!/usr/bin/env python
+"""Word-level language model — the [U:example/gluon/word_language_model/]
+analog: contrib.text vocabulary + Embedding + LSTM + tied softmax,
+truncated-BPTT training with hidden-state carry and gradient clipping.
+
+    python example/word_language_model.py --epochs 3
+"""
+import argparse
+import math
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def synthetic_corpus(n_sent=400, seed=0):
+    """A tiny Markov-ish corpus a small LSTM can actually compress."""
+    rng = np.random.RandomState(seed)
+    nouns = ["cat", "dog", "bird", "fish"]
+    verbs = ["sees", "chases", "likes"]
+    sents = []
+    for _ in range(n_sent):
+        s = ["the", rng.choice(nouns), rng.choice(verbs),
+             "the", rng.choice(nouns)]
+        sents.append(" ".join(s))
+    return "\n".join(sents)
+
+
+def batchify(ids, batch_size):
+    n = len(ids) // batch_size
+    return np.asarray(ids[: n * batch_size], np.int32).reshape(batch_size, n)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=3)
+    ap.add_argument("--batch-size", type=int, default=16)
+    ap.add_argument("--bptt", type=int, default=8)
+    ap.add_argument("--embed", type=int, default=32)
+    ap.add_argument("--hidden", type=int, default=64)
+    ap.add_argument("--lr", type=float, default=1.0)
+    ap.add_argument("--clip", type=float, default=0.25)
+    args = ap.parse_args()
+
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import autograd, gluon
+    from incubator_mxnet_tpu.contrib import text
+
+    corpus = synthetic_corpus()
+    vocab = text.Vocabulary(text.count_tokens_from_str(corpus))
+    ids = vocab.to_indices(corpus.replace("\n", " <eos> ").split())
+    data = batchify(ids, args.batch_size)
+
+    class RNNModel(gluon.Block):
+        def __init__(self, vocab_size, embed, hidden, **kw):
+            super().__init__(**kw)
+            with self.name_scope():
+                self.embedding = gluon.nn.Embedding(vocab_size, embed)
+                self.lstm = gluon.rnn.LSTM(hidden, layout="NTC")
+                self.decoder = gluon.nn.Dense(vocab_size, flatten=False)
+
+        def forward(self, x, state=None):
+            h = self.embedding(x)
+            out, state = self.lstm(h, state)
+            return self.decoder(out), state
+
+        def begin_state(self, batch_size):
+            return self.lstm.begin_state(batch_size)
+
+    mx.random.seed(0)
+    net = RNNModel(len(vocab), args.embed, args.hidden)
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": args.lr})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    n_steps = (data.shape[1] - 1) // args.bptt
+    for epoch in range(args.epochs):
+        state = net.begin_state(args.batch_size)
+        total, count = 0.0, 0
+        t0 = time.time()
+        for step in range(n_steps):
+            lo = step * args.bptt
+            x = mx.nd.array(data[:, lo:lo + args.bptt], dtype="int32")
+            y = mx.nd.array(data[:, lo + 1:lo + args.bptt + 1], dtype="int32")
+            state = [s.detach() for s in state]  # truncated BPTT
+            with autograd.record():
+                out, state = net(x, state)
+                loss = loss_fn(out.reshape((-1, len(vocab))),
+                               y.reshape((-1,)))
+            loss.backward()
+            gluon.utils.clip_global_norm(
+                [p.grad() for p in net.collect_params().values()
+                 if p.grad_req != "null"],
+                args.clip * args.batch_size * args.bptt)
+            trainer.step(args.batch_size * args.bptt)
+            total += float(loss.mean().asscalar())
+            count += 1
+        ppl = math.exp(total / count)
+        print(f"epoch {epoch}: perplexity {ppl:.1f} "
+              f"({count / (time.time() - t0):.1f} steps/s)")
+
+
+if __name__ == "__main__":
+    main()

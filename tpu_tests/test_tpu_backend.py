@@ -230,3 +230,40 @@ def test_train_mlp_on_tpu():
     pred = net(mx.nd.array(xb.astype(np.float32))).asnumpy().argmax(axis=1)
     acc = (pred == yb).mean()
     assert acc > 0.9, (acc, final)
+
+
+# ---------------------------------------------------------------------------
+# Round-3 op families on the chip
+# ---------------------------------------------------------------------------
+
+
+def test_quantized_fc_on_tpu():
+    """int8 MXU matmul path executes on hardware within int8 tolerance."""
+    x = _r(8, 32)
+    w = _r(16, 32)
+    ctx = mx.tpu()
+    xq, xmn, xmx = mx.nd.quantize_v2(mx.nd.array(x, ctx=ctx))
+    wq, wmn, wmx = mx.nd.quantize_v2(mx.nd.array(w, ctx=ctx))
+    out = mx.nd.quantized_fully_connected(
+        xq, wq, None, xmn, xmx, wmn, wmx, num_hidden=16, no_bias=True)
+    ref = x @ w.T
+    np.testing.assert_allclose(out.asnumpy(), ref, atol=np.abs(ref).max() * 0.05)
+
+
+def test_control_flow_foreach_on_tpu():
+    ctx = mx.tpu()
+    data = mx.nd.array(_r(6, 4), ctx=ctx)
+    init = mx.nd.array(np.zeros(4, np.float32), ctx=ctx)
+    outs, final = mx.nd.contrib.foreach(lambda x, s: (s + x, s + x), data, init)
+    np.testing.assert_allclose(final.asnumpy(), data.asnumpy().sum(axis=0),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_gather_positions_on_tpu():
+    ctx = mx.tpu()
+    seq = mx.nd.array(_r(2, 8, 4), ctx=ctx)
+    pos_np = np.array([[1, 5], [0, 7]], np.int32)
+    pos = mx.nd.array(pos_np, ctx=ctx)
+    out = mx.nd.gather_positions(seq, pos)
+    ref = np.take_along_axis(seq.asnumpy(), pos_np[..., None], axis=1)
+    np.testing.assert_allclose(out.asnumpy(), ref, rtol=1e-6)
