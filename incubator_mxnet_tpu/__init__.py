@@ -67,6 +67,9 @@ from . import config
 from . import predictor
 from . import monitor
 from .monitor import Monitor
+from . import name
+from . import attribute
+from .attribute import AttrScope
 from . import visualization
 from . import visualization as viz
 config.apply_env()

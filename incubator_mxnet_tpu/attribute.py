@@ -1,0 +1,74 @@
+"""``mx.AttrScope`` — scoped symbol attributes.
+
+Parity target: [U:python/mxnet/attribute.py].  Every symbol created inside
+``with mx.AttrScope(ctx_group='dev1', lr_mult='0.1'):`` carries those
+attributes; ``Symbol.attr(key)`` / ``Symbol.attr_dict()`` read them back.
+The reference uses this for ``group2ctx`` model-parallel placement and
+per-parameter optimizer multipliers.
+
+TPU-native note: attributes ride the Symbol DAG as metadata only.  Static
+op kwargs live in the same per-node dict under their bare names, so scope
+attributes are stored dunder-wrapped (``ctx_group`` → ``__ctx_group__``) —
+the executor strips dunder keys before calling the op, and the JSON serde
+round-trips them.  ``ctx_group`` placement itself is subsumed by
+``jax.sharding`` PartitionSpecs (parallel/sharding.py), which is strictly
+more capable than per-group device pinning; the attribute is preserved so
+reference graphs keep their metadata through import/export.
+"""
+from __future__ import annotations
+
+import threading
+
+__all__ = ["AttrScope"]
+
+_tls = threading.local()
+
+
+def _stack():
+    if not hasattr(_tls, "attr_stack"):
+        _tls.attr_stack = []
+    return _tls.attr_stack
+
+
+class AttrScope:
+    """Context manager holding attributes to attach to symbols created in
+    scope.  Nesting merges scopes; the innermost value wins, and explicit
+    per-symbol attributes win over any scope."""
+
+    def __init__(self, **kwargs):
+        for v in kwargs.values():
+            if not isinstance(v, str):
+                raise ValueError(
+                    "AttrScope values must be strings (parity with the "
+                    f"reference attribute system); got {type(v).__name__}")
+        self._attr = kwargs
+
+    def get(self, attr=None):
+        """Merge this scope's attributes with ``attr`` (``attr`` wins)."""
+        if not self._attr:
+            return dict(attr or {})
+        merged = dict(self._attr)
+        merged.update(attr or {})
+        return merged
+
+    def __enter__(self):
+        s = _stack()
+        merged = dict(s[-1]._attr) if s else {}
+        merged.update(self._attr)
+        scope = AttrScope.__new__(AttrScope)
+        scope._attr = merged
+        s.append(scope)
+        return scope
+
+    def __exit__(self, exc_type, exc, tb):
+        _stack().pop()
+        return False
+
+
+_EMPTY = AttrScope()
+
+
+def current():
+    """The innermost active AttrScope (or an empty one)."""
+    s = _stack()
+    return s[-1] if s else _EMPTY

@@ -24,6 +24,8 @@ import json
 import threading
 
 from ..ops.registry import get_op, list_ops
+from .. import attribute as _attr_mod
+from .. import name as _name_mod
 
 __all__ = ["Symbol", "Variable", "var", "Group", "load", "load_json", "zeros", "ones"]
 
@@ -45,6 +47,15 @@ def _auto_name(hint):
 
 def _reset_naming():  # test helper
     _tls.sym_counters = {}
+
+
+def _dunder(k):
+    """Normalize a user-attr key to single-dunder storage form.  Accepts
+    both bare keys ('ctx_group') and reference-style pre-wrapped keys
+    ('__ctx_group__') without double-wrapping."""
+    if k.startswith("__") and k.endswith("__") and len(k) > 4:
+        return k
+    return f"__{k}__"
 
 
 # Aux-state naming convention (parity: BatchNorm's auxiliary moving stats
@@ -169,6 +180,29 @@ class Symbol:
 
     def list_inputs(self):
         return [n.name for n in self._topo() if n.op is None]
+
+    def attr(self, key):
+        """This symbol's attribute ``key`` (set via ``AttrScope``, the
+        ``attr=`` kwarg of Variable, or lr_mult/wd_mult), or None.
+        Parity: ``Symbol.attr`` ([U:python/mxnet/symbol/symbol.py])."""
+        k = _dunder(key)
+        if k in _TYPED_DUNDER:
+            return None
+        return self._outputs[0][0].attrs.get(k)
+
+    def attr_dict(self):
+        """``{node_name: {key: value}}`` over every node that carries
+        user-level attributes (dunder-stored, string-valued; static op
+        kwargs and internal typed attrs excluded).
+        Parity: ``Symbol.attr_dict``."""
+        out = {}
+        for node in self._topo():
+            d = {k[2:-2]: v for k, v in node.attrs.items()
+                 if k.startswith("__") and k.endswith("__")
+                 and k not in _TYPED_DUNDER}
+            if d:
+                out[node.name] = d
+        return out
 
     def get_internals(self):
         """Symbol over every node's primary output (parity:
@@ -318,6 +352,19 @@ def _parse_attr(s):
         return s
 
 
+# Internal dunder attrs carrying typed values that must be re-parsed on
+# load.  Every OTHER dunder key is a user-level attribute (AttrScope /
+# Variable ``attr=``/``lr_mult=``), string-typed by contract — left
+# verbatim so e.g. lr_mult="0.1" round-trips as the string it was set to.
+_TYPED_DUNDER = ("__input_names__", "__shape__")
+
+
+def _parse_loaded_attr(k, v):
+    if k.startswith("__") and k.endswith("__") and k not in _TYPED_DUNDER:
+        return v
+    return _parse_attr(v)
+
+
 def _binary(broadcast_op, scalar_op, lhs, rhs, swap=False):
     if isinstance(rhs, Symbol):
         return _apply_op(broadcast_op, (lhs, rhs), {})
@@ -336,11 +383,18 @@ def Variable(name, attr=None, shape=None, lr_mult=None, wd_mult=None,
     if init is not None:
         attrs["__init__"] = init if isinstance(init, str) else init.__class__.__name__
     if lr_mult is not None:
-        attrs["__lr_mult__"] = lr_mult
+        attrs["__lr_mult__"] = str(lr_mult)
     if wd_mult is not None:
-        attrs["__wd_mult__"] = wd_mult
+        attrs["__wd_mult__"] = str(wd_mult)
     if attr:
-        attrs.update(attr)
+        for k, v in attr.items():
+            if not isinstance(v, str):
+                raise ValueError(
+                    "Variable attr values must be strings (same contract "
+                    f"as AttrScope); got {type(v).__name__} for {k!r}")
+            attrs[_dunder(k)] = v
+    for k, v in _attr_mod.current().get().items():
+        attrs.setdefault(_dunder(k), v)
     return Symbol([(_Node(None, name, attrs=attrs), 0)])
 
 
@@ -355,22 +409,21 @@ def Group(symbols):
 
 
 def zeros(shape, dtype="float32", name=None, **kwargs):
-    name = name or _auto_name("_zeros")
-    return _apply_op("_sym_zeros", (), {"shape": tuple(shape) if not isinstance(shape, int) else (shape,), "dtype": dtype}, name=name)
+    return _apply_op("_sym_zeros", (), {"shape": tuple(shape) if not isinstance(shape, int) else (shape,), "dtype": dtype}, name=name, hint="_zeros")
 
 
 def ones(shape, dtype="float32", name=None, **kwargs):
-    name = name or _auto_name("_ones")
-    return _apply_op("_sym_ones", (), {"shape": tuple(shape) if not isinstance(shape, int) else (shape,), "dtype": dtype}, name=name)
+    return _apply_op("_sym_ones", (), {"shape": tuple(shape) if not isinstance(shape, int) else (shape,), "dtype": dtype}, name=name, hint="_ones")
 
 
-def _apply_op(opname, args, kwargs, name=None):
+def _apply_op(opname, args, kwargs, name=None, hint=None):
     """Build an op node: positional/keyword Symbols are tensor inputs,
     everything else static attrs; missing tensor params are auto-created as
     Variables named ``<node>_<param>``."""
     op = get_op(opname)
     tnames = _tensor_params(opname, op.fn)
-    name = name or _auto_name(opname.lower().lstrip("_"))
+    name = _name_mod.current().get(name, hint or opname.lower().lstrip("_"))
+    scope_attrs = _attr_mod.current().get()
 
     if tnames is None:  # variadic op: all positional Symbols are inputs
         inputs, input_names = [], []
@@ -385,6 +438,8 @@ def _apply_op(opname, args, kwargs, name=None):
         attrs = {k: v for k, v in kwargs.items() if not isinstance(v, Symbol)}
         node = _Node(opname, name, inputs, attrs)
         node.attrs["__input_names__"] = input_names
+        for k, v in scope_attrs.items():
+            node.attrs.setdefault(_dunder(k), v)
         return Symbol([(node, 0)])
 
     provided = {}
@@ -421,14 +476,19 @@ def _apply_op(opname, args, kwargs, name=None):
             if flag is not None and attrs.get(flag, False):
                 continue  # e.g. no_bias=True
             # missing inputs auto-create variables, incl. the MXNet idiom
-            # sym.SoftmaxOutput(data, name='softmax') → 'softmax_label'
-            inputs.append((_Node(None, f"{name}_{t}"), 0))
+            # sym.SoftmaxOutput(data, name='softmax') → 'softmax_label';
+            # they inherit the active AttrScope (the reference's main use
+            # case: per-parameter lr_mult/ctx_group on auto-created weights)
+            auto_attrs = {_dunder(k): v for k, v in scope_attrs.items()}
+            inputs.append((_Node(None, f"{name}_{t}", attrs=auto_attrs), 0))
             input_names.append(t)
 
     # pass skipped-optional info through attrs so the executor calls the op
     # with the right arity
     node = _Node(opname, name, inputs, attrs)
     node.attrs["__input_names__"] = input_names
+    for k, v in scope_attrs.items():
+        node.attrs.setdefault(_dunder(k), v)
     return Symbol([(node, 0)])
 
 
@@ -447,7 +507,7 @@ def load_json(json_str):
     payload = json.loads(json_str)
     nodes = []
     for spec in payload["nodes"]:
-        attrs = {k: _parse_attr(v) for k, v in spec.get("attrs", {}).items()}
+        attrs = {k: _parse_loaded_attr(k, v) for k, v in spec.get("attrs", {}).items()}
         op = spec["op"]
         node = _Node(None if op == "null" else op, spec["name"], attrs=attrs)
         nodes.append((node, spec.get("inputs", [])))

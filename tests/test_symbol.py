@@ -242,3 +242,112 @@ class TestReviewRegressions:
         # d(dropout(x))/dx elementwise == y/x (mask/(1-p)); must match the
         # mask actually drawn in forward
         np.testing.assert_allclose(g, y / x, rtol=1e-5)
+
+
+class TestNamingAndAttrs:
+    """mx.name.Prefix / NameManager + mx.AttrScope (parity:
+    [U:python/mxnet/name.py], [U:python/mxnet/attribute.py])."""
+
+    def test_prefix_scopes_auto_names(self):
+        data = sym.Variable("data")
+        with mx.name.Prefix("stage1_"):
+            fc = sym.FullyConnected(data, num_hidden=4)
+        args = fc.list_arguments()
+        assert fc.name.startswith("stage1_fullyconnected")
+        assert any(a.startswith("stage1_") and a.endswith("_weight") for a in args)
+
+    def test_name_manager_counts_per_scope(self):
+        data = sym.Variable("data")
+        with mx.name.NameManager():
+            a = sym.Activation(data, act_type="relu")
+            b = sym.Activation(data, act_type="relu")
+        assert a.name == "activation0"
+        assert b.name == "activation1"
+        # fresh manager restarts the count
+        with mx.name.NameManager():
+            c = sym.Activation(data, act_type="relu")
+        assert c.name == "activation0"
+
+    def test_prefix_applies_to_explicit_names(self):
+        data = sym.Variable("data")
+        with mx.name.Prefix("p_"):
+            fc = sym.FullyConnected(data, num_hidden=4, name="fc")
+        assert fc.name == "p_fc"
+        assert "p_fc_weight" in fc.list_arguments()
+
+    def test_attr_scope_attaches_and_reads_back(self):
+        data = sym.Variable("data")
+        with mx.AttrScope(ctx_group="dev1"):
+            fc = sym.FullyConnected(data, num_hidden=4, name="fc1")
+        assert fc.attr("ctx_group") == "dev1"
+        assert fc.attr_dict()["fc1"]["ctx_group"] == "dev1"
+        # symbols created outside the scope carry nothing
+        fc2 = sym.FullyConnected(data, num_hidden=4, name="fc2")
+        assert fc2.attr("ctx_group") is None
+
+    def test_attr_scope_nesting_and_explicit_override(self):
+        with mx.AttrScope(ctx_group="a", lr_mult="2"):
+            with mx.AttrScope(ctx_group="b"):
+                v = sym.Variable("w", attr={"ctx_group": "explicit"})
+                fc = sym.FullyConnected(v, num_hidden=4, name="fc")
+        assert v.attr("ctx_group") == "explicit"   # explicit wins
+        assert v.attr("lr_mult") == "2"            # outer scope inherited
+        assert fc.attr("ctx_group") == "b"         # inner scope wins
+
+    def test_attr_scope_rejects_non_string(self):
+        with pytest.raises(ValueError):
+            mx.AttrScope(lr_mult=2.0)
+
+    def test_attrs_do_not_leak_into_op_kwargs(self):
+        # executor must still run: scope attrs are metadata, not op kwargs
+        data = sym.Variable("data")
+        with mx.AttrScope(ctx_group="dev1"):
+            out = sym.FullyConnected(data, num_hidden=3, name="fc")
+        ex = out.simple_bind(data=(2, 5))
+        y = ex.forward()[0]
+        assert y.shape == (2, 3)
+
+    def test_attrs_roundtrip_json(self):
+        data = sym.Variable("data")
+        with mx.AttrScope(ctx_group="dev7"):
+            out = sym.FullyConnected(data, num_hidden=3, name="fc")
+        loaded = mx.sym.load_json(out.tojson())
+        assert loaded.attr("ctx_group") == "dev7"
+
+    def test_attr_scope_reaches_autocreated_params(self):
+        data = sym.Variable("data")
+        with mx.AttrScope(lr_mult="0.1"):
+            fc = sym.FullyConnected(data, num_hidden=4, name="fc")
+        d = fc.attr_dict()
+        assert d["fc_weight"]["lr_mult"] == "0.1"
+        assert d["fc_bias"]["lr_mult"] == "0.1"
+        assert "data" not in d  # created outside the scope
+
+    def test_attr_dict_excludes_internal_typed_attrs(self):
+        v = sym.Variable("w", shape=(2, 3), attr={"k": "v"})
+        assert v.attr_dict() == {"w": {"k": "v"}}
+        assert v.infer_shape()[0]  # __shape__ still drives inference
+        loaded = mx.sym.load_json(v.tojson())
+        assert loaded.attr_dict()["w"]["k"] == "v"
+        assert "shape" not in loaded.attr_dict()["w"]
+
+    def test_variable_attr_rejects_non_string(self):
+        with pytest.raises(ValueError):
+            sym.Variable("w", attr={"foo": 2})
+
+    def test_review_regressions(self):
+        # zeros/ones resolve their name exactly once under Prefix
+        with mx.name.Prefix("p_"):
+            z = sym.zeros((2, 2))
+            zn = sym.zeros((2, 2), name="z")
+        assert z.name == "p__zeros0"
+        assert zn.name == "p_z"
+        # reference-style pre-dunder attr keys are stored once, readable
+        v = sym.Variable("w", attr={"__ctx_group__": "dev1"})
+        assert v.attr("ctx_group") == "dev1"
+        assert v.attr("__ctx_group__") == "dev1"
+        assert v.attr_dict()["w"]["ctx_group"] == "dev1"
+        # as-bound scope object sees inherited outer attrs
+        with mx.AttrScope(a="1"):
+            with mx.AttrScope(b="2") as inner:
+                assert inner.get() == {"a": "1", "b": "2"}
