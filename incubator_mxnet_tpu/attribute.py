@@ -23,6 +23,19 @@ __all__ = ["AttrScope"]
 
 _tls = threading.local()
 
+# Keys whose dunder form collides with internal graph metadata
+# (__shape__/__dtype__/__init__/__input_names__ in symbol.py) — user attrs
+# may not use them, or they would silently corrupt shape/type inference.
+_RESERVED = frozenset({"shape", "dtype", "init", "input_names"})
+
+
+def _check_key(k, where):
+    base = k.strip("_")
+    if base in _RESERVED:
+        raise ValueError(
+            f"{where} key {k!r} is reserved for internal graph metadata "
+            f"(reserved: {sorted(_RESERVED)})")
+
 
 def _stack():
     if not hasattr(_tls, "attr_stack"):
@@ -36,7 +49,8 @@ class AttrScope:
     per-symbol attributes win over any scope."""
 
     def __init__(self, **kwargs):
-        for v in kwargs.values():
+        for k, v in kwargs.items():
+            _check_key(k, "AttrScope")
             if not isinstance(v, str):
                 raise ValueError(
                     "AttrScope values must be strings (parity with the "

@@ -1,5 +1,7 @@
 """``gluon.contrib`` (parity: [U:python/mxnet/gluon/contrib/])."""
 from . import estimator
 from .estimator import Estimator
+from . import nn
+from . import rnn
 
-__all__ = ["estimator", "Estimator"]
+__all__ = ["estimator", "Estimator", "nn", "rnn"]

@@ -1,0 +1,154 @@
+"""``gluon.contrib.nn`` — experimental layer extras.
+
+Parity target: [U:python/mxnet/gluon/contrib/nn/basic_layers.py] —
+Concurrent, HybridConcurrent, Identity, SparseEmbedding, SyncBatchNorm,
+PixelShuffle1D/2D/3D.
+
+TPU-native notes:
+* ``SyncBatchNorm``: the reference implements cross-GPU stat sync with a
+  dedicated NCCL kernel ([U:src/operator/contrib/sync_batch_norm.cc]).
+  Under this framework's SPMD design the batch axis is *sharded over the
+  mesh inside one jitted program*, so the plain BatchNorm reduction over
+  the batch axis is already a global reduction — XLA inserts the
+  cross-device collective automatically.  SyncBatchNorm is therefore
+  BatchNorm (the subsumption is the feature); ``num_devices`` is accepted
+  and ignored.
+* ``SparseEmbedding``: the reference stores the gradient row_sparse so the
+  PS only moves touched rows.  Here the dense-storage/lazy-update
+  equivalent is ``grad_stype='row_sparse'`` (optimizer applies
+  ``*_lazy_update`` row-wise semantics; see ndarray/sparse.py divergence
+  note).
+* ``PixelShuffle*D``: pure reshape/transpose — XLA fuses them into
+  neighbouring ops; shapes are static under trace so ``x.shape`` is free.
+"""
+from __future__ import annotations
+
+from ..nn.basic_layers import (
+    BatchNorm,
+    Concatenate,
+    Embedding,
+    HybridConcatenate,
+    Identity,
+)
+from ..block import HybridBlock
+
+__all__ = [
+    "Concurrent",
+    "HybridConcurrent",
+    "Identity",
+    "SparseEmbedding",
+    "SyncBatchNorm",
+    "PixelShuffle1D",
+    "PixelShuffle2D",
+    "PixelShuffle3D",
+]
+
+
+class Concurrent(Concatenate):
+    """Run children on the same input, concat outputs (parity:
+    ``contrib.nn.Concurrent``; the 2.x name is Concatenate)."""
+
+
+class HybridConcurrent(HybridConcatenate):
+    """Hybridizable :class:`Concurrent` (parity:
+    ``contrib.nn.HybridConcurrent``)."""
+
+
+class SparseEmbedding(Embedding):
+    """Embedding whose gradient is row-sparse (parity:
+    ``contrib.nn.SparseEmbedding``).  Storage is dense on TPU; the
+    row-sparse contract survives as lazy per-row optimizer updates."""
+
+    def __init__(self, input_dim, output_dim, dtype="float32", weight_initializer=None,
+                 prefix=None, params=None):
+        super().__init__(input_dim, output_dim, dtype=dtype,
+                         weight_initializer=weight_initializer,
+                         sparse_grad=True, prefix=prefix, params=params)
+
+
+class SyncBatchNorm(BatchNorm):
+    """Cross-device synchronized BatchNorm (parity:
+    ``contrib.nn.SyncBatchNorm``).  See module docstring: under SPMD the
+    batch-axis reduction is already global, so this IS BatchNorm."""
+
+    def __init__(self, in_channels=0, num_devices=None, momentum=0.9, epsilon=1e-5,
+                 center=True, scale=True, use_global_stats=False,
+                 beta_initializer="zeros", gamma_initializer="ones",
+                 running_mean_initializer="zeros", running_variance_initializer="ones",
+                 **kwargs):
+        del num_devices  # subsumed: stats reduce over the full sharded batch
+        super().__init__(axis=1, momentum=momentum, epsilon=epsilon,
+                         center=center, scale=scale,
+                         use_global_stats=use_global_stats,
+                         beta_initializer=beta_initializer,
+                         gamma_initializer=gamma_initializer,
+                         running_mean_initializer=running_mean_initializer,
+                         running_variance_initializer=running_variance_initializer,
+                         in_channels=in_channels, **kwargs)
+
+
+class PixelShuffle1D(HybridBlock):
+    """Rearrange ``(N, C*f, W)`` → ``(N, C, W*f)`` (parity:
+    ``contrib.nn.PixelShuffle1D``)."""
+
+    def __init__(self, factor, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._factor = int(factor)
+
+    def hybrid_forward(self, F, x):
+        f = self._factor
+        n, cf, w = x.shape
+        x = F.reshape(x, shape=(n, cf // f, f, w))
+        x = F.transpose(x, axes=(0, 1, 3, 2))        # (N, C, W, f)
+        return F.reshape(x, shape=(n, cf // f, w * f))
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self._factor})"
+
+
+class PixelShuffle2D(HybridBlock):
+    """Rearrange ``(N, C*f1*f2, H, W)`` → ``(N, C, H*f1, W*f2)`` (parity:
+    ``contrib.nn.PixelShuffle2D`` — the sub-pixel conv upsampler)."""
+
+    def __init__(self, factor, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        try:
+            f1, f2 = factor
+        except TypeError:
+            f1 = f2 = factor
+        self._factors = (int(f1), int(f2))
+
+    def hybrid_forward(self, F, x):
+        f1, f2 = self._factors
+        n, c, h, w = x.shape
+        c //= f1 * f2
+        x = F.reshape(x, shape=(n, c, f1, f2, h, w))
+        x = F.transpose(x, axes=(0, 1, 4, 2, 5, 3))  # (N, C, H, f1, W, f2)
+        return F.reshape(x, shape=(n, c, h * f1, w * f2))
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self._factors})"
+
+
+class PixelShuffle3D(HybridBlock):
+    """Rearrange ``(N, C*f1*f2*f3, D, H, W)`` → ``(N, C, D*f1, H*f2, W*f3)``
+    (parity: ``contrib.nn.PixelShuffle3D``)."""
+
+    def __init__(self, factor, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        try:
+            f1, f2, f3 = factor
+        except TypeError:
+            f1 = f2 = f3 = factor
+        self._factors = (int(f1), int(f2), int(f3))
+
+    def hybrid_forward(self, F, x):
+        f1, f2, f3 = self._factors
+        n, c, d, h, w = x.shape
+        c //= f1 * f2 * f3
+        x = F.reshape(x, shape=(n, c, f1, f2, f3, d, h, w))
+        x = F.transpose(x, axes=(0, 1, 5, 2, 6, 3, 7, 4))
+        return F.reshape(x, shape=(n, c, d * f1, h * f2, w * f3))
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self._factors})"

@@ -1,0 +1,155 @@
+"""``gluon.contrib.rnn`` — experimental recurrent-cell extras.
+
+Parity target: [U:python/mxnet/gluon/contrib/rnn/rnn_cell.py] —
+``VariationalDropoutCell`` (one dropout mask shared across every time
+step, Gal & Ghahramani 2016) and ``LSTMPCell`` (LSTM with a hidden-state
+projection, the LSTMP of Sak et al. 2014).
+
+The reference's Conv{1,2,3}D{RNN,LSTM,GRU}Cell family is not ported
+(documented divergence: no baseline workload exercises convolutional
+recurrence; the cells compose from Convolution + the RecurrentCell
+contract here if needed).
+
+TPU-native note: the variational masks are drawn once per sequence with
+the framework RNG and then reused — under trace the mask is a plain
+captured tensor, so every step's multiply fuses into the cell matmuls.
+"""
+from __future__ import annotations
+
+from ..rnn.rnn_cell import LSTMCell, RecurrentCell, _ModifierCell
+
+__all__ = ["VariationalDropoutCell", "LSTMPCell"]
+
+
+class VariationalDropoutCell(_ModifierCell):
+    """Apply fixed dropout masks to inputs/states/outputs across all time
+    steps of a sequence (parity: ``contrib.rnn.VariationalDropoutCell``).
+
+    Masks are (re)drawn on the first call after ``reset()`` — one mask per
+    role, shared by every subsequent step, so the same units are dropped
+    for the whole sequence."""
+
+    def __init__(self, base_cell, drop_inputs=0.0, drop_states=0.0, drop_outputs=0.0):
+        super().__init__(base_cell)
+        self.drop_inputs = drop_inputs
+        self.drop_states = drop_states
+        self.drop_outputs = drop_outputs
+        self._input_mask = None
+        self._state_mask = None
+        self._output_mask = None
+
+    def reset(self):
+        super().reset()
+        self._input_mask = None
+        self._state_mask = None
+        self._output_mask = None
+
+    @staticmethod
+    def _mask(p, like):
+        from ... import ndarray as nd
+
+        # Dropout of ones == scaled keep-mask (values 0 or 1/(1-p)); reusing
+        # it IS the variational trick.
+        return nd.Dropout(nd.ones_like(like), p=p, training=True)
+
+    def __call__(self, inputs, states):
+        from ... import autograd
+
+        if not autograd.is_training():
+            return self.base_cell(inputs, states)
+        if self.drop_inputs:
+            if self._input_mask is None:
+                self._input_mask = self._mask(self.drop_inputs, inputs)
+            inputs = inputs * self._input_mask
+        if self.drop_states:
+            if self._state_mask is None:
+                self._state_mask = self._mask(self.drop_states, states[0])
+            states = [states[0] * self._state_mask] + list(states[1:])
+        output, next_states = self.base_cell(inputs, states)
+        if self.drop_outputs:
+            if self._output_mask is None:
+                self._output_mask = self._mask(self.drop_outputs, output)
+            output = output * self._output_mask
+        return output, next_states
+
+    def _alias(self):
+        return "vardrop"
+
+    def hybrid_forward(self, F, inputs, states):
+        raise NotImplementedError  # stateful masks: dispatch is in __call__
+
+    def __repr__(self):
+        return (f"VariationalDropoutCell(p_in={self.drop_inputs}, "
+                f"p_state={self.drop_states}, p_out={self.drop_outputs})")
+
+
+class LSTMPCell(RecurrentCell):
+    """LSTM cell with hidden-state projection (parity:
+    ``contrib.rnn.LSTMPCell``).  Gate math matches :class:`LSTMCell`
+    (order [i, f, g, o]); the output hidden state is ``r = W_r h`` with
+    ``W_r`` of shape (projection_size, hidden_size), shrinking the
+    recurrent matmul to (4h × p) — the Sak et al. LSTMP."""
+
+    def __init__(self, hidden_size, projection_size,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 h2r_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 input_size=0, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._hidden_size = hidden_size
+        self._projection_size = projection_size
+        self._input_size = input_size
+        with self.name_scope():
+            self.i2h_weight = self.params.get(
+                "i2h_weight", shape=(4 * hidden_size, input_size),
+                init=i2h_weight_initializer, allow_deferred_init=True)
+            self.h2h_weight = self.params.get(
+                "h2h_weight", shape=(4 * hidden_size, projection_size),
+                init=h2h_weight_initializer, allow_deferred_init=True)
+            self.h2r_weight = self.params.get(
+                "h2r_weight", shape=(projection_size, hidden_size),
+                init=h2r_weight_initializer, allow_deferred_init=True)
+            self.i2h_bias = self.params.get(
+                "i2h_bias", shape=(4 * hidden_size,),
+                init=i2h_bias_initializer, allow_deferred_init=True)
+            self.h2h_bias = self.params.get(
+                "h2h_bias", shape=(4 * hidden_size,),
+                init=h2h_bias_initializer, allow_deferred_init=True)
+
+    def state_info(self, batch_size=0):
+        return [
+            {"shape": (batch_size, self._projection_size), "__layout__": "NC"},
+            {"shape": (batch_size, self._hidden_size), "__layout__": "NC"},
+        ]
+
+    def _alias(self):
+        return "lstmp"
+
+    def _shape_inference(self, x, *args):
+        self.i2h_weight._finish_deferred_init((4 * self._hidden_size, x.shape[-1]))
+        self.h2h_weight._finish_deferred_init((4 * self._hidden_size, self._projection_size))
+        self.h2r_weight._finish_deferred_init((self._projection_size, self._hidden_size))
+        self.i2h_bias._finish_deferred_init((4 * self._hidden_size,))
+        self.h2h_bias._finish_deferred_init((4 * self._hidden_size,))
+
+    def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight, h2r_weight,
+                       i2h_bias, h2h_bias):
+        prev_r, prev_c = states
+        i2h = F.FullyConnected(inputs, i2h_weight, i2h_bias,
+                               num_hidden=4 * self._hidden_size)
+        h2h = F.FullyConnected(prev_r, h2h_weight, h2h_bias,
+                               num_hidden=4 * self._hidden_size)
+        gates = i2h + h2h
+        slices = F.split(gates, num_outputs=4, axis=1)
+        in_gate = F.sigmoid(slices[0])
+        forget_gate = F.sigmoid(slices[1])
+        in_transform = F.tanh(slices[2])
+        out_gate = F.sigmoid(slices[3])
+        next_c = forget_gate * prev_c + in_gate * in_transform
+        hidden = out_gate * F.tanh(next_c)
+        next_r = F.FullyConnected(hidden, h2r_weight, no_bias=True,
+                                  num_hidden=self._projection_size)
+        return next_r, [next_r, next_c]
+
+    def __repr__(self):
+        return (f"LSTMPCell({self._hidden_size} -> {self._projection_size})")

@@ -352,11 +352,12 @@ def _parse_attr(s):
         return s
 
 
-# Internal dunder attrs carrying typed values that must be re-parsed on
-# load.  Every OTHER dunder key is a user-level attribute (AttrScope /
-# Variable ``attr=``/``lr_mult=``), string-typed by contract — left
-# verbatim so e.g. lr_mult="0.1" round-trips as the string it was set to.
-_TYPED_DUNDER = ("__input_names__", "__shape__")
+# Internal dunder attrs (graph metadata, hidden from attr()/attr_dict();
+# the first two carry typed values re-parsed on load).  Every OTHER dunder
+# key is a user-level attribute (AttrScope / Variable ``attr=``/
+# ``lr_mult=``), string-typed by contract — left verbatim so e.g.
+# lr_mult="0.1" round-trips as the string it was set to.
+_TYPED_DUNDER = ("__input_names__", "__shape__", "__dtype__", "__init__")
 
 
 def _parse_loaded_attr(k, v):
@@ -388,6 +389,7 @@ def Variable(name, attr=None, shape=None, lr_mult=None, wd_mult=None,
         attrs["__wd_mult__"] = str(wd_mult)
     if attr:
         for k, v in attr.items():
+            _attr_mod._check_key(k, "Variable attr")
             if not isinstance(v, str):
                 raise ValueError(
                     "Variable attr values must be strings (same contract "

@@ -1,0 +1,143 @@
+"""gluon.contrib nn/rnn extras (parity idioms:
+tests/python/unittest/test_gluon_contrib.py in the reference —
+pixelshuffle shape/value checks, variational-dropout mask reuse, LSTMP
+state shapes)."""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import gluon
+from incubator_mxnet_tpu.gluon import nn
+from incubator_mxnet_tpu.gluon.contrib import nn as cnn
+from incubator_mxnet_tpu.gluon.contrib import rnn as crnn
+
+
+class TestContribNN:
+    def test_concurrent(self):
+        net = cnn.HybridConcurrent(axis=1)
+        net.add(nn.Dense(3), nn.Dense(5))
+        net.initialize()
+        out = net(mx.nd.ones((2, 4)))
+        assert out.shape == (2, 8)
+        net.hybridize()
+        out2 = net(mx.nd.ones((2, 4)))
+        np.testing.assert_allclose(out.asnumpy(), out2.asnumpy(), rtol=1e-6)
+
+    def test_identity(self):
+        x = mx.nd.array(np.random.rand(3, 3))
+        np.testing.assert_array_equal(cnn.Identity()(x).asnumpy(), x.asnumpy())
+
+    def test_pixelshuffle1d(self):
+        net = cnn.PixelShuffle1D(2)
+        x = mx.nd.array(np.arange(12).reshape(1, 4, 3).astype(np.float32))
+        y = net(x)
+        assert y.shape == (1, 2, 6)
+        # channel c, position w*f+j comes from input channel c*f+j
+        xn = x.asnumpy()
+        yn = y.asnumpy()
+        for c in range(2):
+            for w in range(3):
+                for j in range(2):
+                    assert yn[0, c, w * 2 + j] == xn[0, c * 2 + j, w]
+
+    def test_pixelshuffle2d_matches_torch_semantics(self):
+        # oracle: torch.nn.functional.pixel_shuffle
+        torch = pytest.importorskip("torch")
+        f = 2
+        x = np.random.rand(2, 8, 3, 5).astype(np.float32)
+        want = torch.nn.functional.pixel_shuffle(torch.from_numpy(x), f).numpy()
+        got = cnn.PixelShuffle2D(f)(mx.nd.array(x)).asnumpy()
+        np.testing.assert_allclose(got, want, rtol=1e-6)
+
+    def test_pixelshuffle3d_shape_and_volume(self):
+        net = cnn.PixelShuffle3D((2, 1, 2))
+        x = mx.nd.array(np.random.rand(1, 8, 2, 3, 4).astype(np.float32))
+        y = net(x)
+        assert y.shape == (1, 2, 4, 3, 8)
+        assert np.allclose(np.sort(y.asnumpy().ravel()),
+                           np.sort(x.asnumpy().ravel()))
+
+    def test_pixelshuffle_hybridized(self):
+        net = cnn.PixelShuffle2D(2)
+        x = mx.nd.array(np.random.rand(2, 8, 3, 3).astype(np.float32))
+        eager = net(x).asnumpy()
+        net.hybridize()
+        np.testing.assert_allclose(net(x).asnumpy(), eager, rtol=1e-6)
+
+    def test_sync_batch_norm_is_batch_norm(self):
+        net = cnn.SyncBatchNorm(in_channels=4, num_devices=8)
+        net.initialize()
+        x = mx.nd.array(np.random.rand(2, 4, 3, 3).astype(np.float32))
+        ref = nn.BatchNorm(in_channels=4)
+        ref.initialize()
+        with mx.autograd.record():
+            y = net(x)
+            want = ref(x)
+        np.testing.assert_allclose(y.asnumpy(), want.asnumpy(),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_sparse_embedding_row_sparse_contract(self):
+        emb = cnn.SparseEmbedding(10, 4)
+        emb.initialize()
+        assert emb.weight.stype == "row_sparse"
+        out = emb(mx.nd.array(np.array([[1, 2]], dtype=np.float32)))
+        assert out.shape == (1, 2, 4)
+
+
+class TestContribRNN:
+    def test_variational_dropout_mask_reused_across_steps(self):
+        mx.random.seed(7)
+        cell = crnn.VariationalDropoutCell(
+            gluon.rnn.RNNCell(8), drop_outputs=0.5)
+        cell.base_cell.initialize()
+        x = mx.nd.ones((4, 8))
+        states = cell.begin_state(batch_size=4)
+        with mx.autograd.record():
+            o1, states = cell(x, states)
+            o2, _ = cell(x, states)
+        z1 = o1.asnumpy() == 0.0
+        z2 = o2.asnumpy() == 0.0
+        # same units dropped at both steps (the variational property)
+        np.testing.assert_array_equal(z1, z2)
+        assert z1.any()
+        # a fresh sequence redraws the mask eventually
+        cell.reset()
+        assert cell._output_mask is None
+
+    def test_variational_dropout_inference_is_identity(self):
+        cell = crnn.VariationalDropoutCell(
+            gluon.rnn.RNNCell(8), drop_inputs=0.5, drop_outputs=0.5)
+        cell.base_cell.initialize()
+        x = mx.nd.ones((2, 8))
+        states = cell.begin_state(batch_size=2)
+        base_out, _ = cell.base_cell(x, states)
+        out, _ = cell(x, states)
+        np.testing.assert_allclose(out.asnumpy(), base_out.asnumpy())
+
+    def test_lstmp_shapes_and_unroll(self):
+        cell = crnn.LSTMPCell(hidden_size=16, projection_size=6)
+        cell.initialize()
+        x = mx.nd.ones((3, 5))
+        states = cell.begin_state(batch_size=3)
+        assert states[0].shape == (3, 6)      # projected h
+        assert states[1].shape == (3, 16)     # cell state
+        out, next_states = cell(x, states)
+        assert out.shape == (3, 6)
+        assert next_states[0].shape == (3, 6)
+        assert next_states[1].shape == (3, 16)
+        outs, _ = cell.unroll(4, mx.nd.ones((3, 4, 5)), layout="NTC")
+        assert outs.shape == (3, 4, 6)
+
+    def test_lstmp_gradients_flow(self):
+        cell = crnn.LSTMPCell(hidden_size=8, projection_size=4)
+        cell.initialize()
+        x = mx.nd.array(np.random.rand(2, 3).astype(np.float32))
+        states = cell.begin_state(batch_size=2)
+        with mx.autograd.record():
+            out, _ = cell(x, states)
+            loss = mx.nd.sum(out * out)
+        loss.backward()
+        for name, p in cell.collect_params().items():
+            g = p.grad().asnumpy()
+            assert np.isfinite(g).all(), name
+        assert np.abs(cell.h2r_weight.grad().asnumpy()).sum() > 0

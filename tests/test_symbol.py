@@ -351,3 +351,16 @@ class TestNamingAndAttrs:
         with mx.AttrScope(a="1"):
             with mx.AttrScope(b="2") as inner:
                 assert inner.get() == {"a": "1", "b": "2"}
+
+    def test_reserved_attr_keys_rejected(self):
+        with pytest.raises(ValueError):
+            mx.AttrScope(shape="NCHW")
+        with pytest.raises(ValueError):
+            mx.AttrScope(__dtype__="x")
+        with pytest.raises(ValueError):
+            sym.Variable("w", attr={"init": "Xavier"})
+
+    def test_internal_var_metadata_hidden_from_attr_api(self):
+        v = sym.Variable("w", shape=(2, 3), dtype="float16", init="Xavier")
+        assert v.attr("dtype") is None and v.attr("init") is None
+        assert v.attr_dict() == {}
