@@ -9,5 +9,6 @@ from .. import amp  # noqa: F401  (reference path: mx.contrib.amp)
 from . import quantization  # noqa: F401
 from . import onnx  # noqa: F401
 from . import text  # noqa: F401
+from . import svrg_optimization  # noqa: F401
 
-__all__ = ["amp", "quantization", "onnx", "text"]
+__all__ = ["amp", "quantization", "onnx", "text", "svrg_optimization"]

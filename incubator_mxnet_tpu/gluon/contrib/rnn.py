@@ -16,7 +16,7 @@ captured tensor, so every step's multiply fuses into the cell matmuls.
 """
 from __future__ import annotations
 
-from ..rnn.rnn_cell import LSTMCell, RecurrentCell, _ModifierCell
+from ..rnn.rnn_cell import RecurrentCell, _ModifierCell
 
 __all__ = ["VariationalDropoutCell", "LSTMPCell"]
 

@@ -352,16 +352,19 @@ def _parse_attr(s):
         return s
 
 
-# Internal dunder attrs (graph metadata, hidden from attr()/attr_dict();
-# the first two carry typed values re-parsed on load).  Every OTHER dunder
-# key is a user-level attribute (AttrScope / Variable ``attr=``/
+# Internal dunder attrs (graph metadata, hidden from attr()/attr_dict()).
+# Only _PARSED_DUNDER carry typed values re-parsed on load; __dtype__/
+# __init__ stay strings (an __init__ attr may itself be JSON — the
+# Initializer.dumps() format — and must round-trip verbatim).  Every OTHER
+# dunder key is a user-level attribute (AttrScope / Variable ``attr=``/
 # ``lr_mult=``), string-typed by contract — left verbatim so e.g.
 # lr_mult="0.1" round-trips as the string it was set to.
 _TYPED_DUNDER = ("__input_names__", "__shape__", "__dtype__", "__init__")
+_PARSED_DUNDER = ("__input_names__", "__shape__")
 
 
 def _parse_loaded_attr(k, v):
-    if k.startswith("__") and k.endswith("__") and k not in _TYPED_DUNDER:
+    if k.startswith("__") and k.endswith("__") and k not in _PARSED_DUNDER:
         return v
     return _parse_attr(v)
 
@@ -387,6 +390,20 @@ def Variable(name, attr=None, shape=None, lr_mult=None, wd_mult=None,
         attrs["__lr_mult__"] = str(lr_mult)
     if wd_mult is not None:
         attrs["__wd_mult__"] = str(wd_mult)
+    if stype is not None:
+        attrs["__stype__"] = str(stype)
+    # reference contract: extra kwargs must be dunder-named attributes
+    # (``sym.Variable('w', __ctx_group__='dev1')``); anything else raises
+    # rather than being silently dropped
+    for k, v in kwargs.items():
+        if not (k.startswith("__") and k.endswith("__")):
+            raise ValueError(
+                f"Variable: unknown kwarg {k!r} — attribute kwargs must be "
+                "dunder-named (e.g. __ctx_group__), or use attr={...}")
+        if attr and k in attr:
+            continue
+        attr = dict(attr or {})
+        attr[k] = v
     if attr:
         for k, v in attr.items():
             _attr_mod._check_key(k, "Variable attr")

@@ -364,3 +364,19 @@ class TestNamingAndAttrs:
         v = sym.Variable("w", shape=(2, 3), dtype="float16", init="Xavier")
         assert v.attr("dtype") is None and v.attr("init") is None
         assert v.attr_dict() == {}
+
+    def test_variable_kwarg_attrs(self):
+        v = sym.Variable("w", __ctx_group__="dev1")
+        assert v.attr("ctx_group") == "dev1"
+        with pytest.raises(ValueError):
+            sym.Variable("w", ctx_group="dev1")  # non-dunder kwarg
+        v2 = sym.Variable("w2", stype="row_sparse")
+        assert v2.attr("stype") == "row_sparse"
+
+    def test_json_init_attr_roundtrips_verbatim(self):
+        # __init__ may itself be JSON (Initializer.dumps format) — must
+        # stay a string through save/load
+        v = sym.Variable("w", init='["Xavier", {"magnitude": 2}]')
+        loaded = mx.sym.load_json(v.tojson())
+        got = loaded._outputs[0][0].attrs["__init__"]
+        assert got == '["Xavier", {"magnitude": 2}]'
