@@ -1,0 +1,98 @@
+#!/usr/bin/env python
+"""INT8 post-training quantization — the [U:example/quantization/] analog:
+train a small fp32 CNN, calibrate activation ranges on held-out batches,
+quantize in place with ``mx.contrib.quantization.quantize_net``, and
+report fp32-vs-int8 accuracy and agreement.
+
+TPU-native notes: the int8 path runs weights and activations through the
+MXU's native int8 matmul/conv (``ops/quantization.py``); calibration is
+minmax over hooked layer inputs, matching the reference's ``calib_mode=
+'naive'``.
+
+    python example/quantize_int8.py --epochs 2
+"""
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+logging.basicConfig(level=logging.INFO)
+
+
+def synthetic(n, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.rand(n, 1, 16, 16).astype(np.float32)
+    w = np.random.RandomState(1).randn(256, 10)
+    y = (x.reshape(n, -1) @ w).argmax(1).astype(np.float32)
+    return x, y
+
+
+def accuracy(net, X, y, batch=128):
+    import incubator_mxnet_tpu as mx
+    correct = 0
+    for i in range(0, len(X), batch):
+        out = net(mx.nd.array(X[i:i + batch])).asnumpy()
+        correct += (out.argmax(1) == y[i:i + batch]).sum()
+    return correct / len(X)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=2)
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--calib-batches", type=int, default=4)
+    args = ap.parse_args()
+
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import gluon
+    from incubator_mxnet_tpu.contrib.quantization import quantize_net
+    from incubator_mxnet_tpu.gluon import nn
+
+    Xtr, ytr = synthetic(2048)
+    Xte, yte = synthetic(512, seed=7)
+
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(8, 3, activation="relu"), nn.MaxPool2D(2, 2),
+            nn.Flatten(), nn.Dense(64, activation="relu"), nn.Dense(10))
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "adam", {"learning_rate": 1e-3})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    for epoch in range(args.epochs):
+        lsum, nb = 0.0, 0
+        for i in range(0, len(Xtr), args.batch_size):
+            xb = mx.nd.array(Xtr[i:i + args.batch_size])
+            yb = mx.nd.array(ytr[i:i + args.batch_size])
+            with mx.autograd.record():
+                loss = loss_fn(net(xb), yb)
+            loss.backward()
+            trainer.step(xb.shape[0])
+            lsum += loss.mean().asscalar()
+            nb += 1
+        logging.info("epoch %d: loss=%.4f", epoch, lsum / nb)
+
+    fp32_acc = accuracy(net, Xte, yte)
+    fp32_out = net(mx.nd.array(Xte[:256])).asnumpy()
+
+    # -- calibrate + quantize in place -----------------------------------
+    n_calib = min(args.calib_batches, len(Xtr) // args.batch_size)
+    calib = [mx.nd.array(Xtr[i * args.batch_size:(i + 1) * args.batch_size])
+             for i in range(n_calib)]
+    quantize_net(net, calib, quantized_dtype="int8", calib_mode="naive")
+
+    int8_acc = accuracy(net, Xte, yte)
+    int8_out = net(mx.nd.array(Xte[:256])).asnumpy()
+    agree = (fp32_out.argmax(1) == int8_out.argmax(1)).mean()
+
+    logging.info("fp32 acc=%.3f  int8 acc=%.3f  top1 agreement=%.3f",
+                 fp32_acc, int8_acc, agree)
+    print(f"fp32-acc {fp32_acc:.3f} int8-acc {int8_acc:.3f} agreement {agree:.3f}")
+    return fp32_acc, int8_acc, agree
+
+
+if __name__ == "__main__":
+    main()
