@@ -1,0 +1,33 @@
+"""MXNET_TPU_PRNG / determinism interplay (config.py env contract)."""
+import os
+import subprocess
+import sys
+
+_PROBE = ("import sys; sys.path.insert(0, {root!r}); "
+          "import incubator_mxnet_tpu, jax; "
+          "print('IMPL=' + str(jax.config.jax_default_prng_impl))")
+
+
+def _impl(extra_env):
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("MXNET_TPU_PRNG", "MXNET_ENFORCE_DETERMINISM")}
+    env.update(extra_env)
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = subprocess.run([sys.executable, "-c", _PROBE.format(root=root)],
+                         env=env, capture_output=True, text=True, timeout=240)
+    for line in out.stdout.splitlines():
+        if line.startswith("IMPL="):
+            return line[5:]
+    raise AssertionError(out.stdout + out.stderr)
+
+
+def test_default_is_rbg():
+    assert "rbg" in _impl({})
+
+
+def test_determinism_implies_threefry():
+    assert "threefry" in _impl({"MXNET_ENFORCE_DETERMINISM": "1"})
+
+
+def test_invalid_value_falls_back_to_rbg():
+    assert "rbg" in _impl({"MXNET_TPU_PRNG": "rgb"})
