@@ -70,6 +70,7 @@ from .monitor import Monitor
 from . import name
 from . import attribute
 from .attribute import AttrScope
+from . import rtc
 from . import visualization
 from . import visualization as viz
 config.apply_env()

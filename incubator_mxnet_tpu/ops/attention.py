@@ -54,20 +54,9 @@ def _use_pallas(x=None):
         return False, False
     if mode == "interpret":
         return True, True
-    # Resolve the platform this call will actually execute on: a concrete
-    # input's device wins (eager op on a CPU-placed array while the default
-    # backend is tpu, e.g. model init under ``jax.default_device(cpu)``);
-    # then an active jax_default_device override; then the default backend.
-    platform = None
-    if x is not None and not isinstance(x, jax.core.Tracer):
-        try:
-            platform = next(iter(x.devices())).platform
-        except Exception:
-            platform = None
-    if platform is None:
-        dd = getattr(jax.config, "jax_default_device", None)
-        platform = getattr(dd, "platform", None) or jax.default_backend()
-    on_tpu = platform == "tpu"
+    from ..util import resolve_platform
+
+    on_tpu = resolve_platform(x) == "tpu"
     if mode == "on":
         return True, not on_tpu
     return on_tpu, False  # auto

@@ -58,3 +58,24 @@ def use_np(func):
         return func(*a, **k)
 
     return wrapper
+
+
+def resolve_platform(x=None):
+    """The platform a dispatch will actually execute on: a concrete
+    input's device wins (eager op on a CPU-placed array while the default
+    backend is tpu, e.g. model init under ``jax.default_device(cpu)``);
+    then an active ``jax_default_device`` override; then the default
+    backend.  Shared by ops/attention.py and rtc.py so the two dispatch
+    disciplines cannot drift."""
+    import jax
+
+    platform = None
+    if x is not None and not isinstance(x, jax.core.Tracer):
+        try:
+            platform = next(iter(x.devices())).platform
+        except Exception:
+            platform = None
+    if platform is None:
+        dd = getattr(jax.config, "jax_default_device", None)
+        platform = getattr(dd, "platform", None) or jax.default_backend()
+    return platform
