@@ -162,5 +162,9 @@ class PallasModule:
                 f"{len(out_shapes)} out_shapes")
         if out_specs is not None and not isinstance(out_specs, (list, tuple)):
             out_specs = [out_specs]
+        if out_specs is not None and len(out_specs) != len(out_shapes):
+            raise ValueError(
+                f"out_specs has {len(out_specs)} entries for "
+                f"{len(out_shapes)} out_shapes")
         return Kernel(self._kernels[name], name, out_shapes, out_dtypes,
                       grid, in_specs, out_specs)

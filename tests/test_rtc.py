@@ -100,3 +100,14 @@ def test_launch_reuses_compiled_call():
     k.launch([x, x])
     k.launch([x, x])
     assert len(k._calls) == 1  # second launch hit the cache
+
+
+def test_out_specs_count_validated_at_get_kernel():
+    from jax.experimental import pallas as pl
+
+    mod = mx.rtc.PallasModule(SRC)
+    spec = pl.BlockSpec((8, 128), lambda i: (i, 0))
+    with pytest.raises(ValueError):
+        mod.get_kernel("scale_add", out_shapes=[(2, 2), (2, 2)],
+                       out_dtypes=["float32", "float32"],
+                       grid=(1,), out_specs=spec)
