@@ -12,7 +12,7 @@ copies for.
 This example runs on the 8-device virtual CPU mesh (dp=4 × tp=2),
 trains a 2-layer LSTM regression model twice — tensor-parallel and
 fully replicated — and checks the two learn identical parameters, then
-prints the per-device parameter bytes to show the weights really are
+prints the per-device shard shapes to show the weights really are
 split.
 
     XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
