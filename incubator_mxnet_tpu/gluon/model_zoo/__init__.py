@@ -1,16 +1,22 @@
 """Model zoo (parity: [U:python/mxnet/gluon/model_zoo/])."""
 from . import vision
 from . import bert
+from . import yolo
 from .vision import get_model
 from .bert import BERTModel, BERTForPretrain, bert_base, bert_large, bert_sharding_rules
+from .yolo import YOLOV3, DarknetV3, yolo3_darknet53
 
 __all__ = [
     "vision",
     "bert",
+    "yolo",
     "get_model",
     "BERTModel",
     "BERTForPretrain",
     "bert_base",
     "bert_large",
     "bert_sharding_rules",
+    "YOLOV3",
+    "DarknetV3",
+    "yolo3_darknet53",
 ]

@@ -1,0 +1,302 @@
+"""YOLOv3 (parity: the reference ecosystem's YOLOv3-darknet53 — the
+detection family SURVEY.md's goal statement pairs with SSD-512; the
+reference's own detection path is [U:example/ssd/] plus the YOLO
+augmenters in [U:python/mxnet/image/detection.py]).
+
+TPU-first shape discipline (same contract as ssd.py): every stage is
+fixed-shape.  Per-scale grids and anchor tables are computed from the
+statically-known feature shapes under trace, predictions concatenate to
+one ``[B, N, 5+C]`` tensor, decoding is pure elementwise math, and NMS is
+the mask-based ``box_nms`` from :mod:`...ops.detection`.  Both the
+forward and a full training step jit.
+
+Training targets use the dense best-anchor assignment
+(:func:`yolo3_targets`): IoU of every (padded) ground-truth box against
+every anchor prior, argmax over anchors — a static-shape formulation of
+the reference's dynamic target matcher, mask-based like MultiBoxTarget.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from ..block import HybridBlock
+from .. import nn
+
+__all__ = ["DarknetV3", "YOLOV3", "yolo3_darknet53", "yolo3_decode",
+           "yolo3_targets", "yolo3_loss", "Yolo3DefaultAnchors"]
+
+# The canonical COCO anchor schedule (pixels, for a 416 input), small→large
+# stride scales: [8 is not used by v3; strides are 8/16/32 bottom-up].
+Yolo3DefaultAnchors = [
+    [(10, 13), (16, 30), (33, 23)],       # stride 8
+    [(30, 61), (62, 45), (59, 119)],      # stride 16
+    [(116, 90), (156, 198), (373, 326)],  # stride 32
+]
+Yolo3Strides = [8, 16, 32]
+
+
+def _conv2d(channel, kernel, padding, stride):
+    """conv → BN → LeakyReLU(0.1), the darknet unit."""
+    cell = nn.HybridSequential(prefix="")
+    cell.add(nn.Conv2D(channel, kernel_size=kernel, strides=stride,
+                       padding=padding, use_bias=False))
+    cell.add(nn.BatchNorm(epsilon=1e-5, momentum=0.9))
+    cell.add(nn.LeakyReLU(0.1))
+    return cell
+
+
+class DarknetBasicBlockV3(HybridBlock):
+    """1×1 bottleneck + 3×3, residual add."""
+
+    def __init__(self, channel, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.body = nn.HybridSequential(prefix="")
+            self.body.add(_conv2d(channel // 2, 1, 0, 1))
+            self.body.add(_conv2d(channel, 3, 1, 1))
+
+    def hybrid_forward(self, F, x):
+        return x + self.body(x)
+
+
+class DarknetV3(HybridBlock):
+    """Darknet-53 backbone: stem + 5 stages of [1, 2, 8, 8, 4] residual
+    blocks; ``stage_outputs`` taps the last 3 stages (strides 8/16/32)."""
+
+    def __init__(self, layers=(1, 2, 8, 8, 4),
+                 channels=(64, 128, 256, 512, 1024), **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.stages = nn.HybridSequential(prefix="")
+            stem = nn.HybridSequential(prefix="")
+            stem.add(_conv2d(32, 3, 1, 1))
+            self.stages.add(stem)
+            for nlayer, channel in zip(layers, channels):
+                stage = nn.HybridSequential(prefix="")
+                stage.add(_conv2d(channel, 3, 1, 2))  # stride-2 entry
+                for _ in range(nlayer):
+                    stage.add(DarknetBasicBlockV3(channel))
+                self.stages.add(stage)
+
+    def hybrid_forward(self, F, x):
+        outs = []
+        for i, stage in enumerate(self.stages._children.values()):
+            x = stage(x)
+            if i >= 3:  # stages at stride 8, 16, 32
+                outs.append(x)
+        return tuple(outs)
+
+
+class YOLODetectionBlockV3(HybridBlock):
+    """5-conv body → ``route`` (lateral, c) and ``tip`` (3×3, 2c)."""
+
+    def __init__(self, channel, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.body = nn.HybridSequential(prefix="")
+            for _ in range(2):
+                self.body.add(_conv2d(channel, 1, 0, 1))
+                self.body.add(_conv2d(channel * 2, 3, 1, 1))
+            self.body.add(_conv2d(channel, 1, 0, 1))
+            self.tip = _conv2d(channel * 2, 3, 1, 1)
+
+    def hybrid_forward(self, F, x):
+        route = self.body(x)
+        return route, self.tip(route)
+
+
+class YOLOV3(HybridBlock):
+    """YOLOv3 with a top-down FPN over 3 backbone scales.
+
+    Forward returns the RAW per-anchor prediction tensor
+    ``[B, N, 5 + num_classes]`` (tx, ty, tw, th, obj, cls...) plus the
+    static decode tables ``offsets [1, N, 2]``, ``anchors [1, N, 2]``,
+    ``strides [1, N, 1]`` — feed them to :func:`yolo3_decode` for boxes
+    or :func:`yolo3_loss` for training.
+    """
+
+    def __init__(self, backbone=None, num_classes=80,
+                 anchors=Yolo3DefaultAnchors, strides=Yolo3Strides,
+                 channels=(128, 256, 512), **kwargs):
+        super().__init__(**kwargs)
+        self.num_classes = num_classes
+        self._anchors = anchors
+        self._strides = list(strides)
+        self._table_cache = {}  # (h, w, scale_idx) → static decode tables
+        na = len(anchors[0])
+        with self.name_scope():
+            self.backbone = backbone or DarknetV3()
+            # top-down order: build blocks for the LARGEST stride first
+            self.blocks = nn.HybridSequential(prefix="blk_")
+            self.outputs = nn.HybridSequential(prefix="out_")
+            self.laterals = nn.HybridSequential(prefix="lat_")
+            for i, ch in enumerate(reversed(channels)):
+                self.blocks.add(YOLODetectionBlockV3(ch))
+                self.outputs.add(nn.Conv2D(na * (5 + num_classes),
+                                           kernel_size=1))
+                if i < len(channels) - 1:
+                    self.laterals.add(_conv2d(ch // 2, 1, 0, 1))
+
+    def hybrid_forward(self, F, x):
+        feats = list(self.backbone(x))          # strides [8, 16, 32]
+        feats = feats[::-1]                     # top-down: 32 first
+        strides = self._strides[::-1]
+        anchors = self._anchors[::-1]
+        na = len(anchors[0])
+
+        all_preds, all_offsets, all_anchors, all_strides = [], [], [], []
+        route = None
+        blocks = list(self.blocks._children.values())
+        outputs = list(self.outputs._children.values())
+        laterals = list(self.laterals._children.values())
+        for i, feat in enumerate(feats):
+            if route is not None:
+                up = F.UpSampling(laterals[i - 1](route), scale=2,
+                                  sample_type="nearest")
+                feat = F.concat(up, feat, dim=1)
+            route, tip = blocks[i](feat)
+            raw = outputs[i](tip)               # [B, A*(5+C), H, W]
+            b, _, h, w = raw.shape
+            raw = raw.transpose((0, 2, 3, 1)).reshape((b, h * w * na,
+                                                       5 + self.num_classes))
+            all_preds.append(raw)
+
+            # static decode tables for this scale: input-independent, so
+            # computed once per feature shape and reused every forward
+            key = (h, w, i)
+            if key not in self._table_cache:
+                np = _np
+                ys, xs = np.meshgrid(np.arange(h), np.arange(w), indexing="ij")
+                grid = np.stack([xs, ys], axis=-1).reshape(h * w, 1, 2)
+                grid = np.broadcast_to(grid, (h * w, na, 2)).reshape(1, -1, 2)
+                anc = np.asarray(anchors[i], dtype=np.float32).reshape(1, 1, na, 2)
+                anc = np.broadcast_to(anc, (1, h * w, na, 2)).reshape(1, -1, 2)
+                st = np.full((1, h * w * na, 1), strides[i], dtype=np.float32)
+                self._table_cache[key] = (F.array(grid.astype(np.float32)),
+                                          F.array(anc.copy()), F.array(st))
+            off_c, anc_c, st_c = self._table_cache[key]
+            all_offsets.append(off_c)
+            all_anchors.append(anc_c)
+            all_strides.append(st_c)
+
+        return (F.concat(*all_preds, dim=1),
+                F.concat(*all_offsets, dim=1),
+                F.concat(*all_anchors, dim=1),
+                F.concat(*all_strides, dim=1))
+
+
+def yolo3_decode(preds, offsets, anchors, strides, num_classes):
+    """Raw predictions → ``(ids [B,N,1], scores [B,N,1], boxes [B,N,4])``
+    in pixel corner format: the standard v3 decode
+    (σ(txy)+grid)·stride, exp(twh)·anchor, σ(obj)·σ(cls)."""
+    from ... import ndarray as nd
+
+    txy = nd.slice_axis(preds, axis=-1, begin=0, end=2)
+    twh = nd.slice_axis(preds, axis=-1, begin=2, end=4)
+    obj = nd.slice_axis(preds, axis=-1, begin=4, end=5)
+    cls = nd.slice_axis(preds, axis=-1, begin=5, end=5 + num_classes)
+
+    xy = (nd.sigmoid(txy) + offsets) * strides
+    wh = nd.exp(nd.clip(twh, -10, 8)) * anchors
+    half = wh * 0.5
+    boxes = nd.concat(xy - half, xy + half, dim=-1)
+    scores = nd.sigmoid(obj) * nd.sigmoid(cls)          # [B, N, C]
+    conf = nd.max(scores, axis=-1, keepdims=True)
+    ids = nd.argmax(scores, axis=-1).expand_dims(-1)
+    return ids, conf, boxes
+
+
+def yolo3_targets(gt_boxes, gt_ids, offsets, anchors, strides, num_classes,
+                  ignore_thresh=0.7):
+    """Dense static-shape target assignment.
+
+    gt_boxes: [B, M, 4] pixel corners, padded rows = -1.
+    Returns (obj_t [B,N,1], box_t [B,N,4] raw-space, cls_t [B,N,C],
+    masks [B,N,2]): for each valid gt, the prior (grid cell × anchor)
+    whose centered anchor box has max IoU gets objectness 1, the encoded
+    (tx,ty,tw,th), and the one-hot class.  When several gts pick the same
+    prior, the highest-IoU gt wins (never a sum of encodings).
+    ``masks[..., 0]`` is the positive mask; ``masks[..., 1]`` weights the
+    objectness BCE — 0 for non-positive priors whose IoU with any gt
+    exceeds ``ignore_thresh`` (the reference's ignore band, which keeps
+    near-hits out of the negative loss)."""
+    from ... import ndarray as nd
+
+    B, M, _ = gt_boxes.shape
+    N = offsets.shape[1]
+    centers = (offsets + 0.5) * strides                  # [1, N, 2]
+    half = anchors * 0.5
+    priors = nd.concat(centers - half, centers + half, dim=-1)  # [1, N, 4]
+
+    valid = (nd.slice_axis(gt_ids, axis=-1, begin=0, end=1) >= 0)  # [B, M, 1]
+    ious = nd.reshape(nd.box_iou(gt_boxes.reshape((-1, 4)),
+                                 priors.reshape((-1, 4))), (B, M, N))
+    ious = ious * valid                                  # kill padded rows
+    best = nd.argmax(ious, axis=-1)                      # [B, M] prior index
+
+    onehotN = nd.one_hot(best.reshape((-1,)), N).reshape((B, M, N))
+    onehotN = onehotN * valid                            # [B, M, N]
+    obj_t = nd.max(onehotN, axis=1).expand_dims(-1)      # [B, N, 1]
+
+    # crowded-scene tie-break: among gts assigned to a prior, the one with
+    # max IoU wins it outright
+    winner = nd.argmax(onehotN * ious, axis=1)           # [B, N] gt index
+    winner_oh = nd.one_hot(winner.reshape((-1,)), M).reshape((B, N, M))
+    winner_oh = winner_oh.transpose((0, 2, 1))           # [B, M, N]
+    assign = winner_oh * onehotN                         # ≤1 gt per prior
+
+    # encode each gt in raw space against ITS assigned prior
+    gxy = (nd.slice_axis(gt_boxes, axis=-1, begin=0, end=2)
+           + nd.slice_axis(gt_boxes, axis=-1, begin=2, end=4)) * 0.5
+    gwh = (nd.slice_axis(gt_boxes, axis=-1, begin=2, end=4)
+           - nd.slice_axis(gt_boxes, axis=-1, begin=0, end=2))
+    strid = strides.reshape((1, 1, N, 1))
+    offs = offsets.reshape((1, 1, N, 2))
+    ancs = anchors.reshape((1, 1, N, 2))
+    txy = gxy.reshape((B, M, 1, 2)) / strid - offs       # pre-sigmoid target
+    txy = nd.clip(txy, 1e-6, 1 - 1e-6)
+    twh = nd.log(nd.clip(gwh.reshape((B, M, 1, 2)) / ancs, 1e-6, 1e6))
+    enc = nd.concat(txy, twh, dim=-1)                    # [B, M, N, 4]
+    box_t = nd.sum(enc * assign.expand_dims(-1), axis=1)  # [B, N, 4]
+
+    oh_cls = nd.one_hot(nd.clip(gt_ids.reshape((B, M)), 0, num_classes - 1),
+                        num_classes)                     # [B, M, C]
+    cls_t = nd.sum(assign.expand_dims(-1)
+                   * oh_cls.reshape((B, M, 1, num_classes)), axis=1)
+
+    # objectness ignore band: non-positive priors overlapping any gt above
+    # ignore_thresh contribute nothing to the negative BCE
+    max_iou = nd.max(ious, axis=1).expand_dims(-1)       # [B, N, 1]
+    obj_w = nd.where(obj_t + (max_iou < ignore_thresh) > 0,
+                     nd.ones_like(obj_t), nd.zeros_like(obj_t))
+    return obj_t, box_t, cls_t, nd.concat(obj_t, obj_w, dim=-1)
+
+
+def yolo3_loss(preds, obj_t, box_t, cls_t, masks, num_classes):
+    """The v3 loss: BCE(obj) over non-ignored priors (see
+    :func:`yolo3_targets`' ignore band) + (BCE(cls) + L2 on
+    (σ(txy), twh)) on positives, averaged per image."""
+    from ... import ndarray as nd
+
+    pos_mask = nd.slice_axis(masks, axis=-1, begin=0, end=1)
+    obj_w = nd.slice_axis(masks, axis=-1, begin=1, end=2)
+
+    txy = nd.sigmoid(nd.slice_axis(preds, axis=-1, begin=0, end=2))
+    twh = nd.slice_axis(preds, axis=-1, begin=2, end=4)
+    obj = nd.slice_axis(preds, axis=-1, begin=4, end=5)
+    cls = nd.slice_axis(preds, axis=-1, begin=5, end=5 + num_classes)
+
+    def bce(logit, target):
+        return nd.relu(logit) - logit * target + nd.log1p(nd.exp(-nd.abs(logit)))
+
+    obj_loss = nd.mean(nd.sum(bce(obj, obj_t) * obj_w, axis=(1, 2)))
+    cls_loss = nd.mean(nd.sum(bce(cls, cls_t) * pos_mask, axis=(1, 2)))
+    box_pred = nd.concat(txy, twh, dim=-1)
+    box_loss = nd.mean(nd.sum(nd.square(box_pred - box_t) * pos_mask,
+                              axis=(1, 2)))
+    return obj_loss + cls_loss + box_loss
+
+
+def yolo3_darknet53(num_classes=80, **kwargs):
+    """YOLOv3 with the Darknet-53 backbone (the canonical config)."""
+    return YOLOV3(DarknetV3(), num_classes=num_classes, **kwargs)

@@ -1,0 +1,168 @@
+"""YOLOv3 model family (decode math vs hand computation, target
+assignment vs a numpy oracle, NMS inference path, trainability)."""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu.gluon.model_zoo import yolo
+
+
+def _tiny_yolo(num_classes=3):
+    # 2 scales worth of anchors but a small darknet for test speed
+    net = yolo.YOLOV3(yolo.DarknetV3(layers=(1, 1, 1, 1, 1),
+                                     channels=(8, 16, 32, 64, 128)),
+                      num_classes=num_classes,
+                      channels=(16, 32, 64))
+    net.initialize()
+    return net
+
+
+class TestForward:
+    def test_shapes_and_tables(self):
+        net = _tiny_yolo()
+        x = mx.nd.zeros((2, 3, 64, 64))
+        preds, offsets, anchors, strides = net(x)
+        # strides 8/16/32 on 64px: (8²+4²+2²)·3 anchors = 252 priors
+        n = (64 + 16 + 4) * 3
+        assert preds.shape == (2, n, 5 + 3)
+        assert offsets.shape == (1, n, 2)
+        assert anchors.shape == (1, n, 2)
+        assert strides.shape == (1, n, 1)
+        sv = np.unique(strides.asnumpy())
+        np.testing.assert_array_equal(sv, [8.0, 16.0, 32.0])
+
+    def test_hybridize_consistency(self):
+        net = _tiny_yolo()
+        x = mx.nd.array(np.random.rand(1, 3, 64, 64).astype(np.float32))
+        eager = net(x)[0].asnumpy()
+        net.hybridize()
+        hybrid = net(x)[0].asnumpy()
+        np.testing.assert_allclose(eager, hybrid, rtol=2e-4, atol=2e-4)
+
+
+class TestDecode:
+    def test_zero_logits_decode_to_anchor_boxes(self):
+        # tx=ty=0 → σ=0.5 (cell center); tw=th=0 → wh = anchor
+        N, C = 6, 2
+        offsets = mx.nd.array(np.array([[[i, 0] for i in range(N)]],
+                                       np.float32))
+        anchors = mx.nd.array(np.full((1, N, 2), 20, np.float32))
+        strides = mx.nd.array(np.full((1, N, 1), 8, np.float32))
+        preds = mx.nd.zeros((1, N, 5 + C))
+        ids, conf, boxes = yolo.yolo3_decode(preds, offsets, anchors,
+                                             strides, C)
+        b = boxes.asnumpy()
+        for i in range(N):
+            cx, cy = (i + 0.5) * 8, 0.5 * 8
+            np.testing.assert_allclose(b[0, i],
+                                       [cx - 10, cy - 10, cx + 10, cy + 10],
+                                       rtol=1e-5)
+        np.testing.assert_allclose(conf.asnumpy(), 0.25, rtol=1e-5)  # σ(0)²
+
+    def test_nms_pipeline(self):
+        net = _tiny_yolo()
+        x = mx.nd.array(np.random.rand(2, 3, 64, 64).astype(np.float32))
+        preds, offsets, anchors, strides = net(x)
+        ids, conf, boxes = yolo.yolo3_decode(preds, offsets, anchors,
+                                             strides, net.num_classes)
+        dets = mx.nd.contrib.box_nms(
+            mx.nd.concat(ids, conf, boxes, dim=-1),
+            overlap_thresh=0.5, valid_thresh=0.01, topk=10)
+        assert dets.shape[0] == 2 and dets.shape[2] == 6
+
+
+class TestTargetsAndLoss:
+    def test_assignment_matches_numpy_oracle(self):
+        C = 3
+        offsets = mx.nd.array(np.array(
+            [[[i % 4, i // 4] for i in range(16)]], np.float32))
+        anchors = mx.nd.array(np.full((1, 16, 2), 16, np.float32))
+        strides = mx.nd.array(np.full((1, 16, 1), 16, np.float32))
+        # one gt centered on cell (1, 2) → prior index 9, plus padding
+        gt_boxes = mx.nd.array(np.array(
+            [[[16, 32, 40, 52], [-1, -1, -1, -1]]], np.float32))
+        gt_ids = mx.nd.array(np.array([[[1], [-1]]], np.float32))
+        obj_t, box_t, cls_t, masks = yolo.yolo3_targets(
+            gt_boxes, gt_ids, offsets, anchors, strides, C)
+        assert masks.shape == (1, 16, 2)
+        o = obj_t.asnumpy()[0, :, 0]
+        assert o.sum() == 1.0
+        idx = int(o.argmax())
+        assert idx == 9  # cell x=1, y=2 → 2*4+1
+        np.testing.assert_allclose(cls_t.asnumpy()[0, idx], [0, 1, 0])
+        bt = box_t.asnumpy()[0, idx]
+        # txy: center (28, 42)/16 - (1, 2) = (0.75, 0.625)
+        np.testing.assert_allclose(bt[:2], [0.75, 0.625], rtol=1e-5)
+        # twh: log(24/16), log(20/16)
+        np.testing.assert_allclose(bt[2:], np.log([24 / 16, 20 / 16]),
+                                   rtol=1e-5)
+
+    def test_loss_decreases_training_to_one_box(self):
+        mx.random.seed(0)
+        net = _tiny_yolo(num_classes=2)
+        from incubator_mxnet_tpu import gluon
+        trainer = gluon.Trainer(net.collect_params(), "adam",
+                                {"learning_rate": 1e-3})
+        x = mx.nd.array(np.random.rand(2, 3, 64, 64).astype(np.float32))
+        gt_boxes = mx.nd.array(np.array(
+            [[[8, 8, 30, 30]], [[20, 20, 50, 60]]], np.float32))
+        gt_ids = mx.nd.array(np.array([[[0]], [[1]]], np.float32))
+        first = None
+        for _ in range(12):
+            with mx.autograd.record():
+                preds, offsets, anchors, strides = net(x)
+                obj_t, box_t, cls_t, pos = yolo.yolo3_targets(
+                    gt_boxes, gt_ids, offsets, anchors, strides, 2)
+                loss = yolo.yolo3_loss(preds, obj_t, box_t, cls_t, pos, 2)
+            loss.backward()
+            trainer.step(2)
+            v = loss.asscalar()
+            assert np.isfinite(v)
+            if first is None:
+                first = v
+        assert v < first, (first, v)
+
+    def test_crowded_same_prior_highest_iou_wins(self):
+        # two gts land on the same prior: the higher-IoU one must own it
+        # outright (no summed encodings, no multi-hot classes)
+        C = 3
+        offsets = mx.nd.array(np.array(
+            [[[i % 4, i // 4] for i in range(16)]], np.float32))
+        anchors = mx.nd.array(np.full((1, 16, 2), 16, np.float32))
+        strides = mx.nd.array(np.full((1, 16, 1), 16, np.float32))
+        gt_boxes = mx.nd.array(np.array(
+            [[[16, 32, 40, 52], [18, 34, 38, 50]]], np.float32))
+        gt_ids = mx.nd.array(np.array([[[1], [2]]], np.float32))
+        obj_t, box_t, cls_t, masks = yolo.yolo3_targets(
+            gt_boxes, gt_ids, offsets, anchors, strides, C)
+        idx = int(obj_t.asnumpy()[0, :, 0].argmax())
+        bt = box_t.asnumpy()[0, idx]
+        assert 0.0 < bt[0] < 1.0 and 0.0 < bt[1] < 1.0, bt  # valid σ range
+        c = cls_t.asnumpy()[0, idx]
+        assert c.sum() == 1.0, c  # single-hot, the winner's class
+        # IoU vs the winning prior [16,32,32,48]: gt0 256/480=0.533,
+        # gt1 196/380=0.516 — gt0 (class 1) owns the prior
+        np.testing.assert_allclose(c, [0, 1, 0])
+        np.testing.assert_allclose(bt[:2], [0.75, 0.625], rtol=1e-5)
+
+    def test_ignore_band_excludes_near_hits_from_negatives(self):
+        C = 2
+        offsets = mx.nd.array(np.array(
+            [[[i % 4, i // 4] for i in range(16)]], np.float32))
+        # 24px anchors on a 16px grid: neighbor priors overlap the gt a
+        # little (IoU ≈ 0.083), far priors not at all
+        anchors = mx.nd.array(np.full((1, 16, 2), 24, np.float32))
+        strides = mx.nd.array(np.full((1, 16, 1), 16, np.float32))
+        gt_boxes = mx.nd.array(np.array([[[16, 16, 32, 32]]], np.float32))
+        gt_ids = mx.nd.array(np.array([[[0]]], np.float32))
+        obj_t, box_t, cls_t, masks = yolo.yolo3_targets(
+            gt_boxes, gt_ids, offsets, anchors, strides, C,
+            ignore_thresh=0.05)
+        m = masks.asnumpy()[0]
+        pos = int(obj_t.asnumpy()[0, :, 0].argmax())
+        assert m[pos, 1] == 1.0  # positives always weighted
+        # neighbors overlapping the gt above 0.2 IoU are ignored (weight 0)
+        ignored = (m[:, 1] == 0).sum()
+        assert ignored > 0
+        # far-away priors remain negatives
+        assert m[15, 1] == 1.0
