@@ -272,10 +272,13 @@ def yolo3_targets(gt_boxes, gt_ids, offsets, anchors, strides, num_classes,
     return obj_t, box_t, cls_t, nd.concat(obj_t, obj_w, dim=-1)
 
 
-def yolo3_loss(preds, obj_t, box_t, cls_t, masks, num_classes):
+def yolo3_loss(preds, obj_t, box_t, cls_t, masks, num_classes,
+               reduction="mean"):
     """The v3 loss: BCE(obj) over non-ignored priors (see
     :func:`yolo3_targets`' ignore band) + (BCE(cls) + L2 on
-    (σ(txy), twh)) on positives, averaged per image."""
+    (σ(txy), twh)) on positives.  ``reduction='mean'`` averages over the
+    batch (a scalar); ``'none'`` returns the per-sample loss ``[B]`` (the
+    form SPMDTrainer loss_fns return)."""
     from ... import ndarray as nd
 
     pos_mask = nd.slice_axis(masks, axis=-1, begin=0, end=1)
@@ -289,12 +292,14 @@ def yolo3_loss(preds, obj_t, box_t, cls_t, masks, num_classes):
     def bce(logit, target):
         return nd.relu(logit) - logit * target + nd.log1p(nd.exp(-nd.abs(logit)))
 
-    obj_loss = nd.mean(nd.sum(bce(obj, obj_t) * obj_w, axis=(1, 2)))
-    cls_loss = nd.mean(nd.sum(bce(cls, cls_t) * pos_mask, axis=(1, 2)))
     box_pred = nd.concat(txy, twh, dim=-1)
-    box_loss = nd.mean(nd.sum(nd.square(box_pred - box_t) * pos_mask,
-                              axis=(1, 2)))
-    return obj_loss + cls_loss + box_loss
+    per_sample = (nd.sum(bce(obj, obj_t) * obj_w, axis=(1, 2))
+                  + nd.sum(bce(cls, cls_t) * pos_mask, axis=(1, 2))
+                  + nd.sum(nd.square(box_pred - box_t) * pos_mask,
+                           axis=(1, 2)))
+    if reduction == "none":
+        return per_sample
+    return nd.mean(per_sample)
 
 
 def yolo3_darknet53(num_classes=80, **kwargs):
