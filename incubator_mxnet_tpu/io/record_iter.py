@@ -24,8 +24,29 @@ from .io import DataBatch, DataDesc, DataIter
 
 __all__ = ["ImageRecordIter"]
 
-_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
-    os.path.dirname(os.path.abspath(__file__)))), "native")
+# Search order: $MXTPU_NATIVE_DIR wins unconditionally when set; else the
+# package-internal _native/ (wheel installs, staged by ``setup.py
+# build_native``), else the repo-layout native/ (source tree) — preferring
+# a dir with a built .so, falling back to one with a Makefile (lazy build).
+_PKG_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _resolve_native_dir():
+    env = os.environ.get("MXTPU_NATIVE_DIR")
+    if env:
+        return env
+    candidates = [os.path.join(_PKG_DIR, "_native"),
+                  os.path.join(os.path.dirname(_PKG_DIR), "native")]
+    for d in candidates:
+        if os.path.exists(os.path.join(d, "libmxtpu_io.so")):
+            return d
+    for d in candidates:
+        if os.path.exists(os.path.join(d, "Makefile")):
+            return d
+    return candidates[-1]
+
+
+_NATIVE_DIR = _resolve_native_dir()
 _LIB = None
 _LIB_TRIED = False
 

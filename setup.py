@@ -1,0 +1,52 @@
+"""Build integration for the native pipeline libraries.
+
+The reference builds ~500k LoC of C++ into libmxnet.so via CMake
+([U:CMakeLists.txt]); here the native surface is two small shared
+libraries (RecordIO/JPEG pipeline, XLA-FFI custom-op demo) built from
+``native/`` by ``make``.  ``python setup.py build_native`` compiles them
+and stages sources + binaries into ``incubator_mxnet_tpu/_native/`` so a
+wheel carries them; at runtime ``io/record_iter.py`` searches the
+package-internal ``_native/`` first, then the repo-layout ``native/``
+(building lazily when only sources are present).
+"""
+import os
+import shutil
+import subprocess
+
+from setuptools import Command, setup
+from setuptools.command.build import build as _build
+
+
+class BuildNative(Command):
+    description = "build native/*.so via make and stage into the package"
+    user_options = []
+
+    def initialize_options(self):
+        pass
+
+    def finalize_options(self):
+        pass
+
+    def run(self):
+        root = os.path.dirname(os.path.abspath(__file__))
+        native = os.path.join(root, "native")
+        dest = os.path.join(root, "incubator_mxnet_tpu", "_native")
+        os.makedirs(dest, exist_ok=True)
+        subprocess.run(["make", "-C", native, "libmxtpu_io.so"], check=True)
+        # custom-op lib needs jax FFI headers; best-effort (demo library)
+        subprocess.run(["make", "-C", native, "libmxtpu_custom_op.so"],
+                       check=False)
+        for f in os.listdir(native):
+            if f.endswith((".so", ".cpp")) or f == "Makefile":
+                shutil.copy2(os.path.join(native, f), os.path.join(dest, f))
+        print(f"staged native artifacts into {dest}")
+
+
+class Build(_build):
+    # stage native artifacts in every standard build, so `pip install .` /
+    # `pip wheel .` wheels actually contain _native/ (the package-data
+    # globs in pyproject.toml)
+    sub_commands = [("build_native", None)] + _build.sub_commands
+
+
+setup(cmdclass={"build_native": BuildNative, "build": Build})
