@@ -25,9 +25,10 @@ from .io import DataBatch, DataDesc, DataIter
 __all__ = ["ImageRecordIter"]
 
 # Search order: $MXTPU_NATIVE_DIR wins unconditionally when set; else the
-# package-internal _native/ (wheel installs, staged by ``setup.py
-# build_native``), else the repo-layout native/ (source tree) — preferring
-# a dir with a built .so, falling back to one with a Makefile (lazy build).
+# repo-layout native/ (source tree — preferred so rebuilds there are never
+# shadowed by a stale staged copy), else the package-internal _native/
+# (wheel installs, staged by ``setup.py build_native``) — preferring a dir
+# with a built .so, falling back to one with a Makefile (lazy build).
 _PKG_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
@@ -35,8 +36,8 @@ def _resolve_native_dir():
     env = os.environ.get("MXTPU_NATIVE_DIR")
     if env:
         return env
-    candidates = [os.path.join(_PKG_DIR, "_native"),
-                  os.path.join(os.path.dirname(_PKG_DIR), "native")]
+    candidates = [os.path.join(os.path.dirname(_PKG_DIR), "native"),
+                  os.path.join(_PKG_DIR, "_native")]
     for d in candidates:
         if os.path.exists(os.path.join(d, "libmxtpu_io.so")):
             return d
