@@ -76,6 +76,8 @@ def main():
     ap.add_argument("--steps-per-epoch", type=int, default=30)
     ap.add_argument("--lr", type=float, default=2e-4)
     args = ap.parse_args()
+    if args.epochs < 1 or args.steps_per_epoch < 1:
+        raise SystemExit("--epochs and --steps-per-epoch must be >= 1")
 
     import incubator_mxnet_tpu as mx
     from incubator_mxnet_tpu import gluon
@@ -104,9 +106,12 @@ def main():
             real = mx.nd.array(real_batch(rng, B))
             noise = mx.nd.array(rng.randn(B, args.latent, 1, 1)
                                 .astype(np.float32))
-            # -- D step: real→1, fake→0 (fake detached: no G grads) ------
-            fake = gen(noise).detach()
+            # -- D step: real→1, fake→0.  Fake is generated INSIDE
+            # record() so G's BatchNorm runs in training mode (batch
+            # stats) — the same distribution the G step optimizes —
+            # then detached so no G grads flow.
             with mx.autograd.record():
+                fake = gen(noise).detach()
                 d_loss = (loss_fn(disc(real).reshape((-1,)), ones)
                           + loss_fn(disc(fake).reshape((-1,)), zeros))
             d_loss.backward()

@@ -35,6 +35,11 @@ class BuildNative(Command):
             return
         dest = os.path.join(root, "incubator_mxnet_tpu", "_native")
         os.makedirs(dest, exist_ok=True)
+        # drop any stale staged binaries (older build_native versions
+        # copied .so files here; they would shadow newer sources)
+        for f in os.listdir(dest):
+            if f.endswith(".so"):
+                os.remove(os.path.join(dest, f))
         # Stage SOURCES only — the wheel stays py3-none-any; the runtime
         # builds for the host lazily (and degrades to the pure-Python
         # pipeline when no toolchain is available, same as a failed make)

@@ -14,7 +14,7 @@ import pytest
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def test_dist_sync_kvstore_two_workers():
+def _run_dist(n):
     env = dict(os.environ)
     # children must boot their own 1-device CPU backend, not inherit the
     # pytest 8-device virtual mesh or the tunneled TPU
@@ -22,10 +22,22 @@ def test_dist_sync_kvstore_two_workers():
     env["JAX_PLATFORMS"] = "cpu"
     proc = subprocess.run(
         [sys.executable, os.path.join(ROOT, "tools", "launch_local.py"),
-         "-n", "2", sys.executable, os.path.join(ROOT, "tests", "dist_worker.py")],
+         "-n", str(n), sys.executable,
+         os.path.join(ROOT, "tests", "dist_worker.py")],
         env=env, capture_output=True, text=True, timeout=280,
     )
     sys.stdout.write(proc.stdout[-4000:])
     sys.stderr.write(proc.stderr[-4000:])
     assert proc.returncode == 0, f"dist workers failed (rc={proc.returncode})"
-    assert proc.stdout.count("all assertions passed") == 2
+    assert proc.stdout.count("all assertions passed") == n
+
+
+def test_dist_sync_kvstore_two_workers():
+    _run_dist(2)
+
+
+def test_dist_sync_kvstore_four_workers():
+    """The dist_sync math must hold at process_count>2 (exact aggregated
+    values scale with the worker count — the [U:tests/nightly/
+    dist_sync_kvstore.py] multi-worker discipline)."""
+    _run_dist(4)
