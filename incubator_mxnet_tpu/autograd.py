@@ -8,10 +8,14 @@ reverse walk calling stored vjp closures — residuals live on device exactly
 like the reference's saved forward buffers.
 
 Scopes (``record``, ``pause``, ``train_mode``, ``predict_mode``) and the
-``backward``/``grad``/``Function`` APIs match the reference.  Differences:
-``create_graph=True`` (grad-of-grad through the tape) is not supported — use
-:func:`incubator_mxnet_tpu.grad_fn` (functional ``jax.grad``) for higher-order
-derivatives, which the reference cannot express at all for jitted graphs.
+``backward``/``grad``/``Function`` APIs match the reference, including
+``grad(..., create_graph=True)``: the backward pass re-derives each node's
+vjp as a recorded op (see ``_grad_create_graph``), so returned gradients
+are differentiable w.r.t. the original inputs (grad-of-grad).  The one
+divergence: a custom ``Function``'s backward is opaque user code, so it
+runs eagerly during a create_graph pass and its gradients enter the
+higher-order tape as constants; functional higher-order AD is also
+available via :func:`incubator_mxnet_tpu.grad_fn`.
 """
 from __future__ import annotations
 
@@ -108,7 +112,8 @@ _node_counter = itertools.count()
 class _Node:
     """One recorded op: holds the vjp closure and provenance of its inputs."""
 
-    __slots__ = ("oid", "vjp_fn", "in_prov", "n_out", "name", "_avals")
+    __slots__ = ("oid", "vjp_fn", "in_prov", "n_out", "name", "_avals",
+                 "_replay_fn", "_replay_raw")
 
     def __init__(self, vjp_fn, in_prov, n_out, name=""):
         self.oid = next(_node_counter)
@@ -116,6 +121,10 @@ class _Node:
         self.in_prov = in_prov  # list of (_Node|NDArray-leaf|None, out_index)
         self.n_out = n_out
         self.name = name
+        # set by record_op for ordinary ops; custom Functions leave them
+        # None (their backward is user code, not a replayable pure fn)
+        self._replay_fn = None
+        self._replay_raw = None
 
 
 def record_op(fn, raw_inputs, input_arrays, kwargs, name=""):
@@ -140,6 +149,13 @@ def record_op(fn, raw_inputs, input_arrays, kwargs, name=""):
     prov = [_provenance(a) for a, n in zip(input_arrays, needs) if n]
     node = _Node(vjp_fn, prov, len(outs), name=name)
     node._avals = [jax.ShapeDtypeStruct(o.shape, o.dtype) for o in outs]
+    # keep what a second-order backward needs to re-derive this op's vjp
+    # as a recorded computation (grad-of-grad, see _grad_create_graph).
+    # Raw arrays are SNAPSHOTS of the inputs at record time — immune to
+    # later in-place NDArray mutation — and alias the buffers the vjp
+    # residuals already hold, so they cost no extra memory.
+    node._replay_fn = pure
+    node._replay_raw = diff_in
     return outs, node
 
 
@@ -225,18 +241,10 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
         if slots is None:
             continue
         # vjp requires a cotangent per output, matching the recorded aval
-        # exactly: fill missing slots with zeros, and cast dtype mismatches
-        # (mixed-precision tapes: an fp32 loss head feeding a bf16-output
-        # node under mx.amp).
-        filled = []
-        for s, aval in zip(slots, _out_avals(node)):
-            if s is None:
-                filled.append(jnp.zeros(aval.shape, aval.dtype))
-            elif s.dtype != aval.dtype:
-                filled.append(s.astype(aval.dtype))
-            else:
-                filled.append(s)
-        outs = tuple(filled)
+        # exactly (see _expand_cotangents)
+        present = [j for j, s in enumerate(slots) if s is not None]
+        outs = _expand_cotangents([slots[j] for j in present], present,
+                                  _out_avals(node))
         in_gs = node.vjp_fn(outs)
         for prov, g in zip(node.in_prov, in_gs):
             if prov is None or g is None:
@@ -255,7 +263,10 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
                 slots2 = node_grads.setdefault(pid, [None] * pnode.n_out)
                 slots2[idx] = g if slots2[idx] is None else slots2[idx] + g
         if not retain_graph:
-            node.vjp_fn = None  # free residuals eagerly
+            # free residuals (and the replay snapshot aliasing them) eagerly
+            node.vjp_fn = None
+            node._replay_fn = None
+            node._replay_raw = None
 
     # Write into leaf .grad respecting grad_req.
     for lid, leaf in leaves.items():
@@ -274,6 +285,24 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
     _np  # silence linters
 
 
+def _expand_cotangents(cots, present, avals):
+    """Rebuild a full per-output cotangent tuple from the compacted list
+    ``cots`` covering output indices ``present``: missing slots become
+    zeros of the recorded aval, dtype mismatches are cast (mixed-precision
+    tapes under mx.amp).  Shared by backward() and both second-order
+    paths."""
+    import jax.numpy as jnp
+
+    full, ci = [], iter(cots)
+    for j, aval in enumerate(avals):
+        if j in present:
+            c = next(ci)
+            full.append(c.astype(aval.dtype) if c.dtype != aval.dtype else c)
+        else:
+            full.append(jnp.zeros(aval.shape, aval.dtype))
+    return tuple(full)
+
+
 def _out_avals(node):
     """Shape/dtype of a node's outputs, recovered from the vjp closure."""
     # jax.vjp closures don't expose avals publicly; we stash them at record
@@ -286,19 +315,20 @@ def _out_avals(node):
 
 def grad(heads, variables, head_grads=None, retain_graph=None, create_graph=False, train_mode=True):
     """Return gradients of ``heads`` w.r.t. ``variables`` without touching
-    ``.grad`` buffers.  Parity: ``mx.autograd.grad``."""
+    ``.grad`` buffers.  With ``create_graph=True`` the backward pass is
+    itself recorded on the tape, so the returned gradients are
+    differentiable (grad-of-grad).  Parity: ``mx.autograd.grad``.
+    """
     from .ndarray import NDArray
 
-    if create_graph:
-        raise NotImplementedError(
-            "create_graph=True is not supported by the tape; use jax.grad via "
-            "incubator_mxnet_tpu.grad_fn for higher-order derivatives"
-        )
     if isinstance(variables, NDArray):
         variables = [variables]
         single = True
     else:
         single = False
+    if create_graph:
+        out = _grad_create_graph(heads, variables, head_grads)
+        return out[0] if single else out
     # Temporarily swap grads into fresh buffers.
     from .ndarray import zeros
 
@@ -320,6 +350,137 @@ def grad(heads, variables, head_grads=None, retain_graph=None, create_graph=Fals
         for v, (g, req) in zip(variables, saved):
             v._grad, v._grad_req = g, req
     return out[0] if single else out
+
+
+def _grad_create_graph(heads, variables, head_grads):
+    """Backward walk where every node's vjp runs as a RECORDED op, so the
+    returned gradient NDArrays carry their own tape (higher-order AD —
+    the reference's ``Imperative::Backward(create_graph=true)``).
+
+    Each ordinary node re-derives its vjp from the stored pure function
+    and record-time input snapshots inside the recorded op, so gradients
+    are differentiable w.r.t. the ORIGINAL inputs, not just the
+    cotangents.  Custom :class:`Function` nodes (no replayable fn) run
+    their user backward eagerly; their gradients are constants on the
+    higher-order tape (documented divergence).
+    """
+    import heapq
+
+    import jax.numpy as jnp
+
+    from .ndarray import NDArray, zeros as nd_zeros
+    from .ndarray.ndarray import invoke
+
+    if isinstance(heads, NDArray):
+        heads = [heads]
+    if head_grads is None:
+        head_grads = [None] * len(heads)
+    elif isinstance(head_grads, NDArray):
+        head_grads = [head_grads]
+    if len(heads) != len(head_grads):
+        raise ValueError("heads and head_grads length mismatch")
+
+    for v in variables:
+        if _provenance(v) is None:
+            raise ValueError(
+                "variables passed to autograd.grad must participate in the "
+                "recorded graph (attach_grad() or be computed under record())")
+
+    node_cots: dict[int, list] = {}     # nid -> [NDArray|None] per output
+    leaf_cots: dict[int, NDArray] = {}
+    nodes: dict[int, _Node] = {}
+    final_cots: dict[tuple, NDArray] = {}  # (nid, idx) -> settled cotangent
+
+    def seed(prov, g):
+        if prov is None:
+            return
+        tag, payload = prov
+        if tag == "leaf":
+            lid = id(payload)
+            leaf_cots[lid] = g if lid not in leaf_cots else leaf_cots[lid] + g
+        else:
+            node, idx = tag, payload
+            nodes[node.oid] = node
+            slots = node_cots.setdefault(node.oid, [None] * node.n_out)
+            slots[idx] = g if slots[idx] is None else slots[idx] + g
+
+    with _scope(True, None):  # the backward computation records itself
+        for h, hg in zip(heads, head_grads):
+            prov = _provenance(h)
+            if prov is None:
+                raise ValueError(
+                    "cannot differentiate a head that is not part of the "
+                    "recorded graph")
+            if hg is None:
+                hg = nd_zeros(h.shape, dtype=str(h._data.dtype), ctx=h.ctx) + 1.0
+            seed(prov, hg)
+
+        heap = [-nid for nid in nodes]
+        heapq.heapify(heap)
+        while heap:
+            nid = -heapq.heappop(heap)
+            node = nodes[nid]
+            slots = node_cots.pop(nid, None)
+            if slots is None:
+                continue
+            present = [j for j, s in enumerate(slots) if s is not None]
+            for j in present:
+                final_cots[(nid, j)] = slots[j]
+            avals = _out_avals(node)
+            cot_arrays = [slots[j] for j in present]
+
+            if node._replay_fn is not None:
+                # replay from the record-time raw snapshots, but carry the
+                # ORIGINAL provenance so d(grad)/d(input) flows — immune
+                # to in-place mutation of the user-visible NDArrays
+                pure = node._replay_fn
+                rep_ins = []
+                for raw, prov in zip(node._replay_raw, node.in_prov):
+                    snap = NDArray(raw)
+                    snap._prov = prov
+                    rep_ins.append(snap)
+                k = len(rep_ins)
+
+                def node_bwd(*args, _pure=pure, _k=k, _present=tuple(present),
+                             _avals=tuple(avals)):
+                    ins, cots = args[:_k], args[_k:]
+                    _, vjp_fn = jax.vjp(_pure, *ins)
+                    return tuple(vjp_fn(_expand_cotangents(cots, _present,
+                                                           _avals)))
+
+                in_gs = invoke(node_bwd, rep_ins + cot_arrays, {},
+                               name=f"_backward_{node.name or 'op'}")
+                if isinstance(in_gs, NDArray):
+                    in_gs = [in_gs]
+            else:
+                # custom Function: its backward is opaque user code — run
+                # it EAGERLY (not under jax tracing; it may call asnumpy()
+                # etc.).  Its output gradients are therefore constants on
+                # the higher-order tape (documented divergence).
+                full = _expand_cotangents([c._data for c in cot_arrays],
+                                          present, avals)
+                with _scope(False, None):
+                    raw_gs = node.vjp_fn(full)
+                in_gs = [g if g is None else NDArray(g) for g in raw_gs]
+            for prov, g in zip(node.in_prov, in_gs):
+                if prov is None or g is None:
+                    continue
+                if prov[0] != "leaf" and prov[0].oid not in nodes:
+                    nodes[prov[0].oid] = prov[0]
+                    heapq.heappush(heap, -prov[0].oid)
+                seed(prov, g)
+
+        out = []
+        for v in variables:
+            tag, payload = _provenance(v)
+            if tag == "leaf":
+                g = leaf_cots.get(id(payload))
+            else:
+                g = final_cots.get((tag.oid, payload))
+            if g is None:
+                g = nd_zeros(v.shape, dtype=str(v._data.dtype), ctx=v.ctx)
+            out.append(g)
+    return out
 
 
 def mark_variables(variables, gradients, grad_reqs="write"):

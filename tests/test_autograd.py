@@ -244,3 +244,126 @@ def test_dropout_fast_path_unbiased(monkeypatch):
     np.testing.assert_allclose(kept, 256.0 / thresh, rtol=1e-6)
     drop_rate = 1.0 - len(kept) / n
     assert abs(drop_rate - (1 - thresh / 256.0)) < 0.01
+
+
+def test_second_order_grad_basic():
+    """grad(create_graph=True): d/dx of ||grad sum(x^3)||^2 == 36 x^3
+    (parity: tests/python/unittest/test_higher_order_grad.py idiom)."""
+    x = mx.nd.array(np.array([1.0, 2.0, -0.5], np.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = (x ** 3).sum()
+        g = autograd.grad(y, x, create_graph=True)
+        z = (g * g).sum()
+    z.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), 36 * x.asnumpy() ** 3,
+                               rtol=1e-5)
+
+
+def test_second_order_grad_matches_jax_oracle():
+    """Gradient penalty d/dx and d/dw of ||∂f/∂x||² vs functional jax —
+    the cross-term through the replayed forward must be exact."""
+    import jax
+    import jax.numpy as jnp
+
+    xv = np.array([0.3, -1.2, 0.8], np.float32)
+    wv = np.array([0.5, 2.0, -1.0], np.float32)
+
+    def f(x, w):
+        return jnp.sum(jnp.tanh(x * w))
+
+    def pen(x, w):
+        return jnp.sum(jax.grad(f, argnums=0)(x, w) ** 2)
+
+    want_x = np.asarray(jax.grad(pen, argnums=0)(jnp.array(xv), jnp.array(wv)))
+    want_w = np.asarray(jax.grad(pen, argnums=1)(jnp.array(xv), jnp.array(wv)))
+
+    x = mx.nd.array(xv)
+    w = mx.nd.array(wv)
+    x.attach_grad()
+    w.attach_grad()
+    with autograd.record():
+        y = mx.nd.tanh(x * w).sum()
+        gx = autograd.grad(y, x, create_graph=True)
+        p = (gx * gx).sum()
+    p.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), want_x, rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(w.grad.asnumpy(), want_w, rtol=1e-4, atol=1e-6)
+
+
+def test_third_order_grad():
+    """create_graph composes: d³/dx³ of x⁴ (summed) is 24x."""
+    x = mx.nd.array(np.array([1.5, -2.0], np.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = (x ** 4).sum()
+        g1 = autograd.grad(y, x, create_graph=True)   # 4x³
+        g2 = autograd.grad(g1.sum(), x, create_graph=True)  # 12x²
+        z3 = g2.sum()
+    z3.backward()                                     # 24x
+    np.testing.assert_allclose(x.grad.asnumpy(), 24 * x.asnumpy(), rtol=1e-5)
+
+
+def test_second_order_grad_wrt_intermediate():
+    """create_graph also returns grads w.r.t. intermediates (not only
+    marked leaves)."""
+    x = mx.nd.array(np.array([2.0], np.float32))
+    x.attach_grad()
+    with autograd.record():
+        h = x * x           # intermediate
+        y = (h * h).sum()   # x^4
+        gh = autograd.grad(y, h, create_graph=True)  # 2h = 2x²
+        z = (gh * gh).sum()                          # 4x⁴
+    z.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), 16 * x.asnumpy() ** 3,
+                               rtol=1e-5)
+
+
+def test_create_graph_immune_to_inplace_mutation():
+    """Second-order replay uses record-time snapshots: mutating x after
+    the forward must not corrupt the gradient."""
+    x = mx.nd.array(np.array([3.0], np.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = (x * x).sum()
+        x[:] = 0.0  # in-place mutation after the recorded op
+        g = autograd.grad(y, x, create_graph=True)
+    np.testing.assert_allclose(g.asnumpy(), [6.0])  # 2*x at record time
+
+
+def test_create_graph_with_numpy_calling_function():
+    """A custom Function whose backward uses asnumpy() must not break an
+    unrelated create_graph pass (it runs eagerly, grads are constants)."""
+
+    class NumpyBackward(autograd.Function):
+        def forward(self, a):
+            return a * 2.0
+
+        def backward(self, dy):
+            scale = float(dy.sum().asnumpy())  # eager-only operation
+            return dy * (2.0 if scale == scale else 0.0)
+
+    w = mx.nd.array(np.array([1.0, 2.0], np.float32))
+    w.attach_grad()
+    a = mx.nd.array(np.array([0.5, 0.5], np.float32))
+    a.attach_grad()
+    with autograd.record():
+        y = (w ** 2).sum() + NumpyBackward()(a).sum()
+        g = autograd.grad(y, w, create_graph=True)
+        z = (g * g).sum()
+    z.backward()
+    np.testing.assert_allclose(w.grad.asnumpy(), 8 * w.asnumpy(), rtol=1e-5)
+
+
+def test_backward_frees_replay_state():
+    """Plain first-order backward must release the replay snapshot along
+    with the vjp residuals (peak-memory contract)."""
+    x = mx.nd.array(np.array([2.0], np.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = (x * x).sum()
+    node = y._prov[0]
+    assert node._replay_raw is not None
+    y.backward()
+    assert node.vjp_fn is None
+    assert node._replay_fn is None and node._replay_raw is None
