@@ -57,6 +57,11 @@ _ELEMWISE = {"elemwise_add": "Add", "broadcast_add": "Add", "_plus": "Add",
              "elemwise_sub": "Sub", "broadcast_sub": "Sub", "_sub": "Sub",
              "elemwise_mul": "Mul", "broadcast_mul": "Mul", "_mul": "Mul",
              "elemwise_div": "Div", "broadcast_div": "Div", "_div": "Div"}
+_UNARY = {"tanh": "Tanh", "sigmoid": "Sigmoid", "relu": "Relu",
+          "exp": "Exp", "sqrt": "Sqrt", "log": "Log", "negative": "Neg",
+          "abs": "Abs"}
+_SCALAR = {"_plus_scalar": "Add", "_mul_scalar": "Mul",
+           "_minus_scalar": "Sub", "_div_scalar": "Div"}
 
 
 def _export_node(node, in_names, out_name, extra_inits):
@@ -163,6 +168,53 @@ def _export_node(node, in_names, out_name, extra_inits):
         return [{"op_type": "Gather", "name": nm,
                  "input": [in_names[1], in_names[0]], "output": [out_name],
                  "attribute": [_attr_i("axis", 0)]}]
+    if op == "Deconvolution":
+        if a.get("target_shape"):
+            raise NotImplementedError(
+                "Deconvolution target_shape is resolved at runtime and has "
+                "no ONNX attribute; set explicit pad for export")
+        kernel = _tuplize(a.get("kernel", (1, 1)))
+        pad = _tuplize(a.get("pad", 0), len(kernel))
+        stride = _tuplize(a.get("stride", 1), len(kernel))
+        adj = _tuplize(a.get("adj", 0), len(kernel))
+        dilate = _tuplize(a.get("dilate", 1), len(kernel))
+        return [{"op_type": "ConvTranspose", "name": nm, "input": in_names,
+                 "output": [out_name],
+                 "attribute": [_attr_ints("kernel_shape", kernel),
+                               _attr_ints("pads", tuple(pad) * 2),
+                               _attr_ints("strides", stride),
+                               _attr_ints("output_padding", adj),
+                               _attr_ints("dilations", dilate),
+                               _attr_i("group", a.get("num_group", 1))]}]
+    if op == "UpSampling":
+        scale = float(a.get("scale", 2))
+        mode = (b"nearest" if a.get("sample_type", "nearest") == "nearest"
+                else b"linear")
+        sc_name = nm + "_scales"
+        extra_inits.append({"name": sc_name, "dims": (4,),
+                            "data_type": P.TP_FLOAT,
+                            "raw": _np.asarray([1, 1, scale, scale],
+                                               _np.float32).tobytes()})
+        # Resize-13 positional inputs: X, roi (empty = unused), scales
+        return [{"op_type": "Resize", "name": nm,
+                 "input": [in_names[0], "", sc_name], "output": [out_name],
+                 "attribute": [_attr_s("mode", mode)]}]
+    if op == "transpose":
+        axes = tuple(a.get("axes", ()))
+        return [{"op_type": "Transpose", "name": nm, "input": in_names,
+                 "output": [out_name],
+                 "attribute": ([_attr_ints("perm", axes)] if axes else [])}]
+    if op in _UNARY:
+        return [{"op_type": _UNARY[op], "name": nm, "input": in_names,
+                 "output": [out_name], "attribute": []}]
+    if op in _SCALAR:
+        c_name = nm + "_const"
+        extra_inits.append({"name": c_name, "dims": (),
+                            "data_type": P.TP_FLOAT,
+                            "raw": _np.float32(a.get("scalar", 0)).tobytes()})
+        return [{"op_type": _SCALAR[op], "name": nm,
+                 "input": in_names + [c_name], "output": [out_name],
+                 "attribute": []}]
     raise NotImplementedError(f"no ONNX converter for op {op!r}")
 
 
@@ -178,6 +230,14 @@ def export_model(sym, params, input_shape=None, input_type=_np.float32,
     nodes, inits, inputs = [], [], []
     out_of = {}  # (id(node), idx) -> onnx name
     order = sym._topo()
+    # ONNX BatchNormalization has no fix_gamma; bake the semantics into the
+    # exported scale tensor (the reference exporter does the same)
+    for node in order:
+        if node.op == "BatchNorm" and node.attrs.get("fix_gamma", True) \
+                in (True, 1, "True", "true"):
+            src, _ = node.inputs[1]
+            if src.op is None and src.name in flat:
+                flat[src.name] = _np.ones_like(flat[src.name])
     data_inputs = [n for n in order if n.op is None and n.name not in flat]
     shapes = {}
     if input_shape is not None:
@@ -215,6 +275,27 @@ def export_model(sym, params, input_shape=None, input_type=_np.float32,
 # ---------------------------------------------------------------------------
 # import: ONNX -> mx Symbol + params
 # ---------------------------------------------------------------------------
+
+
+def _drop_if_unused(name, g, inits, env, folded):
+    """Remove a folded-away initializer once EVERY reading node has folded
+    it (shared scalar constants feed several nodes)."""
+    folded[name] = folded.get(name, 0) + 1
+    uses = sum(1 for n in g["node"] for i in n["input"] if i == name)
+    if folded[name] >= uses:
+        inits.pop(name, None)
+        env.pop(name, None)
+
+
+def _check_symmetric_pads(node, n):
+    """ONNX pads are (begin..., end...); the mx ops apply one symmetric
+    pad per axis — reject asymmetric forms instead of silently truncating."""
+    pads = list(_get_attr(node, "pads", [0] * n * 2))
+    if pads[:n] != pads[n:]:
+        raise NotImplementedError(
+            f"asymmetric pads {pads} are not representable by the mx "
+            "Convolution/Deconvolution pad attribute")
+    return tuple(pads[:n])
 
 
 def _get_attr(node, name, default=None):
@@ -257,6 +338,8 @@ def import_model(model_file):
     rev_act = {v: k for k, v in _ACT_MAP.items()}
     rev_elem = {"Add": "broadcast_add", "Sub": "broadcast_sub",
                 "Mul": "broadcast_mul", "Div": "broadcast_div"}
+    _REV_UNARY = {v: k for k, v in _UNARY.items()}
+    folded = {}  # initializer name -> #nodes that folded it away
 
     import incubator_mxnet_tpu.symbol as sym_mod
 
@@ -299,7 +382,8 @@ def import_model(model_file):
                 else:
                     b_key = b_name
                 b = env[b_key]
-            out = sym_mod.FullyConnected(x, env[w_key], b,
+            fc_in = [x, env[w_key]] + ([b] if b is not None else [])
+            out = sym_mod.FullyConnected(*fc_in,
                                          num_hidden=w_arr.shape[0],
                                          no_bias=b is None, flatten=False,
                                          name=nm)
@@ -307,15 +391,18 @@ def import_model(model_file):
             out = sym_mod.Flatten(env[node["input"][0]], name=nm)
         elif op == "Conv":
             kernel = tuple(_get_attr(node, "kernel_shape"))
-            pads = _get_attr(node, "pads", [0] * len(kernel) * 2)
+            pads = _check_symmetric_pads(node, len(kernel))
             strides = tuple(_get_attr(node, "strides", (1,) * len(kernel)))
             dil = tuple(_get_attr(node, "dilations", (1,) * len(kernel)))
             grp = _get_attr(node, "group", 1)
             w = inits[node["input"][1]]
             b = env[node["input"][2]] if len(node["input"]) > 2 else None
+            in_syms = [env[node["input"][0]], env[node["input"][1]]]
+            if b is not None:
+                in_syms.append(b)
             out = sym_mod.Convolution(
-                env[node["input"][0]], env[node["input"][1]], b,
-                kernel=kernel, pad=tuple(pads[: len(kernel)]), stride=strides,
+                *in_syms,
+                kernel=kernel, pad=pads, stride=strides,
                 dilate=dil, num_filter=w.shape[0], num_group=grp,
                 no_bias=b is None, name=nm)
         elif op in ("MaxPool", "AveragePool", "GlobalMaxPool", "GlobalAveragePool"):
@@ -361,8 +448,26 @@ def import_model(model_file):
         elif op == "Dropout":
             out = sym_mod.Dropout(env[node["input"][0]], name=nm)
         elif op in rev_elem:
-            out = getattr(sym_mod, rev_elem[op])(
-                env[node["input"][0]], env[node["input"][1]], name=nm)
+            a_name, b_name = node["input"][:2]
+
+            def _scalar_init(nme):
+                return nme in inits and inits[nme].ndim == 0
+
+            if _scalar_init(b_name):
+                opmap = {"Add": "_plus_scalar", "Sub": "_minus_scalar",
+                         "Mul": "_mul_scalar", "Div": "_div_scalar"}
+                out = getattr(sym_mod, opmap[op])(
+                    env[a_name], scalar=float(inits[b_name]), name=nm)
+                _drop_if_unused(b_name, g, inits, env, folded)
+            elif _scalar_init(a_name):
+                opmap = {"Add": "_plus_scalar", "Sub": "_rminus_scalar",
+                         "Mul": "_mul_scalar", "Div": "_rdiv_scalar"}
+                out = getattr(sym_mod, opmap[op])(
+                    env[b_name], scalar=float(inits[a_name]), name=nm)
+                _drop_if_unused(a_name, g, inits, env, folded)
+            else:
+                out = getattr(sym_mod, rev_elem[op])(
+                    env[a_name], env[b_name], name=nm)
         elif op == "MatMul":
             out = sym_mod.dot(env[node["input"][0]], env[node["input"][1]], name=nm)
         elif op == "Gather":
@@ -371,6 +476,54 @@ def import_model(model_file):
             out = sym_mod.Embedding(env[node["input"][1]], env[w_name],
                                     input_dim=w.shape[0], output_dim=w.shape[1],
                                     name=nm)
+        elif op == "ConvTranspose":
+            kernel = tuple(_get_attr(node, "kernel_shape"))
+            pads = _check_symmetric_pads(node, len(kernel))
+            w = inits[node["input"][1]]
+            b = env[node["input"][2]] if len(node["input"]) > 2 else None
+            grp = _get_attr(node, "group", 1)
+            in_syms = [env[node["input"][0]], env[node["input"][1]]]
+            if b is not None:
+                in_syms.append(b)
+            out = sym_mod.Deconvolution(
+                *in_syms,
+                kernel=kernel, pad=pads,
+                stride=tuple(_get_attr(node, "strides", (1,) * len(kernel))),
+                adj=tuple(_get_attr(node, "output_padding", (0,) * len(kernel))),
+                dilate=tuple(_get_attr(node, "dilations", (1,) * len(kernel))),
+                num_filter=w.shape[1] * grp, num_group=grp,
+                no_bias=b is None, name=nm)
+        elif op == "Resize":
+            mode = _get_attr(node, "mode", b"nearest")
+            mode = mode.decode() if isinstance(mode, bytes) else mode
+            # positional contract: input 2 is `scales`; the sizes-based
+            # form (input 3) is a different computation — reject clearly
+            ins = node["input"]
+            if len(ins) > 3 and ins[3]:
+                raise NotImplementedError(
+                    "Resize import supports the scales form, not sizes")
+            sc_name = ins[2] if len(ins) > 2 else ""
+            if not sc_name or sc_name not in inits:
+                raise NotImplementedError(
+                    "Resize import needs `scales` as a graph initializer")
+            scales = inits[sc_name]
+            if (mode not in ("nearest", "linear")
+                    or len(scales) != 4 or scales[2] != scales[3]):
+                raise NotImplementedError(
+                    "Resize import supports nearest/linear with equal "
+                    "H/W scales")
+            out = sym_mod.UpSampling(
+                env[ins[0]], scale=int(scales[2]),
+                sample_type="nearest" if mode == "nearest" else "bilinear",
+                name=nm)
+            _drop_if_unused(sc_name, g, inits, env, folded)
+        elif op == "Transpose":
+            out = sym_mod.transpose(env[node["input"][0]],
+                                    axes=tuple(_get_attr(node, "perm", ())),
+                                    name=nm)
+        elif op in _REV_UNARY:
+            out = getattr(sym_mod, _REV_UNARY[op])(env[node["input"][0]],
+                                                   name=nm)
         else:
             raise NotImplementedError(f"no import converter for ONNX op {op!r}")
         env[node["output"][0]] = out

@@ -378,9 +378,21 @@ class HybridBlock(Block):
             try:
                 return self._call_cached(args)
             except DeferredInit:
-                # run eagerly once to materialize deferred params, then retry
-                out = super().__call__(*args, **kwargs)
-                return out
+                # materialization pass: run eagerly once with aux side
+                # effects swallowed (a throwaway collector), then retry the
+                # cached path so the first user-visible call compiles +
+                # caches AND applies aux updates exactly once
+                _aux_stack().append([])
+                try:
+                    super().__call__(*args, **kwargs)
+                finally:
+                    _aux_stack().pop()
+                try:
+                    return self._call_cached(args)
+                except DeferredInit:
+                    # a param forward never touches can stay deferred;
+                    # fall back to plain eager (real side effects)
+                    return super().__call__(*args, **kwargs)
         return super().__call__(*args, **kwargs)
 
     def forward(self, x, *args):

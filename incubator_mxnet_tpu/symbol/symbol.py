@@ -97,6 +97,13 @@ _TENSOR_PARAMS = {
 }
 
 
+def _flag_default(fn, flag):
+    """Default value of an optional-tensor gate flag (e.g. no_bias) from
+    the op's own signature."""
+    p = inspect.signature(fn).parameters.get(flag)
+    return bool(p.default) if p is not None and p.default is not inspect.Parameter.empty else False
+
+
 def _tensor_params(opname, fn):
     """Tensor-input parameter names, or None for variadic ops (``*args``
     like concat/add_n/stack, which take any number of tensor inputs)."""
@@ -492,8 +499,11 @@ def _apply_op(opname, args, kwargs, name=None, hint=None):
             input_names.append(t)
         else:
             flag = optional.get(t)
-            if flag is not None and attrs.get(flag, False):
-                continue  # e.g. no_bias=True
+            if flag is not None and attrs.get(flag, _flag_default(op.fn, flag)):
+                # e.g. no_bias=True — including by the OP'S OWN default
+                # (Deconvolution defaults no_bias=true in the reference,
+                # Convolution false; the signature is the source of truth)
+                continue
             # missing inputs auto-create variables, incl. the MXNet idiom
             # sym.SoftmaxOutput(data, name='softmax') → 'softmax_label';
             # they inherit the active AttrScope (the reference's main use

@@ -145,3 +145,48 @@ def test_import_foreign_gemm_transB0(tmp_path):
     x = np.random.RandomState(4).rand(2, 5).astype(np.float32)
     out = _bind_forward(sym, args, x)
     np.testing.assert_allclose(out, 2.0 * (x @ w) + 0.5 * b, rtol=1e-5, atol=1e-6)
+
+
+class TestRound3Converters:
+    def test_deconv_upsample_roundtrip(self, tmp_path):
+        """DCGAN-generator-shaped graph: ConvTranspose + BN + activations
+        + nearest Resize survive export->import numerically."""
+        S.symbol._reset_naming()
+        data = S.var("data")
+        d1 = S.Deconvolution(data, kernel=(4, 4), stride=(2, 2), pad=(1, 1),
+                             num_filter=4, name="d1")
+        b1 = S.BatchNorm(d1, name="b1")
+        r1 = S.Activation(b1, act_type="relu", name="r1")
+        u1 = S.UpSampling(r1, scale=2, sample_type="nearest", name="u1")
+        out_sym = S.tanh(u1, name="t1")
+
+        data_np = np.random.RandomState(3).rand(2, 3, 4, 4).astype(np.float32)
+        params = _rand_params(out_sym, data_np.shape)
+        ref = _bind_forward(out_sym, params, data_np)
+
+        f = str(tmp_path / "gen.onnx")
+        onnx_mxnet.export_model(out_sym, params, input_shape=data_np.shape,
+                                onnx_file_path=f)
+        sym2, arg2, aux2 = onnx_mxnet.import_model(f)
+        arg2.update(aux2)
+        out = _bind_forward(sym2, arg2, data_np)
+        assert out.shape == (2, 4, 16, 16)
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+    def test_transpose_scalar_unary_roundtrip(self, tmp_path):
+        S.symbol._reset_naming()
+        data = S.var("data")
+        t = S.transpose(data, axes=(0, 2, 1), name="tr1")
+        s = t * 0.5 + 2.0        # _mul_scalar, _plus_scalar
+        out_sym = S.exp(S.sqrt(S.abs(s, name="ab1"), name="sq1"), name="ex1")
+
+        data_np = np.random.RandomState(4).rand(2, 3, 5).astype(np.float32)
+        ref = _bind_forward(out_sym, {}, data_np)
+
+        f = str(tmp_path / "misc.onnx")
+        onnx_mxnet.export_model(out_sym, {}, input_shape=data_np.shape,
+                                onnx_file_path=f)
+        sym2, arg2, aux2 = onnx_mxnet.import_model(f)
+        arg2.update(aux2)
+        out = _bind_forward(sym2, arg2, data_np)
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)

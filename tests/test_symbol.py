@@ -380,3 +380,15 @@ class TestNamingAndAttrs:
         loaded = mx.sym.load_json(v.tojson())
         got = loaded._outputs[0][0].attrs["__init__"]
         assert got == '["Xavier", {"magnitude": 2}]'
+
+    def test_deconvolution_no_bias_reference_default(self):
+        """Deconvolution defaults no_bias=true in the reference — the
+        symbol front end must honor the OP's signature default and not
+        auto-create a bias."""
+        data = sym.Variable("data")
+        d = sym.Deconvolution(data, kernel=(2, 2), num_filter=3, name="d")
+        assert d.list_arguments() == ["data", "d_weight"]
+        # explicit opt-in restores the bias
+        d2 = sym.Deconvolution(data, kernel=(2, 2), num_filter=3,
+                               no_bias=False, name="d2")
+        assert d2.list_arguments() == ["data", "d2_weight", "d2_bias"]
