@@ -60,8 +60,10 @@ _ELEMWISE = {"elemwise_add": "Add", "broadcast_add": "Add", "_plus": "Add",
 _UNARY = {"tanh": "Tanh", "sigmoid": "Sigmoid", "relu": "Relu",
           "exp": "Exp", "sqrt": "Sqrt", "log": "Log", "negative": "Neg",
           "abs": "Abs"}
-_SCALAR = {"_plus_scalar": "Add", "_mul_scalar": "Mul",
-           "_minus_scalar": "Sub", "_div_scalar": "Div"}
+# op -> (onnx op, scalar operand position: 1 = x∘c, 0 = c∘x)
+_SCALAR = {"_plus_scalar": ("Add", 1), "_mul_scalar": ("Mul", 1),
+           "_minus_scalar": ("Sub", 1), "_div_scalar": ("Div", 1),
+           "_rminus_scalar": ("Sub", 0), "_rdiv_scalar": ("Div", 0)}
 
 
 def _export_node(node, in_names, out_name, extra_inits):
@@ -208,13 +210,14 @@ def _export_node(node, in_names, out_name, extra_inits):
         return [{"op_type": _UNARY[op], "name": nm, "input": in_names,
                  "output": [out_name], "attribute": []}]
     if op in _SCALAR:
+        onnx_op, pos = _SCALAR[op]
         c_name = nm + "_const"
         extra_inits.append({"name": c_name, "dims": (),
                             "data_type": P.TP_FLOAT,
                             "raw": _np.float32(a.get("scalar", 0)).tobytes()})
-        return [{"op_type": _SCALAR[op], "name": nm,
-                 "input": in_names + [c_name], "output": [out_name],
-                 "attribute": []}]
+        ins = in_names + [c_name] if pos == 1 else [c_name] + in_names
+        return [{"op_type": onnx_op, "name": nm, "input": ins,
+                 "output": [out_name], "attribute": []}]
     raise NotImplementedError(f"no ONNX converter for op {op!r}")
 
 
@@ -415,7 +418,7 @@ def import_model(model_file):
                 out = sym_mod.Pooling(
                     env[node["input"][0]], kernel=kernel,
                     stride=tuple(_get_attr(node, "strides", kernel)),
-                    pad=tuple(_get_attr(node, "pads", (0,) * len(kernel) * 2)[: len(kernel)]),
+                    pad=_check_symmetric_pads(node, len(kernel)),
                     pool_type="avg" if op == "AveragePool" else "max", name=nm)
         elif op == "BatchNormalization":
             out = sym_mod.BatchNorm(
@@ -508,10 +511,14 @@ def import_model(model_file):
                     "Resize import needs `scales` as a graph initializer")
             scales = inits[sc_name]
             if (mode not in ("nearest", "linear")
-                    or len(scales) != 4 or scales[2] != scales[3]):
+                    or len(scales) != 4 or scales[2] != scales[3]
+                    or scales[0] != 1 or scales[1] != 1
+                    or float(scales[2]) != int(scales[2])
+                    or int(scales[2]) < 1):
                 raise NotImplementedError(
-                    "Resize import supports nearest/linear with equal "
-                    "H/W scales")
+                    "Resize import supports nearest/linear upsampling with "
+                    "unit batch/channel scales and an equal integer H/W "
+                    f"factor; got scales={list(map(float, scales))}")
             out = sym_mod.UpSampling(
                 env[ins[0]], scale=int(scales[2]),
                 sample_type="nearest" if mode == "nearest" else "bilinear",

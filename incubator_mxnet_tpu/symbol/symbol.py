@@ -19,6 +19,7 @@ Reference behaviors kept:
 """
 from __future__ import annotations
 
+import functools
 import inspect
 import json
 import threading
@@ -97,9 +98,10 @@ _TENSOR_PARAMS = {
 }
 
 
+@functools.lru_cache(maxsize=None)
 def _flag_default(fn, flag):
     """Default value of an optional-tensor gate flag (e.g. no_bias) from
-    the op's own signature."""
+    the op's own signature (cached: graph-construction hot path)."""
     p = inspect.signature(fn).parameters.get(flag)
     return bool(p.default) if p is not None and p.default is not inspect.Parameter.empty else False
 

@@ -178,6 +178,7 @@ class TestRound3Converters:
         data = S.var("data")
         t = S.transpose(data, axes=(0, 2, 1), name="tr1")
         s = t * 0.5 + 2.0        # _mul_scalar, _plus_scalar
+        s = 3.0 - s              # _rminus_scalar (reverse operand order)
         out_sym = S.exp(S.sqrt(S.abs(s, name="ab1"), name="sq1"), name="ex1")
 
         data_np = np.random.RandomState(4).rand(2, 3, 5).astype(np.float32)
