@@ -44,7 +44,30 @@ __all__ = [
 _WRAPPER_CACHE = {}
 
 
+def _unwrap_nested(x):
+    if isinstance(x, NDArray):
+        return x._data
+    if isinstance(x, (list, tuple)):
+        return type(x)(_unwrap_nested(e) for e in x)
+    return x
+
+
 def _make_wrapper(op):
+    if not op.wrap_ndarray:
+        # raw kernels (multi-tensor optimizer updates, all_finite …): accept
+        # NDArrays anywhere — including inside list arguments — but return
+        # the function's own structure (lists of arrays) unwrapped; these
+        # are utility ops whose outputs feed more kernels, not the tape.
+        def raw_wrapper(*args, **kwargs):
+            args = [_unwrap_nested(a) for a in args]
+            kwargs = {k: _unwrap_nested(v) for k, v in kwargs.items()}
+            return op.fn(*args, **kwargs)
+
+        raw_wrapper.__name__ = op.name
+        raw_wrapper.__qualname__ = f"nd.{op.name}"
+        raw_wrapper.__doc__ = op.doc
+        return raw_wrapper
+
     def wrapper(*args, out=None, **kwargs):
         res = invoke(op.fn, args, kwargs, name=op.name)
         if out is not None:

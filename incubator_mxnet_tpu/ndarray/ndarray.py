@@ -323,8 +323,14 @@ class NDArray:
     # in-place (buffer swap + version bump)
     def _inplace(self, opname, other):
         new = _op(opname, self, other)
+        was_leaf = self._prov is not None and self._prov[0] == "leaf"
         self._data = new._data
         self._prov = new._prov
+        if new._prov is None and was_leaf:
+            # `w -= lr * w.grad` outside record() is the reference's manual
+            # SGD idiom: an attach_grad leaf stays a tape leaf across
+            # in-place updates ([U:python/mxnet/ndarray/ndarray.py])
+            self._prov = ("leaf", self)
         self._version += 1
         return self
 
@@ -517,6 +523,12 @@ def invoke(fn, arrays, kwargs, name="", ctx=None):
     ([U:src/c_api/c_api_ndarray.cc], [U:src/imperative/imperative.cc]).
     """
     raw = [a._data if isinstance(a, NDArray) else a for a in arrays]
+    # optional tensor parameters arrive as kwargs (sequence_length=,
+    # data_lengths=, mask=…): unwrap them too — they are vjp constants
+    # (no gradient flows to kwarg tensors, matching the reference's
+    # treatment of auxiliary inputs)
+    kwargs = {k: (v._data if isinstance(v, NDArray) else v)
+              for k, v in kwargs.items()}
     if _amp is not None:
         # mx.amp dispatch hook: per-op-list dtype casting (covers eager,
         # hybridize traces, Symbol executors and SPMDTrainer alike, since

@@ -367,3 +367,20 @@ def test_backward_frees_replay_state():
     y.backward()
     assert node.vjp_fn is None
     assert node._replay_fn is None and node._replay_raw is None
+
+
+@with_seed()
+def test_leaf_survives_inplace_update():
+    """`w -= lr * w.grad` outside record() — the reference's manual-SGD
+    idiom — must keep the attach_grad leaf on the tape (round-4 fix:
+    _inplace used to wipe the leaf provenance)."""
+    w = mx.nd.array(np.array([4.0, -3.0], np.float32))
+    w.attach_grad()
+    losses = []
+    for _ in range(25):
+        with autograd.record():
+            loss = (w * w).sum()
+        loss.backward()
+        w -= 0.1 * w.grad
+        losses.append(float(loss.asnumpy()))
+    assert losses[-1] < 1e-2 * losses[0], losses[-1]
