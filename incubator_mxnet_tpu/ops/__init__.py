@@ -14,5 +14,9 @@ from . import rnn_ops  # noqa: F401  (registers fused RNN)
 from . import attention  # noqa: F401  (registers fused/flash attention)
 from . import detection  # noqa: F401  (registers MultiBox*/box_nms/box_iou)
 from . import quantization  # noqa: F401  (registers quantize_v2/dequantize/int8 ops)
+from . import linalg  # noqa: F401  (registers the la_op family)
+from . import random_ops  # noqa: F401  (registers _random_*/_sample_* samplers)
+from . import optimizer_ops  # noqa: F401  (registers fused update kernels as public ops)
+from . import spatial  # noqa: F401  (registers ROI/grid/bilinear/spatial CV ops)
 
 __all__ = ["register", "get_op", "list_ops", "Op", "registry", "tensor", "nn"]

@@ -1,0 +1,191 @@
+"""Advanced linear-algebra operators — the full ``la_op`` family.
+
+Parity target: [U:src/operator/tensor/la_op.cc] / la_op.cu (``linalg_gemm``,
+``linalg_trmm``, ``linalg_trsm``, ``linalg_potrf``, ``linalg_potri``,
+``linalg_gelqf``, ``linalg_syevd``, ``linalg_sumlogdiag``, the diag/trian
+pack/unpack ops, det variants).  The reference dispatches to cuSOLVER/LAPACK;
+here every op lowers through XLA's native decomposition/triangular-solve HLOs
+(MXU-backed batched matmuls, vectorized solves), and every op is
+differentiable through ``jax.vjp`` with no hand-written backward kernels
+(the reference maintains ~40 backward La* structs).
+
+Conventions follow the reference: all ops operate on the last two axes and
+batch over leading axes; ``lower`` selects the triangle; gemm/trmm/trsm take
+an ``alpha`` scale.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+__all__ = []
+
+
+def _tri_mask(n, lower, offset=0, dtype=jnp.float32):
+    r = jnp.arange(n)
+    if lower:
+        return (r[:, None] >= (r[None, :] - offset)).astype(dtype)
+    return (r[:, None] <= (r[None, :] - offset)).astype(dtype)
+
+
+@register("linalg_gemm")
+def linalg_gemm(a, b, c, transpose_a=False, transpose_b=False, alpha=1.0, beta=1.0):
+    """C_out = alpha * op(A) @ op(B) + beta * C."""
+    if transpose_a:
+        a = jnp.swapaxes(a, -1, -2)
+    if transpose_b:
+        b = jnp.swapaxes(b, -1, -2)
+    return alpha * jnp.matmul(a, b) + beta * c
+
+
+@register("linalg_potri")
+def linalg_potri(a, lower=True):
+    """Inverse of the SPD matrix whose Cholesky factor is ``a``:
+    given L (lower) returns (L Lᵀ)⁻¹ = L⁻ᵀ L⁻¹."""
+    n = a.shape[-1]
+    eye = jnp.broadcast_to(jnp.eye(n, dtype=a.dtype), a.shape)
+    inv = lax.linalg.triangular_solve(a, eye, left_side=True, lower=lower)
+    if lower:
+        return jnp.matmul(jnp.swapaxes(inv, -1, -2), inv)
+    return jnp.matmul(inv, jnp.swapaxes(inv, -1, -2))
+
+
+@register("linalg_trmm")
+def linalg_trmm(a, b, transpose=False, rightside=False, lower=True, alpha=1.0):
+    """Triangular matmul: out = alpha * op(A) @ B (or B @ op(A) if
+    ``rightside``); only the selected triangle of A participates."""
+    mask = _tri_mask(a.shape[-1], lower, dtype=a.dtype)
+    a = a * mask
+    if transpose:
+        a = jnp.swapaxes(a, -1, -2)
+    out = jnp.matmul(b, a) if rightside else jnp.matmul(a, b)
+    return alpha * out
+
+
+@register("linalg_trsm")
+def linalg_trsm(a, b, transpose=False, rightside=False, lower=True, alpha=1.0):
+    """Solve op(A) X = alpha B (or X op(A) = alpha B if ``rightside``) with A
+    triangular."""
+    return lax.linalg.triangular_solve(
+        a, alpha * b, left_side=not rightside, lower=lower,
+        transpose_a=transpose)
+
+
+@register("linalg_sumlogdiag")
+def linalg_sumlogdiag(a):
+    """Sum of log of the diagonal entries (per batch matrix)."""
+    d = jnp.diagonal(a, axis1=-2, axis2=-1)
+    return jnp.sum(jnp.log(d), axis=-1)
+
+
+@register("linalg_extractdiag")
+def linalg_extractdiag(a, offset=0):
+    return jnp.diagonal(a, offset=offset, axis1=-2, axis2=-1)
+
+
+@register("linalg_makediag")
+def linalg_makediag(v, offset=0):
+    n = v.shape[-1] + abs(offset)
+    eye = jnp.eye(n, k=offset, dtype=v.dtype)
+    idx = jnp.arange(v.shape[-1])
+    rows = idx + max(-offset, 0)
+    cols = idx + max(offset, 0)
+    out = jnp.zeros(v.shape[:-1] + (n, n), dtype=v.dtype)
+    return out.at[..., rows, cols].set(v)
+
+
+def _trian_indices(n, offset, lower):
+    if lower:
+        rows, cols = jnp.tril_indices(n, k=offset)
+    else:
+        rows, cols = jnp.triu_indices(n, k=offset)
+    return rows, cols
+
+
+@register("linalg_extracttrian")
+def linalg_extracttrian(a, offset=0, lower=True):
+    """Pack the selected triangle into a vector (row-major order of the
+    triangle entries, matching the reference's copy order)."""
+    rows, cols = _trian_indices(a.shape[-1], offset, lower)
+    return a[..., rows, cols]
+
+
+@register("linalg_maketrian")
+def linalg_maketrian(v, offset=0, lower=True):
+    """Unpack a packed-triangle vector into an otherwise-zero square matrix
+    (inverse of ``linalg_extracttrian``)."""
+    # infer n from the packed length: len = n(n+1)/2 shifted by the offset band
+    L = v.shape[-1]
+    k = abs(offset)
+    # solve m(m+1)/2 = L where m = n - k  (entries of the shifted triangle)
+    m = int((-1 + (1 + 8 * L) ** 0.5) // 2)
+    n = m + k
+    rows, cols = _trian_indices(n, offset, lower)
+    out = jnp.zeros(v.shape[:-1] + (n, n), dtype=v.dtype)
+    return out.at[..., rows, cols].set(v)
+
+
+@register("linalg_gelqf")
+def linalg_gelqf(a):
+    """LQ factorization A = L Q with Q orthonormal rows (m ≤ n).  Returns
+    (Q, L) like the reference (two outputs)."""
+    q2, r2 = jnp.linalg.qr(jnp.swapaxes(a, -1, -2))
+    q = jnp.swapaxes(q2, -1, -2)
+    l = jnp.swapaxes(r2, -1, -2)
+    # sign-normalize so diag(L) >= 0 (LAPACK convention the reference tests)
+    s = jnp.sign(jnp.diagonal(l, axis1=-2, axis2=-1))
+    s = jnp.where(s == 0, 1.0, s).astype(a.dtype)
+    return q * s[..., :, None], l * s[..., None, :]
+
+
+@register("linalg_syevd")
+def linalg_syevd(a):
+    """Symmetric eigendecomposition: returns (U, L) with A = Uᵀ diag(L) U —
+    eigenvectors in ROWS (the reference's convention, transposed from
+    LAPACK's columns)."""
+    w, v = jnp.linalg.eigh(a)
+    return jnp.swapaxes(v, -1, -2), w
+
+
+@register("linalg_inverse")
+def linalg_inverse(a):
+    return jnp.linalg.inv(a)
+
+
+@register("linalg_det")
+def linalg_det(a):
+    return jnp.linalg.det(a)
+
+
+@register("linalg_slogdet")
+def linalg_slogdet(a):
+    sign, logabs = jnp.linalg.slogdet(a)
+    return sign, logabs
+
+
+@register("khatri_rao")
+def khatri_rao(*matrices):
+    """Column-wise Kronecker product (parity: [U:src/operator/contrib/
+    krprod.cc]).  All inputs share the trailing (column) dimension."""
+    if not matrices:
+        raise ValueError("khatri_rao needs at least one matrix")
+    out = matrices[0]
+    for m in matrices[1:]:
+        out = (out[:, None, :] * m[None, :, :]).reshape(-1, out.shape[-1])
+    return out
+
+
+@register("moments")
+def moments(data, axes=None, keepdims=False):
+    """Mean and variance over ``axes`` (parity: [U:src/operator/nn/moments.cc]).
+    One-pass E[x²]−E[x]² form so both statistics fuse into a single read."""
+    ax = tuple(axes) if axes is not None else None
+    mean = jnp.mean(data, axis=ax, keepdims=True)
+    var = jnp.mean(jnp.square(data), axis=ax, keepdims=True) - jnp.square(mean)
+    if not keepdims:
+        mean = jnp.squeeze(mean, axis=ax) if ax else mean.reshape(())
+        var = jnp.squeeze(var, axis=ax) if ax else var.reshape(())
+    return mean, var

@@ -1,0 +1,238 @@
+"""Random-sampling operators.
+
+Parity targets: [U:src/operator/random/sample_op.cc] (``_random_uniform`` …)
+and [U:src/operator/random/multisample_op.cc] (``_sample_uniform`` … — one
+draw-batch per row of the distribution-parameter tensors).  The reference
+pulls per-device RNG streams from the Resource manager; here every sampler
+is a pure function of an explicit PRNG key threaded from :mod:`..random`
+(trace-safe under jit; the hardware ``rbg`` generator is the package
+default on TPU — config.py).
+
+Multisample shape convention (the reference's): output shape is
+``params.shape + shape`` — each scalar parameter row yields an independent
+``shape``-shaped draw batch.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..base import _as_np_dtype
+from .registry import register
+
+__all__ = []
+
+
+def _key(key):
+    if key is not None:
+        return key
+    from ..random import get_key
+
+    return get_key()
+
+
+def _threefry(key):
+    """jax.random.poisson supports only the threefry impl; under the
+    package's hardware-PRNG (rbg) default, fold the key bits into a
+    threefry key (counter-based samplers stay deterministic per key)."""
+    if jax.dtypes.issubdtype(key.dtype, jax.dtypes.prng_key):
+        data = jax.random.key_data(key)
+    else:
+        data = key
+    flat = data.reshape(-1).astype(jnp.uint32)
+    if flat.shape[0] == 2:
+        words = flat
+    else:
+        words = jnp.stack([flat[0] ^ flat[-2], flat[1] ^ flat[-1]])
+    return jax.random.wrap_key_data(words, impl="threefry2x32")
+
+
+def _poisson(key, lam, shape):
+    return jax.random.poisson(_threefry(key), lam, shape)
+
+
+def _shape_tuple(shape):
+    if shape is None:
+        return ()
+    if isinstance(shape, int):
+        return (shape,)
+    return tuple(shape)
+
+
+# ---------------------------------------------------------------------------
+# _random_* — tensor-shaped draws with scalar parameters
+# ---------------------------------------------------------------------------
+
+
+@register("_random_uniform", differentiable=False)
+def _random_uniform(low=0.0, high=1.0, shape=(1,), dtype="float32", key=None):
+    return jax.random.uniform(_key(key), _shape_tuple(shape),
+                              dtype=_as_np_dtype(dtype), minval=low, maxval=high)
+
+
+@register("_random_normal", differentiable=False)
+def _random_normal(loc=0.0, scale=1.0, shape=(1,), dtype="float32", key=None):
+    dt = _as_np_dtype(dtype)
+    return loc + scale * jax.random.normal(_key(key), _shape_tuple(shape), dtype=dt)
+
+
+@register("_random_gamma", differentiable=False)
+def _random_gamma(alpha=1.0, beta=1.0, shape=(1,), dtype="float32", key=None):
+    dt = _as_np_dtype(dtype)
+    return beta * jax.random.gamma(_key(key), alpha, _shape_tuple(shape), dtype=dt)
+
+
+@register("_random_exponential", differentiable=False)
+def _random_exponential(lam=1.0, shape=(1,), dtype="float32", key=None):
+    dt = _as_np_dtype(dtype)
+    return jax.random.exponential(_key(key), _shape_tuple(shape), dtype=dt) / lam
+
+
+@register("_random_poisson", differentiable=False)
+def _random_poisson(lam=1.0, shape=(1,), dtype="float32", key=None):
+    out = _poisson(_key(key), lam, _shape_tuple(shape))
+    return out.astype(_as_np_dtype(dtype))
+
+
+@register("_random_negative_binomial", differentiable=False)
+def _random_negative_binomial(k=1, p=0.5, shape=(1,), dtype="float32", key=None):
+    """Gamma–Poisson mixture: X ~ Poisson(Gamma(k, (1-p)/p)) — failures
+    before the k-th success."""
+    kg, kp = jax.random.split(_key(key))
+    lam = jax.random.gamma(kg, float(k), _shape_tuple(shape)) * ((1.0 - p) / p)
+    return _poisson(kp, lam, None).astype(_as_np_dtype(dtype))
+
+
+@register("_random_generalized_negative_binomial", differentiable=False)
+def _random_generalized_negative_binomial(mu=1.0, alpha=1.0, shape=(1,), dtype="float32", key=None):
+    """NB(mu, alpha): Poisson with Gamma(1/alpha, mu*alpha) rate; alpha→0
+    degenerates to Poisson(mu)."""
+    if alpha <= 0:
+        return _poisson(
+            _key(key), mu, _shape_tuple(shape)).astype(_as_np_dtype(dtype))
+    kg, kp = jax.random.split(_key(key))
+    lam = jax.random.gamma(kg, 1.0 / alpha, _shape_tuple(shape)) * (mu * alpha)
+    return _poisson(kp, lam, None).astype(_as_np_dtype(dtype))
+
+
+@register("_random_randint", differentiable=False)
+def _random_randint(low=0, high=1, shape=(1,), dtype="int32", key=None):
+    return jax.random.randint(_key(key), _shape_tuple(shape), int(low), int(high),
+                              dtype=_as_np_dtype(dtype))
+
+
+# ---------------------------------------------------------------------------
+# _sample_* — per-row parameter tensors (multisample_op)
+# ---------------------------------------------------------------------------
+
+
+def _multi(params, shape):
+    """Broadcast distribution-parameter tensors to a common shape and return
+    (broadcast params, draw shape = common + shape)."""
+    common = jnp.broadcast_shapes(*[jnp.shape(p) for p in params])
+    out = [jnp.broadcast_to(jnp.asarray(p), common) for p in params]
+    return out, common + _shape_tuple(shape)
+
+
+def _expand(p, shape):
+    """Append axes so p broadcasts against the draw shape."""
+    extra = len(shape) - jnp.ndim(p)
+    return jnp.reshape(p, jnp.shape(p) + (1,) * extra)
+
+
+@register("_sample_uniform", differentiable=False)
+def _sample_uniform(low, high, shape=(), dtype=None, key=None):
+    (low, high), full = _multi([low, high], shape)
+    dt = _as_np_dtype(dtype) if dtype else jnp.result_type(low)
+    u = jax.random.uniform(_key(key), full, dtype=dt)
+    return _expand(low, full) + u * (_expand(high, full) - _expand(low, full))
+
+
+@register("_sample_normal", differentiable=False)
+def _sample_normal(mu, sigma, shape=(), dtype=None, key=None):
+    (mu, sigma), full = _multi([mu, sigma], shape)
+    dt = _as_np_dtype(dtype) if dtype else jnp.result_type(mu)
+    z = jax.random.normal(_key(key), full, dtype=dt)
+    return _expand(mu, full) + _expand(sigma, full) * z
+
+
+@register("_sample_gamma", differentiable=False)
+def _sample_gamma(alpha, beta, shape=(), dtype=None, key=None):
+    (alpha, beta), full = _multi([alpha, beta], shape)
+    dt = _as_np_dtype(dtype) if dtype else jnp.result_type(alpha)
+    g = jax.random.gamma(_key(key), _expand(alpha, full), full, dtype=dt)
+    return g * _expand(beta, full)
+
+
+@register("_sample_exponential", differentiable=False)
+def _sample_exponential(lam, shape=(), dtype=None, key=None):
+    (lam,), full = _multi([lam], shape)
+    dt = _as_np_dtype(dtype) if dtype else jnp.result_type(lam)
+    e = jax.random.exponential(_key(key), full, dtype=dt)
+    return e / _expand(lam, full)
+
+
+@register("_sample_poisson", differentiable=False)
+def _sample_poisson(lam, shape=(), dtype="float32", key=None):
+    (lam,), full = _multi([lam], shape)
+    out = _poisson(_key(key), _expand(lam, full), full)
+    return out.astype(_as_np_dtype(dtype))
+
+
+@register("_sample_negative_binomial", differentiable=False)
+def _sample_negative_binomial(k, p, shape=(), dtype="float32", key=None):
+    (k, p), full = _multi([k, p], shape)
+    kg, kp = jax.random.split(_key(key))
+    kb, pb = _expand(k, full), _expand(p, full)
+    lam = jax.random.gamma(kg, kb.astype(jnp.float32), full) * ((1.0 - pb) / pb)
+    return _poisson(kp, lam, None).astype(_as_np_dtype(dtype))
+
+
+@register("_sample_generalized_negative_binomial", differentiable=False)
+def _sample_generalized_negative_binomial(mu, alpha, shape=(), dtype="float32", key=None):
+    (mu, alpha), full = _multi([mu, alpha], shape)
+    kg, kp = jax.random.split(_key(key))
+    mub, ab = _expand(mu, full), _expand(alpha, full)
+    safe = jnp.maximum(ab, 1e-12)
+    lam = jax.random.gamma(kg, 1.0 / safe, full) * (mub * safe)
+    lam = jnp.where(ab <= 0, mub, lam)  # alpha==0 rows degenerate to Poisson(mu)
+    return _poisson(kp, lam, None).astype(_as_np_dtype(dtype))
+
+
+@register("_sample_multinomial", differentiable=False)
+def _sample_multinomial(data, shape=(), get_prob=False, dtype="int32", key=None):
+    """Categorical draws from probability rows ([U:src/operator/random/
+    sample_multinomial_op.cc]).  data: [..., k] probabilities; output
+    ``data.shape[:-1] + shape`` int samples (+ log-prob tensor if
+    ``get_prob`` — the REINFORCE helper the reference documents)."""
+    batch = data.shape[:-1]
+    full = batch + _shape_tuple(shape)
+    logits = jnp.log(jnp.maximum(data, 1e-37))
+    extra = len(full) - len(batch)
+    lg = jnp.reshape(logits, batch + (1,) * extra + logits.shape[-1:])
+    samples = jax.random.categorical(_key(key), lg, axis=-1, shape=full)
+    samples = samples.astype(_as_np_dtype(dtype))
+    if not get_prob:
+        return samples
+    logp = jnp.take_along_axis(
+        jnp.broadcast_to(lg, full + logits.shape[-1:]),
+        samples[..., None].astype(jnp.int32), axis=-1)[..., 0]
+    return samples, logp
+
+
+@register("_random_uniform_like", differentiable=False)
+def _random_uniform_like(data, low=0.0, high=1.0, key=None):
+    return jax.random.uniform(_key(key), data.shape, dtype=data.dtype,
+                              minval=low, maxval=high)
+
+
+@register("_random_normal_like", differentiable=False)
+def _random_normal_like(data, loc=0.0, scale=1.0, key=None):
+    return loc + scale * jax.random.normal(_key(key), data.shape, dtype=data.dtype)
+
+
+@register("shuffle", differentiable=False)
+def shuffle(data, key=None):
+    """Random permutation along the first axis (parity: [U:src/operator/
+    random/shuffle_op.cc])."""
+    return jax.random.permutation(_key(key), data, axis=0)
