@@ -214,3 +214,189 @@ def mp_adam_update(weight, grad, mean, var, weight32, lr, wd, rescale, clip, bet
     lr_t = lr * jnp.sqrt(1 - beta2 ** t) / (1 - beta1 ** t)
     new_w32 = weight32 - lr_t * new_mean / (jnp.sqrt(new_var) + eps)
     return new_w32.astype(weight.dtype), new_mean, new_var, new_w32
+
+
+@jax.jit
+def mp_sgd_update(weight, grad, weight32, lr, wd, rescale, clip):
+    g = jnp.clip(grad.astype(jnp.float32) * rescale, -clip, clip) + wd * weight32
+    new_w32 = weight32 - lr * g
+    return new_w32.astype(weight.dtype), new_w32
+
+
+@jax.jit
+def mp_nag_mom_update(weight, grad, mom, weight32, lr, wd, rescale, clip, momentum):
+    g = jnp.clip(grad.astype(jnp.float32) * rescale, -clip, clip) + wd * weight32
+    new_mom = momentum * mom + g
+    new_w32 = weight32 - lr * (momentum * new_mom + g)
+    return new_w32.astype(weight.dtype), new_mom, new_w32
+
+
+@jax.jit
+def nadam_update(weight, grad, mean, var, m_schedule, lr, wd, rescale, clip,
+                 beta1, beta2, eps, t, schedule_decay):
+    """Nesterov Adam ([U:python/mxnet/optimizer/optimizer.py] Nadam, Dozat
+    2016).  ``m_schedule`` is the running momentum-schedule product the
+    python reference keeps as optimizer state — carried here as a 0-d state
+    array so the kernel stays a pure function of (state, t)."""
+    g = _prep(grad, rescale, clip, wd, weight)
+    m_t = beta1 * (1.0 - 0.5 * 0.96 ** (t * schedule_decay))
+    m_t1 = beta1 * (1.0 - 0.5 * 0.96 ** ((t + 1) * schedule_decay))
+    new_schedule = m_schedule * m_t
+    schedule_next = new_schedule * m_t1
+    new_mean = beta1 * mean + (1 - beta1) * g
+    new_var = beta2 * var + (1 - beta2) * jnp.square(g)
+    g_hat = g / (1.0 - new_schedule)
+    m_hat = new_mean / (1.0 - schedule_next)
+    v_hat = new_var / (1.0 - beta2 ** t)
+    upd = lr * ((1.0 - m_t) * g_hat + m_t1 * m_hat) / (jnp.sqrt(v_hat) + eps)
+    return ((weight.astype(jnp.float32) - upd).astype(weight.dtype),
+            new_mean, new_var, new_schedule)
+
+
+@jax.jit
+def ftml_update(weight, grad, d, v, z, lr, wd, rescale, clip, beta1, beta2, eps, t):
+    """FTML (Zheng & Kwok 2017; parity: [U:src/operator/optimizer_op.cc]
+    ftml_update)."""
+    g = _prep(grad, rescale, clip, wd, weight)
+    new_v = beta2 * v + (1 - beta2) * jnp.square(g)
+    d_t = (1 - beta1 ** t) / lr * (jnp.sqrt(new_v / (1 - beta2 ** t)) + eps)
+    sigma = d_t - beta1 * d
+    new_z = beta1 * z + (1 - beta1) * g - sigma * weight.astype(jnp.float32)
+    new_w = -new_z / d_t
+    return new_w.astype(weight.dtype), d_t, new_v, new_z
+
+
+@jax.jit
+def sgld_update(weight, grad, lr, wd, rescale, clip, noise):
+    """Stochastic Gradient Langevin Dynamics: SGD + N(0, sqrt(lr)) noise
+    (parity: the python SGLD optimizer in [U:python/mxnet/optimizer/])."""
+    g = _prep(grad, rescale, clip, wd, weight)
+    w32 = weight.astype(jnp.float32)
+    return (w32 - 0.5 * lr * g + jnp.sqrt(lr) * noise).astype(weight.dtype)
+
+
+@jax.jit
+def dcasgd_update(weight, grad, mom, prev_weight, lr, wd, rescale, clip, momentum, lamda):
+    """Delay-Compensated ASGD (Zheng et al. 2017): compensates stale
+    gradients with a λ·g²·(w − w_prev) term (g excludes wd, matching the
+    reference recurrence)."""
+    g = jnp.clip(grad.astype(jnp.float32) * rescale, -clip, clip)
+    w32 = weight.astype(jnp.float32)
+    comp = g + wd * w32 + lamda * jnp.square(g) * (w32 - prev_weight)
+    new_mom = momentum * mom - lr * comp
+    new_w32 = w32 + new_mom
+    return new_w32.astype(weight.dtype), new_mom, new_w32
+
+
+@jax.jit
+def adamax_update(weight, grad, mean, inf_norm, lr, wd, rescale, clip, beta1, beta2):
+    """AdaMax (Kingma & Ba): the infinity-norm Adam variant."""
+    g = _prep(grad, rescale, clip, wd, weight)
+    new_mean = beta1 * mean + (1 - beta1) * g
+    new_inf = jnp.maximum(beta2 * inf_norm, jnp.abs(g))
+    upd = lr * new_mean / (new_inf + 1e-8)
+    return (weight.astype(jnp.float32) - upd).astype(weight.dtype), new_mean, new_inf
+
+
+# -- multi-tensor (grouped) updates -----------------------------------------
+# Parity: [U:src/operator/optimizer_op.cc] multi_sgd_update /
+# multi_sgd_mom_update / multi_mp_sgd_* — ONE fused kernel updating a whole
+# parameter group.  On TPU each per-tensor update is elementwise and XLA
+# fuses the group into few HBM passes; the value of the grouped form is one
+# dispatch (and one lr/wd broadcast) for hundreds of small tensors.
+
+
+def multi_sgd_update(weights, grads, lrs, wds, rescale_grad=1.0, clip_gradient=-1.0):
+    clip = jnp.float32(clip_gradient if clip_gradient > 0 else jnp.inf)
+    return [
+        sgd_update(w, g, jnp.float32(lr), jnp.float32(wd), jnp.float32(rescale_grad), clip)
+        for w, g, lr, wd in zip(weights, grads, lrs, wds)
+    ]
+
+
+def multi_sgd_mom_update(weights, grads, moms, lrs, wds, momentum=0.0,
+                         rescale_grad=1.0, clip_gradient=-1.0):
+    clip = jnp.float32(clip_gradient if clip_gradient > 0 else jnp.inf)
+    out = [
+        sgd_mom_update(w, g, m, jnp.float32(lr), jnp.float32(wd),
+                       jnp.float32(rescale_grad), clip, jnp.float32(momentum))
+        for w, g, m, lr, wd in zip(weights, grads, moms, lrs, wds)
+    ]
+    return [o[0] for o in out], [o[1] for o in out]
+
+
+def multi_mp_sgd_update(weights, grads, weights32, lrs, wds,
+                        rescale_grad=1.0, clip_gradient=-1.0):
+    clip = jnp.float32(clip_gradient if clip_gradient > 0 else jnp.inf)
+    out = [
+        mp_sgd_update(w, g, w32, jnp.float32(lr), jnp.float32(wd),
+                      jnp.float32(rescale_grad), clip)
+        for w, g, w32, lr, wd in zip(weights, grads, weights32, lrs, wds)
+    ]
+    return [o[0] for o in out], [o[1] for o in out]
+
+
+def multi_mp_sgd_mom_update(weights, grads, moms, weights32, lrs, wds,
+                            momentum=0.0, rescale_grad=1.0, clip_gradient=-1.0):
+    clip = jnp.float32(clip_gradient if clip_gradient > 0 else jnp.inf)
+    out = [
+        mp_sgd_mom_update(w, g, m, w32, jnp.float32(lr), jnp.float32(wd),
+                          jnp.float32(rescale_grad), clip, jnp.float32(momentum))
+        for w, g, m, w32, lr, wd in zip(weights, grads, moms, weights32, lrs, wds)
+    ]
+    return [o[0] for o in out], [o[1] for o in out], [o[2] for o in out]
+
+
+def multi_sum_sq(*arrays):
+    """Per-tensor sum of squares, one fused pass (parity:
+    [U:src/operator/contrib/multi_sum_sq.cc]; feeds multi_lars)."""
+    return jnp.stack([jnp.sum(jnp.square(a.astype(jnp.float32))) for a in arrays])
+
+
+def multi_lars(lrs, weights_sum_sq, grads_sum_sq, wds, eta=0.001, eps=1e-8,
+               rescale_grad=1.0):
+    """LARS layerwise rates from the stacked norms (parity:
+    [U:src/operator/contrib/multi_lars.cc])."""
+    w_norm = jnp.sqrt(weights_sum_sq)
+    g_norm = jnp.sqrt(grads_sum_sq) * rescale_grad
+    ratio = eta * w_norm / (g_norm + wds * w_norm + eps)
+    return jnp.where(w_norm > 0, lrs * jnp.where(g_norm > 0, ratio, 1.0), lrs)
+
+
+def all_finite(*arrays):
+    """True iff every element of every array is finite (parity:
+    [U:src/operator/contrib/all_finite.cc]; the AMP overflow check)."""
+    ok = jnp.bool_(True)
+    for a in arrays:
+        ok = jnp.logical_and(ok, jnp.all(jnp.isfinite(a.astype(jnp.float32))))
+    return ok
+
+
+multi_all_finite = all_finite
+
+
+def _register_public_ops():
+    """Expose the fused update kernels through the op registry —
+    ``mx.nd.sgd_update`` etc. are public API in the reference
+    ([U:src/operator/optimizer_op.cc] registration block)."""
+    from .registry import register as _reg
+
+    for fn in (
+        sgd_update, sgd_mom_update, sgd_lazy_update, sgd_mom_lazy_update,
+        mp_sgd_update, mp_sgd_mom_update, mp_sgd_mom_lazy_update,
+        nag_mom_update, mp_nag_mom_update,
+        adam_update, adam_lazy_update, mp_adam_update, adamw_update,
+        nadam_update, ftml_update, sgld_update, dcasgd_update, adamax_update,
+        rmsprop_update, rmspropalex_update, adagrad_update, adadelta_update,
+        ftrl_update, signum_update, lamb_update_phase1, lamb_update_phase2,
+        multi_sgd_update, multi_sgd_mom_update, multi_mp_sgd_update,
+        multi_mp_sgd_mom_update, multi_sum_sq, multi_lars, all_finite,
+    ):
+        name = fn.__name__ if hasattr(fn, "__name__") else fn.__wrapped__.__name__
+        _reg(name, differentiable=False, wrap_ndarray=False)(fn)
+    from .registry import alias as _alias
+
+    _alias("multi_all_finite", "all_finite")
+
+
+_register_public_ops()

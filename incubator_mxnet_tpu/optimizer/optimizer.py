@@ -599,6 +599,267 @@ class LAMB(Optimizer):
         _swap(var, new_var)
 
 
+@register
+class Nadam(Optimizer):
+    """Nesterov Adam (parity: [U:python/mxnet/optimizer/optimizer.py] Nadam).
+    The momentum-schedule product is kept as a 0-d state array (the python
+    reference mutates ``self.m_schedule``; a state array keeps the fused
+    SPMD step pure and trace-safe)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, schedule_decay=0.004, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self.schedule_decay = schedule_decay
+
+    def create_state(self, index, weight):
+        return (
+            zeros(weight.shape, dtype="float32", ctx=weight.ctx),
+            zeros(weight.shape, dtype="float32", ctx=weight.ctx),
+            NDArray(jnp.ones((), dtype=jnp.float32)),  # m_schedule product
+        )
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        t = self._index_update_count[index]
+        mean, var, sched = state
+        new_w, new_mean, new_var, new_sched = K.nadam_update(
+            weight._data,
+            grad._data,
+            mean._data,
+            var._data,
+            sched._data,
+            _f32(lr),
+            _f32(wd),
+            _f32(self.rescale_grad),
+            _f32(self.clip_gradient),
+            _f32(self.beta1),
+            _f32(self.beta2),
+            _f32(self.epsilon),
+            _f32(t),
+            _f32(self.schedule_decay),
+        )
+        _swap(weight, new_w)
+        _swap(mean, new_mean)
+        _swap(var, new_var)
+        _swap(sched, new_sched)
+
+
+@register
+class FTML(Optimizer):
+    """Follow the Moving Leader (Zheng & Kwok 2017; parity: ftml_update in
+    [U:src/operator/optimizer_op.cc])."""
+
+    def __init__(self, learning_rate=0.0025, beta1=0.6, beta2=0.999,
+                 epsilon=1e-8, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def create_state(self, index, weight):
+        return tuple(zeros(weight.shape, dtype="float32", ctx=weight.ctx)
+                     for _ in range(3))  # d, v, z
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        t = self._index_update_count[index]
+        d, v, z = state
+        new_w, new_d, new_v, new_z = K.ftml_update(
+            weight._data,
+            grad._data,
+            d._data,
+            v._data,
+            z._data,
+            _f32(lr),
+            _f32(wd),
+            _f32(self.rescale_grad),
+            _f32(self.clip_gradient),
+            _f32(self.beta1),
+            _f32(self.beta2),
+            _f32(self.epsilon),
+            _f32(t),
+        )
+        _swap(weight, new_w)
+        _swap(d, new_d)
+        _swap(v, new_v)
+        _swap(z, new_z)
+
+
+@register
+class SGLD(Optimizer):
+    """Stochastic Gradient Langevin Dynamics (parity:
+    [U:python/mxnet/optimizer/optimizer.py] SGLD): posterior sampling via
+    gradient noise ~ N(0, lr)."""
+
+    def create_state(self, index, weight):
+        return None
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        from ..random import get_key
+        import jax as _jax
+
+        noise = _jax.random.normal(get_key(), weight.shape, dtype=jnp.float32)
+        new_w = K.sgld_update(
+            weight._data,
+            grad._data,
+            _f32(lr),
+            _f32(wd),
+            _f32(self.rescale_grad),
+            _f32(self.clip_gradient),
+            noise,
+        )
+        _swap(weight, new_w)
+
+
+@register
+class DCASGD(Optimizer):
+    """Delay-Compensated Async SGD (Zheng et al. 2017; parity:
+    [U:python/mxnet/optimizer/optimizer.py] DCASGD): keeps the previous
+    weight to compensate gradient staleness with λ·g²·(w − w_prev)."""
+
+    def __init__(self, momentum=0.0, lamda=0.04, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum, self.lamda = momentum, lamda
+
+    def create_state(self, index, weight):
+        return (
+            zeros(weight.shape, dtype="float32", ctx=weight.ctx),  # momentum
+            NDArray(weight._data.astype(jnp.float32)),              # prev weight
+        )
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        mom, prev = state
+        new_w, new_mom, new_prev = K.dcasgd_update(
+            weight._data,
+            grad._data,
+            mom._data,
+            prev._data,
+            _f32(lr),
+            _f32(wd),
+            _f32(self.rescale_grad),
+            _f32(self.clip_gradient),
+            _f32(self.momentum),
+            _f32(self.lamda),
+        )
+        _swap(weight, new_w)
+        _swap(mom, new_mom)
+        _swap(prev, new_prev)
+
+
+@register
+class Adamax(Optimizer):
+    """AdaMax — infinity-norm Adam (parity: [U:python/mxnet/optimizer/
+    optimizer.py] Adamax)."""
+
+    def __init__(self, learning_rate=0.002, beta1=0.9, beta2=0.999, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2 = beta1, beta2
+
+    def create_state(self, index, weight):
+        return (
+            zeros(weight.shape, dtype="float32", ctx=weight.ctx),
+            zeros(weight.shape, dtype="float32", ctx=weight.ctx),
+        )
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        t = self._index_update_count[index]
+        # bias-corrected lr (the reference folds 1/(1-beta1^t) into lr)
+        lr = lr / (1.0 - self.beta1 ** t)
+        mean, inf_norm = state
+        new_w, new_mean, new_inf = K.adamax_update(
+            weight._data,
+            grad._data,
+            mean._data,
+            inf_norm._data,
+            _f32(lr),
+            _f32(wd),
+            _f32(self.rescale_grad),
+            _f32(self.clip_gradient),
+            _f32(self.beta1),
+            _f32(self.beta2),
+        )
+        _swap(weight, new_w)
+        _swap(mean, new_mean)
+        _swap(inf_norm, new_inf)
+
+
+@register
+class LBSGD(Optimizer):
+    """Large-Batch SGD with LARS layer-wise rate scaling + warmup (parity:
+    [U:python/mxnet/optimizer/optimizer.py] LBSGD).  warmup_strategy in
+    {'linear', 'power2', 'sqrt', 'lars'}; 'lars' applies the layerwise
+    trust-ratio throughout.  ``batch_scale`` is the large-batch multiplier:
+    the effective rate ramps from ``lr`` to ``lr * batch_scale`` over the
+    warmup window and stays there (the reference's lr_linear target).
+    ``begin_epoch``/``num_epochs`` are accepted for signature parity (the
+    reference threads them into its internal scheduler bookkeeping only).
+    """
+
+    def __init__(self, momentum=0.0, multi_precision=False,
+                 warmup_strategy="linear", warmup_epochs=5, batch_scale=1,
+                 updates_per_epoch=32, begin_epoch=0, num_epochs=60, **kwargs):
+        super().__init__(multi_precision=multi_precision, **kwargs)
+        self.momentum = momentum
+        self.warmup_strategy = warmup_strategy
+        self.warmup_epochs = warmup_epochs
+        self.batch_scale = max(1.0, float(batch_scale))
+        self.updates_per_epoch = max(1, updates_per_epoch)
+        self.lars_eta = 0.001
+        self.lars_eps = 1e-9
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return zeros(weight.shape, dtype="float32", ctx=weight.ctx)
+
+    def _warmup_scale(self, t):
+        """Ramp 1 → batch_scale over the warmup window (×1 at t=0 would
+        stall LARS runs; the reference ramps from the base lr the same
+        way), shaped by the warmup strategy."""
+        total = self.warmup_epochs * self.updates_per_epoch
+        frac = jnp.minimum(_f32(t) / float(total), 1.0)
+        if self.warmup_strategy == "power2":
+            frac = frac * frac
+        elif self.warmup_strategy == "sqrt":
+            frac = jnp.sqrt(frac)
+        return 1.0 + (self.batch_scale - 1.0) * frac if self.batch_scale > 1.0 else frac
+
+    def _lars_ratio(self, weight, grad, wd):
+        w32 = weight._data.astype(jnp.float32)
+        g32 = grad._data.astype(jnp.float32) * _f32(self.rescale_grad)
+        w_norm = jnp.linalg.norm(w32)
+        g_norm = jnp.linalg.norm(g32)
+        ratio = self.lars_eta * w_norm / (g_norm + wd * w_norm + self.lars_eps)
+        return jnp.where((w_norm > 0) & (g_norm > 0), ratio, 1.0)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        t = self._index_update_count[index]
+        lr = _f32(lr) * self._warmup_scale(t)
+        if self.warmup_strategy == "lars":
+            lr = lr * self._lars_ratio(weight, grad, wd)
+        if state is None:
+            new_w = K.sgd_update(
+                weight._data, grad._data, lr, _f32(wd),
+                _f32(self.rescale_grad), _f32(self.clip_gradient))
+            _swap(weight, new_w)
+        else:
+            new_w, new_mom = K.sgd_mom_update(
+                weight._data, grad._data, state._data, lr, _f32(wd),
+                _f32(self.rescale_grad), _f32(self.clip_gradient),
+                _f32(self.momentum))
+            _swap(weight, new_w)
+            _swap(state, new_mom)
+
+
 class Updater:
     """KVStore-side updater closure (parity: ``mx.optimizer.get_updater`` /
     the serialized optimizer shipped to dist servers)."""
