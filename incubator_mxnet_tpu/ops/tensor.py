@@ -151,7 +151,9 @@ for _new, _old in [
 _UNARY = {
     "abs": jnp.abs,
     "sign": jnp.sign,
-    "round": jnp.round,
+    # MXNet rounds half AWAY from zero ([U:src/operator/tensor/
+    # elemwise_unary_op_basic.cc] round); jnp.round is banker's rounding
+    "round": lambda x: jnp.sign(x) * jnp.floor(jnp.abs(x) + 0.5),
     "rint": jnp.rint,
     "ceil": jnp.ceil,
     "floor": jnp.floor,

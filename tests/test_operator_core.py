@@ -1,0 +1,712 @@
+"""Core-operator depth tests in the reference's test_operator idiom.
+
+Parity target: [U:tests/python/unittest/test_operator.py] — numeric-gradient
+checks, dtype matrices and edge-case coverage for the PRE-EXISTING operator
+families (elemwise/broadcast/reduce/index/shape ops), complementing
+``test_operator.py``'s coverage of the round-4 families.  Every check runs
+against an independently computed numpy reference.
+"""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import autograd
+from incubator_mxnet_tpu.utils.test_utils import (
+    assert_almost_equal,
+    check_numeric_gradient,
+)
+
+from common import with_seed
+
+
+def _nd(x, dtype="float32"):
+    return mx.nd.array(np.asarray(x, dtype=dtype))
+
+
+# ===========================================================================
+# elementwise unary family — value + gradient against closed forms
+# ===========================================================================
+
+_UNARY_CASES = [
+    # (op name, numpy fn, analytic grad fn, domain lo, domain hi)
+    ("sigmoid", lambda x: 1 / (1 + np.exp(-x)),
+     lambda x: (1 / (1 + np.exp(-x))) * (1 - 1 / (1 + np.exp(-x))), -4, 4),
+    ("tanh", np.tanh, lambda x: 1 - np.tanh(x) ** 2, -3, 3),
+    ("relu", lambda x: np.maximum(x, 0), lambda x: (x > 0).astype(np.float64), -2, 2),
+    ("softsign", lambda x: x / (1 + np.abs(x)),
+     lambda x: 1 / (1 + np.abs(x)) ** 2, -3, 3),
+    ("exp", np.exp, np.exp, -2, 2),
+    ("log", np.log, lambda x: 1 / x, 0.1, 5),
+    ("log2", np.log2, lambda x: 1 / (x * np.log(2)), 0.1, 5),
+    ("log10", np.log10, lambda x: 1 / (x * np.log(10)), 0.1, 5),
+    ("log1p", np.log1p, lambda x: 1 / (1 + x), -0.5, 5),
+    ("expm1", np.expm1, np.exp, -2, 2),
+    ("sqrt", np.sqrt, lambda x: 0.5 / np.sqrt(x), 0.1, 5),
+    ("rsqrt", lambda x: 1 / np.sqrt(x), lambda x: -0.5 * x ** -1.5, 0.1, 5),
+    ("cbrt", np.cbrt, lambda x: (np.cbrt(x) ** -2) / 3, 0.1, 5),
+    ("rcbrt", lambda x: 1 / np.cbrt(x), lambda x: -1 / 3 * x ** (-4 / 3), 0.1, 5),
+    ("square", np.square, lambda x: 2 * x, -3, 3),
+    ("reciprocal", lambda x: 1 / x, lambda x: -1 / x ** 2, 0.2, 4),
+    ("sin", np.sin, np.cos, -3, 3),
+    ("cos", np.cos, lambda x: -np.sin(x), -3, 3),
+    ("tan", np.tan, lambda x: 1 / np.cos(x) ** 2, -1, 1),
+    ("arcsin", np.arcsin, lambda x: 1 / np.sqrt(1 - x ** 2), -0.9, 0.9),
+    ("arccos", np.arccos, lambda x: -1 / np.sqrt(1 - x ** 2), -0.9, 0.9),
+    ("arctan", np.arctan, lambda x: 1 / (1 + x ** 2), -3, 3),
+    ("sinh", np.sinh, np.cosh, -2, 2),
+    ("cosh", np.cosh, np.sinh, -2, 2),
+    ("arcsinh", np.arcsinh, lambda x: 1 / np.sqrt(x ** 2 + 1), -3, 3),
+    ("arccosh", np.arccosh, lambda x: 1 / np.sqrt(x ** 2 - 1), 1.2, 4),
+    ("arctanh", np.arctanh, lambda x: 1 / (1 - x ** 2), -0.9, 0.9),
+    ("erf", None, lambda x: 2 / np.sqrt(np.pi) * np.exp(-x ** 2), -2, 2),
+    ("abs", np.abs, np.sign, 0.2, 3),
+]
+
+
+class TestUnaryOps:
+    @with_seed()
+    @pytest.mark.parametrize("name,fn,grad_fn,lo,hi", _UNARY_CASES,
+                             ids=[c[0] for c in _UNARY_CASES])
+    def test_value_and_grad(self, name, fn, grad_fn, lo, hi):
+        x = np.random.uniform(lo, hi, size=(3, 4)).astype(np.float32)
+        op = getattr(mx.nd, name)
+        out = op(_nd(x)).asnumpy()
+        if fn is None:
+            import math
+
+            fn = np.vectorize(math.erf)
+        assert_almost_equal(out, fn(x.astype(np.float64)).astype(np.float32),
+                            rtol=1e-4, atol=1e-5)
+        xa = _nd(x)
+        xa.attach_grad()
+        with autograd.record():
+            y = op(xa)
+        y.backward()
+        assert_almost_equal(xa.grad.asnumpy(),
+                            grad_fn(x.astype(np.float64)).astype(np.float32),
+                            rtol=1e-3, atol=1e-4)
+
+    @with_seed()
+    def test_rounding_ops(self):
+        x = np.array([-2.5, -1.5, -0.5, 0.5, 1.5, 2.5, 1.2, -1.2], np.float32)
+        assert_almost_equal(mx.nd.floor(_nd(x)).asnumpy(), np.floor(x), rtol=0, atol=0)
+        assert_almost_equal(mx.nd.ceil(_nd(x)).asnumpy(), np.ceil(x), rtol=0, atol=0)
+        assert_almost_equal(mx.nd.trunc(_nd(x)).asnumpy(), np.trunc(x), rtol=0, atol=0)
+        assert_almost_equal(mx.nd.rint(_nd(x)).asnumpy(), np.rint(x), rtol=0, atol=0)
+        assert_almost_equal(mx.nd.fix(_nd(x)).asnumpy(), np.fix(x), rtol=0, atol=0)
+        # round: MXNet rounds half away from zero
+        r = mx.nd.round(_nd(x)).asnumpy()
+        expect = np.where(np.abs(x - np.trunc(x)) == 0.5,
+                          np.trunc(x) + np.sign(x), np.rint(x))
+        assert_almost_equal(r, expect, rtol=0, atol=0)
+
+    @with_seed()
+    def test_special_value_predicates(self):
+        x = np.array([1.0, np.inf, -np.inf, np.nan, 0.0], np.float32)
+        assert mx.nd.isnan(_nd(x)).asnumpy().tolist() == [0, 0, 0, 1, 0]
+        assert mx.nd.isinf(_nd(x)).asnumpy().tolist() == [0, 1, 1, 0, 0]
+        assert mx.nd.isfinite(_nd(x)).asnumpy().tolist() == [1, 0, 0, 0, 1]
+
+    @with_seed()
+    def test_gamma_functions(self):
+        import math
+
+        x = np.random.uniform(0.5, 4.0, size=(10,)).astype(np.float32)
+        g = mx.nd.gamma(_nd(x)).asnumpy()
+        expect = np.array([math.gamma(v) for v in x], np.float32)
+        assert_almost_equal(g, expect, rtol=1e-3, atol=1e-4)
+        gl = mx.nd.gammaln(_nd(x)).asnumpy()
+        expect = np.array([math.lgamma(v) for v in x], np.float32)
+        assert_almost_equal(gl, expect, rtol=1e-3, atol=1e-4)
+
+    @with_seed()
+    def test_erfinv_roundtrip(self):
+        x = np.random.uniform(-0.9, 0.9, size=(16,)).astype(np.float32)
+        y = mx.nd.erfinv(_nd(x))
+        back = mx.nd.erf(y).asnumpy()
+        assert_almost_equal(back, x, rtol=1e-3, atol=1e-4)
+
+    @with_seed()
+    def test_degrees_radians(self):
+        x = np.random.uniform(-np.pi, np.pi, size=(8,)).astype(np.float32)
+        assert_almost_equal(mx.nd.degrees(_nd(x)).asnumpy(), np.degrees(x),
+                            rtol=1e-5, atol=1e-5)
+        assert_almost_equal(mx.nd.radians(mx.nd.degrees(_nd(x))).asnumpy(), x,
+                            rtol=1e-5, atol=1e-5)
+
+
+# ===========================================================================
+# broadcast binary family
+# ===========================================================================
+
+_BROADCAST_SHAPES = [
+    ((3, 4), (3, 4)),
+    ((3, 4), (1, 4)),
+    ((3, 4), (3, 1)),
+    ((3, 1, 5), (1, 4, 5)),
+    ((1,), (3, 4)),
+    ((2, 3, 4), (4,)),
+]
+
+_BINARY_CASES = [
+    ("broadcast_add", np.add),
+    ("broadcast_sub", np.subtract),
+    ("broadcast_mul", np.multiply),
+    ("broadcast_div", lambda a, b: a / b),
+    ("broadcast_maximum", np.maximum),
+    ("broadcast_minimum", np.minimum),
+    ("broadcast_power", lambda a, b: np.power(np.abs(a) + 0.5, b)),
+    ("broadcast_hypot", np.hypot),
+]
+
+
+class TestBroadcastOps:
+    @with_seed()
+    @pytest.mark.parametrize("shapes", _BROADCAST_SHAPES,
+                             ids=[str(s) for s in _BROADCAST_SHAPES])
+    @pytest.mark.parametrize("name,ref", _BINARY_CASES, ids=[c[0] for c in _BINARY_CASES])
+    def test_values(self, name, ref, shapes):
+        sa, sb = shapes
+        a = np.random.uniform(0.5, 2.0, size=sa).astype(np.float32)
+        b = np.random.uniform(0.5, 2.0, size=sb).astype(np.float32)
+        if name == "broadcast_power":
+            a_in = np.abs(a) + 0.5
+            out = getattr(mx.nd, name)(_nd(a_in), _nd(b)).asnumpy()
+            expect = np.power(a_in, b)
+        else:
+            out = getattr(mx.nd, name)(_nd(a), _nd(b)).asnumpy()
+            expect = ref(a, b)
+        assert_almost_equal(out, expect.astype(np.float32), rtol=1e-4, atol=1e-5)
+
+    @with_seed()
+    def test_broadcast_grad(self):
+        a = np.random.rand(3, 1).astype(np.float32) + 0.5
+        b = np.random.rand(1, 4).astype(np.float32) + 0.5
+        check_numeric_gradient(lambda x, y: mx.nd.broadcast_mul(x, y), [a, b])
+        check_numeric_gradient(lambda x, y: mx.nd.broadcast_div(x, y), [a, b])
+        check_numeric_gradient(lambda x, y: mx.nd.broadcast_hypot(x, y), [a, b])
+
+    @with_seed()
+    def test_comparison_ops(self):
+        a = np.array([[1, 2, 3], [4, 5, 6]], np.float32)
+        b = np.array([[3, 2, 1]], np.float32)
+        assert_almost_equal(mx.nd.broadcast_equal(_nd(a), _nd(b)).asnumpy(),
+                            (a == b).astype(np.float32), rtol=0, atol=0)
+        assert_almost_equal(mx.nd.broadcast_greater(_nd(a), _nd(b)).asnumpy(),
+                            (a > b).astype(np.float32), rtol=0, atol=0)
+        assert_almost_equal(mx.nd.broadcast_lesser_equal(_nd(a), _nd(b)).asnumpy(),
+                            (a <= b).astype(np.float32), rtol=0, atol=0)
+        assert_almost_equal(mx.nd.broadcast_not_equal(_nd(a), _nd(b)).asnumpy(),
+                            (a != b).astype(np.float32), rtol=0, atol=0)
+
+    @with_seed()
+    def test_logical_ops(self):
+        a = np.array([0, 1, 0, 2], np.float32)
+        b = np.array([0, 0, 3, 4], np.float32)
+        assert mx.nd.logical_and(_nd(a), _nd(b)).asnumpy().tolist() == [0, 0, 0, 1]
+        assert mx.nd.logical_or(_nd(a), _nd(b)).asnumpy().tolist() == [0, 1, 1, 1]
+        assert mx.nd.logical_xor(_nd(a), _nd(b)).asnumpy().tolist() == [0, 1, 1, 0]
+        assert mx.nd.logical_not(_nd(a)).asnumpy().tolist() == [1, 0, 1, 0]
+
+    @with_seed()
+    def test_broadcast_mod(self):
+        a = np.array([[5.0, -5.0, 7.5]], np.float32)
+        b = np.array([[3.0], [3.0]], np.float32)
+        out = mx.nd.broadcast_mod(_nd(a), _nd(b)).asnumpy()
+        assert_almost_equal(out, np.mod(a, b), rtol=1e-5, atol=1e-5)
+
+    @with_seed()
+    def test_broadcast_like_and_to(self):
+        a = np.random.rand(1, 4).astype(np.float32)
+        ref = mx.nd.zeros((3, 4))
+        out = mx.nd.broadcast_like(_nd(a), ref)
+        assert out.shape == (3, 4)
+        out2 = mx.nd.broadcast_to(_nd(a), shape=(3, 4))
+        assert_almost_equal(out.asnumpy(), out2.asnumpy(), rtol=0, atol=0)
+
+    @with_seed()
+    def test_broadcast_axis(self):
+        a = np.random.rand(1, 3, 1).astype(np.float32)
+        out = mx.nd.broadcast_axis(_nd(a), axis=(0, 2), size=(2, 4))
+        assert out.shape == (2, 3, 4)
+        assert_almost_equal(out.asnumpy(), np.broadcast_to(a, (2, 3, 4)),
+                            rtol=0, atol=0)
+
+
+# ===========================================================================
+# reductions
+# ===========================================================================
+
+
+class TestReduceOps:
+    @with_seed()
+    @pytest.mark.parametrize("axis", [None, 0, 1, (0, 2), -1])
+    def test_sum_mean_prod(self, axis):
+        x = np.random.rand(2, 3, 4).astype(np.float32) + 0.5
+        kw = {} if axis is None else {"axis": axis}
+        assert_almost_equal(mx.nd.sum(_nd(x), **kw).asnumpy(),
+                            np.sum(x, axis=axis), rtol=1e-4, atol=1e-5)
+        assert_almost_equal(mx.nd.mean(_nd(x), **kw).asnumpy(),
+                            np.mean(x, axis=axis), rtol=1e-4, atol=1e-5)
+        assert_almost_equal(mx.nd.prod(_nd(x), **kw).asnumpy(),
+                            np.prod(x, axis=axis), rtol=1e-3, atol=1e-5)
+
+    @with_seed()
+    def test_keepdims(self):
+        x = np.random.rand(2, 3, 4).astype(np.float32)
+        out = mx.nd.sum(_nd(x), axis=1, keepdims=True)
+        assert out.shape == (2, 1, 4)
+        assert_almost_equal(out.asnumpy(), x.sum(1, keepdims=True), rtol=1e-4, atol=1e-5)
+
+    @with_seed()
+    def test_min_max(self):
+        x = np.random.randn(3, 5).astype(np.float32)
+        assert_almost_equal(mx.nd.max(_nd(x), axis=1).asnumpy(), x.max(1), rtol=0, atol=0)
+        assert_almost_equal(mx.nd.min(_nd(x), axis=0).asnumpy(), x.min(0), rtol=0, atol=0)
+        assert float(mx.nd.max(_nd(x)).asnumpy()) == pytest.approx(x.max())
+
+    @with_seed()
+    def test_nansum_nanprod(self):
+        x = np.array([[1.0, np.nan, 2.0], [np.nan, 3.0, 4.0]], np.float32)
+        assert_almost_equal(mx.nd.nansum(_nd(x), axis=1).asnumpy(),
+                            np.nansum(x, axis=1), rtol=1e-5, atol=1e-6)
+        assert_almost_equal(mx.nd.nanprod(_nd(x), axis=0).asnumpy(),
+                            np.nanprod(x, axis=0), rtol=1e-5, atol=1e-6)
+
+    @with_seed()
+    def test_norm(self):
+        x = np.random.randn(3, 4).astype(np.float32)
+        assert float(mx.nd.norm(_nd(x)).asnumpy()) == pytest.approx(
+            np.linalg.norm(x), rel=1e-4)
+        out = mx.nd.norm(_nd(x), ord=1, axis=1)
+        assert_almost_equal(out.asnumpy(), np.abs(x).sum(1), rtol=1e-4, atol=1e-5)
+
+    @with_seed()
+    def test_argmax_argmin_pick(self):
+        x = np.random.randn(4, 6).astype(np.float32)
+        assert (mx.nd.argmax(_nd(x), axis=1).asnumpy() == x.argmax(1)).all()
+        assert (mx.nd.argmin(_nd(x), axis=0).asnumpy() == x.argmin(0)).all()
+        idx = np.array([2, 0, 5, 1], np.float32)
+        picked = mx.nd.pick(_nd(x), _nd(idx), axis=1).asnumpy()
+        assert_almost_equal(picked, x[np.arange(4), idx.astype(int)], rtol=0, atol=0)
+
+    @with_seed()
+    def test_sum_grad(self):
+        x = np.random.rand(3, 4).astype(np.float32)
+        check_numeric_gradient(lambda a: mx.nd.sum(a, axis=1), [x])
+        check_numeric_gradient(lambda a: mx.nd.mean(a), [x])
+        check_numeric_gradient(lambda a: mx.nd.prod(a + 1.0, axis=0), [x])
+
+
+# ===========================================================================
+# indexing / gather / scatter
+# ===========================================================================
+
+
+class TestIndexingOps:
+    @with_seed()
+    def test_take_modes(self):
+        x = np.random.randn(5, 3).astype(np.float32)
+        idx = np.array([0, 4, 2], np.float32)
+        out = mx.nd.take(_nd(x), _nd(idx)).asnumpy()
+        assert_almost_equal(out, x[[0, 4, 2]], rtol=0, atol=0)
+        # clip mode for out-of-range
+        idx_oob = np.array([7, -1], np.float32)
+        out = mx.nd.take(_nd(x), _nd(idx_oob), mode="clip").asnumpy()
+        assert_almost_equal(out, x[[4, 0]], rtol=0, atol=0)
+
+    @with_seed()
+    def test_take_axis1_and_grad(self):
+        x = np.random.randn(4, 6).astype(np.float32)
+        idx = np.array([1, 3], np.float32)
+        out = mx.nd.take(_nd(x), _nd(idx), axis=1).asnumpy()
+        assert_almost_equal(out, x[:, [1, 3]], rtol=0, atol=0)
+        xa = _nd(x)
+        xa.attach_grad()
+        with autograd.record():
+            y = mx.nd.take(xa, _nd(idx), axis=1)
+        y.backward()
+        g = xa.grad.asnumpy()
+        expect = np.zeros_like(x)
+        expect[:, [1, 3]] = 1
+        assert_almost_equal(g, expect, rtol=0, atol=0)
+
+    @with_seed()
+    def test_batch_take(self):
+        x = np.random.randn(4, 5).astype(np.float32)
+        idx = np.array([0, 4, 2, 1], np.float32)
+        out = mx.nd.batch_take(_nd(x), _nd(idx)).asnumpy()
+        assert_almost_equal(out, x[np.arange(4), idx.astype(int)], rtol=0, atol=0)
+
+    @with_seed()
+    def test_gather_nd_scatter_nd(self):
+        x = np.random.randn(3, 4, 5).astype(np.float32)
+        # pick elements (0,1,:) and (2,3,:)
+        indices = np.array([[0, 2], [1, 3]], np.float32)  # [ndim_idx, N]
+        out = mx.nd.gather_nd(_nd(x), _nd(indices)).asnumpy()
+        assert_almost_equal(out, x[[0, 2], [1, 3]], rtol=0, atol=0)
+        data = np.random.randn(2, 5).astype(np.float32)
+        scat = mx.nd.scatter_nd(_nd(data), _nd(indices), shape=(3, 4, 5)).asnumpy()
+        expect = np.zeros((3, 4, 5), np.float32)
+        expect[[0, 2], [1, 3]] = data
+        assert_almost_equal(scat, expect, rtol=0, atol=0)
+
+    @with_seed()
+    def test_one_hot(self):
+        idx = np.array([1, 0, 3], np.float32)
+        out = mx.nd.one_hot(_nd(idx), depth=4).asnumpy()
+        assert_almost_equal(out, np.eye(4, dtype=np.float32)[[1, 0, 3]], rtol=0, atol=0)
+        out = mx.nd.one_hot(_nd(idx), depth=4, on_value=2.0, off_value=-1.0).asnumpy()
+        expect = np.full((3, 4), -1.0, np.float32)
+        expect[np.arange(3), [1, 0, 3]] = 2.0
+        assert_almost_equal(out, expect, rtol=0, atol=0)
+
+    @with_seed()
+    def test_topk_and_sort(self):
+        x = np.random.randn(3, 8).astype(np.float32)
+        # ret_typ='indices' (default returns indices in MXNet)
+        out = mx.nd.topk(_nd(x), k=3, axis=1, ret_typ="value").asnumpy()
+        expect = -np.sort(-x, axis=1)[:, :3]
+        assert_almost_equal(out, expect, rtol=0, atol=0)
+        srt = mx.nd.sort(_nd(x), axis=1).asnumpy()
+        assert_almost_equal(srt, np.sort(x, axis=1), rtol=0, atol=0)
+        srt_d = mx.nd.sort(_nd(x), axis=1, is_ascend=False).asnumpy()
+        assert_almost_equal(srt_d, -np.sort(-x, axis=1), rtol=0, atol=0)
+        args = mx.nd.argsort(_nd(x), axis=1).asnumpy()
+        assert (args == np.argsort(x, kind="stable", axis=1)).all()
+
+    @with_seed()
+    def test_where(self):
+        cond = np.array([[1, 0], [0, 1]], np.float32)
+        a = np.ones((2, 2), np.float32)
+        b = np.zeros((2, 2), np.float32)
+        out = mx.nd.where(_nd(cond), _nd(a), _nd(b)).asnumpy()
+        assert_almost_equal(out, cond, rtol=0, atol=0)
+
+    @with_seed()
+    def test_slice_ops(self):
+        x = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+        out = mx.nd.slice(_nd(x), begin=(0, 1, 1), end=(2, 3, 3)).asnumpy()
+        assert_almost_equal(out, x[0:2, 1:3, 1:3], rtol=0, atol=0)
+        out = mx.nd.slice_axis(_nd(x), axis=2, begin=1, end=3).asnumpy()
+        assert_almost_equal(out, x[:, :, 1:3], rtol=0, atol=0)
+        like = mx.nd.zeros((2, 2, 2))
+        out = mx.nd.slice_like(_nd(x), like).asnumpy()
+        assert_almost_equal(out, x[:2, :2, :2], rtol=0, atol=0)
+
+    @with_seed()
+    def test_reverse_flip(self):
+        x = np.arange(12, dtype=np.float32).reshape(3, 4)
+        out = mx.nd.reverse(_nd(x), axis=0).asnumpy()
+        assert_almost_equal(out, x[::-1], rtol=0, atol=0)
+        out = mx.nd.flip(_nd(x), axis=1).asnumpy()
+        assert_almost_equal(out, x[:, ::-1], rtol=0, atol=0)
+
+
+# ===========================================================================
+# shape manipulation
+# ===========================================================================
+
+
+class TestShapeOps:
+    @with_seed()
+    def test_reshape_special_codes(self):
+        x = np.random.rand(2, 3, 4).astype(np.float32)
+        # 0 = copy dim, -1 = infer
+        out = mx.nd.reshape(_nd(x), shape=(0, -1))
+        assert out.shape == (2, 12)
+        out = mx.nd.reshape(_nd(x), shape=(-1, 4))
+        assert out.shape == (6, 4)
+        # -2 = copy remaining, -3 = merge two dims
+        out = mx.nd.reshape(_nd(x), shape=(-3, -2))
+        assert out.shape == (6, 4)
+
+    @with_seed()
+    def test_transpose_swapaxes(self):
+        x = np.random.rand(2, 3, 4).astype(np.float32)
+        out = mx.nd.transpose(_nd(x), axes=(2, 0, 1)).asnumpy()
+        assert_almost_equal(out, x.transpose(2, 0, 1), rtol=0, atol=0)
+        out = mx.nd.swapaxes(_nd(x), 0, 2).asnumpy()
+        assert_almost_equal(out, x.swapaxes(0, 2), rtol=0, atol=0)
+        out = mx.nd.SwapAxis(_nd(x), dim1=1, dim2=2).asnumpy()
+        assert_almost_equal(out, x.swapaxes(1, 2), rtol=0, atol=0)
+
+    @with_seed()
+    def test_expand_squeeze(self):
+        x = np.random.rand(3, 4).astype(np.float32)
+        out = mx.nd.expand_dims(_nd(x), axis=1)
+        assert out.shape == (3, 1, 4)
+        back = mx.nd.squeeze(out)
+        assert back.shape == (3, 4)
+
+    @with_seed()
+    def test_stack_concat_split(self):
+        a = np.random.rand(2, 3).astype(np.float32)
+        b = np.random.rand(2, 3).astype(np.float32)
+        out = mx.nd.stack(_nd(a), _nd(b), axis=1).asnumpy()
+        assert_almost_equal(out, np.stack([a, b], axis=1), rtol=0, atol=0)
+        out = mx.nd.concat(_nd(a), _nd(b), dim=0).asnumpy()
+        assert_almost_equal(out, np.concatenate([a, b], axis=0), rtol=0, atol=0)
+        parts = mx.nd.split(_nd(np.concatenate([a, b], 1)), num_outputs=2, axis=1)
+        assert_almost_equal(parts[0].asnumpy(), a, rtol=0, atol=0)
+        assert_almost_equal(parts[1].asnumpy(), b, rtol=0, atol=0)
+
+    @with_seed()
+    def test_repeat_tile_pad(self):
+        x = np.array([[1, 2], [3, 4]], np.float32)
+        out = mx.nd.repeat(_nd(x), repeats=2, axis=1).asnumpy()
+        assert_almost_equal(out, np.repeat(x, 2, axis=1), rtol=0, atol=0)
+        out = mx.nd.tile(_nd(x), reps=(2, 3)).asnumpy()
+        assert_almost_equal(out, np.tile(x, (2, 3)), rtol=0, atol=0)
+        x4 = np.random.rand(1, 1, 2, 2).astype(np.float32)
+        out = mx.nd.pad(_nd(x4), mode="constant", pad_width=(0, 0, 0, 0, 1, 1, 1, 1),
+                        constant_value=9.0).asnumpy()
+        assert out.shape == (1, 1, 4, 4)
+        assert out[0, 0, 0, 0] == 9.0
+        assert_almost_equal(out[0, 0, 1:3, 1:3], x4[0, 0], rtol=0, atol=0)
+        out = mx.nd.pad(_nd(x4), mode="edge", pad_width=(0, 0, 0, 0, 1, 1, 1, 1)).asnumpy()
+        assert out[0, 0, 0, 0] == x4[0, 0, 0, 0]
+
+    @with_seed()
+    def test_diag(self):
+        x = np.random.rand(4, 4).astype(np.float32)
+        assert_almost_equal(mx.nd.diag(_nd(x)).asnumpy(), np.diag(x), rtol=0, atol=0)
+        v = np.array([1.0, 2.0, 3.0], np.float32)
+        assert_almost_equal(mx.nd.diag(_nd(v)).asnumpy(), np.diag(v), rtol=0, atol=0)
+        assert_almost_equal(mx.nd.diag(_nd(x), k=1).asnumpy(), np.diag(x, k=1),
+                            rtol=0, atol=0)
+
+    @with_seed()
+    def test_shape_size_arrays(self):
+        x = mx.nd.zeros((3, 4, 5))
+        assert mx.nd.shape_array(x).asnumpy().tolist() == [3, 4, 5]
+        assert int(mx.nd.size_array(x).asnumpy()) == 60
+
+    @with_seed()
+    def test_clip_grad(self):
+        x = np.random.uniform(-2, 2, (4, 4)).astype(np.float32)
+        out = mx.nd.clip(_nd(x), -1.0, 1.0).asnumpy()
+        assert_almost_equal(out, np.clip(x, -1, 1), rtol=0, atol=0)
+        xa = _nd(x)
+        xa.attach_grad()
+        with autograd.record():
+            y = mx.nd.clip(xa, -1.0, 1.0)
+        y.backward()
+        expect = ((x >= -1) & (x <= 1)).astype(np.float32)
+        assert_almost_equal(xa.grad.asnumpy(), expect, rtol=0, atol=0)
+
+
+# ===========================================================================
+# dot / matmul family
+# ===========================================================================
+
+
+class TestDotOps:
+    @with_seed()
+    def test_dot_transpose_flags(self):
+        a = np.random.randn(3, 4).astype(np.float32)
+        b = np.random.randn(4, 5).astype(np.float32)
+        assert_almost_equal(mx.nd.dot(_nd(a), _nd(b)).asnumpy(), a @ b,
+                            rtol=1e-4, atol=1e-5)
+        assert_almost_equal(
+            mx.nd.dot(_nd(a.T), _nd(b), transpose_a=True).asnumpy(), a @ b,
+            rtol=1e-4, atol=1e-5)
+        assert_almost_equal(
+            mx.nd.dot(_nd(a), _nd(b.T), transpose_b=True).asnumpy(), a @ b,
+            rtol=1e-4, atol=1e-5)
+
+    @with_seed()
+    def test_batch_dot(self):
+        a = np.random.randn(2, 3, 4).astype(np.float32)
+        b = np.random.randn(2, 4, 5).astype(np.float32)
+        assert_almost_equal(mx.nd.batch_dot(_nd(a), _nd(b)).asnumpy(), a @ b,
+                            rtol=1e-4, atol=1e-5)
+
+    @with_seed()
+    def test_dot_grad(self):
+        a = np.random.randn(3, 2).astype(np.float32)
+        b = np.random.randn(2, 4).astype(np.float32)
+        check_numeric_gradient(lambda x, y: mx.nd.dot(x, y), [a, b])
+
+
+# ===========================================================================
+# softmax family
+# ===========================================================================
+
+
+class TestSoftmaxOps:
+    @with_seed()
+    @pytest.mark.parametrize("axis", [-1, 0, 1])
+    def test_softmax_axis(self, axis):
+        x = np.random.randn(4, 5).astype(np.float32)
+        out = mx.nd.softmax(_nd(x), axis=axis).asnumpy()
+        e = np.exp(x - x.max(axis=axis, keepdims=True))
+        expect = e / e.sum(axis=axis, keepdims=True)
+        assert_almost_equal(out, expect, rtol=1e-4, atol=1e-5)
+
+    @with_seed()
+    def test_softmax_temperature(self):
+        x = np.random.randn(3, 6).astype(np.float32)
+        out = mx.nd.softmax(_nd(x), temperature=2.0).asnumpy()
+        e = np.exp(x / 2.0 - (x / 2.0).max(-1, keepdims=True))
+        assert_almost_equal(out, e / e.sum(-1, keepdims=True), rtol=1e-4, atol=1e-5)
+
+    @with_seed()
+    def test_log_softmax_softmin(self):
+        x = np.random.randn(3, 6).astype(np.float32)
+        out = mx.nd.log_softmax(_nd(x)).asnumpy()
+        e = np.exp(x - x.max(-1, keepdims=True))
+        expect = np.log(e / e.sum(-1, keepdims=True))
+        assert_almost_equal(out, expect, rtol=1e-4, atol=1e-4)
+        out = mx.nd.softmin(_nd(x)).asnumpy()
+        e = np.exp(-x - (-x).max(-1, keepdims=True))
+        assert_almost_equal(out, e / e.sum(-1, keepdims=True), rtol=1e-4, atol=1e-5)
+
+    @with_seed()
+    def test_softmax_grad(self):
+        x = np.random.randn(3, 4).astype(np.float32)
+        check_numeric_gradient(lambda a: mx.nd.softmax(a) ** 2, [x])
+
+    @with_seed()
+    def test_softmax_cross_entropy(self):
+        x = np.random.randn(4, 5).astype(np.float32)
+        label = np.array([0, 2, 4, 1], np.float32)
+        out = mx.nd.softmax_cross_entropy(_nd(x), _nd(label)).asnumpy()
+        e = np.exp(x - x.max(-1, keepdims=True))
+        logp = np.log(e / e.sum(-1, keepdims=True))
+        expect = -logp[np.arange(4), label.astype(int)].sum()
+        assert_almost_equal(out, np.array([expect], np.float32).squeeze(),
+                            rtol=1e-4, atol=1e-4)
+
+
+# ===========================================================================
+# activation blocks
+# ===========================================================================
+
+
+class TestActivationOps:
+    @with_seed()
+    def test_leaky_relu_variants(self):
+        x = np.random.randn(4, 5).astype(np.float32)
+        out = mx.nd.LeakyReLU(_nd(x), act_type="leaky", slope=0.1).asnumpy()
+        assert_almost_equal(out, np.where(x > 0, x, 0.1 * x), rtol=1e-5, atol=1e-6)
+        out = mx.nd.LeakyReLU(_nd(x), act_type="elu", slope=1.0).asnumpy()
+        assert_almost_equal(out, np.where(x > 0, x, np.exp(x) - 1), rtol=1e-4, atol=1e-5)
+        # gelu (erf formulation)
+        import math
+
+        out = mx.nd.LeakyReLU(_nd(x), act_type="gelu").asnumpy()
+        erf = np.vectorize(math.erf)
+        expect = 0.5 * x * (1 + erf(x / np.sqrt(2)))
+        assert_almost_equal(out, expect.astype(np.float32), rtol=1e-3, atol=1e-4)
+
+    @with_seed()
+    def test_activation_op(self):
+        x = np.random.randn(3, 4).astype(np.float32)
+        for act, ref in [("relu", lambda v: np.maximum(v, 0)),
+                         ("sigmoid", lambda v: 1 / (1 + np.exp(-v))),
+                         ("tanh", np.tanh),
+                         ("softsign", lambda v: v / (1 + np.abs(v)))]:
+            out = mx.nd.Activation(_nd(x), act_type=act).asnumpy()
+            assert_almost_equal(out, ref(x).astype(np.float32), rtol=1e-4, atol=1e-5)
+
+    @with_seed()
+    def test_hard_sigmoid_smooth_l1(self):
+        x = np.random.uniform(-4, 4, (10,)).astype(np.float32)
+        out = mx.nd.hard_sigmoid(_nd(x)).asnumpy()
+        assert_almost_equal(out, np.clip(0.2 * x + 0.5, 0, 1), rtol=1e-5, atol=1e-6)
+        s = 1.0
+        out = mx.nd.smooth_l1(_nd(x), scalar=s).asnumpy()
+        expect = np.where(np.abs(x) < 1, 0.5 * x ** 2, np.abs(x) - 0.5)
+        assert_almost_equal(out, expect, rtol=1e-5, atol=1e-6)
+
+
+# ===========================================================================
+# sequence ops depth
+# ===========================================================================
+
+
+class TestSequenceOps:
+    @with_seed()
+    def test_sequence_mask(self):
+        x = np.random.randn(4, 3, 2).astype(np.float32)  # [T, B, ...]
+        length = np.array([2, 4, 1], np.float32)
+        out = mx.nd.SequenceMask(_nd(x), _nd(length), use_sequence_length=True,
+                                 value=-7.0).asnumpy()
+        for b, l in enumerate(length.astype(int)):
+            assert_almost_equal(out[:l, b], x[:l, b], rtol=0, atol=0)
+            assert (out[l:, b] == -7.0).all()
+
+    @with_seed()
+    def test_sequence_last(self):
+        x = np.random.randn(5, 3, 2).astype(np.float32)
+        length = np.array([1, 5, 3], np.float32)
+        out = mx.nd.SequenceLast(_nd(x), _nd(length), use_sequence_length=True).asnumpy()
+        for b, l in enumerate(length.astype(int)):
+            assert_almost_equal(out[b], x[l - 1, b], rtol=0, atol=0)
+        # without lengths: plain last step
+        out = mx.nd.SequenceLast(_nd(x)).asnumpy()
+        assert_almost_equal(out, x[-1], rtol=0, atol=0)
+
+    @with_seed()
+    def test_sequence_reverse(self):
+        x = np.random.randn(4, 2, 3).astype(np.float32)
+        length = np.array([2, 4], np.float32)
+        out = mx.nd.SequenceReverse(_nd(x), _nd(length), use_sequence_length=True).asnumpy()
+        assert_almost_equal(out[:2, 0], x[:2, 0][::-1], rtol=0, atol=0)
+        assert_almost_equal(out[2:, 0], x[2:, 0], rtol=0, atol=0)  # tail untouched
+        assert_almost_equal(out[:, 1], x[:, 1][::-1], rtol=0, atol=0)
+
+    @with_seed()
+    def test_sequence_mask_grad(self):
+        x = np.random.randn(3, 2, 2).astype(np.float32)
+        length = np.array([1, 3], np.float32)
+        xa = _nd(x)
+        xa.attach_grad()
+        with autograd.record():
+            y = mx.nd.SequenceMask(xa, _nd(length), use_sequence_length=True)
+        y.backward()
+        g = xa.grad.asnumpy()
+        assert (g[:1, 0] == 1).all() and (g[1:, 0] == 0).all()
+        assert (g[:, 1] == 1).all()
+
+
+# ===========================================================================
+# dtype matrix across core families
+# ===========================================================================
+
+
+class TestCoreDtypes:
+    @with_seed()
+    @pytest.mark.parametrize("dtype", ["float16", "bfloat16", "float32"])
+    def test_arithmetic_dtype_preserved(self, dtype):
+        a = mx.nd.array(np.random.rand(3, 4), dtype=dtype)
+        b = mx.nd.array(np.random.rand(3, 4), dtype=dtype)
+        for op in (mx.nd.elemwise_add, mx.nd.elemwise_mul, mx.nd.broadcast_add):
+            assert op(a, b).dtype == a.dtype
+        assert mx.nd.sum(a, axis=1).dtype == a.dtype
+        assert mx.nd.relu(a).dtype == a.dtype
+
+    @with_seed()
+    @pytest.mark.parametrize("dtype", ["int32", "int8", "uint8"])
+    def test_integer_arithmetic(self, dtype):
+        a = mx.nd.array(np.array([[1, 2], [3, 4]]), dtype=dtype)
+        b = mx.nd.array(np.array([[5, 6], [7, 8]]), dtype=dtype)
+        out = mx.nd.elemwise_add(a, b)
+        assert str(out.dtype) == dtype
+        assert out.asnumpy().tolist() == [[6, 8], [10, 12]]
+
+    @with_seed()
+    def test_cast_matrix(self):
+        x = np.array([1.7, -2.3, 0.0], np.float32)
+        for dt in ["float16", "bfloat16", "int32", "float32"]:
+            out = mx.nd.Cast(_nd(x), dtype=dt)
+            assert str(out.dtype) == dt
+        assert mx.nd.Cast(_nd(x), dtype="int32").asnumpy().tolist() == [1, -2, 0]
+
+    @with_seed()
+    def test_embedding_dtype(self):
+        w = mx.nd.array(np.random.rand(10, 4), dtype="bfloat16")
+        idx = mx.nd.array(np.array([1, 5]), dtype="int32")
+        out = mx.nd.Embedding(idx, w, input_dim=10, output_dim=4)
+        assert out.dtype == w.dtype
