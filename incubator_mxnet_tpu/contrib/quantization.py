@@ -2,24 +2,104 @@
 
 Parity: [U:python/mxnet/contrib/quantization.py] — ``quantize_net`` (the
 Gluon entry the reference added in 1.6; its symbol-level ``quantize_model``
-rewrites the graph the same way) with **naive minmax calibration**:
+rewrites the graph the same way):
 
 1. hook every Dense/Conv2D layer and run calibration batches, recording
-   per-layer input min/max;
+   per-layer input ranges — min/max (``calib_mode='naive'``) or the
+   KL-optimal clipping threshold over activation histograms
+   (``calib_mode='entropy'``, the reference's `_get_optimal_threshold`
+   TensorRT-style sweep, reimplemented in :func:`optimal_threshold`);
 2. quantize each hooked layer's weight to int8 once (symmetric, per-tensor);
 3. replace the layer's forward with
    quantize_v2(calibrated ranges) → int8 MXU matmul/conv → float out.
 
 Layers named in ``excluded_layers`` (or without calibration data reaching
-them) stay fp32.  Entropy/KL calibration is accepted as an argument for
-API parity but maps to minmax (documented divergence — KL needs activation
-histograms; the hook records them in ``collect_mode='full'`` for users who
-want to post-process)."""
+them) stay fp32."""
 from __future__ import annotations
 
 import numpy as _np
 
-__all__ = ["quantize_net"]
+__all__ = ["quantize_net", "optimal_threshold"]
+
+
+def optimal_threshold(arr, num_bins=8001, num_quantized_bins=255):
+    """KL-optimal symmetric clipping threshold for ``arr`` (the reference's
+    `_get_optimal_threshold`): sweep candidate thresholds over a symmetric
+    histogram, for each build the clipped reference distribution P (outliers
+    folded into the edge bins) and its 255-bin quantized reconstruction Q,
+    and pick the threshold minimizing KL(P‖Q)."""
+    arr = _np.asarray(arr).ravel()
+    if arr.size == 0:
+        return 0.0
+    th = float(_np.abs(arr).max())
+    if th == 0.0:
+        return 0.0
+    hist, hist_edges = _np.histogram(arr, bins=num_bins, range=(-th, th))
+    return optimal_threshold_from_hist(hist, hist_edges, num_quantized_bins)
+
+
+def optimal_threshold_from_hist(hist, hist_edges, num_quantized_bins=255):
+    """The KL sweep itself, over a pre-accumulated symmetric histogram —
+    what the streaming calibration collector feeds (the reference's
+    LayerHistogramCollector accumulates the same way: O(num_bins) memory
+    per layer, not O(activations))."""
+    num_bins = hist.size
+    zero_bin = num_bins // 2
+    half_q = num_quantized_bins // 2
+
+    n_sweeps = zero_bin - half_q + 1
+    thresholds = _np.zeros(n_sweeps)
+    divergence = _np.full(n_sweeps, _np.inf)
+    for j, i in enumerate(range(half_q, zero_bin + 1)):
+        start = zero_bin - i
+        stop = zero_bin + i + 1
+        thresholds[j] = hist_edges[stop]
+        sliced = hist[start:stop].astype(_np.float64)
+        p = sliced.copy()
+        p[0] += hist[:start].sum()
+        p[-1] += hist[stop:].sum()
+        is_nonzero = (p != 0)
+        # downsample the 2i+1 bins into num_quantized_bins chunks
+        n = sliced.size
+        merged = n // num_quantized_bins
+        if merged == 0:
+            continue
+        trunc = merged * num_quantized_bins
+        q_bins = sliced[:trunc].reshape(num_quantized_bins, merged).sum(axis=1)
+        q_bins[-1] += sliced[trunc:].sum()
+        # expand back uniformly over the NONZERO positions of each chunk
+        q = _np.zeros(n, dtype=_np.float64)
+        for b in range(num_quantized_bins):
+            s = b * merged
+            e = n if b == num_quantized_bins - 1 else s + merged
+            nz = is_nonzero[s:e]
+            cnt = nz.sum()
+            if cnt:
+                q[s:e][nz] = q_bins[b] / cnt
+        psum = p.sum()
+        if psum == 0:
+            continue
+        p /= psum
+        qsum = q.sum()
+        if qsum == 0:
+            continue
+        q /= qsum
+        # smooth (the reference's eps-shift) so KL is finite
+        eps = 1e-4
+        nz_p = p != 0
+        n0 = (~nz_p).sum()
+        if n0:
+            p = p + eps * (~nz_p) - eps * n0 / max(nz_p.sum(), 1) * nz_p
+        nz_q = q != 0
+        n0q = (~nz_q).sum()
+        if n0q:
+            q = q + eps * (~nz_q) - eps * n0q / max(nz_q.sum(), 1) * nz_q
+        with _np.errstate(divide="ignore", invalid="ignore"):
+            kl = _np.where(p > 0, p * _np.log(_np.maximum(p, 1e-30) /
+                                              _np.maximum(q, 1e-30)), 0.0).sum()
+        divergence[j] = kl
+    best = int(_np.argmin(divergence))
+    return float(thresholds[best])
 
 
 def _quantizable(block):
@@ -54,35 +134,79 @@ def quantize_net(network, calib_data, quantized_dtype="int8",
                if _quantizable(blk) and name not in set(excluded_layers)
                and blk.name not in set(excluded_layers)}
 
-    # -- 1. calibration: record per-layer input ranges through a hook ----
-    ranges = {name: [_np.inf, -_np.inf] for name in targets}
-    handles = []
+    if calib_mode not in ("naive", "entropy"):
+        raise ValueError(f"calib_mode must be 'naive' or 'entropy', got {calib_mode!r}")
 
-    def make_hook(name):
+    def run_calibration(hook_factory, batches):
+        hooks = []
+        for name, blk in targets.items():
+            h = hook_factory(name)
+            blk._forward_pre_hooks.append(h)
+            hooks.append((blk, h))
+        try:
+            for batch in batches:
+                ins = batch if isinstance(batch, (list, tuple)) else (batch,)
+                network(*ins)
+        finally:
+            for blk, h in hooks:
+                blk._forward_pre_hooks.remove(h)
+
+    def _bounded(it):
+        for i, batch in enumerate(it):
+            if num_calib_batches is not None and i >= num_calib_batches:
+                break
+            yield batch
+
+    # entropy needs two passes (range, then histograms at that range), so
+    # materialize the bounded batch list; naive streams in one pass.
+    batches = list(_bounded(calib_data)) if calib_mode == "entropy" else None
+
+    # -- 1a. pass 1 (both modes): per-layer input min/max -----------------
+    ranges = {name: [_np.inf, -_np.inf] for name in targets}
+
+    def range_hook(name):
         def hook(block, inputs):
             x = inputs[0]
             arr = x.asnumpy() if isinstance(x, NDArray) else _np.asarray(x)
-            lo, hi = float(arr.min()), float(arr.max())
             r = ranges[name]
-            r[0] = min(r[0], lo, 0.0)
-            r[1] = max(r[1], hi, 0.0)
+            r[0] = min(r[0], float(arr.min()), 0.0)
+            r[1] = max(r[1], float(arr.max()), 0.0)
 
         return hook
 
-    hooks = []
-    for name, blk in targets.items():
-        h = make_hook(name)
-        blk._forward_pre_hooks.append(h)
-        hooks.append((blk, h))
-    try:
-        for i, batch in enumerate(calib_data):
-            if num_calib_batches is not None and i >= num_calib_batches:
-                break
-            ins = batch if isinstance(batch, (list, tuple)) else (batch,)
-            network(*ins)
-    finally:
-        for blk, h in hooks:
-            blk._forward_pre_hooks.remove(h)
+    run_calibration(range_hook, batches if batches is not None
+                    else _bounded(calib_data))
+
+    # -- 1b. pass 2 (entropy): accumulate fixed-range histograms and run
+    # the KL sweep — O(num_bins) memory per layer, the reference's
+    # LayerHistogramCollector discipline.
+    if calib_mode == "entropy":
+        num_bins = 8001
+        hists = {}
+
+        def hist_hook(name):
+            lo, hi = ranges[name]
+            amax = max(abs(lo), abs(hi))
+
+            def hook(block, inputs):
+                if amax == 0 or not _np.isfinite(amax):
+                    return
+                x = inputs[0]
+                arr = x.asnumpy() if isinstance(x, NDArray) else _np.asarray(x)
+                h, edges = _np.histogram(arr.ravel(), bins=num_bins,
+                                         range=(-amax, amax))
+                if name in hists:
+                    hists[name][0] += h
+                else:
+                    hists[name] = [h.astype(_np.int64), edges]
+
+            return hook
+
+        run_calibration(hist_hook, batches)
+        for name, (h, edges) in hists.items():
+            th = optimal_threshold_from_hist(h, edges)
+            if th > 0:
+                ranges[name] = [-th, th]  # symmetric KL-clipped range
 
     # -- 2+3. quantize weights once, swap forwards ----------------------
     q_v2 = get_op("quantize_v2").fn

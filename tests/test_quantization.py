@@ -99,3 +99,68 @@ class TestQuantizeNet:
         out = net(calib[0]).asnumpy()
         scale = np.abs(ref).max()
         np.testing.assert_allclose(out, ref, atol=scale * 0.06)
+
+
+class TestEntropyCalibration:
+    """calib_mode='entropy': the KL threshold sweep of
+    [U:python/mxnet/contrib/quantization.py] _get_optimal_threshold."""
+
+    def test_optimal_threshold_clips_outliers(self):
+        from incubator_mxnet_tpu.contrib.quantization import optimal_threshold
+
+        rng = np.random.RandomState(0)
+        x = rng.randn(200000).astype(np.float32)  # bulk in ~[-4, 4]
+        x[:20] = 500.0                            # rare huge outliers
+        th = optimal_threshold(x)
+        assert 2.0 < th < 100.0, th  # clipped far below the 500 max
+        # clean gaussian: threshold stays near the true range
+        th_clean = optimal_threshold(rng.randn(200000).astype(np.float32))
+        assert th_clean > 2.5, th_clean
+
+    def test_entropy_differs_from_naive_and_wins_on_skewed(self):
+        mx.random.seed(3)
+        rng = np.random.RandomState(3)
+        net_a = gluon.nn.HybridSequential()
+        net_a.add(gluon.nn.Dense(32, activation="relu"), gluon.nn.Dense(10))
+        net_a.initialize()
+        # skewed calibration data: mostly small values + rare big outliers
+        def make_batch():
+            d = rng.randn(64, 16).astype(np.float32)
+            d[rng.rand(64) < 0.02] *= 60.0
+            return mx.nd.array(d)
+        calib = [make_batch() for _ in range(4)]
+        # clean eval batch (the bulk distribution)
+        test_x = mx.nd.array(rng.randn(64, 16).astype(np.float32))
+        ref = net_a(test_x).asnumpy()
+
+        import copy
+        # clone the net for the naive run by rebuilding with same params
+        net_b = gluon.nn.HybridSequential()
+        net_b.add(gluon.nn.Dense(32, activation="relu"), gluon.nn.Dense(10))
+        net_b.initialize()
+        for pa, pb in zip(sorted(net_a.collect_params().values(), key=lambda p: p.name),
+                          sorted(net_b.collect_params().values(), key=lambda p: p.name)):
+            pb.set_data(pa.data())
+
+        quantize_net(net_a, calib, calib_mode="entropy")
+        quantize_net(net_b, calib, calib_mode="naive")
+        out_e = net_a(test_x).asnumpy()
+        out_n = net_b(test_x).asnumpy()
+        err_e = np.abs(out_e - ref).mean()
+        err_n = np.abs(out_n - ref).mean()
+        # KL calibration must beat minmax on the outlier-skewed stream
+        assert err_e < err_n, (err_e, err_n)
+        # and land within a few percent of fp32 on the bulk data
+        assert err_e <= 0.05 * np.abs(ref).max(), (err_e, np.abs(ref).max())
+
+    def test_entropy_mode_rejects_bad_mode(self):
+        net = gluon.nn.HybridSequential()
+        net.add(gluon.nn.Dense(4))
+        net.initialize()
+        calib = [mx.nd.array(RNG.rand(2, 3).astype(np.float32))]
+        try:
+            quantize_net(net, calib, calib_mode="percentile")
+        except ValueError:
+            pass
+        else:
+            raise AssertionError("expected ValueError for unknown calib_mode")
