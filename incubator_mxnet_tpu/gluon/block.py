@@ -281,6 +281,65 @@ class Block:
         for child in self._children.values():
             child.hybridize(active, **kwargs)
 
+    def export_jittable(self, training=False, rng_key=None):
+        """Return ``(fn, param_arrays)`` — a PURE function over jax arrays.
+
+        ``fn(param_arrays, *input_arrays) -> array | tuple of arrays`` runs
+        this block's forward with parameters taken from the ``param_arrays``
+        list (sorted by parameter name, matching ``param_arrays``'s order)
+        instead of the block's own buffers.  It is safe to ``jax.jit``,
+        ``jax.grad``, shard, or export to StableHLO — this is the supported
+        surface for driver harnesses and serving (the role
+        [U:src/c_api/c_predict_api.cc] plays for the reference), replacing
+        any reach into ``_traced_data``/TLS internals.
+
+        ``training`` selects train-mode semantics (dropout live, BatchNorm
+        batch stats; aux-state side effects are NOT returned — use
+        ``parallel.SPMDTrainer`` for a full training step).  ``rng_key``
+        seeds dropout when training (default: a fixed key, so the exported
+        fn is deterministic).
+        """
+        import jax
+
+        from .. import autograd
+        from ..ndarray.ndarray import NDArray
+        from ..random import push_traced_key, pop_traced_key
+
+        params = sorted(self.collect_params().values(), key=lambda p: p.name)
+        for p in params:
+            if p._data is None:
+                raise ValueError(
+                    f"Parameter {p.name} is not materialized (deferred init?). "
+                    "Run one forward pass before export_jittable().")
+        param_arrays = [p._data._data for p in params]
+        key = rng_key if rng_key is not None else jax.random.PRNGKey(0)
+        block = self
+
+        def fn(param_arrs, *inputs):
+            saved = []
+            for p, a in zip(params, param_arrs):
+                saved.append(getattr(p, "_traced_data", None))
+                p._traced_data = NDArray(a)
+            push_traced_key(key)
+            _aux_stack().append([])
+            prev = getattr(_tls, "tracing", 0)
+            _tls.tracing = prev + 1
+            try:
+                with autograd._scope(False, training):
+                    out = block(*[NDArray(x) if x is not None else None
+                                  for x in inputs])
+            finally:
+                _tls.tracing = prev
+                _aux_stack().pop()
+                pop_traced_key()
+                for p, s in zip(params, saved):
+                    p._traced_data = s
+            if isinstance(out, (list, tuple)):
+                return tuple(o._data for o in out)
+            return out._data
+
+        return fn, param_arrays
+
     def summary(self, *inputs):
         """Print a per-layer summary (parity: ``Block.summary``)."""
         rows = []
