@@ -1,0 +1,80 @@
+#!/usr/bin/env bash
+# One-command CI entry (the [U:ci/build.py] + runtime_functions.sh analog).
+#
+# Runs the four evidence tiers in order and prints a per-tier summary:
+#   1. unit      — CPU suite on the 8-device virtual mesh (fast tiers)
+#   2. dist      — multi-process kvstore/launcher tier
+#   3. examples  — example-script smoke tier
+#   4. bench     — bench.py smoke on whatever backend is present (CPU-safe)
+#   5. tpu       — (opt-in: CI_TPU=1) on-chip correctness tier, needs a chip
+#
+# Usage:  tools/ci.sh [tier ...]      # default: unit dist examples bench
+# Env:    CI_TPU=1 adds the tpu tier; CI_PYTEST_ARGS extra pytest flags.
+set -u -o pipefail
+
+cd "$(dirname "$0")/.."
+
+# The ambient axon tunnel (PALLAS_AXON_POOL_IPS) routes every eager op to a
+# remote chip; CI tiers 1-4 must run on the virtual CPU mesh.
+CPU_ENV=(env -u PALLAS_AXON_POOL_IPS
+         JAX_PLATFORMS=cpu
+         XLA_FLAGS="--xla_force_host_platform_device_count=8")
+
+TIERS=("$@")
+[ ${#TIERS[@]} -eq 0 ] && TIERS=(unit dist examples bench)
+[ "${CI_TPU:-0}" = "1" ] && TIERS+=(tpu)
+
+declare -A RESULT
+FAIL=0
+
+run_tier() {
+    local name="$1"; shift
+    echo "===================================================================="
+    echo "== tier: $name"
+    echo "===================================================================="
+    local t0=$SECONDS
+    if "$@"; then
+        RESULT[$name]="PASS ($((SECONDS - t0))s)"
+    else
+        RESULT[$name]="FAIL ($((SECONDS - t0))s)"
+        FAIL=1
+    fi
+}
+
+for tier in "${TIERS[@]}"; do
+    case "$tier" in
+        unit)
+            run_tier unit "${CPU_ENV[@]}" python -m pytest tests/ -q \
+                --ignore=tests/test_examples.py --ignore=tests/test_dist.py \
+                ${CI_PYTEST_ARGS:-}
+            ;;
+        dist)
+            run_tier dist "${CPU_ENV[@]}" python -m pytest tests/test_dist.py -q \
+                ${CI_PYTEST_ARGS:-}
+            ;;
+        examples)
+            run_tier examples "${CPU_ENV[@]}" python -m pytest tests/test_examples.py -q \
+                ${CI_PYTEST_ARGS:-}
+            ;;
+        bench)
+            # CPU smoke: tiny batch, 1-2 steps — proves the headline path runs
+            run_tier bench "${CPU_ENV[@]}" \
+                env MXNET_TPU_BENCH_BATCH=8 python bench.py
+            ;;
+        tpu)
+            # on-chip tier: runs under the ambient axon env (NOT cpu-cleaned)
+            run_tier tpu env MXNET_TEST_CTX=tpu python -m pytest tpu_tests/ -q \
+                ${CI_PYTEST_ARGS:-}
+            ;;
+        *)
+            echo "unknown tier: $tier" >&2; exit 2
+            ;;
+    esac
+done
+
+echo "===================================================================="
+echo "== CI summary"
+for tier in "${TIERS[@]}"; do
+    printf '  %-10s %s\n' "$tier" "${RESULT[$tier]:-SKIPPED}"
+done
+exit $FAIL
