@@ -189,8 +189,12 @@ def bench_transformer():
     from incubator_mxnet_tpu.parallel import make_mesh, SPMDTrainer
 
     backend = jax.default_backend()
+    # S=256 default: the WMT bucketed pipeline's dominant bucket (the
+    # round-3 S=64 config flattered tokens/s and starved the MXU —
+    # VERDICT r3 item 3).  MXNET_TPU_BENCH_SEQ overrides for probes.
     B = int(os.environ.get("MXNET_TPU_BENCH_BATCH", "32"))
-    S, vocab = 64, 32768
+    S = int(os.environ.get("MXNET_TPU_BENCH_SEQ", "256"))
+    vocab = 32768
     warmup, steps = (3, 40) if backend != "cpu" else (1, 2)
     from incubator_mxnet_tpu import amp
     if os.environ.get("MXNET_TPU_BENCH_AMP", "1") == "1":
@@ -206,10 +210,17 @@ def bench_transformer():
         labels = mx.nd.array(rng.randint(0, vocab, (B, S)), dtype="int32")
         net(mx.nd.zeros((2, S), dtype="int32"), mx.nd.zeros((2, S), dtype="int32"))
 
+    # same bf16-canonical-params + fp32-master discipline as the BERT bench
+    mp = (os.environ.get("MXNET_TPU_BENCH_BF16_PARAMS", "1") == "1"
+          and os.environ.get("MXNET_TPU_BENCH_AMP", "1") == "1")
+    if mp:
+        net.cast("bfloat16")
+
     def loss_fn(out, label):
         return NDArray(streaming_softmax_ce(out._data, label._data).mean(axis=-1))
 
-    trainer = SPMDTrainer(net, loss_fn, "adam", {"learning_rate": 1e-4},
+    trainer = SPMDTrainer(net, loss_fn, "adam",
+                          {"learning_rate": 1e-4, "multi_precision": mp},
                           mesh=make_mesh())
     src, tgt, labels = trainer.shard_batch(src, tgt, labels)
     dt = _run_spmd(trainer, (src, tgt), labels, warmup, steps)

@@ -59,7 +59,7 @@ _ELEMWISE = {"elemwise_add": "Add", "broadcast_add": "Add", "_plus": "Add",
              "elemwise_div": "Div", "broadcast_div": "Div", "_div": "Div"}
 _UNARY = {"tanh": "Tanh", "sigmoid": "Sigmoid", "relu": "Relu",
           "exp": "Exp", "sqrt": "Sqrt", "log": "Log", "negative": "Neg",
-          "abs": "Abs"}
+          "abs": "Abs", "erf": "Erf"}
 # op -> (onnx op, scalar operand position: 1 = x∘c, 0 = c∘x)
 _SCALAR = {"_plus_scalar": ("Add", 1), "_mul_scalar": ("Mul", 1),
            "_minus_scalar": ("Sub", 1), "_div_scalar": ("Div", 1),
@@ -75,17 +75,57 @@ def _export_node(node, in_names, out_name, extra_inits):
         flatten = a.get("flatten", True)
         nodes = []
         x = in_names[0]
-        if flatten:
-            nodes.append({"op_type": "Flatten", "name": nm + "_flatten",
-                          "input": [x], "output": [nm + "_flat"],
-                          "attribute": [_attr_i("axis", 1)]})
-            x = nm + "_flat"
+        if not flatten:
+            # rank-preserving FC (transformer layers): Gemm is 2-D only, so
+            # emit Transpose(W) → MatMul → Add(bias) (the standard ONNX
+            # decomposition for batched dense layers)
+            wt = nm + "_wT"
+            nodes.append({"op_type": "Transpose", "name": nm + "_transposeW",
+                          "input": [in_names[1]], "output": [wt],
+                          "attribute": [_attr_ints("perm", (1, 0))]})
+            mm_out = out_name if len(in_names) == 2 else nm + "_mm"
+            nodes.append({"op_type": "MatMul", "name": nm + "_matmul",
+                          "input": [x, wt], "output": [mm_out], "attribute": []})
+            if len(in_names) > 2:
+                nodes.append({"op_type": "Add", "name": nm, "attribute": [],
+                              "input": [mm_out, in_names[2]],
+                              "output": [out_name]})
+            return nodes
+        nodes.append({"op_type": "Flatten", "name": nm + "_flatten",
+                      "input": [x], "output": [nm + "_flat"],
+                      "attribute": [_attr_i("axis", 1)]})
+        x = nm + "_flat"
         gemm_in = [x] + in_names[1:]
         nodes.append({"op_type": "Gemm", "name": nm, "input": gemm_in,
                       "output": [out_name],
                       "attribute": [_attr_f("alpha", 1.0), _attr_f("beta", 1.0),
                                     _attr_i("transB", 1)]})
         return nodes
+    if op == "LayerNorm":
+        axis = int(a.get("axis", -1))
+        if axis != -1:
+            # ONNX LayerNormalization normalizes over ALL axes [axis, rank)
+            # while mx LayerNorm normalizes exactly one; only the last axis
+            # means the same thing in both (export has no shape info to
+            # check rank, so anything else is rejected, not mistranslated)
+            raise NotImplementedError(
+                "LayerNorm export supports axis=-1 only (ONNX "
+                "LayerNormalization normalizes all trailing axes)")
+        return [{"op_type": "LayerNormalization", "name": nm,
+                 "input": in_names, "output": [out_name],
+                 "attribute": [_attr_f("epsilon", a.get("eps", 1e-5)),
+                               _attr_i("axis", -1)]}]
+    if op in ("batch_dot", "linalg_gemm2"):
+        if a.get("transpose_a", False) or a.get("transpose_b", False):
+            # ONNX MatMul has no transpose attrs and export runs without
+            # shape inference; write an explicit sym.transpose instead
+            raise NotImplementedError(
+                "batch_dot/linalg_gemm2 transpose flags have no ONNX MatMul "
+                "form; apply sym.transpose to the operand explicitly")
+        if float(a.get("alpha", 1.0)) != 1.0:
+            raise NotImplementedError("linalg_gemm2 alpha!=1 export")
+        return [{"op_type": "MatMul", "name": nm, "input": in_names,
+                 "output": [out_name], "attribute": []}]
     if op == "Convolution":
         kernel = _tuplize(a.get("kernel", (1, 1)))
         pad = _tuplize(a.get("pad", 0), len(kernel))
@@ -163,6 +203,15 @@ def _export_node(node, in_names, out_name, extra_inits):
         return [{"op_type": _ELEMWISE[op], "name": nm, "input": in_names,
                  "output": [out_name], "attribute": []}]
     if op == "dot":
+        # mx dot on >2-D operands contracts last-with-first — NOT ONNX
+        # MatMul's batched-matmul semantics.  Export has shapes only for
+        # initializer inputs; reject the provably-wrong case rather than
+        # mistranslate (use batch_dot/linalg_gemm2 for batched matmul).
+        for entry in extra_inits:
+            if entry["name"] in in_names and len(entry["dims"]) > 2:
+                raise NotImplementedError(
+                    "dot with a >2-D operand has no ONNX MatMul equivalent "
+                    "(contract-last-with-first); use batch_dot/linalg_gemm2")
         return [{"op_type": "MatMul", "name": nm, "input": in_names,
                  "output": [out_name], "attribute": []}]
     if op == "Embedding":
@@ -343,6 +392,8 @@ def import_model(model_file):
                 "Mul": "broadcast_mul", "Div": "broadcast_div"}
     _REV_UNARY = {v: k for k, v in _UNARY.items()}
     folded = {}  # initializer name -> #nodes that folded it away
+    transposed_weights = {}  # Transpose-node output -> original [out,in] init
+    fc_pending_bias = {}  # reconstructed bias-less FC output -> (x, w, units)
 
     import incubator_mxnet_tpu.symbol as sym_mod
 
@@ -452,6 +503,17 @@ def import_model(model_file):
             out = sym_mod.Dropout(env[node["input"][0]], name=nm)
         elif op in rev_elem:
             a_name, b_name = node["input"][:2]
+            if (op == "Add" and a_name in fc_pending_bias
+                    and b_name in inits and inits[b_name].ndim == 1):
+                # second half of the rank-preserving dense idiom:
+                # Add(MatMul(x, Wᵀ), b) → FullyConnected with bias (the
+                # bias-less FC emitted for the MatMul goes unused)
+                x_sym, w_sym, units = fc_pending_bias.pop(a_name)
+                out = sym_mod.FullyConnected(
+                    x_sym, w_sym, env[b_name], num_hidden=units,
+                    flatten=False, no_bias=False, name=nm)
+                env[node["output"][0]] = out
+                continue
 
             def _scalar_init(nme):
                 return nme in inits and inits[nme].ndim == 0
@@ -472,7 +534,47 @@ def import_model(model_file):
                 out = getattr(sym_mod, rev_elem[op])(
                     env[a_name], env[b_name], name=nm)
         elif op == "MatMul":
-            out = sym_mod.dot(env[node["input"][0]], env[node["input"][1]], name=nm)
+            rhs = node["input"][1]
+            orig_w = transposed_weights.get(rhs)
+            if orig_w is not None and orig_w in inits and inits[orig_w].ndim == 2:
+                # the rank-preserving dense idiom Transpose(W)→MatMul:
+                # reconstruct FullyConnected(flatten=False) on the ORIGINAL
+                # [out, in] weight — restores op-level shape inference for
+                # the weight (a generic matmul var would need bind-time
+                # shapes) exactly as the Gemm branch does for 2-D FCs
+                units = int(inits[orig_w].shape[0])
+                out = sym_mod.FullyConnected(
+                    env[node["input"][0]], env[orig_w],
+                    num_hidden=units, flatten=False, no_bias=True, name=nm)
+                inits.pop(rhs + "_folded", None)  # folded copy unused now
+                env.pop(rhs + "_folded", None)
+                fc_pending_bias[node["output"][0]] = (
+                    env[node["input"][0]], env[orig_w], units)
+            else:
+                # ONNX MatMul is numpy-matmul semantics (batched over
+                # leading axes) — linalg_gemm2, not mx dot's
+                # contract-last-with-first
+                out = sym_mod.linalg_gemm2(env[node["input"][0]],
+                                           env[rhs], name=nm)
+        elif op == "LayerNormalization":
+            if int(_get_attr(node, "axis", -1)) != -1:
+                raise NotImplementedError(
+                    "LayerNormalization import supports axis=-1 only (mx "
+                    "LayerNorm normalizes a single axis; ONNX normalizes "
+                    "all trailing axes)")
+            scale_name = node["input"][1]
+            if len(node["input"]) > 2 and node["input"][2]:
+                beta = env[node["input"][2]]
+            else:
+                # bias input is optional in ONNX: synthesize zero beta
+                b_key = nm + "_beta0"
+                inits[b_key] = _np.zeros_like(inits[scale_name]) \
+                    if scale_name in inits else _np.zeros(1, _np.float32)
+                env[b_key] = S.var(b_key)
+                beta = env[b_key]
+            out = sym_mod.LayerNorm(
+                env[node["input"][0]], env[scale_name], beta,
+                axis=-1, eps=_get_attr(node, "epsilon", 1e-5), name=nm)
         elif op == "Gather":
             w_name = node["input"][0]
             w = inits[w_name]
@@ -525,6 +627,25 @@ def import_model(model_file):
                 name=nm)
             _drop_if_unused(sc_name, g, inits, env, folded)
         elif op == "Transpose":
+            src = node["input"][0]
+            if src in inits:
+                # constant-fold a transposed initializer (exporters emit
+                # Transpose(W)→MatMul for rank-preserving dense layers);
+                # keeps weights as plain vars so forward shape inference
+                # never has to invert a transpose.  Rank-2 (1,0) transposes
+                # are additionally remembered so a consuming MatMul can be
+                # reconstructed as FullyConnected on the ORIGINAL weight.
+                perm = tuple(_get_attr(node, "perm", ()))
+                arr = inits[src]
+                if arr.ndim == 2 and perm in ((), (1, 0)):
+                    transposed_weights[node["output"][0]] = src
+                folded_arr = _np.ascontiguousarray(
+                    arr.transpose(perm) if perm else arr.T)
+                key = node["output"][0] + "_folded"
+                inits[key] = folded_arr
+                env[key] = S.var(key)
+                env[node["output"][0]] = env[key]
+                continue
             out = sym_mod.transpose(env[node["input"][0]],
                                     axes=tuple(_get_attr(node, "perm", ())),
                                     name=nm)

@@ -191,3 +191,145 @@ class TestRound3Converters:
         arg2.update(aux2)
         out = _bind_forward(sym2, arg2, data_np)
         np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# model-scale roundtrips (VERDICT r3 item 7): full ResNet-50 and a BERT
+# encoder — export, re-import, numeric equality at rtol 1e-5.
+# ---------------------------------------------------------------------------
+
+
+def _resnet50_sym():
+    """Full ResNet-50-v1 bottleneck graph (3-4-6-3) in the Symbol API."""
+    S.symbol._reset_naming()
+    data = S.var("data")
+    x = S.Convolution(data, kernel=(7, 7), stride=(2, 2), pad=(3, 3),
+                      num_filter=64, no_bias=True, name="conv0")
+    x = S.BatchNorm(x, name="bn0")
+    x = S.Activation(x, act_type="relu", name="relu0")
+    x = S.Pooling(x, kernel=(3, 3), stride=(2, 2), pad=(1, 1),
+                  pool_type="max", name="pool0")
+
+    def bottleneck(x, prefix, filters, stride, downsample):
+        sc = x
+        if downsample:
+            sc = S.Convolution(x, kernel=(1, 1), stride=(stride, stride),
+                               num_filter=filters * 4, no_bias=True,
+                               name=f"{prefix}_scconv")
+            sc = S.BatchNorm(sc, name=f"{prefix}_scbn")
+        y = S.Convolution(x, kernel=(1, 1), num_filter=filters, no_bias=True,
+                          name=f"{prefix}_conv1")
+        y = S.BatchNorm(y, name=f"{prefix}_bn1")
+        y = S.Activation(y, act_type="relu", name=f"{prefix}_relu1")
+        y = S.Convolution(y, kernel=(3, 3), stride=(stride, stride),
+                          pad=(1, 1), num_filter=filters, no_bias=True,
+                          name=f"{prefix}_conv2")
+        y = S.BatchNorm(y, name=f"{prefix}_bn2")
+        y = S.Activation(y, act_type="relu", name=f"{prefix}_relu2")
+        y = S.Convolution(y, kernel=(1, 1), num_filter=filters * 4,
+                          no_bias=True, name=f"{prefix}_conv3")
+        y = S.BatchNorm(y, name=f"{prefix}_bn3")
+        y = S.broadcast_add(y, sc, name=f"{prefix}_add")
+        return S.Activation(y, act_type="relu", name=f"{prefix}_out")
+
+    for stage, (blocks, filters) in enumerate(
+            [(3, 64), (4, 128), (6, 256), (3, 512)], start=1):
+        for b in range(blocks):
+            stride = 2 if (stage > 1 and b == 0) else 1
+            x = bottleneck(x, f"s{stage}b{b}", filters, stride, b == 0)
+    x = S.Pooling(x, global_pool=True, pool_type="avg", kernel=(1, 1),
+                  name="gap")
+    x = S.Flatten(x, name="flat")
+    return S.FullyConnected(x, num_hidden=1000, name="fc1000")
+
+
+def _bert_encoder_sym(units=64, heads=4, hidden=128, layers=2):
+    """BERT-style encoder: embeddings + LN + [MHA + FFN] blocks, gelu,
+    rank-preserving FCs, batched attention matmuls."""
+    S.symbol._reset_naming()
+    tokens = S.var("data")  # [B, T] int32
+    x = S.Embedding(tokens, input_dim=50, output_dim=units, name="embed")
+    x = S.LayerNorm(x, name="embed_ln")
+    import math
+
+    for i in range(layers):
+        p = f"l{i}"
+        q = S.FullyConnected(x, num_hidden=units, flatten=False, name=f"{p}_q")
+        k = S.FullyConnected(x, num_hidden=units, flatten=False, name=f"{p}_k")
+        v = S.FullyConnected(x, num_hidden=units, flatten=False, name=f"{p}_v")
+
+        def heads_split(t, nme):
+            t = S.reshape(t, shape=(0, -1, heads, units // heads), name=f"{nme}_r")
+            return S.transpose(t, axes=(0, 2, 1, 3), name=f"{nme}_t")
+
+        qh = heads_split(q, f"{p}_qh")
+        kh = heads_split(k, f"{p}_kh")
+        vh = heads_split(v, f"{p}_vh")
+        kt = S.transpose(kh, axes=(0, 1, 3, 2), name=f"{p}_kT")
+        scores = S.batch_dot(qh, kt, name=f"{p}_scores")
+        scores = S._mul_scalar(scores, scalar=1.0 / math.sqrt(units // heads),
+                               name=f"{p}_scale")
+        probs = S.softmax(scores, axis=-1, name=f"{p}_probs")
+        ctx = S.batch_dot(probs, vh, name=f"{p}_ctx")
+        ctx = S.transpose(ctx, axes=(0, 2, 1, 3), name=f"{p}_ctxT")
+        ctx = S.reshape(ctx, shape=(0, -1, units), name=f"{p}_merge")
+        proj = S.FullyConnected(ctx, num_hidden=units, flatten=False,
+                                name=f"{p}_proj")
+        x = S.LayerNorm(S.broadcast_add(x, proj, name=f"{p}_res1"),
+                        name=f"{p}_ln1")
+        h = S.FullyConnected(x, num_hidden=hidden, flatten=False,
+                             name=f"{p}_ffn1")
+        h = S.LeakyReLU(h, act_type="gelu", name=f"{p}_gelu")
+        h = S.FullyConnected(h, num_hidden=units, flatten=False,
+                             name=f"{p}_ffn2")
+        x = S.LayerNorm(S.broadcast_add(x, h, name=f"{p}_res2"),
+                        name=f"{p}_ln2")
+    return x
+
+
+class TestModelScaleRoundtrip:
+    def test_resnet50_roundtrip(self, tmp_path):
+        sym = _resnet50_sym()
+        data = np.random.RandomState(0).rand(1, 3, 64, 64).astype(np.float32)
+        params = _rand_params(sym, data.shape)
+        ref = _bind_forward(sym, params, data)
+        path = str(tmp_path / "resnet50.onnx")
+        onnx_mxnet.export_model(sym, params, input_shape=data.shape,
+                                onnx_file_path=path)
+        sym2, arg2, aux2 = onnx_mxnet.import_model(path)
+        out = _bind_forward(sym2, {**arg2, **aux2}, data)
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-4)
+
+    def test_bert_encoder_roundtrip(self, tmp_path):
+        sym = _bert_encoder_sym()
+        rng = np.random.RandomState(1)
+        data = rng.randint(0, 50, (2, 12)).astype(np.int32)
+        # infer_shape needs the int input; _rand_params assumes float data —
+        # inline a variant
+        shapes, _, aux_shapes = sym.infer_shape(data=data.shape)
+        params = {}
+        for name, shp in zip(sym.list_arguments(), shapes):
+            if name != "data":
+                params[name] = mx.nd.array(rng.randn(*shp).astype(np.float32) * 0.1)
+
+        def fwd(s, ps):
+            # no bind-time shape hints: the importer reconstructs
+            # FullyConnected from the Transpose(W)→MatMul idiom, so weight
+            # shapes infer from the graph like any native symbol
+            exe = s.simple_bind(data=data.shape)
+            exe.arg_dict["data"][:] = data
+            for kk, vv in ps.items():
+                nm2 = kk.split(":", 1)[1] if ":" in kk else kk
+                if nm2 in exe.arg_dict:
+                    exe.arg_dict[nm2][:] = vv.asnumpy()
+                elif nm2 in exe.aux_dict:
+                    exe.aux_dict[nm2][:] = vv.asnumpy()
+            return exe.forward(is_train=False)[0].asnumpy()
+
+        ref = fwd(sym, params)
+        path = str(tmp_path / "bert_encoder.onnx")
+        onnx_mxnet.export_model(sym, params, input_shape=data.shape,
+                                input_type=np.int32, onnx_file_path=path)
+        sym2, arg2, aux2 = onnx_mxnet.import_model(path)
+        out = fwd(sym2, {**arg2, **aux2})
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
