@@ -1,9 +1,15 @@
 """Test harness config.
 
-Tests run on a *virtual 8-device CPU mesh* (SURVEY.md §4: the reference's
-single-host multi-process distributed tests map to
+Default: tests run on a *virtual 8-device CPU mesh* (SURVEY.md §4: the
+reference's single-host multi-process distributed tests map to
 ``xla_force_host_platform_device_count``), NOT the tunneled TPU chip — the
 tunnel adds an RPC per eager op and hangs all of jax when it wedges.
+
+``MXNET_TEST_CTX=tpu`` flips the suite onto the REAL chip (the reference's
+GPU tier reruns the unit suite under the accelerator context —
+[U:tests/python/gpu/test_operator_gpu.py]); tests whose contract is the
+8-device mesh are skipped there with an explicit marker (the machine
+exposes one chip).
 
 The axon PJRT plugin registers itself from sitecustomize before conftest
 runs (and jax is already imported), so env vars alone are too late: the
@@ -13,26 +19,68 @@ config.update (the env var was already parsed as 'axon').
 import os
 import sys
 
-# XLA flags are read when the CPU backend is *created* (lazily), so this is
-# still early enough.
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
-os.environ["JAX_PLATFORMS"] = "cpu"
+_TPU_TIER = os.environ.get("MXNET_TEST_CTX") == "tpu"
+
+if not _TPU_TIER:
+    # XLA flags are read when the CPU backend is *created* (lazily), so
+    # this is still early enough.
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
+    os.environ["JAX_PLATFORMS"] = "cpu"
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
+if not _TPU_TIER:
+    jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_default_matmul_precision", "highest")
 
-try:  # drop the tunneled-TPU backend registered by sitecustomize, if any
-    from jax._src import xla_bridge as _xb
+if not _TPU_TIER:
+    try:  # drop the tunneled-TPU backend registered by sitecustomize, if any
+        from jax._src import xla_bridge as _xb
 
-    _xb._backend_factories.pop("axon", None)
-except Exception:
-    pass
+        _xb._backend_factories.pop("axon", None)
+    except Exception:
+        pass
 
-assert jax.default_backend() == "cpu", f"tests must run on cpu, got {jax.default_backend()}"
-assert len(jax.devices()) == 8, f"expected 8 virtual cpu devices, got {len(jax.devices())}"
+    assert jax.default_backend() == "cpu", f"tests must run on cpu, got {jax.default_backend()}"
+    assert len(jax.devices()) == 8, f"expected 8 virtual cpu devices, got {len(jax.devices())}"
+else:
+    assert jax.default_backend() != "cpu", (
+        "MXNET_TEST_CTX=tpu but no accelerator backend is active")
+
+
+# Test files whose contract is the multi-device mesh or subprocess workers;
+# on the single-chip tier they are skipped with this documented reason.
+_MESH_ONLY_FILES = {
+    "test_parallel.py": "dp/tp/sp/pp sharding needs the 8-device mesh",
+    "test_dist.py": "multi-process kvstore tier (own launcher, CPU workers)",
+    "test_checkpoint.py": "sharded/preemption checkpointing drives mesh shards",
+    "test_examples.py": "example smoke tier spawns CPU-pinned subprocesses",
+}
+
+# Individual tests in otherwise chip-clean files that build explicit
+# fixed-size meshes (make_mesh() with no sizes adapts to the device count
+# and stays runnable).
+_MESH_ONLY_TESTS = {
+    "test_bert_spmd_tp_training": "builds explicit dp=8 / dp=4×tp=2 meshes",
+}
+
+
+def pytest_collection_modifyitems(config, items):
+    if not _TPU_TIER:
+        return
+    import pytest
+
+    if len(jax.devices()) >= 8:
+        return
+    n = len(jax.devices())
+    for item in items:
+        base = os.path.basename(str(getattr(item, "fspath", "")))
+        reason = (_MESH_ONLY_FILES.get(base)
+                  or _MESH_ONLY_TESTS.get(item.name.split("[", 1)[0]))
+        if reason is not None:
+            item.add_marker(pytest.mark.skip(
+                reason=f"chip tier has {n} device(s): {reason}"))

@@ -1,0 +1,98 @@
+#!/usr/bin/env python
+"""Pipeline-parallel efficiency sweep (VERDICT r4 evidence).
+
+Measures ``pipeline_apply`` wall time at pp=P over an n_microbatches sweep
+on the virtual CPU mesh and reports measured efficiency against the GPipe
+bubble model  eff(M) = M / (M + P - 1)  (the fraction of ticks a stage is
+busy).  Absolute CPU times are not TPU times — the *shape* of the curve
+(efficiency rising toward the model as M grows) is the evidence; on real
+chips the same program rides ICI ppermutes.
+
+Usage:
+    env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+        XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python tools/bench_pipeline.py [P] [width]
+"""
+import os
+import functools
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    P = int(sys.argv[1]) if len(sys.argv) > 1 else 4
+    width = int(sys.argv[2]) if len(sys.argv) > 2 else 512
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from incubator_mxnet_tpu.parallel import (
+        make_mesh, pipeline_apply, stack_stage_params)
+
+    mesh = make_mesh(pp=P, devices=jax.devices()[:P])
+    rng = np.random.RandomState(0)
+    stages = [{"w": jnp.asarray(rng.randn(width, width).astype(np.float32) * 0.05)}
+              for _ in range(P)]
+    params = stack_stage_params(stages, mesh)
+
+    def stage_fn(p, h):
+        # a few matmuls so per-tick compute dominates permute latency
+        for _ in range(4):
+            h = jnp.tanh(h @ p["w"])
+        return h
+
+    B = 32 * P
+    x = jnp.asarray(rng.randn(B, width).astype(np.float32))
+
+    # sequential reference for correctness + the no-pipeline unit of work
+    ref = x
+    for s in stages:
+        ref = stage_fn(s, ref)
+
+    # Independent zero-bubble baseline: time the SEQUENTIAL composition on
+    # one device; with P stages perfectly parallel and no bubble the
+    # pipeline's floor is t_seq / P.  eff_meas = (t_seq / P) / t(M).
+    seq_fn = jax.jit(lambda xx: functools.reduce(
+        lambda h, s: stage_fn(s, h), stages, xx))
+    jax.block_until_ready(seq_fn(x))
+    t0 = time.perf_counter()
+    for _ in range(5):
+        out = seq_fn(x)
+    jax.block_until_ready(out)
+    t_seq = (time.perf_counter() - t0) / 5 * 1000
+
+    times = {}
+    sweep = (1, 2, 4, 8, 16, 32)
+    for M in sweep:
+        fn = jax.jit(functools.partial(
+            _apply, stage_fn=stage_fn, mesh=mesh, M=M))
+        out = fn(params, x)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-4, atol=2e-5)
+        n_rep = 5
+        t0 = time.perf_counter()
+        for _ in range(n_rep):
+            out = fn(params, x)
+        jax.block_until_ready(out)
+        times[M] = (time.perf_counter() - t0) / n_rep * 1000
+    t_ideal = t_seq / P
+
+    print(f"pp={P}, width={width}, B={B}  t_seq={t_seq:.2f} ms  "
+          f"zero-bubble floor={t_ideal:.2f} ms  (GPipe model eff = M/(M+{P - 1}))")
+    print(f"{'M':>4} {'wall ms':>9} {'eff (meas)':>11} {'eff (model)':>12}")
+    for M in sweep:
+        print(f"{M:>4} {times[M]:>9.2f} {t_ideal / times[M]:>11.3f} "
+              f"{M / (M + P - 1):>12.3f}")
+
+
+def _apply(params, x, *, stage_fn, mesh, M):
+    from incubator_mxnet_tpu.parallel import pipeline_apply
+
+    return pipeline_apply(stage_fn, params, x, mesh, n_microbatches=M)
+
+
+if __name__ == "__main__":
+    main()
