@@ -15,7 +15,7 @@ from __future__ import annotations
 from ..block import HybridBlock
 from .. import nn
 
-__all__ = ["SSD", "ssd_512_resnet18", "SSDAnchorScales"]
+__all__ = ["SSD", "ssd_512_resnet18", "ssd_512_vgg16_atrous", "SSDAnchorScales"]
 
 # Per-scale (sizes, ratios) — the classic SSD512 schedule, normalized.
 SSDAnchorScales = [
@@ -114,4 +114,31 @@ def ssd_512_resnet18(num_classes=20, **kwargs):
     # reference keeps everything up to (not incl.) the global pool / output
     for layer in list(base.features._children.values())[:-2]:
         features.add(layer)
+    return SSD(features, num_classes, **kwargs)
+
+
+def ssd_512_vgg16_atrous(num_classes=20, **kwargs):
+    """SSD-512 with the reference's VGG-16 (atrous) backbone
+    ([U:example/ssd/symbol/vgg16_reduced.py] / GluonCV
+    ssd_512_vgg16_atrous): conv1_1..conv5_3 with the third maxpool
+    ceil-rounded, pool5 3×3/1, and fc6 as a dilated 1024-channel conv +
+    fc7 1×1 — the benchmark-parity backbone (the resnet18 variant is the
+    lighter alternative)."""
+    from ..nn import Conv2D, MaxPool2D
+
+    layers, filters = [2, 2, 3, 3, 3], [64, 128, 256, 512, 512]
+    features = nn.HybridSequential(prefix="vggbackbone_")
+    for i, num in enumerate(layers):
+        for _ in range(num):
+            features.add(Conv2D(filters[i], kernel_size=3, padding=1,
+                                activation="relu"))
+        if i < len(layers) - 1:  # pool1..pool4 stride 2; pool5 below
+            # pool3 ceil-rounds in the reference (75->38 at 300-input)
+            features.add(MaxPool2D(pool_size=2, strides=2, ceil_mode=(i == 2)))
+    # pool5: 3x3 stride 1 (keeps conv5 resolution for the atrous fc6)
+    features.add(MaxPool2D(pool_size=3, strides=1, padding=1))
+    # fc6: dilated conv (atrous trick), fc7: 1x1 conv
+    features.add(Conv2D(1024, kernel_size=3, padding=6, dilation=6,
+                        activation="relu"))
+    features.add(Conv2D(1024, kernel_size=1, activation="relu"))
     return SSD(features, num_classes, **kwargs)

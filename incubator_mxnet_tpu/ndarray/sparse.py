@@ -19,6 +19,8 @@ param_dict (tests/test_sparse.py::TestRowSparseLazyUpdate).
 """
 from __future__ import annotations
 
+import os as _os
+
 import numpy as _np
 
 import jax
@@ -217,8 +219,28 @@ def dot(lhs, rhs, transpose_a=False, transpose_b=False):
 
     csr × dense and csrᵀ × dense stay structured (gather-matmul /
     scatter-add — XLA lowers both to efficient TPU gathers); row_sparse
-    falls back to densify-then-dot."""
+    falls back to densify-then-dot.  ``MXNET_TPU_SPARSE_BACKEND=bcoo``
+    routes csr×dense through ``jax.experimental.sparse.BCOO`` instead
+    (same math, jaxlib's sparse lowering).
+
+    Perf guidance (documented divergence from the reference's CPU CSR
+    kernels): on TPU the MXU makes DENSE matmul so fast that csr only wins
+    at extreme sparsity (≳95% zeros at these tile sizes); for large-vocab
+    embedding gradients prefer the dense-backed ``row_sparse`` path (lazy
+    optimizer updates keep the semantics) over csr."""
     if isinstance(lhs, CSRNDArray) and isinstance(rhs, NDArray):
+        if _os.environ.get("MXNET_TPU_SPARSE_BACKEND") == "bcoo":
+            from jax.experimental import sparse as jsparse
+
+            nnz = lhs.data.shape[0]
+            rows = _csr_rows(lhs.indptr._data, nnz)
+            coo = jsparse.BCOO(
+                (lhs.data._data,
+                 jnp.stack([rows, lhs.indices._data], axis=1)),
+                shape=tuple(lhs.shape))
+            rhs_data = rhs._data.T if transpose_b else rhs._data
+            mat = coo.T if transpose_a else coo
+            return NDArray(mat @ rhs_data)
         nnz = lhs.data.shape[0]
         rows = _csr_rows(lhs.indptr._data, nnz)
         cols = lhs.indices._data

@@ -93,13 +93,66 @@ def while_loop(cond_fn, func, loop_vars, max_iterations=None):
     (parity: ``mx.nd.contrib.while_loop``).  ``max_iterations`` is required
     (as in the reference); lowered to a fixed-trip scan with an active mask
     so shapes/FLOPs are static.  Returns ``(outputs, final_loop_vars)``;
-    output rows past the executed step count are zeros."""
+    output rows past the executed step count are zeros.
+
+    Early-exit fast path: when the loop emits NO per-step outputs, the call
+    is eager (concrete arrays, not inside an outer jit trace) and no
+    autograd tape is recording, the loop lowers to ``lax.while_loop``
+    instead — it stops at the actual trip count rather than running
+    ``max_iterations`` masked iterations.  (The masked scan remains the
+    traced/training form: it is differentiable and stack-shaped; lax.while_loop
+    is neither.)"""
+    import jax as _jax
+
     from ..ndarray.ndarray import NDArray, invoke
 
     if max_iterations is None:
         raise ValueError("while_loop requires max_iterations (static shapes on TPU)")
     var_list, multi_var = _as_list(loop_vars)
     nv = len(var_list)
+
+    from .. import autograd as _autograd
+
+    concrete = all(not isinstance(v._data, _jax.core.Tracer) for v in var_list)
+    if concrete and not _autograd.is_recording():
+        # probe the output structure abstractly (tracers, no FLOPs)
+        n_outs_cell = []
+
+        def _probe(*arrays):
+            with _paused():
+                out, new_vars = func(*[NDArray(a) for a in arrays])
+            outs, _ = _as_list(out)
+            n_outs_cell.append(len(outs))
+            new, _ = _as_list(new_vars)
+            return tuple(n._data for n in new)
+
+        try:
+            _jax.eval_shape(_probe, *[v._data for v in var_list])
+        except Exception:
+            n_outs_cell = [None]
+        if n_outs_cell and n_outs_cell[0] == 0:
+            def pure_early(*arrays):
+                def cond_f(carry):
+                    i, vars_ = carry
+                    with _paused():
+                        c = cond_fn(*[NDArray(v) for v in vars_])
+                    return jnp.logical_and(i < int(max_iterations),
+                                           c._data.astype(bool).reshape(()))
+
+                def body_f(carry):
+                    i, vars_ = carry
+                    with _paused():
+                        _, new_vars = func(*[NDArray(v) for v in vars_])
+                    new, _ = _as_list(new_vars)
+                    return (i + 1, tuple(n._data for n in new))
+
+                _, final = lax.while_loop(cond_f, body_f,
+                                          (jnp.int32(0), tuple(arrays)))
+                return tuple(final)
+
+            results = invoke(pure_early, var_list, {}, name="_while_loop")
+            results = results if isinstance(results, list) else [results]
+            return [], (results if multi_var else results[0])
 
     def pure(*arrays):
         def scan_body(carry, _):

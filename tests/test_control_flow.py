@@ -162,3 +162,37 @@ class TestCond:
         g_neg = jax.grad(f)(jnp.asarray([-3.0]))
         np.testing.assert_allclose(np.asarray(g_pos), [6.0])
         np.testing.assert_allclose(np.asarray(g_neg), [-1.0])
+
+
+def test_while_loop_early_exit_no_outputs():
+    """Eager no-output loops take the lax.while_loop fast path: the loop
+    must stop at the TRUE trip count (observable through the final vars)
+    and still respect the max_iterations cap."""
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu.ops.control_flow import while_loop
+
+    outs, (i_f, x_f) = while_loop(
+        lambda i, x: (x > 1.0),
+        lambda i, x: ([], (i + 1, x * 0.5)),
+        (mx.nd.array([0.0]), mx.nd.array([1000.0])),
+        max_iterations=1000)
+    assert outs == []
+    assert float(i_f.asnumpy()[0]) == 10  # 1000 / 2^10 < 1, not 1000 iters
+    np.testing.assert_allclose(float(x_f.asnumpy()[0]), 1000.0 / 1024, rtol=1e-6)
+
+    # cap respected when the condition never goes false
+    _, (i_c, _) = while_loop(
+        lambda i, x: (x > -1.0),
+        lambda i, x: ([], (i + 1, x + 1.0)),
+        (mx.nd.array([0.0]), mx.nd.array([0.0])),
+        max_iterations=7)
+    assert float(i_c.asnumpy()[0]) == 7
+
+    # with outputs the masked path is used and padding stays zeros
+    outs, fin = while_loop(
+        lambda i, v: i < 2,
+        lambda i, v: (v * 2, (i + 1, v + 1)),
+        (mx.nd.array([0.0]), mx.nd.array([3.0])),
+        max_iterations=4)
+    o = outs.asnumpy()
+    assert o.shape[0] == 4 and (o[2:] == 0).all()

@@ -58,3 +58,31 @@ def test_multihost_mesh_two_processes_four_devices():
     sync spanning hosts."""
     _run_dist(2, script="multihost_worker.py",
               marker="multihost assertions passed")
+
+
+def test_cluster_launcher_dry_run():
+    """tools/launch.py ([U:tools/launch.py] analog): ssh and tpu-pod modes
+    emit the right fan-out commands (dry-run — no remote targets exist
+    here); local mode delegates to the tested launch_local tier."""
+    hosts = os.path.join(ROOT, "tools", "__test_hosts.txt")
+    with open(hosts, "w") as f:
+        f.write("host-a\nhost-b\n")
+    try:
+        out = subprocess.run(
+            [sys.executable, os.path.join(ROOT, "tools", "launch.py"),
+             "--launcher", "ssh", "--hostfile", hosts, "-n", "2",
+             "--dry-run", "--", "python", "train.py"],
+            capture_output=True, text=True, timeout=60)
+        assert out.returncode == 0, out.stderr
+        assert out.stdout.count("ssh -o StrictHostKeyChecking=no") == 2
+        assert "DMLC_WORKER_ID=1" in out.stdout
+        assert "DMLC_NUM_WORKER=2" in out.stdout
+        out = subprocess.run(
+            [sys.executable, os.path.join(ROOT, "tools", "launch.py"),
+             "--launcher", "tpu-pod", "--tpu-name", "pod0", "--zone", "z",
+             "--dry-run", "--", "python", "train.py"],
+            capture_output=True, text=True, timeout=60)
+        assert out.returncode == 0, out.stderr
+        assert "gcloud compute tpus tpu-vm ssh pod0 --worker=all" in out.stdout
+    finally:
+        os.remove(hosts)

@@ -185,3 +185,53 @@ class TestRowSparseLazyUpdate:
         w2 = net.weight.data().asnumpy()
         np.testing.assert_array_equal(w2[1], w1[1])  # no wd decay on row 1
         assert np.abs(w2[2] - w1[2]).max() > 0
+
+
+def test_compression_wire_widens_past_127_workers():
+    """>127 workers: int8 code sums would saturate; the wire dtype must
+    widen to int32 (VERDICT r3 escape hatch)."""
+    import incubator_mxnet_tpu as mx
+
+    kv = mx.kv.create("local")
+    kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    kv.init(1, mx.nd.zeros((4,)))
+
+    class Wide(type(kv)):
+        @property
+        def num_workers(self):
+            return 256
+
+    kv.__class__ = Wide
+    kv.push(1, mx.nd.array(np.array([1.0, -1.0, 0.1, 0.7], np.float32)))
+    assert kv._last_wire_dtype == "int16", kv._last_wire_dtype
+    kv2 = mx.kv.create("local")
+    kv2.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    kv2.init(1, mx.nd.zeros((4,)))
+    kv2.push(1, mx.nd.array(np.array([1.0, -1.0, 0.1, 0.7], np.float32)))
+    assert kv2._last_wire_dtype == "int8", kv2._last_wire_dtype
+
+
+def test_csr_dot_bcoo_backend_matches():
+    """MXNET_TPU_SPARSE_BACKEND=bcoo: jax.experimental.sparse lowering must
+    agree with the gather/scatter path (incl. transpose_a)."""
+    import os
+
+    from incubator_mxnet_tpu.ndarray import sparse as sp
+
+    rng = np.random.RandomState(0)
+    dense = rng.rand(6, 5).astype(np.float32)
+    dense[dense < 0.7] = 0
+    csr = sp.csr_matrix(dense)
+    rhs = mx.nd.array(rng.rand(5, 3).astype(np.float32))
+    rhs_t = mx.nd.array(rng.rand(6, 3).astype(np.float32))
+    ref = sp.dot(csr, rhs).asnumpy()
+    ref_t = sp.dot(csr, rhs_t, transpose_a=True).asnumpy()
+    os.environ["MXNET_TPU_SPARSE_BACKEND"] = "bcoo"
+    try:
+        out = sp.dot(csr, rhs).asnumpy()
+        out_t = sp.dot(csr, rhs_t, transpose_a=True).asnumpy()
+    finally:
+        del os.environ["MXNET_TPU_SPARSE_BACKEND"]
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(out_t, ref_t, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(ref, dense @ rhs.asnumpy(), rtol=1e-5, atol=1e-6)

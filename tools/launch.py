@@ -1,0 +1,138 @@
+#!/usr/bin/env python
+"""Cluster launcher — the [U:tools/launch.py] analog beyond localhost.
+
+Launchers:
+
+* ``--launcher local``  — delegate to tools/launch_local.py (tested tier).
+* ``--launcher ssh``    — one worker per line of ``--hostfile``, started
+  over ssh with the DMLC_* env the trackers set
+  ([U:3rdparty/dmlc-core/tracker/dmlc_tracker/ssh.py]); worker 0's host
+  doubles as the jax.distributed coordinator.
+* ``--launcher tpu-pod`` — the TPU-native deployment: one process per pod
+  host via ``gcloud compute tpus tpu-vm ssh --worker=all``.  On a pod the
+  TPU runtime itself supplies topology, so workers only need
+  ``jax.distributed.initialize()`` with no args; the launcher's job is
+  fan-out + env hygiene, not rendezvous.
+
+``--dry-run`` prints every command instead of executing — the only mode
+exercisable in this sandbox (no ssh targets, no pods); the local tier is
+the executed-and-tested path (tests/test_dist.py).
+"""
+import argparse
+import os
+import shlex
+import subprocess
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def _read_hostfile(path):
+    with open(path) as f:
+        hosts = [ln.strip() for ln in f if ln.strip() and not ln.startswith("#")]
+    if not hosts:
+        raise SystemExit(f"hostfile {path} has no hosts")
+    return hosts
+
+
+def launch_local(args, cmd):
+    sub = [sys.executable, os.path.join(HERE, "launch_local.py"),
+           "-n", str(args.num_workers)] + ["--env=" + e for e in args.env] + cmd
+    if args.dry_run:
+        print(" ".join(shlex.quote(c) for c in sub))
+        return 0
+    return subprocess.call(sub)
+
+
+def launch_ssh(args, cmd):
+    hosts = _read_hostfile(args.hostfile)
+    n = args.num_workers or len(hosts)
+    if n > len(hosts):
+        raise SystemExit(f"{n} workers > {len(hosts)} hosts in {args.hostfile}")
+    coord = f"{hosts[0]}:{args.port}"
+    procs = []
+    rc = 0
+    for rank in range(n):
+        env = {
+            "DMLC_ROLE": "worker",
+            "DMLC_PS_ROOT_URI": hosts[0],
+            "DMLC_PS_ROOT_PORT": str(args.port),
+            "DMLC_NUM_WORKER": str(n),
+            "DMLC_WORKER_ID": str(rank),
+        }
+        for e in args.env:
+            k, _, v = e.partition("=")
+            env[k] = v
+        env_prefix = " ".join(f"{k}={shlex.quote(v)}" for k, v in env.items())
+        remote = f"cd {shlex.quote(args.workdir)} && {env_prefix} " + \
+            " ".join(shlex.quote(c) for c in cmd)
+        ssh_cmd = ["ssh", "-o", "StrictHostKeyChecking=no", hosts[rank], remote]
+        if args.dry_run:
+            print(" ".join(shlex.quote(c) for c in ssh_cmd))
+            continue
+        procs.append(subprocess.Popen(ssh_cmd))
+    for p in procs:
+        rc |= p.wait()
+    if args.dry_run:
+        print(f"# coordinator: {coord}")
+    return rc
+
+
+def launch_tpu_pod(args, cmd):
+    """Fan the command out to every host of a Cloud TPU pod slice.  The pod
+    runtime provides rendezvous (jax.distributed.initialize() no-args), so
+    no DMLC_* env is needed — only the user's --env extras."""
+    if not args.tpu_name:
+        raise SystemExit("--launcher tpu-pod requires --tpu-name")
+    env_prefix = " ".join(shlex.quote(e) for e in args.env)
+    remote = ((env_prefix + " ") if env_prefix else "") + \
+        " ".join(shlex.quote(c) for c in cmd)
+    g = ["gcloud", "compute", "tpus", "tpu-vm", "ssh", args.tpu_name,
+         "--worker=all"]
+    if args.zone:  # omitted -> gcloud's configured default zone
+        g.append(f"--zone={args.zone}")
+    g += ["--command", remote]
+    if args.dry_run:
+        print(" ".join(shlex.quote(c) for c in g))
+        return 0
+    return subprocess.call(g)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__,
+                                 formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("-n", "--num-workers", type=int, default=0)
+    ap.add_argument("-s", "--num-servers", type=int, default=0,
+                    help="accepted for reference-script compat; ignored (no PS tier)")
+    ap.add_argument("--launcher", choices=("local", "ssh", "tpu-pod"),
+                    default="local")
+    ap.add_argument("-H", "--hostfile", help="one host per line (ssh mode)")
+    ap.add_argument("--tpu-name", help="TPU pod slice name (tpu-pod mode)")
+    ap.add_argument("--zone", default=os.environ.get("CLOUDSDK_COMPUTE_ZONE", ""),
+                    help="GCE zone (tpu-pod mode)")
+    ap.add_argument("--port", type=int, default=9000)
+    ap.add_argument("--workdir", default=os.getcwd(),
+                    help="remote working directory (ssh mode)")
+    ap.add_argument("--env", action="append", default=[],
+                    help="extra KEY=VALUE for the workers")
+    ap.add_argument("--dry-run", action="store_true",
+                    help="print the launch commands without executing")
+    ap.add_argument("command", nargs=argparse.REMAINDER)
+    args = ap.parse_args(argv)
+    if not args.command:
+        ap.error("no worker command given")
+    cmd = args.command[1:] if args.command[0] == "--" else args.command
+
+    if args.launcher == "local":
+        if not args.num_workers:
+            ap.error("-n is required for --launcher local")
+        return launch_local(args, cmd)
+    if args.launcher == "ssh":
+        if not args.hostfile:
+            ap.error("--hostfile is required for --launcher ssh")
+        return launch_ssh(args, cmd)
+    return launch_tpu_pod(args, cmd)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
