@@ -23,9 +23,10 @@ collapses onto XLA collectives:
   (matching [U:src/kvstore/gradient_compression.cc]'s worker-side
   compress → push order); the cross-worker reduction then sums int8 codes
   (4× the wire bytes of fp32) and the aggregate is reconstructed as
-  ``sum(codes) · t``.  Past 127 workers int8 sums would saturate, so the
-  wire dtype widens to int16 automatically (exact to 32767 workers, still
-  2× smaller than fp32).
+  ``sum(codes) · t``.  The cross-worker sum accumulates in int32
+  (jnp.sum's integer promotion), so code sums are exact at ANY worker
+  count; int8 is the per-worker buffer/staging format (4× smaller than
+  fp32 gradients), and the collective itself moves the promoted values.
 """
 from __future__ import annotations
 
@@ -180,11 +181,6 @@ class KVStore:
         residual._data = g - codes.astype(g.dtype) * threshold
         residual._version += 1
         self._store[res_key] = residual
-        # int8 code sums saturate at >127 workers; widen the wire dtype to
-        # int16 past that (exact to 32767 workers, still half the fp32
-        # bytes — the escape hatch VERDICT r3 asked for)
-        if self.num_workers > 127:
-            codes = codes.astype(jnp.int16)
         wire = self._reduce_codes(codes)
         self._last_wire_dtype = str(codes.dtype)  # test/observability hook
         return NDArray(wire.astype(grad.dtype) * threshold, ctx=grad.context)

@@ -113,7 +113,9 @@ def while_loop(cond_fn, func, loop_vars, max_iterations=None):
 
     from .. import autograd as _autograd
 
-    concrete = all(not isinstance(v._data, _jax.core.Tracer) for v in var_list)
+    concrete = all(
+        not isinstance(getattr(v, "_data", v), _jax.core.Tracer)
+        for v in var_list)
     if concrete and not _autograd.is_recording():
         # probe the output structure abstractly (tracers, no FLOPs)
         n_outs_cell = []
@@ -127,7 +129,8 @@ def while_loop(cond_fn, func, loop_vars, max_iterations=None):
             return tuple(n._data for n in new)
 
         try:
-            _jax.eval_shape(_probe, *[v._data for v in var_list])
+            _jax.eval_shape(_probe, *[jnp.asarray(getattr(v, "_data", v))
+                                      for v in var_list])
         except Exception:
             n_outs_cell = [None]
         if n_outs_cell and n_outs_cell[0] == 0:

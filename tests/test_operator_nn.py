@@ -1,0 +1,272 @@
+"""NN-operator depth tests (the [U:tests/python/unittest/test_operator.py]
+normalization/conv/pool sections): every check against an independent
+numpy reference, gradients by finite differences where cheap.
+"""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import autograd
+from incubator_mxnet_tpu.utils.test_utils import (
+    assert_almost_equal,
+    check_numeric_gradient,
+)
+
+from common import with_seed
+
+
+def _nd(x, dtype="float32"):
+    return mx.nd.array(np.asarray(x, dtype=dtype))
+
+
+class TestNormalizationOps:
+    @with_seed()
+    def test_batchnorm_training_stats(self):
+        x = np.random.randn(4, 3, 5, 5).astype(np.float32) * 2 + 1
+        gamma = np.random.rand(3).astype(np.float32) + 0.5
+        beta = np.random.randn(3).astype(np.float32)
+        mean = np.zeros(3, np.float32)
+        var = np.ones(3, np.float32)
+        # the op computes batch statistics (returns out, batch_mean,
+        # batch_var; the gluon layer owns running-stat mutation)
+        out, bmean, bvar = mx.nd.BatchNorm(_nd(x), _nd(gamma), _nd(beta),
+                                           _nd(mean), _nd(var),
+                                           fix_gamma=False)
+        bm = x.mean(axis=(0, 2, 3))
+        bv = x.var(axis=(0, 2, 3))
+        assert_almost_equal(bmean.asnumpy(), bm, rtol=1e-4, atol=1e-4)
+        assert_almost_equal(bvar.asnumpy(), bv, rtol=1e-3, atol=1e-4)
+        expect = ((x - bm[None, :, None, None])
+                  / np.sqrt(bv[None, :, None, None] + 1e-5)
+                  * gamma[None, :, None, None] + beta[None, :, None, None])
+        assert_almost_equal(out.asnumpy(), expect, rtol=1e-3, atol=1e-3)
+
+    @with_seed()
+    def test_batchnorm_inference_uses_running(self):
+        x = np.random.randn(2, 3, 4, 4).astype(np.float32)
+        gamma = np.ones(3, np.float32)
+        beta = np.zeros(3, np.float32)
+        mean = np.array([0.5, -0.5, 1.0], np.float32)
+        var = np.array([2.0, 1.0, 0.5], np.float32)
+        out = mx.nd.BatchNorm(_nd(x), _nd(gamma), _nd(beta), _nd(mean),
+                              _nd(var), fix_gamma=False,
+                              use_global_stats=True)[0]
+        expect = (x - mean[None, :, None, None]) / np.sqrt(
+            var[None, :, None, None] + 1e-5)
+        assert_almost_equal(out.asnumpy(), expect, rtol=1e-3, atol=1e-3)
+
+    @with_seed()
+    def test_layernorm_vs_numpy(self):
+        x = np.random.randn(3, 7).astype(np.float32)
+        gamma = np.random.rand(7).astype(np.float32) + 0.5
+        beta = np.random.randn(7).astype(np.float32)
+        out = mx.nd.LayerNorm(_nd(x), _nd(gamma), _nd(beta), eps=1e-5)
+        mu = x.mean(-1, keepdims=True)
+        sd = np.sqrt(x.var(-1, keepdims=True) + 1e-5)
+        assert_almost_equal(out.asnumpy(), (x - mu) / sd * gamma + beta,
+                            rtol=1e-4, atol=1e-4)
+
+    @with_seed()
+    def test_layernorm_grad(self):
+        x = np.random.randn(2, 5).astype(np.float32)
+        g = np.random.rand(5).astype(np.float32) + 0.5
+        b = np.random.randn(5).astype(np.float32)
+        check_numeric_gradient(
+            lambda a, gg, bb: mx.nd.LayerNorm(a, gg, bb) ** 2, [x, g, b],
+            rtol=2e-2, atol=2e-3)
+
+    @with_seed()
+    def test_groupnorm_instancenorm_rmsnorm(self):
+        x = np.random.randn(2, 4, 3, 3).astype(np.float32)
+        g = np.ones(4, np.float32)
+        b = np.zeros(4, np.float32)
+        # InstanceNorm: per-sample per-channel normalization
+        out = mx.nd.InstanceNorm(_nd(x), _nd(g), _nd(b), eps=1e-5).asnumpy()
+        mu = x.mean(axis=(2, 3), keepdims=True)
+        sd = np.sqrt(x.var(axis=(2, 3), keepdims=True) + 1e-5)
+        assert_almost_equal(out, (x - mu) / sd, rtol=1e-3, atol=1e-3)
+        # GroupNorm with 2 groups
+        out = mx.nd.GroupNorm(_nd(x), _nd(g), _nd(b), num_groups=2,
+                              eps=1e-5).asnumpy()
+        xr = x.reshape(2, 2, 2, 3, 3)
+        mu = xr.mean(axis=(2, 3, 4), keepdims=True)
+        sd = np.sqrt(xr.var(axis=(2, 3, 4), keepdims=True) + 1e-5)
+        expect = ((xr - mu) / sd).reshape(x.shape)
+        assert_almost_equal(out, expect, rtol=1e-3, atol=1e-3)
+        # RMSNorm over the last axis
+        xr2 = np.random.randn(3, 6).astype(np.float32)
+        gw = np.random.rand(6).astype(np.float32) + 0.5
+        out = mx.nd.RMSNorm(_nd(xr2), _nd(gw), eps=1e-6).asnumpy()
+        rms = np.sqrt((xr2 ** 2).mean(-1, keepdims=True) + 1e-6)
+        assert_almost_equal(out, xr2 / rms * gw, rtol=1e-4, atol=1e-4)
+
+    @with_seed()
+    def test_l2_normalization(self):
+        x = np.random.randn(3, 5).astype(np.float32)
+        out = mx.nd.L2Normalization(_nd(x), mode="instance").asnumpy()
+        expect = x / np.sqrt((x ** 2).sum(-1, keepdims=True) + 1e-10)
+        assert_almost_equal(out, expect, rtol=1e-4, atol=1e-5)
+        x4 = np.random.randn(2, 3, 4, 4).astype(np.float32)
+        out = mx.nd.L2Normalization(_nd(x4), mode="channel").asnumpy()
+        expect = x4 / np.sqrt((x4 ** 2).sum(1, keepdims=True) + 1e-10)
+        assert_almost_equal(out, expect, rtol=1e-4, atol=1e-5)
+
+
+class TestConvPoolOps:
+    @with_seed()
+    def test_convolution_vs_numpy(self):
+        x = np.random.randn(2, 2, 5, 5).astype(np.float32)
+        w = np.random.randn(3, 2, 3, 3).astype(np.float32)
+        b = np.random.randn(3).astype(np.float32)
+        out = mx.nd.Convolution(_nd(x), _nd(w), _nd(b), kernel=(3, 3),
+                                num_filter=3, pad=(1, 1)).asnumpy()
+        xp = np.pad(x, [(0, 0), (0, 0), (1, 1), (1, 1)])
+        expect = np.zeros((2, 3, 5, 5), np.float32)
+        for n in range(2):
+            for f in range(3):
+                for i in range(5):
+                    for j in range(5):
+                        expect[n, f, i, j] = (
+                            xp[n, :, i:i + 3, j:j + 3] * w[f]).sum() + b[f]
+        assert_almost_equal(out, expect, rtol=1e-3, atol=1e-3)
+
+    @with_seed()
+    def test_convolution_stride_dilate_group(self):
+        x = np.random.randn(1, 4, 8, 8).astype(np.float32)
+        w = np.random.randn(4, 2, 3, 3).astype(np.float32)
+        out = mx.nd.Convolution(_nd(x), _nd(w), kernel=(3, 3), num_filter=4,
+                                stride=(2, 2), num_group=2, no_bias=True)
+        assert out.shape == (1, 4, 3, 3)
+        # grouped: filter f sees only its group's input channels
+        g0 = out.asnumpy()[0, 0]
+        xp = x[0, 0:2]
+        expect = np.zeros((3, 3), np.float32)
+        for i in range(3):
+            for j in range(3):
+                expect[i, j] = (xp[:, 2 * i:2 * i + 3, 2 * j:2 * j + 3] * w[0]).sum()
+        assert_almost_equal(g0, expect, rtol=1e-3, atol=1e-3)
+        # dilation
+        out = mx.nd.Convolution(_nd(x), _nd(w[:, :, :, :]), kernel=(3, 3),
+                                num_filter=4, dilate=(2, 2), num_group=2,
+                                no_bias=True)
+        assert out.shape == (1, 4, 4, 4)
+
+    @with_seed()
+    def test_conv_grad(self):
+        x = np.random.randn(1, 1, 4, 4).astype(np.float32)
+        w = np.random.randn(2, 1, 3, 3).astype(np.float32)
+        check_numeric_gradient(
+            lambda a, ww: mx.nd.Convolution(a, ww, kernel=(3, 3), num_filter=2,
+                                            pad=(1, 1), no_bias=True),
+            [x, w], rtol=2e-2, atol=2e-3)
+
+    @with_seed()
+    def test_deconvolution_shapes_and_values(self):
+        x = np.random.randn(1, 2, 3, 3).astype(np.float32)
+        w = np.random.randn(2, 3, 2, 2).astype(np.float32)
+        out = mx.nd.Deconvolution(_nd(x), _nd(w), kernel=(2, 2), num_filter=3,
+                                  stride=(2, 2), no_bias=True)
+        assert out.shape == (1, 3, 6, 6)
+        # each input pixel stamps w scaled by its value (stride=kernel → no overlap)
+        expect = np.zeros((1, 3, 6, 6), np.float32)
+        for c_in in range(2):
+            for i in range(3):
+                for j in range(3):
+                    expect[0, :, 2 * i:2 * i + 2, 2 * j:2 * j + 2] += (
+                        x[0, c_in, i, j] * w[c_in])
+        assert_almost_equal(out.asnumpy(), expect, rtol=1e-3, atol=1e-3)
+
+    @with_seed()
+    def test_pooling_modes(self):
+        x = np.random.randn(1, 2, 4, 4).astype(np.float32)
+        out = mx.nd.Pooling(_nd(x), kernel=(2, 2), stride=(2, 2),
+                            pool_type="max").asnumpy()
+        expect = x.reshape(1, 2, 2, 2, 2, 2).max(axis=(3, 5))
+        assert_almost_equal(out, expect, rtol=0, atol=0)
+        out = mx.nd.Pooling(_nd(x), kernel=(2, 2), stride=(2, 2),
+                            pool_type="avg").asnumpy()
+        expect = x.reshape(1, 2, 2, 2, 2, 2).mean(axis=(3, 5))
+        assert_almost_equal(out, expect, rtol=1e-5, atol=1e-6)
+        out = mx.nd.Pooling(_nd(x), global_pool=True, pool_type="avg",
+                            kernel=(1, 1)).asnumpy()
+        assert_almost_equal(out[..., 0, 0], x.mean(axis=(2, 3)),
+                            rtol=1e-5, atol=1e-6)
+
+    @with_seed()
+    def test_maxpool_grad_routes_to_argmax(self):
+        x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+        xa = _nd(x)
+        xa.attach_grad()
+        with autograd.record():
+            y = mx.nd.Pooling(xa, kernel=(2, 2), stride=(2, 2), pool_type="max")
+        y.backward()
+        g = xa.grad.asnumpy()[0, 0]
+        expect = np.zeros((4, 4), np.float32)
+        expect[1::2, 1::2] = 1  # max of each 2x2 block is bottom-right
+        assert_almost_equal(g, expect, rtol=0, atol=0)
+
+    @with_seed()
+    def test_upsampling_nearest(self):
+        x = np.array([[[[1.0, 2.0], [3.0, 4.0]]]], np.float32)
+        out = mx.nd.UpSampling(_nd(x), scale=2, sample_type="nearest").asnumpy()
+        expect = np.repeat(np.repeat(x, 2, axis=2), 2, axis=3)
+        assert_almost_equal(out, expect, rtol=0, atol=0)
+
+
+class TestEmbeddingAndHeads:
+    @with_seed()
+    def test_embedding_grad_accumulates(self):
+        w = np.random.randn(10, 4).astype(np.float32)
+        idx = np.array([1, 3, 1], np.float32)  # repeated row 1
+        wa = _nd(w)
+        wa.attach_grad()
+        with autograd.record():
+            out = mx.nd.Embedding(_nd(idx, dtype="int32"), wa,
+                                  input_dim=10, output_dim=4)
+        out.backward()
+        g = wa.grad.asnumpy()
+        assert (g[1] == 2).all()  # row 1 hit twice
+        assert (g[3] == 1).all()
+        assert g[[0, 2, 4, 5, 6, 7, 8, 9]].sum() == 0
+
+    @with_seed()
+    def test_fullyconnected_flatten_semantics(self):
+        x = np.random.randn(2, 3, 4).astype(np.float32)
+        w = np.random.randn(5, 12).astype(np.float32)
+        b = np.zeros(5, np.float32)
+        out = mx.nd.FullyConnected(_nd(x), _nd(w), _nd(b), num_hidden=5)
+        assert out.shape == (2, 5)
+        assert_almost_equal(out.asnumpy(), x.reshape(2, 12) @ w.T,
+                            rtol=1e-4, atol=1e-4)
+        w2 = np.random.randn(5, 4).astype(np.float32)
+        out = mx.nd.FullyConnected(_nd(x), _nd(w2), _nd(b), num_hidden=5,
+                                   flatten=False)
+        assert out.shape == (2, 3, 5)
+        assert_almost_equal(out.asnumpy(), x @ w2.T, rtol=1e-4, atol=1e-4)
+
+    @with_seed()
+    def test_dropout_statistics_and_determinism(self):
+        x = np.ones((400, 100), np.float32)
+        with autograd.record(train_mode=True):
+            out = mx.nd.Dropout(_nd(x), p=0.3)
+        o = out.asnumpy()
+        keep_rate = (o != 0).mean()
+        assert abs(keep_rate - 0.7) < 0.02
+        # kept values rescaled by 1/keep
+        kept = o[o != 0]
+        assert abs(kept.mean() - 1.0 / 0.7) < 0.05
+        # eval mode: identity
+        out = mx.nd.Dropout(_nd(x), p=0.3)
+        assert_almost_equal(out.asnumpy(), x, rtol=0, atol=0)
+
+    @with_seed()
+    def test_slice_channel(self):
+        x = np.random.randn(2, 6, 3).astype(np.float32)
+        parts = mx.nd.SliceChannel(_nd(x), num_outputs=3, axis=1)
+        assert len(parts) == 3
+        for k in range(3):
+            assert_almost_equal(parts[k].asnumpy(), x[:, 2 * k:2 * k + 2],
+                                rtol=0, atol=0)
+        sq = mx.nd.SliceChannel(_nd(x[:, :3]), num_outputs=3, axis=1,
+                                squeeze_axis=True)
+        assert sq[0].shape == (2, 3)

@@ -84,7 +84,11 @@ def launch_tpu_pod(args, cmd):
     no DMLC_* env is needed — only the user's --env extras."""
     if not args.tpu_name:
         raise SystemExit("--launcher tpu-pod requires --tpu-name")
-    env_prefix = " ".join(shlex.quote(e) for e in args.env)
+    def _assign(e):
+        k, _, v = e.partition("=")
+        return f"{k}={shlex.quote(v)}"
+
+    env_prefix = " ".join(_assign(e) for e in args.env)
     remote = ((env_prefix + " ") if env_prefix else "") + \
         " ".join(shlex.quote(c) for c in cmd)
     g = ["gcloud", "compute", "tpus", "tpu-vm", "ssh", args.tpu_name,

@@ -21,10 +21,7 @@ feature-map traffic (weights are ~25M params ≈ 50 MB bf16, noise at B=256):
 
 Maxpool/residual-add/loss-head traffic is counted separately below.
 """
-import os
 import sys
-
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def feature_maps(B):

@@ -1,0 +1,51 @@
+#!/usr/bin/env bash
+# On-chip test tier: run the FULL tests/ suite file-by-file on the real
+# TPU (MXNET_TEST_CTX=tpu — tests/conftest.py skips mesh-contract files
+# with documented reasons), appending per-file results to the log.
+# File-by-file (not one pytest run) so a wedged tunnel costs one file's
+# timeout, not the tier; each file gets its own process + fresh backend.
+#
+# Usage: tools/run_tpu_tier.sh [logfile] [per-file timeout seconds]
+set -u
+cd "$(dirname "$0")/.."
+
+LOG="${1:-docs/TPU_TIER_LOG_r04.txt}"
+TMO="${2:-420}"
+
+{
+    echo "# On-chip tier (MXNET_TEST_CTX=tpu), $(date -u +%FT%TZ)"
+    echo "# per-file timeout ${TMO}s; mesh-contract files skip via conftest"
+    python - <<'PYEOF'
+import jax
+print(f"# backend: {jax.default_backend()}, devices: {jax.devices()}")
+PYEOF
+} > "$LOG"
+
+PASS=0; FAIL=0; TOUT=0; SKIPFILES=0
+for f in tests/test_*.py; do
+    base=$(basename "$f")
+    start=$SECONDS
+    out=$(MXNET_TEST_CTX=tpu timeout "$TMO" python -m pytest "$f" -q --no-header 2>&1)
+    rc=$?
+    dur=$((SECONDS - start))
+    tail_line=$(echo "$out" | grep -E "passed|failed|skipped|error" | tail -1)
+    if [ $rc -eq 124 ]; then
+        echo "TIMEOUT  ${base} (${dur}s)" >> "$LOG"
+        TOUT=$((TOUT + 1))
+    elif [ $rc -eq 0 ]; then
+        if echo "$tail_line" | grep -q "passed"; then
+            echo "PASS     ${base} (${dur}s): ${tail_line}" >> "$LOG"
+            PASS=$((PASS + 1))
+        else
+            echo "SKIP     ${base} (${dur}s): ${tail_line}" >> "$LOG"
+            SKIPFILES=$((SKIPFILES + 1))
+        fi
+    else
+        echo "FAIL     ${base} (${dur}s): ${tail_line}" >> "$LOG"
+        echo "$out" | tail -20 | sed 's/^/    | /' >> "$LOG"
+        FAIL=$((FAIL + 1))
+    fi
+done
+echo "# summary: ${PASS} files passed, ${FAIL} failed, ${TOUT} timed out, ${SKIPFILES} all-skipped" >> "$LOG"
+tail -1 "$LOG"
+[ $FAIL -eq 0 ]
