@@ -6,7 +6,8 @@ report fp32-vs-int8 accuracy and agreement.
 
 TPU-native notes: the int8 path runs weights and activations through the
 MXU's native int8 matmul/conv (``ops/quantization.py``); calibration is
-minmax over hooked layer inputs, matching the reference's ``calib_mode=
+minmax over hooked layer inputs (``--calib-mode entropy`` switches to
+the KL threshold sweep), matching the reference's ``calib_mode=
 'naive'``.
 
     python example/quantize_int8.py --epochs 2
@@ -45,6 +46,9 @@ def main():
     ap.add_argument("--epochs", type=int, default=2)
     ap.add_argument("--batch-size", type=int, default=64)
     ap.add_argument("--calib-batches", type=int, default=4)
+    ap.add_argument("--calib-mode", choices=("naive", "entropy"),
+                    default="naive",
+                    help="minmax ranges or the KL-optimal threshold sweep")
     args = ap.parse_args()
 
     import incubator_mxnet_tpu as mx
@@ -82,7 +86,7 @@ def main():
     n_calib = min(args.calib_batches, len(Xtr) // args.batch_size)
     calib = [mx.nd.array(Xtr[i * args.batch_size:(i + 1) * args.batch_size])
              for i in range(n_calib)]
-    quantize_net(net, calib, quantized_dtype="int8", calib_mode="naive")
+    quantize_net(net, calib, quantized_dtype="int8", calib_mode=args.calib_mode)
 
     int8_acc = accuracy(net, Xte, yte)
     int8_out = net(mx.nd.array(Xte[:256])).asnumpy()

@@ -62,3 +62,8 @@ def test_word_language_model():
     out = _run("word_language_model.py", "--epochs", "1",
                "--batch-size", "8", "--bptt", "4")
     assert out.strip()
+
+
+def test_ctc_ocr():
+    out = _run("ctc_ocr.py", "--smoke")
+    assert "smoke ok" in out
