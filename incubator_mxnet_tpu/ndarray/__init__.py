@@ -25,6 +25,7 @@ from ..ops import registry as _registry
 from . import random  # noqa: F401
 from . import contrib  # noqa: F401
 from . import sparse  # noqa: F401
+from .sparse import cast_storage  # noqa: F401  (mx.nd.cast_storage parity)
 
 __all__ = [
     "NDArray",

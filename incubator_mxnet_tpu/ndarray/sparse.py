@@ -32,7 +32,7 @@ from .ndarray import NDArray
 __all__ = [
     "BaseSparseNDArray", "RowSparseNDArray", "CSRNDArray",
     "row_sparse_array", "csr_matrix", "zeros", "array", "empty",
-    "dot", "add", "retain",
+    "dot", "add", "retain", "cast_storage",
 ]
 
 
@@ -63,6 +63,10 @@ class BaseSparseNDArray:
             return self
         if stype == "default":
             return self.todense()
+        if stype == "row_sparse":
+            return row_sparse_array(self.todense())
+        if stype == "csr":
+            return csr_matrix(self.todense())
         raise ValueError(f"cannot convert {self.stype} to {stype}")
 
     def wait_to_read(self):
@@ -297,3 +301,20 @@ def retain(data, indices):
     keep = _np.where(hit_np)[0]
     return RowSparseNDArray(data.data._data[keep],
                             _np.asarray(stored)[keep], data.shape)
+
+
+def cast_storage(arr, stype):
+    """Convert between storage types (parity: ``mx.nd.cast_storage``,
+    [U:src/operator/tensor/cast_storage.cc]): 'default' ↔ 'row_sparse' /
+    'csr'.  Same-stype casts are identity; all conversion logic lives in
+    ``tostype`` (one implementation for both parity surfaces)."""
+    if stype not in ("default", "row_sparse", "csr"):
+        raise ValueError(f"unknown storage type {stype!r}")
+    current = getattr(arr, "stype", "default")
+    if current == stype:
+        return arr
+    if isinstance(arr, BaseSparseNDArray):
+        return arr.tostype(stype)
+    if stype == "row_sparse":
+        return row_sparse_array(arr)
+    return csr_matrix(arr)

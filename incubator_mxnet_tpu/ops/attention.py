@@ -668,3 +668,73 @@ def fused_kv_attention(q, kv, num_heads=1, causal=False, scale=None):
     out = _attend_bshd(q.reshape(b, sq, h, dh), x[:, :, 0], x[:, :, 1],
                        causal, scale)
     return out.reshape(b, sq, d)
+
+
+# ---------------------------------------------------------------------------
+# interleaved_matmul_* (parity: [U:src/operator/contrib/transformer.cc], the
+# GluonNLP 0.x fused-MHA fast path).  Layout convention: projections are
+# [S, B, H·3·Dh] (self-attn, q/k/v interleaved PER HEAD) or [S, B, H·2·Dh]
+# (enc-dec k/v).  On TPU these are einsum forms — XLA's layout assignment
+# does what the reference's hand-written interleaved GEMMs do by hand.
+# ---------------------------------------------------------------------------
+
+
+def _deinterleave(proj, heads, parts):
+    s, b, hpd = proj.shape
+    if hpd % (heads * parts):
+        raise ValueError(
+            f"interleaved projection width {hpd} is not divisible by "
+            f"heads({heads})×{parts}")
+    dh = hpd // (heads * parts)
+    x = proj.reshape(s, b, heads, parts, dh)
+    return tuple(x[:, :, :, i] for i in range(parts))  # each [S, B, H, Dh]
+
+
+@register("_contrib_interleaved_matmul_selfatt_qk")
+def interleaved_matmul_selfatt_qk(queries_keys_values, heads=1):
+    """scores[B·H, Sq, Sk] = Q·Kᵀ/√Dh from the interleaved projection."""
+    q, k, _ = _deinterleave(queries_keys_values, heads, 3)
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    s = jnp.einsum("qbhd,kbhd->bhqk", q.astype(jnp.float32) * scale,
+                   k.astype(jnp.float32))
+    b, h, sq, sk = s.shape
+    return s.reshape(b * h, sq, sk).astype(queries_keys_values.dtype)
+
+
+@register("_contrib_interleaved_matmul_selfatt_valatt")
+def interleaved_matmul_selfatt_valatt(queries_keys_values, attention, heads=1):
+    """context [S, B, H·Dh] = attention · V with V from the interleaved
+    projection; attention is [B·H, Sq, Sk]."""
+    _, _, v = _deinterleave(queries_keys_values, heads, 3)  # [Sk, B, H, Dh]
+    sk, b, h, dh = v.shape
+    att = attention.reshape(b, h, -1, sk)
+    out = jnp.einsum("bhqk,kbhd->qbhd", att.astype(jnp.float32),
+                     v.astype(jnp.float32))
+    sq = out.shape[0]
+    return out.reshape(sq, b, h * dh).astype(queries_keys_values.dtype)
+
+
+@register("_contrib_interleaved_matmul_encdec_qk")
+def interleaved_matmul_encdec_qk(queries, keys_values, heads=1):
+    """Cross-attention scores from separate Q [Sq, B, H·Dh] and interleaved
+    KV [Sk, B, H·2·Dh]."""
+    sq, b, hd = queries.shape
+    if hd % heads:
+        raise ValueError(f"query width {hd} not divisible by heads({heads})")
+    dh = hd // heads
+    q = queries.reshape(sq, b, heads, dh)
+    k, _ = _deinterleave(keys_values, heads, 2)
+    scale = 1.0 / math.sqrt(dh)
+    s = jnp.einsum("qbhd,kbhd->bhqk", q.astype(jnp.float32) * scale,
+                   k.astype(jnp.float32))
+    return s.reshape(b * heads, sq, -1).astype(queries.dtype)
+
+
+@register("_contrib_interleaved_matmul_encdec_valatt")
+def interleaved_matmul_encdec_valatt(keys_values, attention, heads=1):
+    _, v = _deinterleave(keys_values, heads, 2)  # [Sk, B, H, Dh]
+    sk, b, h, dh = v.shape
+    att = attention.reshape(b, h, -1, sk)
+    out = jnp.einsum("bhqk,kbhd->qbhd", att.astype(jnp.float32),
+                     v.astype(jnp.float32))
+    return out.reshape(out.shape[0], b, h * dh).astype(keys_values.dtype)

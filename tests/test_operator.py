@@ -1156,3 +1156,66 @@ class TestDtypeMatrix:
             pytest.skip("x64 disabled by default in this build (jax default)")
         u = mx.nd._random_uniform(shape=(10,), dtype=dtype)
         assert str(u.dtype) == dtype
+
+
+class TestInterleavedMatmul:
+    """_contrib_interleaved_matmul_* (the GluonNLP fused-MHA fast path,
+    [U:src/operator/contrib/transformer.cc])."""
+
+    @with_seed()
+    def test_selfatt_roundtrip(self):
+        S, B, H, D = 6, 2, 2, 4
+        qkv = np.random.randn(S, B, H * 3 * D).astype(np.float32)
+        sc = mx.nd._contrib_interleaved_matmul_selfatt_qk(_nd(qkv), heads=H)
+        x = qkv.reshape(S, B, H, 3, D)
+        q, k, v = x[:, :, :, 0], x[:, :, :, 1], x[:, :, :, 2]
+        ref = np.einsum("qbhd,kbhd->bhqk", q / np.sqrt(D), k).reshape(B * H, S, S)
+        assert_almost_equal(sc.asnumpy(), ref, rtol=1e-4, atol=1e-5)
+        att = np.exp(ref)
+        att /= att.sum(-1, keepdims=True)
+        ctx = mx.nd._contrib_interleaved_matmul_selfatt_valatt(
+            _nd(qkv), _nd(att), heads=H)
+        ref_ctx = np.einsum("bhqk,kbhd->qbhd", att.reshape(B, H, S, S),
+                            v).reshape(S, B, H * D)
+        assert_almost_equal(ctx.asnumpy(), ref_ctx, rtol=1e-4, atol=1e-5)
+
+    @with_seed()
+    def test_encdec_roundtrip(self):
+        Sq, Sk, B, H, D = 5, 7, 2, 2, 4
+        qx = np.random.randn(Sq, B, H * D).astype(np.float32)
+        kv = np.random.randn(Sk, B, H * 2 * D).astype(np.float32)
+        sc = mx.nd._contrib_interleaved_matmul_encdec_qk(_nd(qx), _nd(kv), heads=H)
+        kvr = kv.reshape(Sk, B, H, 2, D)
+        ref = np.einsum("qbhd,kbhd->bhqk", qx.reshape(Sq, B, H, D) / np.sqrt(D),
+                        kvr[:, :, :, 0]).reshape(B * H, Sq, Sk)
+        assert_almost_equal(sc.asnumpy(), ref, rtol=1e-4, atol=1e-5)
+        att = np.exp(ref)
+        att /= att.sum(-1, keepdims=True)
+        ctx = mx.nd._contrib_interleaved_matmul_encdec_valatt(
+            _nd(kv), _nd(att), heads=H)
+        ref_ctx = np.einsum("bhqk,kbhd->qbhd", att.reshape(B, H, Sq, Sk),
+                            kvr[:, :, :, 1]).reshape(Sq, B, H * D)
+        assert_almost_equal(ctx.asnumpy(), ref_ctx, rtol=1e-4, atol=1e-5)
+
+
+class TestCastStorage:
+    @with_seed()
+    def test_roundtrips(self):
+        dense = np.zeros((4, 5), np.float32)
+        dense[0, 1] = 2.0
+        dense[2, 3] = -1.0
+        d = _nd(dense)
+        csr = mx.nd.cast_storage(d, "csr")
+        assert csr.stype == "csr"
+        assert_almost_equal(csr.asnumpy(), dense, rtol=0, atol=0)
+        rsp = mx.nd.cast_storage(csr, "row_sparse")
+        assert rsp.stype == "row_sparse"
+        assert rsp.indices.asnumpy().tolist() == [0, 2]
+        back = mx.nd.cast_storage(rsp, "default")
+        assert_almost_equal(back.asnumpy(), dense, rtol=0, atol=0)
+        try:
+            mx.nd.cast_storage(d, "coo")
+        except ValueError:
+            pass
+        else:
+            raise AssertionError("expected ValueError for unknown stype")
