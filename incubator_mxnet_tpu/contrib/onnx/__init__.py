@@ -354,10 +354,14 @@ def _get_attr(node, name, default=None):
     for a in node["attribute"]:
         if a["name"] == name:
             t = a["type"]
+            # proto3 omits zero-valued scalar fields on the wire: an
+            # attribute that IS present but carries no i/f field means the
+            # value is 0 (e.g. Clip min=0.0, keepdims=0) — NOT the
+            # caller's absent-attribute default.
             if t == P.ATTR_INT:
-                return a.get("i", default)
+                return a.get("i", 0)
             if t == P.ATTR_FLOAT:
-                return a.get("f", default)
+                return a.get("f", 0.0)
             if t == P.ATTR_INTS:
                 return a["ints"]
             if t == P.ATTR_FLOATS:
@@ -386,6 +390,13 @@ def import_model(model_file):
             env[vi["name"]] = S.var(vi["name"])
     for name, arr in inits.items():
         env[name] = S.var(name)
+
+    def _init_or_reject(name_, what):
+        if name_ not in inits:
+            raise NotImplementedError(
+                f"{what} must be a graph initializer (got the non-constant "
+                f"input {name_!r}; fold Constant nodes first)")
+        return inits[name_]
 
     rev_act = {v: k for k, v in _ACT_MAP.items()}
     rev_elem = {"Add": "broadcast_add", "Sub": "broadcast_sub",
@@ -649,6 +660,100 @@ def import_model(model_file):
             out = sym_mod.transpose(env[node["input"][0]],
                                     axes=tuple(_get_attr(node, "perm", ())),
                                     name=nm)
+        elif op == "Identity":
+            env[node["output"][0]] = env[node["input"][0]]
+            continue
+        elif op == "Cast":
+            to = _get_attr(node, "to", P.TP_FLOAT)
+            if to not in P.TP_TO_DTYPE:
+                raise NotImplementedError(f"Cast to ONNX dtype {to} unsupported")
+            out = sym_mod.Cast(env[node["input"][0]],
+                               dtype=_np.dtype(P.TP_TO_DTYPE[to]).name, name=nm)
+        elif op == "Clip":
+            # opset<11: attrs; opset>=11: optional min/max inputs
+            lo = _get_attr(node, "min", None)
+            hi = _get_attr(node, "max", None)
+            ins = node["input"]
+            if lo is None and len(ins) > 1 and ins[1]:
+                lo = float(_init_or_reject(ins[1], 'Clip min'))
+                _drop_if_unused(ins[1], g, inits, env, folded)
+            if hi is None and len(ins) > 2 and ins[2]:
+                hi = float(_init_or_reject(ins[2], 'Clip max'))
+                _drop_if_unused(ins[2], g, inits, env, folded)
+            out = sym_mod.clip(env[ins[0]],
+                               a_min=-3.4e38 if lo is None else float(lo),
+                               a_max=3.4e38 if hi is None else float(hi),
+                               name=nm)
+        elif op in ("Squeeze", "Unsqueeze"):
+            axes = _get_attr(node, "axes", None)
+            ins = node["input"]
+            if axes is None and len(ins) > 1:  # opset>=13: axes input
+                axes = [int(v) for v in _init_or_reject(ins[1], f'{op} axes')]
+                _drop_if_unused(ins[1], g, inits, env, folded)
+            if axes is None:
+                raise NotImplementedError(f"{op} without axes")
+            x = env[ins[0]]
+            if op == "Squeeze":
+                out = sym_mod.squeeze(x, axis=tuple(axes), name=nm)
+            else:
+                # negative axes index the OUTPUT rank; resolving them needs
+                # the input rank (unavailable without shape inference here)
+                # — reject clearly rather than insert at wrong positions
+                if any(int(a) < 0 for a in axes):
+                    raise NotImplementedError(
+                        "Unsqueeze with negative axes needs the input rank; "
+                        "re-export with non-negative axes")
+                for i, ax in enumerate(sorted(int(a) for a in axes)):
+                    x = sym_mod.expand_dims(x, axis=ax,
+                                            name=f"{nm}_{i}" if len(axes) > 1 else nm)
+                env[node["output"][0]] = x
+                continue
+        elif op == "Pad":
+            mode = _get_attr(node, "mode", b"constant")
+            mode = mode.decode() if isinstance(mode, bytes) else mode
+            pads = _get_attr(node, "pads", None)
+            ins = node["input"]
+            value = _get_attr(node, "value", 0.0)
+            if pads is None and len(ins) > 1:  # opset>=11: pads input
+                pads = [int(v) for v in _init_or_reject(ins[1], 'Pad pads')]
+                _drop_if_unused(ins[1], g, inits, env, folded)
+                if len(ins) > 2 and ins[2]:
+                    value = float(_init_or_reject(ins[2], 'Pad value'))
+                    _drop_if_unused(ins[2], g, inits, env, folded)
+            if pads is None:
+                raise NotImplementedError("Pad without pads")
+            n = len(pads) // 2
+            # ONNX (begins..., ends...) → mx pad_width interleaved
+            width = []
+            for d in range(n):
+                width += [int(pads[d]), int(pads[n + d])]
+            mx_mode = {"constant": "constant", "edge": "edge",
+                       "reflect": "reflect"}.get(mode)
+            if mx_mode is None:
+                raise NotImplementedError(f"Pad mode {mode!r}")
+            out = sym_mod.pad(env[ins[0]], mode=mx_mode,
+                              pad_width=tuple(width),
+                              constant_value=value, name=nm)
+        elif op in ("ReduceMean", "ReduceSum", "ReduceMax", "ReduceMin",
+                    "ReduceProd"):
+            axes = _get_attr(node, "axes", None)
+            ins = node["input"]
+            if axes is None and len(ins) > 1:  # ReduceSum-13: axes input
+                axes = [int(v) for v in _init_or_reject(ins[1], f"{op} axes")]
+                _drop_if_unused(ins[1], g, inits, env, folded)
+            noop_empty = bool(_get_attr(node, "noop_with_empty_axes", 0))
+            if axes is not None and len(axes) == 0:
+                if noop_empty:
+                    env[node["output"][0]] = env[ins[0]]
+                    continue
+                axes = None  # spec: empty axes (noop flag 0) = reduce ALL
+            keep = bool(_get_attr(node, "keepdims", 1))
+            fn = {"ReduceMean": sym_mod.mean, "ReduceSum": sym_mod.sum,
+                  "ReduceMax": sym_mod.max, "ReduceMin": sym_mod.min,
+                  "ReduceProd": sym_mod.prod}[op]
+            out = fn(env[ins[0]],
+                     axis=tuple(axes) if axes is not None else None,
+                     keepdims=keep, name=nm)
         elif op in _REV_UNARY:
             out = getattr(sym_mod, _REV_UNARY[op])(env[node["input"][0]],
                                                    name=nm)

@@ -92,9 +92,13 @@ def enc_attribute(a):
     out = bytearray(_f_bytes(1, a["name"]))
     typ = a["type"]
     if typ == ATTR_FLOAT:
-        out += _f_float(2, a["f"])
+        # proto3 canonical form omits zero-valued scalars; tolerate an
+        # absent field the same way foreign serializers produce it
+        if "f" in a:
+            out += _f_float(2, a["f"])
     elif typ == ATTR_INT:
-        out += _f_varint(3, a["i"])
+        if "i" in a:
+            out += _f_varint(3, a["i"])
     elif typ == ATTR_STRING:
         out += _f_bytes(4, a["s"])
     elif typ == ATTR_TENSOR:

@@ -333,3 +333,97 @@ class TestModelScaleRoundtrip:
         sym2, arg2, aux2 = onnx_mxnet.import_model(path)
         out = fwd(sym2, {**arg2, **aux2})
         np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+
+
+def _foreign_model(tmp_path, nodes, inits, in_shape, name="foreign"):
+    """Assemble a hand-built ONNX model through the wire codec (the
+    foreign-model import fixture)."""
+    from incubator_mxnet_tpu.contrib.onnx import _proto as P
+
+    model = {"ir_version": 8, "opset": 13, "graph": {
+        "name": "g", "node": nodes,
+        "initializer": [
+            {"name": k, "dims": v.shape,
+             "data_type": P.DTYPE_TO_TP[np.dtype(v.dtype)],
+             "raw": np.ascontiguousarray(v).tobytes()}
+            for k, v in inits.items()],
+        "input": [{"name": "data", "elem_type": P.TP_FLOAT, "shape": in_shape}],
+        "output": [{"name": "y", "elem_type": P.TP_FLOAT, "shape": ()}],
+    }}
+    f = str(tmp_path / f"{name}.onnx")
+    with open(f, "wb") as fh:
+        fh.write(P.enc_model(model))
+    return f
+
+
+class TestForeignImportBreadth:
+    """Importers for common foreign-model ops (Clip/Pad/Reduce*/Squeeze/
+    Unsqueeze/Cast/Identity), each against numpy."""
+
+    def test_clip_input_form_and_identity(self, tmp_path):
+        lo = np.array(-0.5, np.float32)
+        hi = np.array(1.0, np.float32)
+        f = _foreign_model(tmp_path, [
+            {"op_type": "Clip", "name": "c", "input": ["data", "lo", "hi"],
+             "output": ["c0"], "attribute": []},
+            {"op_type": "Identity", "name": "i", "input": ["c0"],
+             "output": ["y"], "attribute": []},
+        ], {"lo": lo, "hi": hi}, (2, 4))
+        sym, args, aux = onnx_mxnet.import_model(f)
+        x = np.random.RandomState(0).randn(2, 4).astype(np.float32)
+        out = _bind_forward(sym, args, x)
+        np.testing.assert_allclose(out, np.clip(x, -0.5, 1.0), rtol=1e-6)
+
+    def test_pad_input_form(self, tmp_path):
+        from incubator_mxnet_tpu.contrib.onnx import _proto as P
+
+        pads = np.array([0, 0, 1, 1, 0, 0, 1, 1], np.int64)  # H/W by 1
+        f = _foreign_model(tmp_path, [
+            {"op_type": "Pad", "name": "p", "input": ["data", "pads"],
+             "output": ["y"],
+             "attribute": [{"name": "mode", "type": P.ATTR_STRING,
+                            "s": b"edge"}]},
+        ], {"pads": pads}, (1, 2, 3, 3))
+        sym, args, aux = onnx_mxnet.import_model(f)
+        x = np.random.RandomState(1).rand(1, 2, 3, 3).astype(np.float32)
+        out = _bind_forward(sym, args, x)
+        np.testing.assert_allclose(out, np.pad(x, [(0, 0), (0, 0), (1, 1), (1, 1)],
+                                               mode="edge"), rtol=1e-6)
+
+    def test_reduce_mean_and_sum13(self, tmp_path):
+        from incubator_mxnet_tpu.contrib.onnx import _proto as P
+
+        axes = np.array([1], np.int64)
+        f = _foreign_model(tmp_path, [
+            {"op_type": "ReduceMean", "name": "m", "input": ["data"],
+             "output": ["m0"],
+             "attribute": [{"name": "axes", "type": P.ATTR_INTS, "ints": [2]},
+                           {"name": "keepdims", "type": P.ATTR_INT, "i": 0}]},
+            {"op_type": "ReduceSum", "name": "s", "input": ["m0", "ax"],
+             "output": ["y"],
+             "attribute": [{"name": "keepdims", "type": P.ATTR_INT, "i": 1}]},
+        ], {"ax": axes}, (2, 3, 4))
+        sym, args, aux = onnx_mxnet.import_model(f)
+        x = np.random.RandomState(2).rand(2, 3, 4).astype(np.float32)
+        out = _bind_forward(sym, args, x)
+        np.testing.assert_allclose(out, x.mean(2).sum(1, keepdims=True),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_squeeze_unsqueeze_cast(self, tmp_path):
+        from incubator_mxnet_tpu.contrib.onnx import _proto as P
+
+        f = _foreign_model(tmp_path, [
+            {"op_type": "Unsqueeze", "name": "u", "input": ["data"],
+             "output": ["u0"],
+             "attribute": [{"name": "axes", "type": P.ATTR_INTS, "ints": [0, 3]}]},
+            {"op_type": "Squeeze", "name": "q", "input": ["u0"],
+             "output": ["q0"],
+             "attribute": [{"name": "axes", "type": P.ATTR_INTS, "ints": [0]}]},
+            {"op_type": "Cast", "name": "k", "input": ["q0"], "output": ["y"],
+             "attribute": [{"name": "to", "type": P.ATTR_INT,
+                            "i": P.TP_FLOAT}]},
+        ], {}, (2, 5))
+        sym, args, aux = onnx_mxnet.import_model(f)
+        x = np.random.RandomState(3).rand(2, 5).astype(np.float32)
+        out = _bind_forward(sym, args, x)
+        np.testing.assert_allclose(out, x[:, :, None], rtol=1e-6)
