@@ -285,3 +285,82 @@ def scale_add(x_ref, y_ref, o_ref):
     y = np.random.RandomState(1).rand(128, 256).astype(np.float32)
     z = k.launch([mx.nd.array(x), mx.nd.array(y)])
     np.testing.assert_allclose(z.asnumpy(), 2 * x + y, rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# round-4 op families on the chip (same check_consistency oracle)
+# ---------------------------------------------------------------------------
+
+
+def test_linalg_family_cpu_vs_tpu():
+    spd = np.einsum("ij,kj->ik", *(2 * [np.random.RandomState(0).randn(4, 4).astype(np.float32)])) + 4 * np.eye(4, dtype=np.float32)
+    check_consistency(lambda a: mx.nd.linalg_potrf(a), [spd], rtol=1e-3, atol=1e-3, grad=False)
+    check_consistency(lambda a: mx.nd.linalg_sumlogdiag(
+        mx.nd.linalg_potrf(a)), [spd], rtol=1e-3, atol=1e-3, grad=False)
+    tri = np.tril(np.random.RandomState(1).randn(4, 4)).astype(np.float32)
+    np.fill_diagonal(tri, np.abs(np.diag(tri)) + 2)
+    b = np.random.RandomState(2).randn(4, 3).astype(np.float32)
+    check_consistency(lambda a, bb: mx.nd.linalg_trsm(a, bb), [tri, b],
+                      rtol=1e-3, atol=1e-3)
+    check_consistency(lambda a: mx.nd.linalg_extractdiag(a), [tri],
+                      rtol=0, atol=0)
+
+
+def test_ctc_loss_cpu_vs_tpu():
+    logits = np.random.RandomState(3).randn(6, 2, 5).astype(np.float32)
+    labels = np.array([[1, 2], [3, 0]], np.float32)
+    check_consistency(lambda d: mx.nd.CTCLoss(d, mx.nd.array(labels)),
+                      [logits], rtol=1e-3, atol=1e-3)
+
+
+def test_spatial_family_cpu_vs_tpu():
+    x = np.random.RandomState(4).rand(1, 2, 8, 8).astype(np.float32)
+    rois = np.array([[0, 1, 1, 6, 6]], np.float32)
+    check_consistency(
+        lambda d: mx.nd.ROIPooling(d, mx.nd.array(rois), pooled_size=(2, 2),
+                                   spatial_scale=1.0), [x],
+        rtol=1e-3, atol=1e-4)
+    check_consistency(
+        lambda d: mx.nd._contrib_ROIAlign(d, mx.nd.array(rois),
+                                          pooled_size=(2, 2),
+                                          spatial_scale=1.0, sample_ratio=2),
+        [x], rtol=1e-3, atol=1e-4)
+    theta = np.array([[1, 0, 0.2, 0, 1, -0.1]], np.float32)
+    check_consistency(
+        lambda d, t: mx.nd.SpatialTransformer(d, t, target_shape=(8, 8)),
+        [x, theta], rtol=1e-3, atol=1e-4)
+    check_consistency(
+        lambda d: mx.nd._contrib_AdaptiveAvgPooling2D(d, output_size=(3, 3)),
+        [x], rtol=1e-3, atol=1e-4)
+
+
+def test_new_optimizer_kernels_on_tpu():
+    """nadam/ftml/adamax fused kernels on the chip vs the same kernels on
+    CPU — the file's cpu-vs-tpu oracle, applied at the kernel level."""
+    import jax
+    import jax.numpy as jnp
+
+    from incubator_mxnet_tpu.ops import optimizer_ops as K
+
+    w = np.random.RandomState(5).randn(8, 4).astype(np.float32)
+    g = np.random.RandomState(6).randn(8, 4).astype(np.float32)
+    z = np.zeros_like(w)
+    cpu = jax.local_devices(backend="cpu")[0]
+    hyper = [jnp.float32(v) for v in (0.01, 0.0, 1.0, np.inf)]
+
+    def run(kernel, arrays, extra):
+        tpu_out = kernel(*[jnp.asarray(a) for a in arrays], *hyper, *extra)
+        with jax.default_device(cpu):
+            cpu_out = kernel(*[jnp.asarray(a) for a in arrays], *hyper, *extra)
+        for t, c in zip(tpu_out, cpu_out):
+            np.testing.assert_allclose(np.asarray(t), np.asarray(c),
+                                       rtol=2e-3, atol=2e-4)
+
+    run(K.nadam_update, [w, g, z, z, np.ones((), np.float32)],
+        [jnp.float32(0.9), jnp.float32(0.999), jnp.float32(1e-8),
+         jnp.float32(1), jnp.float32(0.004)])
+    run(K.ftml_update, [w, g, z, z, z],
+        [jnp.float32(0.6), jnp.float32(0.999), jnp.float32(1e-8),
+         jnp.float32(1)])
+    run(K.adamax_update, [w, g, z, z],
+        [jnp.float32(0.9), jnp.float32(0.999)])
