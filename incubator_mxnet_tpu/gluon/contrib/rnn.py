@@ -18,7 +18,8 @@ from __future__ import annotations
 
 from ..rnn.rnn_cell import RecurrentCell, _ModifierCell
 
-__all__ = ["VariationalDropoutCell", "LSTMPCell"]
+__all__ = ["VariationalDropoutCell", "LSTMPCell", "Conv2DRNNCell",
+           "Conv2DLSTMCell", "Conv2DGRUCell"]
 
 
 class VariationalDropoutCell(_ModifierCell):
@@ -153,3 +154,148 @@ class LSTMPCell(RecurrentCell):
 
     def __repr__(self):
         return (f"LSTMPCell({self._hidden_size} -> {self._projection_size})")
+
+
+class _ConvRNNBase(RecurrentCell):
+    """Shared machinery for the convolutional recurrent cells (parity:
+    [U:python/mxnet/gluon/contrib/rnn/conv_rnn_cell.py]).  2-D variants
+    (the Conv2D*Cell family): inputs [B, C, H, W].  Upstream conventions:
+    ``i2h_pad`` defaults to VALID (0, 0) padding — the state's H/W is the
+    i2h conv's output size — while the h2h conv is auto-'same'-padded over
+    the state (odd h2h kernels required, as upstream's auto-pad assumes)."""
+
+    def __init__(self, input_shape, hidden_channels, i2h_kernel, h2h_kernel,
+                 n_gates, i2h_pad=(0, 0), activation="tanh", prefix=None,
+                 params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._input_shape = tuple(input_shape)  # (C, H, W)
+        self._hc = hidden_channels
+        self._gates = n_gates
+        self._activation = activation
+
+        def _pair(k):
+            return (k, k) if isinstance(k, int) else tuple(k)
+
+        self._i2h_kernel = _pair(i2h_kernel)
+        self._h2h_kernel = _pair(h2h_kernel)
+        for k in self._h2h_kernel:
+            if k % 2 == 0:
+                raise ValueError(
+                    f"Conv cells need odd h2h kernels for same-padding, got {k}")
+        self._i2h_pad = _pair(i2h_pad)
+        self._h2h_pad = tuple(k // 2 for k in self._h2h_kernel)
+        # state spatial dims = i2h conv output dims (upstream convention)
+        self._state_hw = tuple(
+            d + 2 * p - k + 1 for d, p, k in zip(
+                self._input_shape[1:], self._i2h_pad, self._i2h_kernel))
+        c = self._input_shape[0]
+        with self.name_scope():
+            self.i2h_weight = self.params.get(
+                "i2h_weight",
+                shape=(n_gates * hidden_channels, c) + self._i2h_kernel)
+            self.h2h_weight = self.params.get(
+                "h2h_weight",
+                shape=(n_gates * hidden_channels, hidden_channels) + self._h2h_kernel)
+            self.i2h_bias = self.params.get(
+                "i2h_bias", shape=(n_gates * hidden_channels,), init="zeros")
+            self.h2h_bias = self.params.get(
+                "h2h_bias", shape=(n_gates * hidden_channels,), init="zeros")
+
+    def state_info(self, batch_size=0):
+        h, w = self._state_hw
+        return [{"shape": (batch_size, self._hc, h, w), "__layout__": "NCHW"}
+                for _ in range(self._n_states)]
+
+    def _convs(self, F, inputs, state, i2h_weight, h2h_weight, i2h_bias, h2h_bias):
+        i2h = F.Convolution(inputs, i2h_weight, i2h_bias,
+                            kernel=self._i2h_kernel, pad=self._i2h_pad,
+                            num_filter=self._gates * self._hc)
+        h2h = F.Convolution(state, h2h_weight, h2h_bias,
+                            kernel=self._h2h_kernel, pad=self._h2h_pad,
+                            num_filter=self._gates * self._hc)
+        return i2h, h2h
+
+
+class Conv2DRNNCell(_ConvRNNBase):
+    """Convolutional vanilla RNN cell (parity: ``contrib.rnn.Conv2DRNNCell``)."""
+
+    _n_states = 1
+
+    def __init__(self, input_shape, hidden_channels, i2h_kernel=(3, 3),
+                 h2h_kernel=(3, 3), i2h_pad=(0, 0), activation="tanh",
+                 prefix=None, params=None):
+        super().__init__(input_shape, hidden_channels, i2h_kernel, h2h_kernel,
+                         n_gates=1, i2h_pad=i2h_pad,
+                         activation=activation, prefix=prefix, params=params)
+
+    def _alias(self):
+        return "conv_rnn"
+
+    def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight,
+                       i2h_bias, h2h_bias):
+        i2h, h2h = self._convs(F, inputs, states[0], i2h_weight, h2h_weight,
+                               i2h_bias, h2h_bias)
+        out = self._get_activation(F, i2h + h2h, self._activation)
+        return out, [out]
+
+
+class Conv2DLSTMCell(_ConvRNNBase):
+    """ConvLSTM (Shi et al. 2015; parity: ``contrib.rnn.Conv2DLSTMCell``);
+    gate order [i, f, g, o] like :class:`LSTMCell`."""
+
+    _n_states = 2
+
+    def __init__(self, input_shape, hidden_channels, i2h_kernel=(3, 3),
+                 h2h_kernel=(3, 3), i2h_pad=(0, 0), activation="tanh",
+                 prefix=None, params=None):
+        super().__init__(input_shape, hidden_channels, i2h_kernel, h2h_kernel,
+                         n_gates=4, i2h_pad=i2h_pad,
+                         activation=activation, prefix=prefix, params=params)
+
+    def _alias(self):
+        return "conv_lstm"
+
+    def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight,
+                       i2h_bias, h2h_bias):
+        prev_h, prev_c = states
+        i2h, h2h = self._convs(F, inputs, prev_h, i2h_weight, h2h_weight,
+                               i2h_bias, h2h_bias)
+        gates = i2h + h2h
+        sl = F.split(gates, num_outputs=4, axis=1)
+        i = F.sigmoid(sl[0])
+        f = F.sigmoid(sl[1])
+        g = self._get_activation(F, sl[2], self._activation)
+        o = F.sigmoid(sl[3])
+        next_c = f * prev_c + i * g
+        next_h = o * self._get_activation(F, next_c, self._activation)
+        return next_h, [next_h, next_c]
+
+
+class Conv2DGRUCell(_ConvRNNBase):
+    """ConvGRU (parity: ``contrib.rnn.Conv2DGRUCell``); gate order
+    [r, z, n] like :class:`GRUCell`."""
+
+    _n_states = 1
+
+    def __init__(self, input_shape, hidden_channels, i2h_kernel=(3, 3),
+                 h2h_kernel=(3, 3), i2h_pad=(0, 0), activation="tanh",
+                 prefix=None, params=None):
+        super().__init__(input_shape, hidden_channels, i2h_kernel, h2h_kernel,
+                         n_gates=3, i2h_pad=i2h_pad,
+                         activation=activation, prefix=prefix, params=params)
+
+    def _alias(self):
+        return "conv_gru"
+
+    def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight,
+                       i2h_bias, h2h_bias):
+        prev_h = states[0]
+        i2h, h2h = self._convs(F, inputs, prev_h, i2h_weight, h2h_weight,
+                               i2h_bias, h2h_bias)
+        i_sl = F.split(i2h, num_outputs=3, axis=1)
+        h_sl = F.split(h2h, num_outputs=3, axis=1)
+        r = F.sigmoid(i_sl[0] + h_sl[0])
+        z = F.sigmoid(i_sl[1] + h_sl[1])
+        n = self._get_activation(F, i_sl[2] + r * h_sl[2], self._activation)
+        next_h = (1 - z) * n + z * prev_h
+        return next_h, [next_h]

@@ -298,6 +298,19 @@ def adamax_update(weight, grad, mean, inf_norm, lr, wd, rescale, clip, beta1, be
     return (weight.astype(jnp.float32) - upd).astype(weight.dtype), new_mean, new_inf
 
 
+@jax.jit
+def group_adagrad_update(weight, grad, history, lr, rescale, clip, eps):
+    """GroupAdaGrad ([U:src/operator/contrib/optimizer_op.cc]): AdaGrad
+    with ONE accumulated statistic per row (group) instead of per element
+    — the embedding-table optimizer."""
+    g = jnp.clip(grad.astype(jnp.float32) * rescale, -clip, clip)
+    row_sq = jnp.mean(jnp.square(g), axis=tuple(range(1, g.ndim)),
+                      keepdims=True)
+    new_hist = history + row_sq
+    upd = lr * g / (jnp.sqrt(new_hist) + eps)
+    return (weight.astype(jnp.float32) - upd).astype(weight.dtype), new_hist
+
+
 # -- multi-tensor (grouped) updates -----------------------------------------
 # Parity: [U:src/operator/optimizer_op.cc] multi_sgd_update /
 # multi_sgd_mom_update / multi_mp_sgd_* — ONE fused kernel updating a whole
@@ -387,7 +400,8 @@ def _register_public_ops():
         nag_mom_update, mp_nag_mom_update,
         adam_update, adam_lazy_update, mp_adam_update, adamw_update,
         nadam_update, ftml_update, sgld_update, dcasgd_update, adamax_update,
-        rmsprop_update, rmspropalex_update, adagrad_update, adadelta_update,
+        rmsprop_update, rmspropalex_update, adagrad_update,
+        group_adagrad_update, adadelta_update,
         ftrl_update, signum_update, lamb_update_phase1, lamb_update_phase2,
         multi_sgd_update, multi_sgd_mom_update, multi_mp_sgd_update,
         multi_mp_sgd_mom_update, multi_sum_sq, multi_lars, all_finite,

@@ -860,6 +860,38 @@ class LBSGD(Optimizer):
             _swap(state, new_mom)
 
 
+@register
+class GroupAdaGrad(Optimizer):
+    """Per-row (grouped) AdaGrad for embedding-style parameters (parity:
+    [U:python/mxnet/optimizer/contrib.py] GroupAdaGrad): one accumulated
+    statistic per row instead of per element — 1/dim the optimizer state
+    of AdaGrad for [vocab, dim] tables."""
+
+    def __init__(self, learning_rate=0.01, eps=1e-5, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.float_stable_eps = eps
+
+    def create_state(self, index, weight):
+        return zeros((weight.shape[0],) + (1,) * (len(weight.shape) - 1),
+                     dtype="float32", ctx=weight.ctx)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        assert self._get_wd(index) == 0.0, "GroupAdaGrad has no wd (parity)"
+        new_w, new_hist = K.group_adagrad_update(
+            weight._data,
+            grad._data,
+            state._data,
+            _f32(lr),
+            _f32(self.rescale_grad),
+            _f32(self.clip_gradient),
+            _f32(self.float_stable_eps),
+        )
+        _swap(weight, new_w)
+        _swap(state, new_hist)
+
+
 class Updater:
     """KVStore-side updater closure (parity: ``mx.optimizer.get_updater`` /
     the serialized optimizer shipped to dist servers)."""

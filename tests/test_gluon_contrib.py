@@ -141,3 +141,80 @@ class TestContribRNN:
             g = p.grad().asnumpy()
             assert np.isfinite(g).all(), name
         assert np.abs(cell.h2r_weight.grad().asnumpy()).sum() > 0
+
+
+class TestConvRNNCells:
+    """contrib.rnn Conv2D{RNN,LSTM,GRU}Cell (parity:
+    [U:python/mxnet/gluon/contrib/rnn/conv_rnn_cell.py])."""
+
+    def test_conv_lstm_matches_gate_math(self):
+        """One ConvLSTM step re-derived through mx.nd.Convolution + the
+        LSTM gate equations must match the cell exactly."""
+        from incubator_mxnet_tpu.gluon.contrib.rnn import Conv2DLSTMCell
+
+        mx.random.seed(3)
+        cell = Conv2DLSTMCell(input_shape=(2, 5, 5), hidden_channels=3,
+                              i2h_pad=(1, 1))
+        cell.initialize()
+        rng = np.random.RandomState(3)
+        x = mx.nd.array(rng.rand(2, 2, 5, 5).astype(np.float32))
+        h0 = mx.nd.array(rng.rand(2, 3, 5, 5).astype(np.float32))
+        c0 = mx.nd.array(rng.rand(2, 3, 5, 5).astype(np.float32))
+        out, (h1, c1) = cell(x, [h0, c0])
+
+        i2h = mx.nd.Convolution(x, cell.i2h_weight.data(), cell.i2h_bias.data(),
+                                kernel=(3, 3), pad=(1, 1), num_filter=12)
+        h2h = mx.nd.Convolution(h0, cell.h2h_weight.data(), cell.h2h_bias.data(),
+                                kernel=(3, 3), pad=(1, 1), num_filter=12)
+        g = (i2h + h2h).asnumpy()
+        sig = lambda v: 1 / (1 + np.exp(-v))
+        i, f, gg, o = np.split(g, 4, axis=1)
+        c_ref = sig(f) * c0.asnumpy() + sig(i) * np.tanh(gg)
+        h_ref = sig(o) * np.tanh(c_ref)
+        np.testing.assert_allclose(h1.asnumpy(), h_ref, rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(c1.asnumpy(), c_ref, rtol=1e-4, atol=1e-5)
+
+    def test_cells_unroll_and_train(self):
+        from incubator_mxnet_tpu.gluon.contrib.rnn import (
+            Conv2DGRUCell, Conv2DLSTMCell, Conv2DRNNCell)
+
+        for Cell in (Conv2DRNNCell, Conv2DLSTMCell, Conv2DGRUCell):
+            mx.random.seed(1)
+            cell = Cell(input_shape=(1, 4, 4), hidden_channels=2, i2h_pad=(1, 1))
+            cell.initialize()
+            seq = [mx.nd.array(np.random.RandomState(i).rand(2, 1, 4, 4)
+                               .astype(np.float32)) for i in range(3)]
+            outs, states = cell.unroll(3, seq, merge_outputs=False)
+            assert len(outs) == 3 and outs[-1].shape == (2, 2, 4, 4)
+            # grads flow to both conv weights through the unrolled graph
+            with mx.autograd.record():
+                outs, _ = cell.unroll(3, seq, merge_outputs=False)
+                loss = outs[-1].sum()
+            loss.backward()
+            i2h_g = cell.i2h_weight.grad().asnumpy()
+            h2h_g = cell.h2h_weight.grad().asnumpy()
+            assert np.abs(i2h_g).sum() > 0 and np.abs(h2h_g).sum() > 0, Cell
+
+    def test_upstream_valid_padding_default(self):
+        """Default i2h_pad=(0,0): the state H/W is the i2h conv OUTPUT size
+        (upstream convention — 16x16 input, 3x3 kernel -> 14x14 state)."""
+        from incubator_mxnet_tpu.gluon.contrib.rnn import Conv2DRNNCell
+
+        cell = Conv2DRNNCell(input_shape=(3, 16, 16), hidden_channels=8)
+        assert cell.state_info(2)[0]["shape"] == (2, 8, 14, 14)
+        cell.initialize()
+        x = mx.nd.array(np.random.RandomState(0).rand(2, 3, 16, 16)
+                        .astype(np.float32))
+        out, (h1,) = cell(x, cell.begin_state(batch_size=2))
+        assert out.shape == (2, 8, 14, 14)
+
+    def test_even_kernel_rejected(self):
+        from incubator_mxnet_tpu.gluon.contrib.rnn import Conv2DRNNCell
+
+        try:
+            Conv2DRNNCell(input_shape=(1, 4, 4), hidden_channels=2,
+                          h2h_kernel=(2, 2))
+        except ValueError as e:
+            assert "odd h2h" in str(e)
+        else:
+            raise AssertionError("expected ValueError for even kernel")

@@ -1219,3 +1219,29 @@ class TestCastStorage:
             pass
         else:
             raise AssertionError("expected ValueError for unknown stype")
+
+
+class TestGroupAdaGrad:
+    @with_seed()
+    def test_matches_reference_recurrence(self):
+        from incubator_mxnet_tpu import optimizer as opt_mod
+
+        lr, eps = 0.1, 1e-5
+        np.random.seed(21)
+        w0 = np.random.randn(6, 4).astype(np.float64)
+        opt = opt_mod.create("groupadagrad", learning_rate=lr, eps=eps)
+        updater = opt_mod.get_updater(opt)
+        w = _nd(w0.astype(np.float32))
+        grads = [np.random.randn(6, 4).astype(np.float64) for _ in range(4)]
+        for g in grads:
+            updater(0, _nd(g.astype(np.float32)), w)
+        wn = w0.copy()
+        hist = np.zeros((6, 1))
+        for g in grads:
+            hist = hist + (g ** 2).mean(axis=1, keepdims=True)
+            wn -= lr * g / (np.sqrt(hist) + eps)
+        assert_almost_equal(w.asnumpy(), wn.astype(np.float32),
+                            rtol=1e-4, atol=1e-5)
+        # state is per-row: 1/dim the elementwise AdaGrad state
+        st = opt.create_state(0, _nd(w0.astype(np.float32)))
+        assert st.shape == (6, 1)
