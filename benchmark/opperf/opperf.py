@@ -23,9 +23,21 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
 if os.environ.get("MXNET_OPPERF_CTX", "cpu") == "cpu":
-    # force CPU even when the ambient env points at a tunneled device
+    # force CPU even when the ambient env points at a tunneled device.
+    # Env vars alone are NOT enough: sitecustomize registers the axon
+    # plugin before this line runs, so deregister it in-process (the
+    # tests/conftest.py pattern) or every per-op compile rides the tunnel.
     os.environ["JAX_PLATFORMS"] = "cpu"
     os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    try:
+        from jax._src import xla_bridge as _xb
+
+        _xb._backend_factories.pop("axon", None)
+    except Exception:
+        pass
 
 import numpy as np
 
@@ -60,12 +72,41 @@ def _cases(rng, large):
         ("Embedding", lambda: (rng.randint(0, D, (B, 16)).astype(np.int32), t(D, 64)), False, None),
         ("Dropout", lambda: (t(B, D),), False, _dropout_fn),
         ("fused_attention", lambda: (t(B, 16, D), t(B, 16, D), t(B, 16, D)), True, None),
+        # round-4 families
+        ("linalg_potrf", lambda: (_gram(t(D // 4, D // 4)),), True, None),
+        ("linalg_trsm", lambda: (np.tril(t(D // 4, D // 4)) + 2 * np.eye(D // 4, dtype=f), t(D // 4, D // 4)), True, None),
+        ("CTCLoss", lambda: (t(16, B, 32), np.tile(np.arange(1, 6, dtype=f), (B, 1))), True, None),
+        ("ROIPooling", lambda: (t(B, C, H, W), np.tile(np.array([0, 1, 1, H - 2, W - 2], f), (8, 1))), True, None),
+        ("_contrib_ROIAlign", lambda: (t(B, C, H, W), np.tile(np.array([0, 1, 1, H - 2, W - 2], f), (8, 1))), True, None),
+        ("_contrib_AdaptiveAvgPooling2D", lambda: (t(B, C, H, W),), True, None),
+        ("im2col", lambda: (t(B, C, H, W),), True, None),
+        ("masked_softmax", lambda: (t(B, D), rng.rand(B, D) > 0.2), True, None),
+        ("_sample_normal", lambda: (t(B), t(B)), False, _sample_normal_fn),
     ]
 
 
 _KW = {"Convolution": {"kernel": (3, 3), "num_filter": 0, "pad": (1, 1)},
        "Pooling": {"kernel": (2, 2), "stride": (2, 2)},
-       "fused_attention": {"num_heads": 4}}
+       "fused_attention": {"num_heads": 4},
+       "ROIPooling": {"pooled_size": (7, 7), "spatial_scale": 1.0},
+       "_contrib_ROIAlign": {"pooled_size": (7, 7), "spatial_scale": 1.0,
+                             "sample_ratio": 2},
+       "_contrib_AdaptiveAvgPooling2D": {"output_size": (7, 7)},
+       "im2col": {"kernel": (3, 3), "stride": (1, 1), "pad": (1, 1)}}
+
+
+def _sample_normal_fn(mu, sigma):
+    import jax
+
+    from incubator_mxnet_tpu.ops.registry import get_op
+
+    return get_op("_sample_normal").fn(mu, sigma, shape=(64,),
+                                       key=jax.random.PRNGKey(0))
+
+
+def _gram(x):
+    """SPD input for the Cholesky benchmarks (A·Aᵀ + 4I)."""
+    return (x @ x.T + 4 * np.eye(x.shape[0], dtype=x.dtype)).astype(x.dtype)
 
 
 def _dropout_fn(x):
