@@ -22,12 +22,58 @@ throughput (same provenance caveat).
 """
 import json
 import os
+import sys
 import time
 
 import numpy as np
 
 BASELINE_SAMPLES_PER_SEC = 100.0
 BASELINE_RESNET50_IMG_PER_SEC = 1400.0
+
+
+def _emit_error(exc):
+    """Structured one-line error JSON: a transient tunnel wedge must degrade
+    to a parseable record, not an rc=1 traceback (the round-4 bench evidence
+    died exactly that way — at backend init, through no fault of the
+    workload)."""
+    mode = os.environ.get("MXNET_TPU_BENCH") or "bert_base"
+    print(json.dumps({
+        "metric": mode, "value": None, "unit": None, "vs_baseline": None,
+        "error": f"{type(exc).__name__}: {exc}"[:800],
+    }))
+
+
+def _probe_backend(deadline_s):
+    """Bounded wait-for-backend.  The probe runs in a CHILD process because a
+    wedged axon tunnel can either raise at init or hang forever, and a failed
+    init poisons jax's in-process backend cache; a subprocess bounds both and
+    leaves this process's backend state untouched.  Polls with backoff up to
+    ``deadline_s`` (default 10 min) before giving up."""
+    import subprocess
+
+    code = ("import jax, numpy as np; x = jax.numpy.ones((8, 8)); "
+            "assert float(np.asarray(x.sum())) == 64.0; "
+            "print('BACKEND_OK', jax.default_backend())")
+    t0 = time.monotonic()
+    delay, last = 5.0, "never probed"
+    while True:
+        try:
+            r = subprocess.run([sys.executable, "-c", code],
+                               capture_output=True, text=True, timeout=180)
+            if r.returncode == 0 and "BACKEND_OK" in r.stdout:
+                print(r.stdout.strip(), file=sys.stderr)
+                return
+            last = (r.stderr or r.stdout).strip()[-500:]
+        except subprocess.TimeoutExpired:
+            last = "probe timed out after 180s (tunnel hang)"
+        waited = time.monotonic() - t0
+        if waited > deadline_s:
+            raise RuntimeError(
+                f"backend unavailable after {int(waited)}s; last: {last}")
+        print(f"bench: backend not ready ({last.splitlines()[-1] if last else '?'}); "
+              f"retrying in {delay:.0f}s", file=sys.stderr)
+        time.sleep(delay)
+        delay = min(delay * 1.7, 60.0)
 
 
 def _fence(trainer, loss):
@@ -469,4 +515,22 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    import signal
+
+    watchdog = int(os.environ.get("MXNET_TPU_BENCH_TIMEOUT", "3000"))
+
+    def _alarm(signum, frame):
+        raise TimeoutError(f"bench exceeded {watchdog}s watchdog")
+
+    try:
+        signal.signal(signal.SIGALRM, _alarm)
+        signal.alarm(watchdog)
+        if os.environ.get("MXNET_TPU_BENCH_SKIP_PROBE") != "1":
+            _probe_backend(float(os.environ.get("MXNET_TPU_BENCH_BACKEND_WAIT", "600")))
+        main()
+        signal.alarm(0)
+    except Exception as e:
+        import traceback
+        traceback.print_exc(file=sys.stderr)
+        _emit_error(e)
+        sys.exit(0)

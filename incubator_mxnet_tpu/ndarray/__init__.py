@@ -53,16 +53,26 @@ def _unwrap_nested(x):
     return x
 
 
+def _rewrap_nested(x):
+    import jax
+    if isinstance(x, (jax.Array,)):
+        return NDArray(x)
+    if isinstance(x, (list, tuple)):
+        return type(x)(_rewrap_nested(e) for e in x)
+    return x
+
+
 def _make_wrapper(op):
     if not op.wrap_ndarray:
         # raw kernels (multi-tensor optimizer updates, all_finite …): accept
-        # NDArrays anywhere — including inside list arguments — but return
-        # the function's own structure (lists of arrays) unwrapped; these
-        # are utility ops whose outputs feed more kernels, not the tape.
+        # NDArrays anywhere — including inside list arguments — and return
+        # the function's own structure with arrays wrapped back as NDArrays
+        # (the reference's mx.nd.*_update return NDArrays); these bypass the
+        # autograd tape — they are terminal update kernels, not graph nodes.
         def raw_wrapper(*args, **kwargs):
             args = [_unwrap_nested(a) for a in args]
             kwargs = {k: _unwrap_nested(v) for k, v in kwargs.items()}
-            return op.fn(*args, **kwargs)
+            return _rewrap_nested(op.fn(*args, **kwargs))
 
         raw_wrapper.__name__ = op.name
         raw_wrapper.__qualname__ = f"nd.{op.name}"

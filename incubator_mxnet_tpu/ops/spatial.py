@@ -93,17 +93,23 @@ def index_copy(old, index, new):
 
 @register("arange_like", differentiable=False)
 def arange_like(data, start=0.0, step=1.0, repeat=1, axis=None):
+    """arange shaped by ``data`` ([U:src/operator/tensor/init_op.cc]
+    _contrib_arange_like): output size is fixed by data (full shape for
+    axis=None, ``data.shape[axis]`` otherwise); ``repeat`` packs
+    ``size // repeat`` distinct values, each repeated, into that size."""
+    repeat = max(1, int(repeat))
+
+    def _ramp(size):
+        n_distinct = -(-size // repeat)  # ceil
+        vals = start + step * jnp.arange(n_distinct, dtype=jnp.float32)
+        return jnp.repeat(vals, repeat)[:size]
+
     if axis is None:
         n = 1
         for s in data.shape:
             n *= s
-        out = start + step * jnp.arange(n, dtype=jnp.float32)
-        return jnp.repeat(out, repeat).reshape(data.shape) if repeat != 1 else out.reshape(data.shape)
-    n = data.shape[axis]
-    out = start + step * jnp.arange(n, dtype=jnp.float32)
-    if repeat != 1:
-        out = jnp.repeat(out, repeat)
-    return out
+        return _ramp(n).reshape(data.shape)
+    return _ramp(data.shape[axis])
 
 
 # ---------------------------------------------------------------------------
@@ -300,10 +306,12 @@ def roi_pooling(data, rois, pooled_size=(1, 1), spatial_scale=1.0):
     B, C, H, W = data.shape
     R = rois.shape[0]
     batch_idx = rois[:, 0].astype(jnp.int32)
-    x1 = jnp.round(rois[:, 1] * spatial_scale)
-    y1 = jnp.round(rois[:, 2] * spatial_scale)
-    x2 = jnp.round(rois[:, 3] * spatial_scale)
-    y2 = jnp.round(rois[:, 4] * spatial_scale)
+    # half-away-from-zero, as the reference rounds (not banker's)
+    _round = lambda v: jnp.sign(v) * jnp.floor(jnp.abs(v) + 0.5)
+    x1 = _round(rois[:, 1] * spatial_scale)
+    y1 = _round(rois[:, 2] * spatial_scale)
+    x2 = _round(rois[:, 3] * spatial_scale)
+    y2 = _round(rois[:, 4] * spatial_scale)
     roi_h = jnp.maximum(y2 - y1 + 1.0, 1.0)
     roi_w = jnp.maximum(x2 - x1 + 1.0, 1.0)
 

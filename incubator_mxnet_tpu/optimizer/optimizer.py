@@ -820,16 +820,19 @@ class LBSGD(Optimizer):
         return zeros(weight.shape, dtype="float32", ctx=weight.ctx)
 
     def _warmup_scale(self, t):
-        """Ramp 1 → batch_scale over the warmup window (×1 at t=0 would
-        stall LARS runs; the reference ramps from the base lr the same
-        way), shaped by the warmup strategy."""
+        """Ramp 1 → batch_scale over the warmup window, shaped by the
+        warmup strategy.  Exactly 1.0 when batch_scale <= 1 (the
+        reference's _get_lbmult multiplier never drops the rate below
+        the base lr)."""
+        if self.batch_scale <= 1.0:
+            return 1.0
         total = self.warmup_epochs * self.updates_per_epoch
         frac = jnp.minimum(_f32(t) / float(total), 1.0)
         if self.warmup_strategy == "power2":
             frac = frac * frac
         elif self.warmup_strategy == "sqrt":
             frac = jnp.sqrt(frac)
-        return 1.0 + (self.batch_scale - 1.0) * frac if self.batch_scale > 1.0 else frac
+        return 1.0 + (self.batch_scale - 1.0) * frac
 
     def _lars_ratio(self, weight, grad, wd):
         w32 = weight._data.astype(jnp.float32)
@@ -843,9 +846,12 @@ class LBSGD(Optimizer):
         self._update_count(index)
         lr, wd = self._get_lr(index), self._get_wd(index)
         t = self._index_update_count[index]
-        lr = _f32(lr) * self._warmup_scale(t)
         if self.warmup_strategy == "lars":
-            lr = lr * self._lars_ratio(weight, grad, wd)
+            # the reference uses the LARS trust ratio *instead of* the
+            # warmup multiplier, not on top of it
+            lr = _f32(lr) * self._lars_ratio(weight, grad, wd)
+        else:
+            lr = _f32(lr) * self._warmup_scale(t)
         if state is None:
             new_w = K.sgd_update(
                 weight._data, grad._data, lr, _f32(wd),

@@ -577,18 +577,29 @@ class TestNewOptimizers:
     def test_lbsgd_warmup(self):
         from incubator_mxnet_tpu import optimizer as opt_mod
 
-        # linear warmup: after half the warmup updates, effective lr ≈ lr/2
-        opt = opt_mod.create("lbsgd", learning_rate=1.0, momentum=0.0,
+        # batch_scale=1: the multiplier is exactly 1.0 — lr never drops
+        # below base (the reference _get_lbmult contract)
+        opt = opt_mod.create("lbsgd", learning_rate=0.5, momentum=0.0,
                              warmup_strategy="linear", warmup_epochs=1,
                              updates_per_epoch=10)
         updater = opt_mod.get_updater(opt)
         w = _nd(np.ones(4, np.float32))
         g = np.ones(4, np.float32)
-        updater(0, _nd(g), w)  # t=1 → scale 0.1
-        assert_almost_equal(w.asnumpy(), np.full(4, 1.0 - 0.1, np.float32),
+        updater(0, _nd(g), w)  # full base lr from step 1
+        assert_almost_equal(w.asnumpy(), np.full(4, 1.0 - 0.5, np.float32),
                             rtol=1e-5, atol=1e-6)
-        updater(0, _nd(g), w)  # t=2 → scale 0.2
-        assert_almost_equal(w.asnumpy(), np.full(4, 0.9 - 0.2, np.float32),
+
+        # batch_scale=2: linear ramp 1 → 2 over the warmup window
+        opt = opt_mod.create("lbsgd", learning_rate=1.0, momentum=0.0,
+                             warmup_strategy="linear", warmup_epochs=1,
+                             updates_per_epoch=10, batch_scale=2)
+        updater = opt_mod.get_updater(opt)
+        w = _nd(np.ones(4, np.float32))
+        updater(0, _nd(g), w)  # t=1 → scale 1 + 0.1 = 1.1
+        assert_almost_equal(w.asnumpy(), np.full(4, 1.0 - 1.1, np.float32),
+                            rtol=1e-5, atol=1e-6)
+        updater(0, _nd(g), w)  # t=2 → scale 1.2
+        assert_almost_equal(w.asnumpy(), np.full(4, -0.1 - 1.2, np.float32),
                             rtol=1e-5, atol=1e-6)
 
     @with_seed()
@@ -678,6 +689,15 @@ class TestSpatialOps:
         assert full.asnumpy().ravel()[-1] == 23
         ax = mx.nd.arange_like(x, axis=1, start=5, step=2)
         assert_almost_equal(ax.asnumpy(), np.array([5, 7, 9], np.float32), rtol=0, atol=0)
+        # repeat: output size stays fixed by data; size//repeat distinct values
+        rep = mx.nd.arange_like(mx.nd.zeros((2, 3)), repeat=2)
+        assert rep.shape == (2, 3)
+        assert_almost_equal(rep.asnumpy().ravel(),
+                            np.array([0, 0, 1, 1, 2, 2], np.float32),
+                            rtol=0, atol=0)
+        rax = mx.nd.arange_like(mx.nd.zeros((2, 4)), axis=1, repeat=2)
+        assert_almost_equal(rax.asnumpy(), np.array([0, 0, 1, 1], np.float32),
+                            rtol=0, atol=0)
 
     @with_seed()
     def test_masked_softmax(self):
