@@ -13,9 +13,20 @@ import jax
 import jax.numpy as jnp
 
 
+def _gclip(g, clip):
+    """Gradient clipping with BOTH no-clip sentinels honored: the
+    reference's public ops clip iff ``clip_gradient >= 0`` (default -1 =
+    don't clip, [U:src/operator/optimizer_op-inl.h]; 0 clamps to zero),
+    while the internal optimizer framework passes +inf (inf takes the
+    clip branch and is a no-op).  One jnp.where keeps a single compiled
+    graph either way."""
+    clip = jnp.asarray(clip, jnp.float32)
+    return jnp.where(clip >= 0, jnp.clip(g, -clip, clip), g)
+
+
 def _prep(grad, rescale, clip, wd, weight):
     g = grad.astype(jnp.float32) * rescale
-    g = jnp.clip(g, -clip, clip)
+    g = _gclip(g, clip)
     return g + wd * weight.astype(jnp.float32)
 
 
@@ -52,7 +63,7 @@ def sgd_lazy_update(weight, grad, lr, wd, rescale, clip):
 @jax.jit
 def mp_sgd_mom_lazy_update(weight, grad, mom, weight32, lr, wd, rescale, clip, momentum):
     a = _row_active(grad)
-    g = jnp.clip(grad.astype(jnp.float32) * rescale, -clip, clip) + wd * weight32
+    g = _gclip(grad.astype(jnp.float32) * rescale, clip) + wd * weight32
     new_mom = momentum * mom - lr * g
     new_w32 = weight32 + new_mom
     return (jnp.where(a, new_w32.astype(weight.dtype), weight),
@@ -106,7 +117,7 @@ def adam_update(weight, grad, mean, var, lr, wd, rescale, clip, beta1, beta2, ep
 @jax.jit
 def adamw_update(weight, grad, mean, var, lr, wd, eta, rescale, clip, beta1, beta2, eps, t):
     w32 = weight.astype(jnp.float32)
-    g = jnp.clip(grad.astype(jnp.float32) * rescale, -clip, clip)
+    g = _gclip(grad.astype(jnp.float32) * rescale, clip)
     new_mean = beta1 * mean + (1 - beta1) * g
     new_var = beta2 * var + (1 - beta2) * jnp.square(g)
     coef1 = 1 - beta1 ** t
@@ -151,7 +162,7 @@ def adadelta_update(weight, grad, acc_g, acc_delta, wd, rescale, clip, rho, eps)
 
 @jax.jit
 def ftrl_update(weight, grad, z, n, lr, wd, rescale, clip, lamda1, beta):
-    g = jnp.clip(grad.astype(jnp.float32) * rescale, -clip, clip)
+    g = _gclip(grad.astype(jnp.float32) * rescale, clip)
     w32 = weight.astype(jnp.float32)
     new_n = n + jnp.square(g)
     sigma = (jnp.sqrt(new_n) - jnp.sqrt(n)) / lr
@@ -166,7 +177,7 @@ def ftrl_update(weight, grad, z, n, lr, wd, rescale, clip, lamda1, beta):
 
 @jax.jit
 def signum_update(weight, grad, mom, lr, wd, rescale, clip, momentum, wd_lh):
-    g = jnp.clip(grad.astype(jnp.float32) * rescale, -clip, clip)
+    g = _gclip(grad.astype(jnp.float32) * rescale, clip)
     w32 = weight.astype(jnp.float32)
     new_mom = momentum * mom - (1 - momentum) * (g + wd * w32)
     new_w = (1 - lr * wd_lh) * w32 + lr * jnp.sign(new_mom)
@@ -175,7 +186,7 @@ def signum_update(weight, grad, mom, lr, wd, rescale, clip, momentum, wd_lh):
 
 @jax.jit
 def lamb_update_phase1(weight, grad, mean, var, wd, rescale, clip, beta1, beta2, eps, t, bias_correction):
-    g = jnp.clip(grad.astype(jnp.float32) * rescale, -clip, clip)
+    g = _gclip(grad.astype(jnp.float32) * rescale, clip)
     w32 = weight.astype(jnp.float32)
     new_mean = beta1 * mean + (1 - beta1) * g
     new_var = beta2 * var + (1 - beta2) * jnp.square(g)
@@ -200,7 +211,7 @@ def lamb_update_phase2(weight, r, lr, lower_bound, upper_bound):
 
 @jax.jit
 def mp_sgd_mom_update(weight, grad, mom, weight32, lr, wd, rescale, clip, momentum):
-    g = jnp.clip(grad.astype(jnp.float32) * rescale, -clip, clip) + wd * weight32
+    g = _gclip(grad.astype(jnp.float32) * rescale, clip) + wd * weight32
     new_mom = momentum * mom - lr * g
     new_w32 = weight32 + new_mom
     return new_w32.astype(weight.dtype), new_mom, new_w32
@@ -208,7 +219,7 @@ def mp_sgd_mom_update(weight, grad, mom, weight32, lr, wd, rescale, clip, moment
 
 @jax.jit
 def mp_adam_update(weight, grad, mean, var, weight32, lr, wd, rescale, clip, beta1, beta2, eps, t):
-    g = jnp.clip(grad.astype(jnp.float32) * rescale, -clip, clip) + wd * weight32
+    g = _gclip(grad.astype(jnp.float32) * rescale, clip) + wd * weight32
     new_mean = beta1 * mean + (1 - beta1) * g
     new_var = beta2 * var + (1 - beta2) * jnp.square(g)
     lr_t = lr * jnp.sqrt(1 - beta2 ** t) / (1 - beta1 ** t)
@@ -218,14 +229,14 @@ def mp_adam_update(weight, grad, mean, var, weight32, lr, wd, rescale, clip, bet
 
 @jax.jit
 def mp_sgd_update(weight, grad, weight32, lr, wd, rescale, clip):
-    g = jnp.clip(grad.astype(jnp.float32) * rescale, -clip, clip) + wd * weight32
+    g = _gclip(grad.astype(jnp.float32) * rescale, clip) + wd * weight32
     new_w32 = weight32 - lr * g
     return new_w32.astype(weight.dtype), new_w32
 
 
 @jax.jit
 def mp_nag_mom_update(weight, grad, mom, weight32, lr, wd, rescale, clip, momentum):
-    g = jnp.clip(grad.astype(jnp.float32) * rescale, -clip, clip) + wd * weight32
+    g = _gclip(grad.astype(jnp.float32) * rescale, clip) + wd * weight32
     new_mom = momentum * mom + g
     new_w32 = weight32 - lr * (momentum * new_mom + g)
     return new_w32.astype(weight.dtype), new_mom, new_w32
@@ -280,7 +291,7 @@ def dcasgd_update(weight, grad, mom, prev_weight, lr, wd, rescale, clip, momentu
     """Delay-Compensated ASGD (Zheng et al. 2017): compensates stale
     gradients with a λ·g²·(w − w_prev) term (g excludes wd, matching the
     reference recurrence)."""
-    g = jnp.clip(grad.astype(jnp.float32) * rescale, -clip, clip)
+    g = _gclip(grad.astype(jnp.float32) * rescale, clip)
     w32 = weight.astype(jnp.float32)
     comp = g + wd * w32 + lamda * jnp.square(g) * (w32 - prev_weight)
     new_mom = momentum * mom - lr * comp
@@ -303,7 +314,7 @@ def group_adagrad_update(weight, grad, history, lr, rescale, clip, eps):
     """GroupAdaGrad ([U:src/operator/contrib/optimizer_op.cc]): AdaGrad
     with ONE accumulated statistic per row (group) instead of per element
     — the embedding-table optimizer."""
-    g = jnp.clip(grad.astype(jnp.float32) * rescale, -clip, clip)
+    g = _gclip(grad.astype(jnp.float32) * rescale, clip)
     row_sq = jnp.mean(jnp.square(g), axis=tuple(range(1, g.ndim)),
                       keepdims=True)
     new_hist = history + row_sq
@@ -360,6 +371,63 @@ def multi_mp_sgd_mom_update(weights, grads, moms, weights32, lrs, wds,
     return [o[0] for o in out], [o[1] for o in out], [o[2] for o in out]
 
 
+# -- preloaded (device-resident lr/wd) group variants ------------------------
+# Parity: [U:src/operator/contrib/preloaded_multi_sgd-inl.h] — identical to
+# multi_sgd_* except learning rates and weight decays arrive as device
+# ARRAYS (one element per tensor), not host scalars, so a training loop can
+# update lr on-device without a host sync.
+
+
+def preloaded_multi_sgd_update(weights, grads, lrs, wds,
+                               rescale_grad=1.0, clip_gradient=-1.0):
+    clip = jnp.float32(clip_gradient)  # kernels decode the <0 no-clip sentinel
+    lrs, wds = jnp.asarray(lrs), jnp.asarray(wds)
+    return [
+        sgd_update(w, g, lrs[i].astype(jnp.float32), wds[i].astype(jnp.float32),
+                   jnp.float32(rescale_grad), clip)
+        for i, (w, g) in enumerate(zip(weights, grads))
+    ]
+
+
+def preloaded_multi_sgd_mom_update(weights, grads, moms, lrs, wds, momentum=0.0,
+                                   rescale_grad=1.0, clip_gradient=-1.0):
+    clip = jnp.float32(clip_gradient)  # kernels decode the <0 no-clip sentinel
+    lrs, wds = jnp.asarray(lrs), jnp.asarray(wds)
+    out = [
+        sgd_mom_update(w, g, m, lrs[i].astype(jnp.float32),
+                       wds[i].astype(jnp.float32), jnp.float32(rescale_grad),
+                       clip, jnp.float32(momentum))
+        for i, (w, g, m) in enumerate(zip(weights, grads, moms))
+    ]
+    return [o[0] for o in out], [o[1] for o in out]
+
+
+def preloaded_multi_mp_sgd_update(weights, grads, weights32, lrs, wds,
+                                  rescale_grad=1.0, clip_gradient=-1.0):
+    clip = jnp.float32(clip_gradient)  # kernels decode the <0 no-clip sentinel
+    lrs, wds = jnp.asarray(lrs), jnp.asarray(wds)
+    out = [
+        mp_sgd_update(w, g, w32, lrs[i].astype(jnp.float32),
+                      wds[i].astype(jnp.float32), jnp.float32(rescale_grad), clip)
+        for i, (w, g, w32) in enumerate(zip(weights, grads, weights32))
+    ]
+    return [o[0] for o in out], [o[1] for o in out]
+
+
+def preloaded_multi_mp_sgd_mom_update(weights, grads, moms, weights32, lrs, wds,
+                                      momentum=0.0, rescale_grad=1.0,
+                                      clip_gradient=-1.0):
+    clip = jnp.float32(clip_gradient)  # kernels decode the <0 no-clip sentinel
+    lrs, wds = jnp.asarray(lrs), jnp.asarray(wds)
+    out = [
+        mp_sgd_mom_update(w, g, m, w32, lrs[i].astype(jnp.float32),
+                          wds[i].astype(jnp.float32), jnp.float32(rescale_grad),
+                          clip, jnp.float32(momentum))
+        for i, (w, g, m, w32) in enumerate(zip(weights, grads, moms, weights32))
+    ]
+    return [o[0] for o in out], [o[1] for o in out], [o[2] for o in out]
+
+
 def multi_sum_sq(*arrays):
     """Per-tensor sum of squares, one fused pass (parity:
     [U:src/operator/contrib/multi_sum_sq.cc]; feeds multi_lars)."""
@@ -404,7 +472,10 @@ def _register_public_ops():
         group_adagrad_update, adadelta_update,
         ftrl_update, signum_update, lamb_update_phase1, lamb_update_phase2,
         multi_sgd_update, multi_sgd_mom_update, multi_mp_sgd_update,
-        multi_mp_sgd_mom_update, multi_sum_sq, multi_lars, all_finite,
+        multi_mp_sgd_mom_update, preloaded_multi_sgd_update,
+        preloaded_multi_sgd_mom_update, preloaded_multi_mp_sgd_update,
+        preloaded_multi_mp_sgd_mom_update,
+        multi_sum_sq, multi_lars, all_finite,
     ):
         name = fn.__name__ if hasattr(fn, "__name__") else fn.__wrapped__.__name__
         _reg(name, differentiable=False, wrap_ndarray=False)(fn)

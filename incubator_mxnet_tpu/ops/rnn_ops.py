@@ -60,6 +60,83 @@ def _cell_step(mode, hidden_size):
     return step, n_gates
 
 
+def rnn_param_size(mode, input_size, state_size, num_layers=1, bidirectional=False):
+    """Length of the packed flat parameter vector the ``RNN`` mega-op
+    consumes — the reference's GetRnnParamSize ([U:src/operator/rnn-inl.h])."""
+    _, n_gates = _cell_step(mode, state_size)
+    dirs = 2 if bidirectional else 1
+    H = int(state_size)
+    total = 0
+    for layer in range(int(num_layers)):
+        in_dim = int(input_size) if layer == 0 else H * dirs
+        total += dirs * (n_gates * H * (in_dim + H)  # i2h + h2h weights
+                         + 2 * n_gates * H)          # i2h + h2h biases
+    return total
+
+
+def _unpack_rnn_params(parameters, mode, input_size, state_size, num_layers,
+                       bidirectional):
+    """Split the flat vector into per-layer/direction (w_i2h, w_h2h, b_i2h,
+    b_h2h), cuDNN layout: ALL weights first (layer-major, direction-minor,
+    i2h before h2h), then ALL biases in the same order
+    ([U:src/operator/rnn-inl.h] GetRnnParamSize / rnn_cell.py FusedRNNCell
+    unpack_weights)."""
+    _, n_gates = _cell_step(mode, state_size)
+    dirs = 2 if bidirectional else 1
+    H = int(state_size)
+    offset = 0
+
+    def take(*shape):
+        nonlocal offset
+        n = 1
+        for s in shape:
+            n *= s
+        out = parameters[offset:offset + n].reshape(shape)
+        offset += n
+        return out
+
+    groups = []
+    for layer in range(int(num_layers)):
+        in_dim = int(input_size) if layer == 0 else H * dirs
+        for _ in range(dirs):
+            groups.append([take(n_gates * H, in_dim), take(n_gates * H, H)])
+    for g in groups:
+        g.append(take(n_gates * H))  # b_i2h
+        g.append(take(n_gates * H))  # b_h2h
+    if offset != parameters.shape[0]:
+        raise ValueError(
+            f"RNN parameters length {parameters.shape[0]} != expected {offset} "
+            f"for mode={mode} input_size={input_size} state_size={state_size} "
+            f"num_layers={num_layers} bidirectional={bidirectional}")
+    return [w for g in groups for w in g]
+
+
+@register("RNN")
+def rnn_mega(data, parameters, state, state_cell=None, *, mode="lstm",
+             state_size=0, num_layers=1, bidirectional=False, p=0.0,
+             state_outputs=False, training=False, key=None):
+    """The reference's fused RNN mega-op under its real name/signature
+    ([U:src/operator/rnn.cc]): ``data`` (T, N, C), ``parameters`` the packed
+    flat vector (cuDNN layout — see ``_unpack_rnn_params``), ``state``
+    (L*dirs, N, H), ``state_cell`` likewise for LSTM.  ``p`` is inter-layer
+    dropout.  Returns ``out`` alone, or with ``state_outputs=True``:
+    ``(out, h_n)`` / ``(out, h_n, c_n)`` for LSTM.  A thin unpacking shim
+    over the one-``lax.scan``-per-layer ``RNNFused`` kernel."""
+    H = int(state_size)
+    flat = _unpack_rnn_params(parameters, mode, data.shape[2], H,
+                              num_layers, bidirectional)
+    if mode == "lstm" and state_cell is None:
+        raise ValueError("LSTM mode requires state_cell")
+    c0 = state_cell if mode == "lstm" else state  # dummy for non-LSTM
+    res = rnn_fused(data, state, c0, *flat, mode=mode,
+                    num_layers=int(num_layers), hidden_size=H,
+                    bidirectional=bool(bidirectional), dropout=float(p),
+                    training=training, key=key)
+    if state_outputs:
+        return res  # (out, h_n) or (out, h_n, c_n)
+    return res[0]
+
+
 @register("RNNFused")
 def rnn_fused(
     data,

@@ -383,6 +383,178 @@ alias("ROIAlign", "_contrib_ROIAlign")
 
 
 # ---------------------------------------------------------------------------
+# Deformable ops (DCN / R-FCN lineage)
+# ---------------------------------------------------------------------------
+
+
+@register("_contrib_DeformableConvolution")
+def deformable_convolution(data, offset, weight, bias=None, kernel=(3, 3),
+                           stride=(1, 1), dilate=(1, 1), pad=(0, 0),
+                           num_filter=0, num_group=1, num_deformable_group=1,
+                           no_bias=False, workspace=None, layout=None):
+    """Deformable convolution v1 ([U:src/operator/contrib/
+    deformable_convolution.cc], Dai et al. 2017): each kernel tap samples
+    the input at a learned fractional offset.  TPU design: one vectorized
+    bilinear gather builds the deformed im2col patches [B, C, K, Ho, Wo],
+    then the conv contraction is a single einsum (MXU matmul) over (c, k) —
+    no per-position scalar loops, static shapes throughout.
+
+    ``offset`` is [B, 2*DG*kh*kw, Ho, Wo]; per deformable group the channel
+    pairs are (Δy, Δx) per kernel tap, the reference's layout.  Out-of-image
+    samples read 0, as the reference's im2col does.
+    """
+    kh, kw = int(kernel[0]), int(kernel[1])
+    sh, sw = int(stride[0]), int(stride[1])
+    dh, dw = int(dilate[0]), int(dilate[1])
+    ph, pw = int(pad[0]), int(pad[1])
+    B, C, H, W = data.shape
+    DG = int(num_deformable_group)
+    G = int(num_group)
+    O = int(num_filter) or weight.shape[0]
+    K = kh * kw
+    Ho = (H + 2 * ph - dh * (kh - 1) - 1) // sh + 1
+    Wo = (W + 2 * pw - dw * (kw - 1) - 1) // sw + 1
+    if offset.shape[1] != 2 * DG * K:
+        raise ValueError(
+            f"offset channels {offset.shape[1]} != 2*num_deformable_group*kh*kw"
+            f" = {2 * DG * K}")
+    if C % DG or C % G:
+        raise ValueError(
+            f"num_group ({G}) and num_deformable_group ({DG}) must both "
+            f"divide the input channel count ({C})")
+    if O % G:
+        raise ValueError(f"num_group ({G}) must divide num_filter ({O})")
+
+    off = offset.reshape(B, DG, K, 2, Ho, Wo).astype(jnp.float32)
+    ky, kx = jnp.meshgrid(jnp.arange(kh, dtype=jnp.float32) * dh,
+                          jnp.arange(kw, dtype=jnp.float32) * dw, indexing="ij")
+    base_y = (ky.ravel()[:, None, None]
+              + (jnp.arange(Ho, dtype=jnp.float32) * sh - ph)[None, :, None])
+    base_x = (kx.ravel()[:, None, None]
+              + (jnp.arange(Wo, dtype=jnp.float32) * sw - pw)[None, None, :])
+    y = base_y[None, None] + off[:, :, :, 0]  # [B, DG, K, Ho, Wo]
+    x = base_x[None, None] + off[:, :, :, 1]
+
+    Cg = C // DG
+    datag = data.reshape(B * DG, Cg, H, W)
+    vals = _bilinear_gather(datag, x.reshape(B * DG, K, Ho, Wo),
+                            y.reshape(B * DG, K, Ho, Wo))  # [B*DG, Cg, K, Ho, Wo]
+    patches = vals.reshape(B, C, K, Ho, Wo)
+
+    wg = weight.reshape(G, O // G, C // G, K).astype(jnp.float32)
+    pg = patches.reshape(B, G, C // G, K, Ho, Wo).astype(jnp.float32)
+    out = jnp.einsum("bgckhw,gock->bgohw", pg, wg).reshape(B, O, Ho, Wo)
+    if bias is not None and not no_bias:
+        out = out + bias.astype(jnp.float32)[None, :, None, None]
+    return out.astype(data.dtype)
+
+
+@register("_contrib_DeformablePSROIPooling")
+def deformable_psroi_pooling(data, rois, trans=None, spatial_scale=1.0,
+                             output_dim=0, group_size=1, pooled_size=1,
+                             part_size=0, sample_per_part=1, trans_std=0.0,
+                             no_trans=False):
+    """Deformable position-sensitive ROI pooling ([U:src/operator/contrib/
+    deformable_psroi_pooling.cc], the R-FCN/DCN head).  Each pooled bin
+    (ph, pw) of output channel ``ctop`` averages ``sample_per_part``²
+    bilinear samples from score-map channel ``(ctop*G + gh)*G + gw``, the
+    bin region shifted by the learned normalized offsets in ``trans``
+    (scaled by ``trans_std`` and the ROI extent).  TPU design: the bin→
+    channel map is static, so everything becomes one flattened 4-corner
+    gather over [R, OD, P, P, S, S] — no dynamic shapes.
+
+    Returns the pooled output [R, output_dim, P, P] (the reference's second
+    ``top_count`` output is backward bookkeeping its CUDA kernel needs;
+    autodiff subsumes it here).
+    """
+    B, C, H, W = data.shape
+    R = rois.shape[0]
+    P = int(pooled_size)
+    G = int(group_size)
+    S = int(sample_per_part)
+    part = int(part_size) or P
+    OD = int(output_dim) or C // (G * G)
+    if C != OD * G * G:
+        raise ValueError(f"data channels {C} != output_dim*group_size² = {OD * G * G}")
+
+    rois = rois.astype(jnp.float32)
+    batch_idx = rois[:, 0].astype(jnp.int32)
+    _round = lambda v: jnp.sign(v) * jnp.floor(jnp.abs(v) + 0.5)
+    x1 = _round(rois[:, 1]) * spatial_scale - 0.5
+    y1 = _round(rois[:, 2]) * spatial_scale - 0.5
+    x2 = (_round(rois[:, 3]) + 1.0) * spatial_scale - 0.5
+    y2 = (_round(rois[:, 4]) + 1.0) * spatial_scale - 0.5
+    rw = jnp.maximum(x2 - x1, 0.1)
+    rh = jnp.maximum(y2 - y1, 0.1)
+    bin_h, bin_w = rh / P, rw / P
+    sub_h, sub_w = bin_h / S, bin_w / S
+
+    phs = jnp.arange(P, dtype=jnp.float32)
+    part_idx = jnp.floor(jnp.arange(P) * part / P).astype(jnp.int32)
+    if no_trans or trans is None:
+        ncls = 1
+        tx = jnp.zeros((R, 1, P, P), jnp.float32)
+        ty = jnp.zeros((R, 1, P, P), jnp.float32)
+    else:
+        ncls = trans.shape[1] // 2
+        t = trans.reshape(R, ncls, 2, part, part).astype(jnp.float32)
+        t = t[:, :, :, part_idx][:, :, :, :, part_idx]  # [R, ncls, 2, P, P]
+        tx = t[:, :, 0] * float(trans_std)
+        ty = t[:, :, 1] * float(trans_std)
+    ch_per_cls = OD // ncls
+
+    # bin start coords, per class: [R, ncls, P(ph), P(pw)]
+    hstart = (phs[None, None, :, None] * bin_h[:, None, None, None]
+              + y1[:, None, None, None] + ty * rh[:, None, None, None])
+    wstart = (phs[None, None, None, :] * bin_w[:, None, None, None]
+              + x1[:, None, None, None] + tx * rw[:, None, None, None])
+    # sample grid: [R, ncls, P, P, S, S]
+    ss = jnp.arange(S, dtype=jnp.float32)
+    hh = hstart[..., None, None] + ss[:, None] * sub_h[:, None, None, None, None, None]
+    ww = wstart[..., None, None] + ss[None, :] * sub_w[:, None, None, None, None, None]
+    valid = (ww >= -0.5) & (ww <= W - 0.5) & (hh >= -0.5) & (hh <= H - 0.5)
+    hc = jnp.clip(hh, 0.0, H - 1.0)
+    wc = jnp.clip(ww, 0.0, W - 1.0)
+
+    # static bin -> score-map channel map: [OD, P, P]
+    gh = jnp.clip(jnp.floor(jnp.arange(P) * G / P), 0, G - 1).astype(jnp.int32)
+    ch = ((jnp.arange(OD)[:, None, None] * G + gh[None, :, None]) * G
+          + gh[None, None, :])
+    cls_of = jnp.arange(OD) // ch_per_cls
+
+    # expand coords to per-output-channel via its class: [R, OD, P, P, S, S]
+    hh_c = hc[:, cls_of]
+    ww_c = wc[:, cls_of]
+    val_c = valid[:, cls_of]
+
+    y0 = jnp.floor(hh_c)
+    x0 = jnp.floor(ww_c)
+    wy = hh_c - y0
+    wx = ww_c - x0
+    flat = data.astype(jnp.float32).reshape(B, C * H * W)[batch_idx]  # [R, CHW]
+    chb = ch[None, :, :, :, None, None]
+
+    def corner(yi, xi):
+        yc = jnp.clip(yi, 0, H - 1).astype(jnp.int32)
+        xc = jnp.clip(xi, 0, W - 1).astype(jnp.int32)
+        idx = (chb * H + yc) * W + xc  # [R, OD, P, P, S, S]
+        return jnp.take_along_axis(flat, idx.reshape(R, -1), axis=1).reshape(idx.shape)
+
+    v = (corner(y0, x0) * (1 - wx) * (1 - wy)
+         + corner(y0, x0 + 1) * wx * (1 - wy)
+         + corner(y0 + 1, x0) * (1 - wx) * wy
+         + corner(y0 + 1, x0 + 1) * wx * wy)
+    v = v * val_c.astype(jnp.float32)
+    count = jnp.sum(val_c, axis=(-1, -2)).astype(jnp.float32)
+    out = jnp.sum(v, axis=(-1, -2)) / jnp.maximum(count, 1.0)
+    return out.astype(data.dtype)
+
+
+alias("DeformableConvolution", "_contrib_DeformableConvolution")
+alias("DeformablePSROIPooling", "_contrib_DeformablePSROIPooling")
+
+
+# ---------------------------------------------------------------------------
 # Correlation (FlowNet)
 # ---------------------------------------------------------------------------
 

@@ -206,6 +206,20 @@ def gamma_fn(x):
         return jnp.exp(jax.scipy.special.gammaln(x))
 
 
+@register("digamma")
+def digamma_fn(x):
+    """ψ(x) = d/dx ln Γ(x) ([U:src/operator/mshadow_op.h] gamma digamma
+    family)."""
+    return jax.scipy.special.digamma(x)
+
+
+@register("polygamma")
+def polygamma_fn(x, n=0):
+    """n-th derivative of digamma ([U:src/operator/mshadow_op.h]); n=0 is
+    digamma itself."""
+    return jax.scipy.special.polygamma(int(n), x)
+
+
 @register("reciprocal")
 def reciprocal(x):
     return 1.0 / x

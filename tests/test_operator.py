@@ -426,6 +426,51 @@ class TestMultiTensorOps:
                             rtol=1e-5, atol=1e-6)
 
     @with_seed()
+    def test_preloaded_multi_sgd_matches_multi(self):
+        """preloaded_* variants take lr/wd as device arrays; results must
+        match the scalar-list forms exactly."""
+        import jax.numpy as jnp
+
+        ws = [np.random.randn(4, 3).astype(np.float32) for _ in range(3)]
+        gs = [np.random.randn(4, 3).astype(np.float32) for _ in range(3)]
+        ms = [np.random.randn(4, 3).astype(np.float32) for _ in range(3)]
+        lrs, wds = [0.1, 0.2, 0.3], [0.0, 0.01, 0.1]
+        lrs_a, wds_a = jnp.asarray(lrs), jnp.asarray(wds)
+        outs = mx.nd.preloaded_multi_sgd_update(
+            [_nd(w)._data for w in ws], [_nd(g)._data for g in gs], lrs_a, wds_a)
+        for w, g, lr, wd, o in zip(ws, gs, lrs, wds, outs):
+            expect = w - lr * (g + wd * w)
+            assert_almost_equal(np.asarray(o), expect, rtol=1e-5, atol=1e-5)
+        new_ws, new_ms = mx.nd.preloaded_multi_sgd_mom_update(
+            [_nd(w)._data for w in ws], [_nd(g)._data for g in gs],
+            [_nd(m)._data for m in ms], lrs_a, wds_a, momentum=0.9)
+        ref_ws, ref_ms = mx.nd.multi_sgd_mom_update(
+            [_nd(w)._data for w in ws], [_nd(g)._data for g in gs],
+            [_nd(m)._data for m in ms], lrs, wds, momentum=0.9)
+        for a, b in zip(list(new_ws) + list(new_ms), list(ref_ws) + list(ref_ms)):
+            assert_almost_equal(np.asarray(a), np.asarray(b), rtol=0, atol=0)
+
+    @with_seed()
+    def test_preloaded_multi_mp_sgd(self):
+        import jax.numpy as jnp
+
+        ws32 = [np.random.randn(6).astype(np.float32) for _ in range(2)]
+        ws16 = [jnp.asarray(w).astype(jnp.bfloat16) for w in ws32]
+        gs = [np.random.randn(6).astype(np.float32) for _ in range(2)]
+        lrs, wds = jnp.asarray([0.1, 0.2]), jnp.asarray([0.0, 0.01])
+        new_w, new_w32 = mx.nd.preloaded_multi_mp_sgd_update(
+            ws16, [_nd(g)._data for g in gs], [_nd(w)._data for w in ws32],
+            lrs, wds)
+        for w32, g, lr, wd, nw32 in zip(ws32, gs, [0.1, 0.2], [0.0, 0.01], new_w32):
+            expect = w32 - lr * (g + wd * w32)
+            assert_almost_equal(np.asarray(nw32), expect, rtol=1e-5, atol=1e-5)
+        moms = [np.zeros(6, np.float32) for _ in range(2)]
+        out = mx.nd.preloaded_multi_mp_sgd_mom_update(
+            ws16, [_nd(g)._data for g in gs], [_nd(m)._data for m in moms],
+            [_nd(w)._data for w in ws32], lrs, wds, momentum=0.9)
+        assert len(out) == 3 and len(out[0]) == 2
+
+    @with_seed()
     def test_all_finite(self):
         good = _nd(np.ones((3, 3)))._data
         bad = _nd(np.array([1.0, np.inf]))._data
@@ -967,6 +1012,166 @@ class TestSpatialOps:
         check_numeric_gradient(
             lambda d: mx.nd._contrib_AdaptiveAvgPooling2D(d, output_size=(2, 2)),
             [x])
+
+
+# ===========================================================================
+# deformable ops (DCN / R-FCN)
+# ===========================================================================
+
+
+class TestDeformableOps:
+    @with_seed()
+    def test_deformable_conv_zero_offset_matches_conv(self):
+        """Zero offsets reduce deformable conv to plain convolution."""
+        B, C, H, W, O, kh, kw = 2, 4, 7, 7, 6, 3, 3
+        x = np.random.randn(B, C, H, W).astype(np.float32)
+        w = np.random.randn(O, C, kh, kw).astype(np.float32)
+        b = np.random.randn(O).astype(np.float32)
+        for stride, pad, dilate in [((1, 1), (1, 1), (1, 1)), ((2, 2), (0, 0), (1, 1))]:
+            Ho = (H + 2 * pad[0] - dilate[0] * (kh - 1) - 1) // stride[0] + 1
+            Wo = (W + 2 * pad[1] - dilate[1] * (kw - 1) - 1) // stride[1] + 1
+            off = np.zeros((B, 2 * kh * kw, Ho, Wo), np.float32)
+            got = mx.nd._contrib_DeformableConvolution(
+                _nd(x), _nd(off), _nd(w), _nd(b), kernel=(kh, kw),
+                stride=stride, pad=pad, dilate=dilate, num_filter=O)
+            want = mx.nd.Convolution(
+                _nd(x), _nd(w), _nd(b), kernel=(kh, kw), stride=stride,
+                pad=pad, dilate=dilate, num_filter=O)
+            assert_almost_equal(got, want, rtol=1e-4, atol=1e-4)
+
+    @with_seed()
+    def test_deformable_conv_matches_naive(self):
+        """Fractional random offsets vs a direct numpy loop over taps."""
+        B, C, H, W, O, k = 1, 2, 5, 5, 3, 3
+        pad = 1
+        x = np.random.randn(B, C, H, W).astype(np.float32)
+        w = np.random.randn(O, C, k, k).astype(np.float32)
+        off = (np.random.rand(B, 2 * k * k, H, W).astype(np.float32) - 0.5) * 2
+
+        def bilin(img, y, xq):
+            if y <= -1 or y >= img.shape[0] or xq <= -1 or xq >= img.shape[1]:
+                return 0.0
+            y0, x0 = int(np.floor(y)), int(np.floor(xq))
+            dy, dx = y - y0, xq - x0
+            val = 0.0
+            for (yy, xx, wt) in [(y0, x0, (1 - dy) * (1 - dx)),
+                                 (y0, x0 + 1, (1 - dy) * dx),
+                                 (y0 + 1, x0, dy * (1 - dx)),
+                                 (y0 + 1, x0 + 1, dy * dx)]:
+                if 0 <= yy < img.shape[0] and 0 <= xx < img.shape[1]:
+                    val += wt * img[yy, xx]
+            return val
+
+        want = np.zeros((B, O, H, W), np.float32)
+        for bb in range(B):
+            for o in range(O):
+                for i in range(H):
+                    for j in range(W):
+                        acc = 0.0
+                        for ki in range(k):
+                            for kj in range(k):
+                                kk = ki * k + kj
+                                dy = off[bb, 2 * kk, i, j]
+                                dx = off[bb, 2 * kk + 1, i, j]
+                                y = i - pad + ki + dy
+                                xq = j - pad + kj + dx
+                                for c in range(C):
+                                    acc += w[o, c, ki, kj] * bilin(x[bb, c], y, xq)
+                        want[bb, o, i, j] = acc
+        got = mx.nd._contrib_DeformableConvolution(
+            _nd(x), _nd(off), _nd(w), kernel=(k, k), pad=(pad, pad),
+            num_filter=O, no_bias=True)
+        assert_almost_equal(got, want, rtol=1e-3, atol=1e-4)
+
+    @with_seed()
+    def test_deformable_conv_groups_and_grad(self):
+        B, C, H, W, O = 1, 4, 6, 6, 4
+        x = np.random.randn(B, C, H, W).astype(np.float32)
+        w = np.random.randn(O, C // 2, 3, 3).astype(np.float32)
+        # off-lattice constant offsets: bilinear interpolation is non-smooth
+        # at integer coords, where numeric and analytic gradients legitimately
+        # disagree; 0.37 keeps every sample strictly between grid points
+        off = np.full((B, 2 * 2 * 9, H, W), 0.37, np.float32)
+        out = mx.nd._contrib_DeformableConvolution(
+            _nd(x), _nd(off), _nd(w), kernel=(3, 3), pad=(1, 1), num_filter=O,
+            num_group=2, num_deformable_group=2, no_bias=True)
+        assert out.shape == (B, O, H, W)
+        check_numeric_gradient(
+            lambda d, f: mx.nd._contrib_DeformableConvolution(
+                d, _nd(off), f, kernel=(3, 3), pad=(1, 1), num_filter=O,
+                num_group=2, num_deformable_group=2, no_bias=True),
+            [x, w], rtol=0.03, atol=0.01)
+
+    @with_seed()
+    def test_deformable_psroi_pooling_matches_naive(self):
+        """no_trans and learned-offset cases vs a direct numpy port of the
+        reference kernel's semantics."""
+        OD, G, P, S = 2, 2, 2, 2
+        C = OD * G * G
+        B, H, W = 1, 8, 8
+        scale, trans_std = 0.5, 0.2
+        x = np.random.randn(B, C, H, W).astype(np.float32)
+        rois = np.array([[0, 1, 1, 11, 13], [0, 3, 2, 9, 9]], np.float32)
+        trans = 0.5 * np.random.randn(2, 2, P, P).astype(np.float32)
+
+        def naive(no_trans):
+            R = rois.shape[0]
+            out = np.zeros((R, OD, P, P), np.float32)
+            for r in range(R):
+                rx1 = round(rois[r, 1]) * scale - 0.5
+                ry1 = round(rois[r, 2]) * scale - 0.5
+                rx2 = (round(rois[r, 3]) + 1) * scale - 0.5
+                ry2 = (round(rois[r, 4]) + 1) * scale - 0.5
+                rw, rh = max(rx2 - rx1, 0.1), max(ry2 - ry1, 0.1)
+                bh, bw = rh / P, rw / P
+                for ct in range(OD):
+                    for ph in range(P):
+                        for pw in range(P):
+                            if no_trans:
+                                tx = ty = 0.0
+                            else:
+                                tx = trans[r, 0, ph, pw] * trans_std
+                                ty = trans[r, 1, ph, pw] * trans_std
+                            hs = ph * bh + ry1 + ty * rh
+                            ws = pw * bw + rx1 + tx * rw
+                            gh = min(max(int(np.floor(ph * G / P)), 0), G - 1)
+                            gw = min(max(int(np.floor(pw * G / P)), 0), G - 1)
+                            c = (ct * G + gh) * G + gw
+                            acc, cnt = 0.0, 0
+                            for ih in range(S):
+                                for iw in range(S):
+                                    hq = hs + ih * bh / S
+                                    wq = ws + iw * bw / S
+                                    if hq < -0.5 or hq > H - 0.5 or wq < -0.5 or wq > W - 0.5:
+                                        continue
+                                    hq = min(max(hq, 0.0), H - 1.0)
+                                    wq = min(max(wq, 0.0), W - 1.0)
+                                    y0, x0 = int(np.floor(hq)), int(np.floor(wq))
+                                    dy, dx = hq - y0, wq - x0
+                                    y1c, x1c = min(y0 + 1, H - 1), min(x0 + 1, W - 1)
+                                    v = (x[0, c, y0, x0] * (1 - dy) * (1 - dx)
+                                         + x[0, c, y0, x1c] * (1 - dy) * dx
+                                         + x[0, c, y1c, x0] * dy * (1 - dx)
+                                         + x[0, c, y1c, x1c] * dy * dx)
+                                    acc += v
+                                    cnt += 1
+                            out[r, ct, ph, pw] = acc / cnt if cnt else 0.0
+            return out
+
+        got_nt = mx.nd._contrib_DeformablePSROIPooling(
+            _nd(x), _nd(rois), spatial_scale=scale, output_dim=OD,
+            group_size=G, pooled_size=P, sample_per_part=S, no_trans=True)
+        assert_almost_equal(got_nt, naive(True), rtol=1e-4, atol=1e-5)
+        got_tr = mx.nd._contrib_DeformablePSROIPooling(
+            _nd(x), _nd(rois), _nd(trans), spatial_scale=scale, output_dim=OD,
+            group_size=G, pooled_size=P, part_size=P, sample_per_part=S,
+            trans_std=trans_std)
+        assert_almost_equal(got_tr, naive(False), rtol=1e-4, atol=1e-5)
+
+    def test_deformable_aliases(self):
+        assert mx.nd.DeformableConvolution is not None
+        assert mx.nd.contrib.DeformableConvolution is not None
+        assert mx.nd.contrib.DeformablePSROIPooling is not None
 
 
 # ===========================================================================

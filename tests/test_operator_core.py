@@ -120,6 +120,22 @@ class TestUnaryOps:
         assert_almost_equal(gl, expect, rtol=1e-3, atol=1e-4)
 
     @with_seed()
+    def test_digamma_polygamma(self):
+        from scipy import special as sp
+
+        x = np.random.uniform(0.5, 4.0, size=(10,)).astype(np.float32)
+        assert_almost_equal(mx.nd.digamma(_nd(x)).asnumpy(),
+                            sp.digamma(x).astype(np.float32),
+                            rtol=1e-4, atol=1e-5)
+        for n in (1, 2):
+            assert_almost_equal(mx.nd.polygamma(_nd(x), n=n).asnumpy(),
+                                sp.polygamma(n, x).astype(np.float32),
+                                rtol=1e-3, atol=1e-4)
+        # polygamma(0) == digamma
+        assert_almost_equal(mx.nd.polygamma(_nd(x), n=0).asnumpy(),
+                            mx.nd.digamma(_nd(x)).asnumpy(), rtol=1e-6, atol=0)
+
+    @with_seed()
     def test_erfinv_roundtrip(self):
         x = np.random.uniform(-0.9, 0.9, size=(16,)).astype(np.float32)
         y = mx.nd.erfinv(_nd(x))

@@ -119,3 +119,76 @@ def test_dropout_and_residual_cells():
     out2, _ = d(x, [])
     assert out.shape == (2, 4)
     assert out2.shape == (2, 4)
+
+
+# ===========================================================================
+# the reference RNN mega-op (packed flat parameter vector)
+# ===========================================================================
+
+
+def _pack_layer_params(layer, num_layers, dirs):
+    """Flatten a gluon fused layer's named weights into the cuDNN packed
+    layout the RNN mega-op consumes: all i2h/h2h weights layer-major,
+    direction-minor, then all biases in the same order."""
+    names = [f"{j}{i}_" for i in range(num_layers) for j in ["l", "r"][:dirs]]
+    chunks = []
+    for n in names:
+        chunks.append(getattr(layer, f"{n}i2h_weight").data().asnumpy().ravel())
+        chunks.append(getattr(layer, f"{n}h2h_weight").data().asnumpy().ravel())
+    for n in names:
+        chunks.append(getattr(layer, f"{n}i2h_bias").data().asnumpy().ravel())
+        chunks.append(getattr(layer, f"{n}h2h_bias").data().asnumpy().ravel())
+    return np.concatenate(chunks)
+
+
+@with_seed()
+@pytest.mark.parametrize("mode,bidirectional", [
+    ("lstm", False), ("lstm", True), ("gru", True), ("rnn_tanh", False)])
+def test_rnn_megaop_matches_fused_layer(mode, bidirectional):
+    """mx.nd.RNN with the packed parameter vector must reproduce the gluon
+    fused layer (itself validated against step-by-step cells) — stacked 2
+    layers, optionally bidirectional."""
+    from incubator_mxnet_tpu.ops.rnn_ops import rnn_param_size
+
+    T, B, C, H, L = 5, 3, 4, 6, 2
+    dirs = 2 if bidirectional else 1
+    cls = {"lstm": gluon.rnn.LSTM, "gru": gluon.rnn.GRU}.get(mode)
+    if cls is None:
+        layer = gluon.rnn.RNN(H, num_layers=L, activation=mode[4:],
+                              bidirectional=bidirectional, input_size=C)
+    else:
+        layer = cls(H, num_layers=L, bidirectional=bidirectional, input_size=C)
+    layer.initialize()
+    x = mx.nd.random.uniform(shape=(T, B, C))
+    layer(x)  # materialize params
+
+    flat = _pack_layer_params(layer, L, dirs)
+    assert flat.size == rnn_param_size(mode, C, H, L, bidirectional)
+    h0 = mx.nd.zeros((L * dirs, B, H))
+    kw = dict(mode=mode, state_size=H, num_layers=L,
+              bidirectional=bidirectional, state_outputs=True)
+    if mode == "lstm":
+        out = mx.nd.RNN(x, mx.nd.array(flat), h0, mx.nd.zeros((L * dirs, B, H)), **kw)
+        assert len(out) == 3 and out[2].shape == (L * dirs, B, H)
+    else:
+        out = mx.nd.RNN(x, mx.nd.array(flat), h0, **kw)
+        assert len(out) == 2
+    expect, states = layer(x, layer.begin_state(B))
+    assert_almost_equal(out[0], expect, rtol=1e-4, atol=1e-5)
+    assert_almost_equal(out[1], states[0], rtol=1e-4, atol=1e-5)
+
+
+def test_rnn_megaop_output_only_and_validation():
+    T, B, C, H = 3, 2, 4, 5
+    x = mx.nd.random.uniform(shape=(T, B, C))
+    from incubator_mxnet_tpu.ops.rnn_ops import rnn_param_size
+    n = rnn_param_size("gru", C, H)
+    out = mx.nd.RNN(x, mx.nd.random.uniform(shape=(n,)), mx.nd.zeros((1, B, H)),
+                    mode="gru", state_size=H, num_layers=1)
+    assert out.shape == (T, B, H)  # state_outputs=False -> output alone
+    with pytest.raises(ValueError):
+        mx.nd.RNN(x, mx.nd.zeros((n + 1,)), mx.nd.zeros((1, B, H)),
+                  mode="gru", state_size=H, num_layers=1)
+    with pytest.raises(ValueError):
+        mx.nd.RNN(x, mx.nd.zeros((rnn_param_size("lstm", C, H),)),
+                  mx.nd.zeros((1, B, H)), mode="lstm", state_size=H)
