@@ -66,11 +66,128 @@ _SCALAR = {"_plus_scalar": ("Add", 1), "_mul_scalar": ("Mul", 1),
            "_rminus_scalar": ("Sub", 0), "_rdiv_scalar": ("Div", 0)}
 
 
+# mx gate order -> ONNX gate order (row-block permutations over H rows):
+# LSTM mx [i,f,g,o] -> onnx [i,o,f,c]; GRU mx [r,z,n] -> onnx [z,r,h]
+_RNN_EXPORT_PERM = {"lstm": (0, 3, 1, 2), "gru": (1, 0, 2),
+                    "rnn_tanh": (0,), "rnn_relu": (0,)}
+_RNN_ONNX_OP = {"lstm": "LSTM", "gru": "GRU",
+                "rnn_tanh": "RNN", "rnn_relu": "RNN"}
+
+
+def _truthy(v):
+    return v in (True, 1, "1", "True", "true")
+
+
+def _export_rnn(node, in_names, out_name, extra_inits):
+    """mx ``RNN`` mega-op -> ONNX LSTM/GRU/RNN node(s), one per layer
+    ([U:python/mxnet/contrib/onnx/mx2onnx/_op_translations.py] convert_RNN).
+    The packed parameter vector is split into per-layer/direction W, R, B
+    initializers with the gate blocks permuted to ONNX order; multi-layer
+    stacks chain through Transpose+Reshape (ONNX Y is [T, D, B, H], the next
+    layer wants [T, B, D*H])."""
+    from ...ops.rnn_ops import _unpack_rnn_params, _cell_step
+
+    a, nm = node.attrs, node.name
+    mode = a.get("mode", "lstm")
+    H = int(a.get("state_size", 0))
+    L = int(a.get("num_layers", 1))
+    bidir = _truthy(a.get("bidirectional", False))
+    D = 2 if bidir else 1
+    if _truthy(a.get("state_outputs", False)):
+        raise NotImplementedError(
+            "RNN export supports state_outputs=False only (the ONNX graph "
+            "output is Y; re-export without state outputs)")
+    _, G = _cell_step(mode, H)
+    perm = _RNN_EXPORT_PERM[mode]
+
+    init_map = {e["name"]: e for e in extra_inits}
+    pname = in_names[1]
+    if pname not in init_map:
+        raise NotImplementedError(
+            "RNN export needs `parameters` bound as an initializer")
+    e = init_map[pname]
+    params = _np.frombuffer(
+        e["raw"], dtype=_np.dtype(P.TP_TO_DTYPE[e["data_type"]])).astype(_np.float32)
+
+    # recover input size C from the packed length
+    per_later = (L - 1) * D * G * H * (H * D + H + 2)
+    C_num = params.size - per_later - D * G * H * (H + 2)
+    if C_num % (D * G * H):
+        raise ValueError("packed RNN parameter length inconsistent with attrs")
+    C = C_num // (D * G * H)
+    flat = _unpack_rnn_params(params, mode, C, H, L, bidir)
+
+    # zero initial states export as ONNX defaults (omitted inputs); anything
+    # else has no initializer-free representation here
+    for sname in in_names[2:]:
+        if sname in init_map:
+            arr = _np.frombuffer(init_map[sname]["raw"],
+                                 dtype=_np.dtype(P.TP_TO_DTYPE[init_map[sname]["data_type"]]))
+            if arr.size and _np.any(arr != 0):
+                raise NotImplementedError(
+                    "RNN export supports zero initial states only")
+            extra_inits.remove(init_map[sname])
+        else:
+            raise NotImplementedError(
+                "RNN export needs initial states bound as (zero) initializers")
+    extra_inits.remove(e)
+
+    def reorder(M):
+        return _np.concatenate([M[p * H:(p + 1) * H] for p in perm], axis=0)
+
+    nodes = []
+    x = in_names[0]
+    for l in range(L):
+        Ws, Rs, Bs = [], [], []
+        for d in range(D):
+            w_i, w_h, b_i, b_h = flat[(l * D + d) * 4:(l * D + d) * 4 + 4]
+            Ws.append(reorder(w_i))
+            Rs.append(reorder(w_h))
+            Bs.append(_np.concatenate([reorder(b_i.reshape(G * H, 1)).ravel(),
+                                       reorder(b_h.reshape(G * H, 1)).ravel()]))
+        for tag, arr in (("W", _np.stack(Ws)), ("R", _np.stack(Rs)),
+                         ("B", _np.stack(Bs))):
+            extra_inits.append({
+                "name": f"{nm}_l{l}_{tag}", "dims": arr.shape,
+                "data_type": P.TP_FLOAT,
+                "raw": _np.ascontiguousarray(arr, _np.float32).tobytes()})
+        attrs = [_attr_i("hidden_size", H),
+                 _attr_s("direction",
+                         b"bidirectional" if bidir else b"forward")]
+        if mode == "gru":
+            attrs.append(_attr_i("linear_before_reset", 1))  # the cuDNN/mx form
+        if mode == "rnn_relu":
+            attrs.append({"name": "activations", "type": P.ATTR_STRINGS,
+                          "strings": [b"Relu"] * D})
+        y = f"{nm}_l{l}_Y"
+        nodes.append({"op_type": _RNN_ONNX_OP[mode], "name": f"{nm}_l{l}",
+                      "input": [x, f"{nm}_l{l}_W", f"{nm}_l{l}_R",
+                                f"{nm}_l{l}_B"],
+                      "output": [y], "attribute": attrs})
+        # [T, D, B, H] -> [T, B, D*H] for the next layer / final output
+        yt = f"{nm}_l{l}_YT"
+        nodes.append({"op_type": "Transpose", "name": f"{nm}_l{l}_t",
+                      "input": [y], "output": [yt],
+                      "attribute": [_attr_ints("perm", (0, 2, 1, 3))]})
+        sh_name = f"{nm}_l{l}_mergeshape"
+        extra_inits.append({"name": sh_name, "dims": (3,),
+                            "data_type": P.TP_INT64,
+                            "raw": _np.asarray([0, 0, -1], _np.int64).tobytes()})
+        merged = out_name if l == L - 1 else f"{nm}_l{l}_merged"
+        nodes.append({"op_type": "Reshape", "name": f"{nm}_l{l}_r",
+                      "input": [yt, sh_name], "output": [merged],
+                      "attribute": []})
+        x = merged
+    return nodes
+
+
 def _export_node(node, in_names, out_name, extra_inits):
     """One mx graph node -> list of ONNX node dicts."""
     op = node.op
     a = node.attrs
     nm = node.name
+    if op == "RNN":
+        return _export_rnn(node, in_names, out_name, extra_inits)
     if op in ("FullyConnected", "fully_connected"):
         flatten = a.get("flatten", True)
         nodes = []
@@ -285,8 +402,7 @@ def export_model(sym, params, input_shape=None, input_type=_np.float32,
     # ONNX BatchNormalization has no fix_gamma; bake the semantics into the
     # exported scale tensor (the reference exporter does the same)
     for node in order:
-        if node.op == "BatchNorm" and node.attrs.get("fix_gamma", True) \
-                in (True, 1, "True", "true"):
+        if node.op == "BatchNorm" and _truthy(node.attrs.get("fix_gamma", True)):
             src, _ = node.inputs[1]
             if src.op is None and src.name in flat:
                 flat[src.name] = _np.ones_like(flat[src.name])
@@ -368,6 +484,8 @@ def _get_attr(node, name, default=None):
                 return a["floats"]
             if t == P.ATTR_STRING:
                 return a["s"]
+            if t == P.ATTR_STRINGS:
+                return a["strings"]
             if t == P.ATTR_TENSOR:
                 return a["t"]
     return default
@@ -403,6 +521,7 @@ def import_model(model_file):
                 "Mul": "broadcast_mul", "Div": "broadcast_div"}
     _REV_UNARY = {v: k for k, v in _UNARY.items()}
     folded = {}  # initializer name -> #nodes that folded it away
+    consumed_names = None  # lazily-built set of all consumed tensor names
     transposed_weights = {}  # Transpose-node output -> original [out,in] init
     fc_pending_bias = {}  # reconstructed bias-less FC output -> (x, w, units)
 
@@ -754,6 +873,116 @@ def import_model(model_file):
             out = fn(env[ins[0]],
                      axis=tuple(axes) if axes is not None else None,
                      keepdims=keep, name=nm)
+        elif op in ("LSTM", "GRU", "RNN"):
+            # one ONNX recurrent node -> one single-layer mx RNN mega-op;
+            # W/R/B gate blocks are permuted back to the mx order and packed
+            # into the flat parameter vector the RNN op consumes
+            H = int(_get_attr(node, "hidden_size", 0))
+            if not H:
+                raise NotImplementedError(f"{op} without hidden_size")
+            direction = _get_attr(node, "direction", b"forward")
+            direction = (direction.decode()
+                         if isinstance(direction, bytes) else direction)
+            if direction == "reverse":
+                raise NotImplementedError(
+                    f"{op} direction='reverse' (wrap the sequence flip "
+                    "explicitly instead)")
+            bidir = direction == "bidirectional"
+            D = 2 if bidir else 1
+            acts = _get_attr(node, "activations", None)
+            if acts is not None:
+                acts = [s.decode() if isinstance(s, bytes) else s for s in acts]
+            if op == "RNN":
+                act_set = set(acts or ["Tanh"])
+                if act_set == {"Tanh"}:
+                    mode = "rnn_tanh"
+                elif act_set == {"Relu"}:
+                    mode = "rnn_relu"
+                else:
+                    raise NotImplementedError(f"RNN activations {acts}")
+            else:
+                mode = op.lower()
+                if acts is not None:
+                    defaults = {"LSTM": ["Sigmoid", "Tanh", "Tanh"],
+                                "GRU": ["Sigmoid", "Tanh"]}[op] * D
+                    if acts != defaults:
+                        raise NotImplementedError(
+                            f"{op} with non-default activations {acts}")
+            if op == "GRU" and not _get_attr(node, "linear_before_reset", 0):
+                raise NotImplementedError(
+                    "GRU with linear_before_reset=0 (the mx/cuDNN cell "
+                    "applies the reset gate after the hidden matmul)")
+            if _get_attr(node, "clip", None) is not None:
+                raise NotImplementedError(f"{op} cell clipping")
+            if _get_attr(node, "layout", 0):
+                raise NotImplementedError(
+                    f"{op} layout=1 (batch-major); mx RNN is time-major — "
+                    "re-export with layout=0")
+            if op == "LSTM" and _get_attr(node, "input_forget", 0):
+                raise NotImplementedError("LSTM input_forget coupling")
+            ins = node["input"]
+            if len(ins) > 4 and ins[4]:
+                raise NotImplementedError(
+                    f"{op} with sequence_lens (variable-length batches)")
+            if op == "LSTM" and len(ins) > 7 and ins[7]:
+                raise NotImplementedError(
+                    "LSTM peephole weights (P input) have no mx cell "
+                    "equivalent")
+            W = _init_or_reject(ins[1], f"{op} W")   # [D, G*H, C]
+            R = _init_or_reject(ins[2], f"{op} R")   # [D, G*H, H]
+            Bv = (_init_or_reject(ins[3], f"{op} B")
+                  if len(ins) > 3 and ins[3] else None)  # [D, 2*G*H]
+            G_gates = {"LSTM": 4, "GRU": 3, "RNN": 1}[op]
+            # invert the export-side mx->ONNX gate permutation
+            inv = tuple(int(i) for i in _np.argsort(_RNN_EXPORT_PERM[mode]))
+
+            def _reorder(M):
+                return _np.concatenate([M[p * H:(p + 1) * H] for p in inv])
+
+            chunks = []
+            for d in range(D):
+                chunks.append(_reorder(W[d]).ravel())
+                chunks.append(_reorder(R[d]).ravel())
+            for d in range(D):
+                b = (Bv[d] if Bv is not None
+                     else _np.zeros(2 * G_gates * H, W.dtype))
+                chunks.append(_reorder(b[:G_gates * H]).ravel())
+                chunks.append(_reorder(b[G_gates * H:]).ravel())
+            pkey = nm + "_parameters"
+            inits[pkey] = _np.concatenate(chunks).astype(_np.float32)
+            env[pkey] = S.var(pkey)
+            for iname in (ins[1], ins[2], ins[3] if Bv is not None else None):
+                if iname:
+                    _drop_if_unused(iname, g, inits, env, folded)
+
+            rnn_in = [env[ins[0]], env[pkey]]
+            init_h = ins[5] if len(ins) > 5 and ins[5] else None
+            init_c = ins[6] if op == "LSTM" and len(ins) > 6 and ins[6] else None
+            if op == "LSTM" and bool(init_h) != bool(init_c):
+                # the mx RNN op takes both LSTM states or neither; a lone
+                # ONNX default-zero partner has no batch-shape-free symbol
+                raise NotImplementedError(
+                    "LSTM with only one of initial_h/initial_c provided")
+            if init_h:
+                rnn_in.append(env[init_h])
+                if init_c:
+                    rnn_in.append(env[init_c])
+            y = sym_mod.RNN(*rnn_in, mode=mode, state_size=H, num_layers=1,
+                            bidirectional=bidir, name=nm)
+            # mx output [T, B, D*H] -> the ONNX Y layout [T, D, B, H]
+            y = sym_mod.reshape(y, shape=(0, 0, D, H), name=nm + "_splitdirs")
+            y = sym_mod.transpose(y, axes=(0, 2, 1, 3), name=nm + "_onnxY")
+            env[node["output"][0]] = y
+            if consumed_names is None:
+                consumed_names = {i for n2 in g["node"] for i in n2["input"]}
+                consumed_names |= {o["name"] for o in g["output"]}
+            consumed = consumed_names
+            for state_out in node["output"][1:]:
+                if state_out and state_out in consumed:
+                    raise NotImplementedError(
+                        f"{op} state outputs (Y_h/Y_c) are consumed by the "
+                        "graph; only Y import is supported")
+            continue
         elif op in _REV_UNARY:
             out = getattr(sym_mod, _REV_UNARY[op])(env[node["input"][0]],
                                                    name=nm)

@@ -112,7 +112,7 @@ def _unpack_rnn_params(parameters, mode, input_size, state_size, num_layers,
 
 
 @register("RNN")
-def rnn_mega(data, parameters, state, state_cell=None, *, mode="lstm",
+def rnn_mega(data, parameters, state=None, state_cell=None, *, mode="lstm",
              state_size=0, num_layers=1, bidirectional=False, p=0.0,
              state_outputs=False, training=False, key=None):
     """The reference's fused RNN mega-op under its real name/signature
@@ -125,8 +125,16 @@ def rnn_mega(data, parameters, state, state_cell=None, *, mode="lstm",
     H = int(state_size)
     flat = _unpack_rnn_params(parameters, mode, data.shape[2], H,
                               num_layers, bidirectional)
-    if mode == "lstm" and state_cell is None:
-        raise ValueError("LSTM mode requires state_cell")
+    dirs = 2 if bidirectional else 1
+    if mode == "lstm" and (state is None) != (state_cell is None):
+        raise ValueError(
+            "LSTM mode takes BOTH state and state_cell, or neither "
+            "(omitting both synthesizes zero initial states)")
+    if state is None:  # ONNX-style default: zero initial states
+        state = jnp.zeros((int(num_layers) * dirs, data.shape[1], H),
+                          dtype=data.dtype)
+        if mode == "lstm":
+            state_cell = jnp.zeros_like(state)
     c0 = state_cell if mode == "lstm" else state  # dummy for non-LSTM
     res = rnn_fused(data, state, c0, *flat, mode=mode,
                     num_layers=int(num_layers), hidden_size=H,

@@ -19,6 +19,7 @@ import jax.numpy as jnp
 
 from ..base import _as_np_dtype
 from ..ops.registry import get_op
+from ..ops.rnn_ops import rnn_param_size as _rnn_param_size
 from .symbol import is_aux_name
 
 __all__ = ["infer_shape", "infer_type", "PARAM_SHAPE_RULES"]
@@ -82,6 +83,17 @@ PARAM_SHAPE_RULES = {
     "GroupNorm": {"gamma": _channel, "beta": _channel},
     "Embedding": {
         "weight": lambda d, a: (a.get("input_dim", 0), a.get("output_dim", 0)),
+    },
+    "RNN": {
+        "parameters": lambda d, a: (_rnn_param_size(
+            a.get("mode", "lstm"), d[2], a.get("state_size", 0),
+            a.get("num_layers", 1), a.get("bidirectional", False)),),
+        "state": lambda d, a: (
+            int(a.get("num_layers", 1)) * (2 if a.get("bidirectional") else 1),
+            d[1], int(a.get("state_size", 0))),
+        "state_cell": lambda d, a: (
+            int(a.get("num_layers", 1)) * (2 if a.get("bidirectional") else 1),
+            d[1], int(a.get("state_size", 0))),
     },
     # loss heads: label shape from data shape (the bidirectional-inference
     # direction the reference's InferShape pass provides — lets predict-

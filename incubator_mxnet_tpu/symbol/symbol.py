@@ -75,6 +75,10 @@ _OPTIONAL_TENSOR = {
     "fully_connected": {"bias": "no_bias"},
     "Convolution": {"bias": "no_bias"},
     "Deconvolution": {"bias": "no_bias"},
+    # flag None: optional tensor with no gate attr — simply omitted from the
+    # node when the caller doesn't pass it (the op fn's own default applies,
+    # e.g. RNN synthesizes zero initial states)
+    "RNN": {"state": None, "state_cell": None},
 }
 
 # Explicit tensor-input lists for ops where signature inspection is not
@@ -487,6 +491,7 @@ def _apply_op(opname, args, kwargs, name=None, hint=None):
 
     inputs, input_names = [], []
     optional = _OPTIONAL_TENSOR.get(opname, {})
+    skipped_optional = None
     for t in tnames:
         if t in attrs:
             # supplied as a non-Symbol kwarg → it is a static attr
@@ -494,18 +499,30 @@ def _apply_op(opname, args, kwargs, name=None, hint=None):
             # do NOT auto-create a phantom variable for it.
             continue
         if t in provided:
+            if skipped_optional is not None:
+                # the executor passes inputs positionally: providing a
+                # tensor AFTER an omitted flagless-optional one would bind
+                # it to the wrong parameter (e.g. RNN state_cell→state)
+                raise ValueError(
+                    f"{opname}: {t} provided but earlier optional input "
+                    f"{skipped_optional!r} omitted; pass both or neither")
             entry = provided[t]._outputs
             if len(entry) != 1:
                 raise ValueError(f"{opname}: input {t} must be a single-output symbol")
             inputs.append(entry[0])
             input_names.append(t)
         else:
-            flag = optional.get(t)
-            if flag is not None and attrs.get(flag, _flag_default(op.fn, flag)):
-                # e.g. no_bias=True — including by the OP'S OWN default
-                # (Deconvolution defaults no_bias=true in the reference,
-                # Convolution false; the signature is the source of truth)
-                continue
+            if t in optional:
+                flag = optional[t]
+                if flag is None:
+                    # flagless optional tensor: omitted when not provided
+                    skipped_optional = t
+                    continue
+                if attrs.get(flag, _flag_default(op.fn, flag)):
+                    # e.g. no_bias=True — including by the OP'S OWN default
+                    # (Deconvolution defaults no_bias=true in the reference,
+                    # Convolution false; the signature is the source of truth)
+                    continue
             # missing inputs auto-create variables, incl. the MXNet idiom
             # sym.SoftmaxOutput(data, name='softmax') → 'softmax_label';
             # they inherit the active AttrScope (the reference's main use

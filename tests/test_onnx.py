@@ -427,3 +427,179 @@ class TestForeignImportBreadth:
         x = np.random.RandomState(3).rand(2, 5).astype(np.float32)
         out = _bind_forward(sym, args, x)
         np.testing.assert_allclose(out, x[:, :, None], rtol=1e-6)
+
+
+# ===========================================================================
+# RNN family (LSTM/GRU/RNN) export + import
+# ===========================================================================
+
+
+def _rnn_sym_and_params(mode, C, H, L, bidir, seed=0, explicit_states=False,
+                        B=3):
+    """Build a sym.RNN graph plus random packed params; with
+    ``explicit_states`` the zero initial states are bound initializers
+    (exercising the exporter's drop-zero-states path) instead of omitted."""
+    from incubator_mxnet_tpu.ops.rnn_ops import rnn_param_size
+
+    S.symbol._reset_naming()
+    D = 2 if bidir else 1
+    data = S.var("data")
+    p = S.var("rnn_parameters")
+    rng = np.random.RandomState(seed)
+    n = rnn_param_size(mode, C, H, L, bidir)
+    params = {"rnn_parameters": mx.nd.array(
+        rng.uniform(-0.4, 0.4, (n,)).astype(np.float32))}
+    ins = [data, p]
+    if explicit_states:
+        ins.append(S.var("rnn_state"))
+        params["rnn_state"] = mx.nd.array(np.zeros((L * D, B, H), np.float32))
+        if mode == "lstm":
+            ins.append(S.var("rnn_state_cell"))
+            params["rnn_state_cell"] = mx.nd.array(
+                np.zeros((L * D, B, H), np.float32))
+    out = S.RNN(*ins, mode=mode, state_size=H, num_layers=L,
+                bidirectional=bidir, name="rnn0")
+    return out, params
+
+
+def _bind_rnn(sym, params, data, B, H, L, D, lstm):
+    exe = sym.simple_bind(data=data.shape)
+    args = exe.arg_dict
+    args["data"][:] = data
+    for k, v in params.items():
+        if k in args:
+            args[k][:] = v.asnumpy()
+    # zero states (present as args)
+    return exe.forward(is_train=False)[0].asnumpy()
+
+
+class TestOnnxRNNFamily:
+    @pytest.mark.parametrize("mode,bidir,L,explicit", [
+        ("lstm", False, 1, False), ("lstm", True, 2, True),
+        ("gru", True, 1, False), ("rnn_relu", False, 2, False),
+        ("rnn_tanh", True, 1, True)])
+    def test_rnn_roundtrip(self, tmp_path, mode, bidir, L, explicit):
+        T, B, C, H = 5, 3, 4, 6
+        D = 2 if bidir else 1
+        sym, params = _rnn_sym_and_params(mode, C, H, L, bidir,
+                                          explicit_states=explicit, B=B)
+        data = np.random.RandomState(1).uniform(-1, 1, (T, B, C)).astype(np.float32)
+        ref = _bind_rnn(sym, params, data, B, H, L, D, mode == "lstm")
+        assert ref.shape == (T, B, D * H)
+
+        f = str(tmp_path / f"{mode}.onnx")
+        onnx_mxnet.export_model(sym, params, input_shape=data.shape,
+                                onnx_file_path=f)
+        sym2, arg2, aux2 = onnx_mxnet.import_model(f)
+        arg2.update(aux2)
+        out = _bind_forward(sym2, arg2, data)
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+    def test_lstm_encoder_roundtrip(self, tmp_path):
+        """2-layer LSTM encoder over an embedding, dense head — the
+        seq2seq-encoder shape the VERDICT names, at rtol 1e-5."""
+        from incubator_mxnet_tpu.ops.rnn_ops import rnn_param_size
+
+        S.symbol._reset_naming()
+        T, B, V, E, H = 6, 2, 50, 8, 10
+        rng = np.random.RandomState(3)
+        tok = S.var("data")  # [T, B] int tokens
+        emb = S.Embedding(tok, S.var("embed_weight"), input_dim=V,
+                          output_dim=E, name="embed")
+        p = S.var("enc_parameters")
+        enc = S.RNN(emb, p, mode="lstm", state_size=H, num_layers=2,
+                    name="enc")
+        head = S.FullyConnected(enc, S.var("head_weight"), S.var("head_bias"),
+                                num_hidden=4, flatten=False, name="head")
+        n = rnn_param_size("lstm", E, H, 2, False)
+        params = {
+            "embed_weight": mx.nd.array(rng.randn(V, E).astype(np.float32) * 0.1),
+            "enc_parameters": mx.nd.array(
+                rng.uniform(-0.3, 0.3, (n,)).astype(np.float32)),
+            "head_weight": mx.nd.array(rng.randn(4, H).astype(np.float32) * 0.1),
+            "head_bias": mx.nd.array(rng.randn(4).astype(np.float32) * 0.1),
+        }
+        data = rng.randint(0, V, (T, B)).astype(np.int64)
+
+        exe = head.simple_bind(data=data.shape)
+        exe.arg_dict["data"][:] = data
+        for k, v in params.items():
+            exe.arg_dict[k][:] = v.asnumpy()
+        ref = exe.forward(is_train=False)[0].asnumpy()
+
+        f = str(tmp_path / "encoder.onnx")
+        onnx_mxnet.export_model(head, params, input_shape=data.shape,
+                                onnx_file_path=f)
+        sym2, arg2, aux2 = onnx_mxnet.import_model(f)
+        arg2.update(aux2)
+        exe2 = sym2.simple_bind(data=data.shape)
+        exe2.arg_dict["data"][:] = data
+        for k, v in arg2.items():
+            if k in exe2.arg_dict and k != "data":
+                exe2.arg_dict[k][:] = v.asnumpy()
+        out = exe2.forward(is_train=False)[0].asnumpy()
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+    def test_rnn_export_rejections(self, tmp_path):
+        # non-zero initial state must be rejected, not mistranslated
+        sym, params = _rnn_sym_and_params("lstm", 3, 4, 1, False,
+                                          explicit_states=True, B=2)
+        params["rnn_state"] = mx.nd.array(np.ones((1, 2, 4), np.float32))
+        with pytest.raises(NotImplementedError):
+            onnx_mxnet.export_model(sym, params, input_shape=(5, 2, 3),
+                                    onnx_file_path=str(tmp_path / "x.onnx"))
+
+    def test_foreign_gru_linear_before_reset0_rejected(self, tmp_path):
+        from incubator_mxnet_tpu.contrib.onnx import _proto as P
+
+        H, C = 4, 3
+        W = np.random.RandomState(0).randn(1, 3 * H, C).astype(np.float32)
+        R = np.random.RandomState(1).randn(1, 3 * H, H).astype(np.float32)
+        f = _foreign_model(tmp_path, [
+            {"op_type": "GRU", "name": "g0", "input": ["data", "W", "R"],
+             "output": ["y"],
+             "attribute": [{"name": "hidden_size", "type": P.ATTR_INT, "i": H}]},
+        ], {"W": W, "R": R}, (5, 2, C))
+        with pytest.raises(NotImplementedError):
+            onnx_mxnet.import_model(f)
+
+    def test_foreign_lstm_no_bias_import(self, tmp_path):
+        """A hand-built single LSTM node without B input: import must
+        zero-fill the bias and produce the right-shaped output."""
+        from incubator_mxnet_tpu.contrib.onnx import _proto as P
+
+        T, B, C, H = 4, 2, 3, 5
+        rng = np.random.RandomState(0)
+        W = rng.randn(1, 4 * H, C).astype(np.float32) * 0.3
+        R = rng.randn(1, 4 * H, H).astype(np.float32) * 0.3
+        f = _foreign_model(tmp_path, [
+            {"op_type": "LSTM", "name": "l0", "input": ["data", "W", "R"],
+             "output": ["Y"], "attribute": [
+                 {"name": "hidden_size", "type": P.ATTR_INT, "i": H}]},
+            {"op_type": "Transpose", "name": "t", "input": ["Y"],
+             "output": ["yt"],
+             "attribute": [{"name": "perm", "type": P.ATTR_INTS,
+                            "ints": [0, 2, 1, 3]}]},
+            {"op_type": "Reshape", "name": "r", "input": ["yt", "shp"],
+             "output": ["y"], "attribute": []},
+        ], {"W": W, "R": R, "shp": np.asarray([0, 0, -1], np.int64)},
+            (T, B, C))
+        sym, args, aux = onnx_mxnet.import_model(f)
+        x = rng.uniform(-1, 1, (T, B, C)).astype(np.float32)
+        out = _bind_forward(sym, args, x)
+        assert out.shape == (T, B, H)
+        # independent check: numpy LSTM with ONNX gate order [i,o,f,c]
+        h = np.zeros((B, H), np.float32)
+        c = np.zeros((B, H), np.float32)
+        sig = lambda v: 1.0 / (1.0 + np.exp(-v))
+        want = np.zeros((T, B, H), np.float32)
+        for t in range(T):
+            gates = x[t] @ W[0].T + h @ R[0].T
+            i = sig(gates[:, 0:H])
+            o = sig(gates[:, H:2*H])
+            fgt = sig(gates[:, 2*H:3*H])
+            cc = np.tanh(gates[:, 3*H:4*H])
+            c = fgt * c + i * cc
+            h = o * np.tanh(c)
+            want[t] = h
+        np.testing.assert_allclose(out, want, rtol=1e-4, atol=1e-5)

@@ -189,6 +189,7 @@ def test_rnn_megaop_output_only_and_validation():
     with pytest.raises(ValueError):
         mx.nd.RNN(x, mx.nd.zeros((n + 1,)), mx.nd.zeros((1, B, H)),
                   mode="gru", state_size=H, num_layers=1)
-    with pytest.raises(ValueError):
-        mx.nd.RNN(x, mx.nd.zeros((rnn_param_size("lstm", C, H),)),
-                  mx.nd.zeros((1, B, H)), mode="lstm", state_size=H)
+    # states omitted -> zero initial states are synthesized (ONNX default)
+    out_nostate = mx.nd.RNN(x, mx.nd.zeros((rnn_param_size("lstm", C, H),)),
+                            mode="lstm", state_size=H)
+    assert out_nostate.shape == (T, B, H)
