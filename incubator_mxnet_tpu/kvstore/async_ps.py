@@ -1,0 +1,253 @@
+"""True ``dist_async`` — a parameter-server tier with asynchronous,
+barrier-free push/pull (parity: [U:src/kvstore/kvstore_dist.cc] async mode
++ [U:src/kvstore/kvstore_dist_server.h] server-side updates).
+
+Architecture: unlike ``dist_sync`` (SPMD peers over XLA collectives — a
+collective IS a barrier, so async semantics cannot ride that path), this
+backend runs an actual server: a threaded TCP parameter server hosted
+inside worker 0's process, the analog of the reference's ps-lite server
+co-located with the scheduler.  Workers push gradients and pull weights
+independently; the server applies each push the moment it arrives (the
+optimizer runs SERVER-side, as the reference's async mode does), so fast
+workers never wait for stragglers — bounded only by the optional
+``MXNET_KVSTORE_MAX_STALENESS`` window.
+
+Wire protocol: length-prefixed pickles of small tuples; tensors cross as
+raw numpy bytes.  This is a control-plane path (the reference's ZMQ tier);
+the SPMD data plane stays on XLA collectives.
+
+Staleness bound: with ``MXNET_KVSTORE_MAX_STALENESS=k``, a worker whose
+push count leads the slowest worker by >= k blocks until the straggler
+catches up (SSP, Ho et al. 2013); unset = unbounded (the reference's
+``dist_async`` contract).
+"""
+from __future__ import annotations
+
+import atexit
+import os
+import pickle
+import socket
+import socketserver
+import struct
+import threading
+import time
+
+import numpy as np
+
+__all__ = ["ParameterServer", "AsyncClient", "serve_if_rank0", "server_port"]
+
+_LEN = struct.Struct("!I")
+
+
+def _send_msg(sock, obj):
+    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    sock.sendall(_LEN.pack(len(payload)) + payload)
+
+
+def _recv_msg(sock):
+    hdr = b""
+    while len(hdr) < _LEN.size:
+        chunk = sock.recv(_LEN.size - len(hdr))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        hdr += chunk
+    (n,) = _LEN.unpack(hdr)
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(min(1 << 20, n - len(buf)))
+        if not chunk:
+            raise ConnectionError("peer closed mid-message")
+        buf += chunk
+    return pickle.loads(bytes(buf))
+
+
+def server_port():
+    """The async-PS listen port: the DMLC coordinator port shifted out of
+    the jax.distributed coordinator's way (override: MXNET_ASYNC_PS_PORT)."""
+    if "MXNET_ASYNC_PS_PORT" in os.environ:
+        return int(os.environ["MXNET_ASYNC_PS_PORT"])
+    return int(os.environ.get("DMLC_PS_ROOT_PORT", "9000")) + 1000
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    def handle(self):
+        ps = self.server.ps
+        try:
+            while True:
+                msg = _recv_msg(self.request)
+                try:
+                    reply = ps.dispatch(msg)
+                except Exception as e:  # keep the connection; report the cause
+                    reply = ("err", f"{type(e).__name__}: {e}")
+                _send_msg(self.request, reply)
+                if msg[0] == "shutdown":
+                    return
+        except (ConnectionError, OSError):
+            return
+
+
+class _TCPServer(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+class ParameterServer:
+    """The server tier: key -> numpy weight, applied-on-arrival updates."""
+
+    def __init__(self, num_workers, port=None, staleness=None):
+        self.num_workers = int(num_workers)
+        self.staleness = staleness if staleness is not None else (
+            int(os.environ["MXNET_KVSTORE_MAX_STALENESS"])
+            if "MXNET_KVSTORE_MAX_STALENESS" in os.environ else None)
+        self._store = {}
+        self._updater = None
+        self._push_counts = [0] * self.num_workers
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._barrier_count = 0
+        self._barrier_gen = 0
+        # bind all interfaces: clients connect to DMLC_PS_ROOT_URI, which a
+        # real tracker sets to the host's routable address, not loopback
+        self._tcp = _TCPServer(("", port if port is not None else server_port()),
+                               _Handler)
+        self._tcp.ps = self
+        self._thread = threading.Thread(target=self._tcp.serve_forever,
+                                        name="mxtpu-async-ps", daemon=True)
+        self._thread.start()
+
+    @property
+    def address(self):
+        return self._tcp.server_address
+
+    # -- message dispatch (runs on handler threads) ----------------------
+    def dispatch(self, msg):
+        kind = msg[0]
+        if kind == "init":
+            _, key, arr = msg
+            with self._lock:
+                self._store.setdefault(key, np.array(arr, copy=True))
+            return ("ok",)
+        if kind == "push":
+            _, key, arr, rank = msg
+            with self._cond:
+                if self.staleness is not None:
+                    # SSP: block while this worker leads the slowest ACTIVE
+                    # worker by >= the bound.  "Active" = has pushed at
+                    # least once: a pull-only evaluator rank must not
+                    # deadlock the pushers (divergence from strict SSP,
+                    # which cannot distinguish 'slow' from 'never').
+                    bound = max(1, self.staleness)
+                    while True:
+                        active = [c for i, c in enumerate(self._push_counts)
+                                  if c > 0 and i != rank]
+                        if not active or (self._push_counts[rank]
+                                          - min(active) < bound):
+                            break
+                        self._cond.wait(timeout=60)
+                if self._updater is not None:
+                    self._apply_update(key, np.asarray(arr))
+                elif key in self._store:
+                    self._store[key] = self._store[key] + np.asarray(arr)
+                else:
+                    self._store[key] = np.array(arr, copy=True)
+                self._push_counts[rank] += 1
+                self._cond.notify_all()
+            return ("ok",)
+        if kind == "pull":
+            _, key = msg
+            with self._lock:
+                if key not in self._store:
+                    return ("err", f"unknown key {key!r}")
+                return ("val", np.array(self._store[key], copy=True))
+        if kind == "set_optimizer":
+            _, blob = msg
+            from ..optimizer import get_updater
+            with self._lock:
+                self._updater = get_updater(pickle.loads(blob))
+            return ("ok",)
+        if kind == "barrier":
+            # counting barrier, generation-tagged for reuse
+            with self._cond:
+                gen = self._barrier_gen
+                self._barrier_count += 1
+                if self._barrier_count == self.num_workers:
+                    self._barrier_count = 0
+                    self._barrier_gen += 1
+                    self._cond.notify_all()
+                else:
+                    while self._barrier_gen == gen:
+                        self._cond.wait(timeout=120)
+            return ("ok",)
+        if kind == "counts":
+            with self._lock:
+                return ("val", list(self._push_counts))
+        if kind == "shutdown":
+            threading.Thread(target=self.stop, daemon=True).start()
+            return ("ok",)
+        return ("err", f"unknown message {kind!r}")
+
+    def _apply_update(self, key, grad):
+        """Server-side optimizer step (the reference's async contract:
+        each push updates the weight immediately, no aggregation window)."""
+        from ..ndarray.ndarray import NDArray
+
+        w = NDArray(self._store[key])
+        self._updater(key, NDArray(grad), w)
+        self._store[key] = np.asarray(w.asnumpy())
+
+    def stop(self):
+        self._tcp.shutdown()
+        self._tcp.server_close()
+
+
+class AsyncClient:
+    """Worker-side connection to the parameter server."""
+
+    def __init__(self, host, port, connect_timeout=60.0):
+        deadline = time.monotonic() + connect_timeout
+        last = None
+        while True:
+            try:
+                self._sock = socket.create_connection((host, port), timeout=300)
+                self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                break
+            except OSError as e:  # server not up yet
+                last = e
+                if time.monotonic() > deadline:
+                    raise ConnectionError(
+                        f"async PS at {host}:{port} unreachable: {last}") from e
+                time.sleep(0.1)
+        self._lock = threading.Lock()
+        atexit.register(self.close)
+
+    def request(self, *msg):
+        with self._lock:
+            _send_msg(self._sock, msg)
+            reply = _recv_msg(self._sock)
+        if reply[0] == "err":
+            raise KeyError(reply[1])
+        return reply[1] if len(reply) > 1 else None
+
+    def close(self):
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+_SERVER = None
+_SERVER_LOCK = threading.Lock()
+
+
+def serve_if_rank0(rank, num_workers):
+    """Start the PS inside worker 0's process (the reference co-locates
+    server+scheduler the same way in single-host mode); returns the server
+    handle or None.  Singleton per process: every KVStore instance in the
+    process shares one server, as ps-lite shares one van."""
+    global _SERVER
+    if int(rank) != 0:
+        return None
+    with _SERVER_LOCK:
+        if _SERVER is None:
+            _SERVER = ParameterServer(num_workers)
+        return _SERVER

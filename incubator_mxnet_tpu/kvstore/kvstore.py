@@ -11,13 +11,17 @@ collapses onto XLA collectives:
   compiled step (psum over the mesh), so push/pull degenerate to a
   key->value store with list-sum on push — semantically identical to the
   reference for the single-worker case and for Module's executor groups.
-* 'dist_sync'/'dist_async'/'dist_sync_device' — multi-process aggregation
-  over jax.distributed (ICI/DCN collectives).  The PS tier (scheduler +
+* 'dist_sync'/'dist_sync_device' — multi-process aggregation over
+  jax.distributed (ICI/DCN collectives).  The PS tier (scheduler +
   servers + DMLC_* bootstrap) has no equivalent process: workers are SPMD
   peers.  ``set_optimizer`` therefore runs the optimizer locally on
   identically-replicated state — same result as server-side updates, no
-  server.  'dist_async' is accepted and behaves synchronously (documented
-  divergence: async staleness is a PS artifact, not a capability).
+  server.
+* 'dist_async' — a REAL async tier (since round 5): a threaded TCP
+  parameter server inside worker 0's process (``async_ps.py``), applying
+  each worker's push the moment it arrives with the optimizer running
+  server-side — the reference's ps-lite async contract, stragglers and
+  all.  Optional SSP bound via MXNET_KVSTORE_MAX_STALENESS.
 * gradient compression — per-worker gradients are quantized to 2-bit
   {-t, 0, +t} codes with an error-feedback residual *before* the wire
   (matching [U:src/kvstore/gradient_compression.cc]'s worker-side
@@ -36,7 +40,8 @@ import numpy as _np
 
 from ..ndarray.ndarray import NDArray, array, zeros
 
-__all__ = ["KVStore", "KVStoreLocal", "KVStoreDist", "create"]
+__all__ = ["KVStore", "KVStoreLocal", "KVStoreDist", "KVStoreDistAsync",
+           "create"]
 
 
 def create(name="local"):
@@ -44,7 +49,9 @@ def create(name="local"):
     name = name.lower()
     if name in ("local", "local_allreduce_cpu", "local_allreduce_device", "device", "nccl"):
         return KVStoreLocal(name)
-    if name in ("dist_sync", "dist_async", "dist_sync_device", "dist_device_sync", "dist"):
+    if name == "dist_async":
+        return KVStoreDistAsync(name)
+    if name in ("dist_sync", "dist_sync_device", "dist_device_sync", "dist"):
         return KVStoreDist(name)
     if name in ("horovod", "byteps"):
         # plugin backends in the reference; SPMD collectives already provide
@@ -340,6 +347,86 @@ class KVStoreDist(KVStore):
             from jax.experimental import multihost_utils
 
             multihost_utils.sync_global_devices("kvstore_barrier")
+
+
+class KVStoreDistAsync(KVStore):
+    """'dist_async': barrier-free push/pull against the TCP parameter
+    server in worker 0 (see ``async_ps.py``).  Pure control-plane sockets —
+    no jax.distributed, no collectives, hence no implicit barriers: a
+    straggler cannot block its peers (parity:
+    [U:src/kvstore/kvstore_dist.cc] async mode)."""
+
+    def __init__(self, name):
+        super().__init__(name)
+        from . import async_ps
+
+        self._rank = int(_os.environ.get("DMLC_WORKER_ID", "0"))
+        self._num_workers = int(_os.environ.get("DMLC_NUM_WORKER", "1"))
+        host = _os.environ.get("DMLC_PS_ROOT_URI", "127.0.0.1")
+        self._server = async_ps.serve_if_rank0(self._rank, self._num_workers)
+        self._client = async_ps.AsyncClient(host, async_ps.server_port())
+
+    @property
+    def rank(self):
+        return self._rank
+
+    @property
+    def num_workers(self):
+        return self._num_workers
+
+    def init(self, key, value):
+        if isinstance(key, (list, tuple)):
+            for k, v in zip(key, value):
+                self.init(k, v)
+            return
+        if isinstance(value, (list, tuple)):
+            value = value[0]
+        self._client.request("init", key, _np.asarray(value.asnumpy()))
+
+    def push(self, key, value, priority=0):
+        if isinstance(key, (list, tuple)):
+            for k, v in zip(key, value):
+                self.push(k, v, priority)
+            return
+        agg = self._aggregate(value)
+        if self._compression is not None:
+            # worker-side compression before the wire, as in dist_sync;
+            # the server adds decoded values, so reconstruct locally
+            agg = self._compressed_reduce(key, agg)
+        self._client.request("push", key, _np.asarray(agg.asnumpy()),
+                             self._rank)
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        if isinstance(key, (list, tuple)):
+            for k, o in zip(key, out):
+                self.pull(k, o, priority)
+            return
+        value = self._client.request("pull", key)
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        for o in outs:
+            array(value, ctx=o.context).copyto(o)
+
+    def pushpull(self, key, value, out=None, priority=0):
+        self.push(key, value, priority)
+        if out is not None:
+            self.pull(key, out, priority)
+
+    def set_optimizer(self, optimizer):
+        """Ship the optimizer to the SERVER (the reference sends it to the
+        ps-lite servers the same way); pushes then apply updates there."""
+        import pickle as _pickle
+
+        self._optimizer = optimizer
+        if self._rank == 0:
+            self._client.request("set_optimizer", _pickle.dumps(optimizer))
+        self.barrier()  # all workers see server-side updates from here on
+
+    def push_counts(self):
+        """Per-worker applied-push counts (observability / SSP tests)."""
+        return self._client.request("counts")
+
+    def barrier(self):
+        self._client.request("barrier")
 
 
 _np  # keep import

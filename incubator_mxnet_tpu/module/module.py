@@ -62,6 +62,7 @@ class Module(BaseModule):
         self._optimizer = None
         self._updater_states = {}
         self._kvstore = None
+        self._kv_dist = False
         self._data_shapes = None
         self._label_shapes = None
 
@@ -186,6 +187,16 @@ class Module(BaseModule):
             self._optimizer = opt_mod.create(optimizer, **dict(optimizer_params))
         from ..kvstore import create as kv_create
         self._kvstore = kv_create(kvstore) if isinstance(kvstore, str) else kvstore
+        # dist stores own the update: gradients go through push/pull (the
+        # reference's kvstore data path — for dist_sync an allreduce + the
+        # store-side updater, for dist_async the parameter server applies
+        # each push on arrival).  Local stores keep the in-process fast
+        # path: update() applies the optimizer directly.
+        self._kv_dist = (self._kvstore is not None
+                         and str(getattr(self._kvstore, "type", "")).startswith("dist"))
+        if self._kv_dist:
+            self._kvstore.set_optimizer(self._optimizer)
+            self._kv_inited = set()
         self._updater_states = {}
         if hasattr(self, "_preloaded_opt_states"):  # Module.load(..., load_optimizer_states=True)
             for i, s in self._preloaded_opt_states.items():
@@ -223,6 +234,21 @@ class Module(BaseModule):
         inside the jitted program here)."""
         assert self.optimizer_initialized
         opt = self._optimizer
+        if self._kv_dist:
+            kv = self._kvstore
+            for name in self._param_names:
+                if name in self._fixed_param_names:
+                    continue
+                grad = self._exec.grad_dict.get(name)
+                if grad is None:
+                    continue
+                weight = self._exec.arg_dict[name]
+                if name not in self._kv_inited:
+                    kv.init(name, weight)
+                    self._kv_inited.add(name)
+                kv.push(name, grad)
+                kv.pull(name, out=weight)
+            return
         for name in self._param_names:
             if name in self._fixed_param_names:
                 continue

@@ -50,6 +50,14 @@ def test_dist_sync_kvstore_eight_workers():
     _run_dist(8)
 
 
+def test_dist_async_straggler_tolerance_eight_workers():
+    """True dist_async (round-5): 8 workers against the worker-0 parameter
+    server; the last rank straggles 3 s, the other 7 must finish their
+    barrier-free pushes+pulls well before it wakes, and the final pull is
+    the exact full sum with server-side SGD verified."""
+    _run_dist(8, script="async_worker.py", marker="async assertions passed")
+
+
 def test_multihost_mesh_two_processes_four_devices():
     """Multi-host-SHAPED topology: 2 processes × 4 virtual devices, one
     global mesh via parallel.init_distributed — the dp axis crosses the

@@ -1,0 +1,114 @@
+"""In-process unit tier for the dist_async parameter server (async_ps.py):
+protocol, applied-on-arrival semantics, and the SSP staleness bound — the
+single-process complement to tests/test_dist.py's 8-worker subprocess tier.
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from incubator_mxnet_tpu.kvstore.async_ps import AsyncClient, ParameterServer
+
+
+@pytest.fixture()
+def server():
+    ps = ParameterServer(num_workers=2, port=0)  # ephemeral port
+    yield ps
+    ps.stop()
+
+
+def _client(ps):
+    host, port = ps.address
+    return AsyncClient(host, port)
+
+
+def test_init_push_pull_roundtrip(server):
+    c = _client(server)
+    c.request("init", "k", np.zeros(3, np.float32))
+    c.request("push", "k", np.ones(3, np.float32), 0)
+    c.request("push", "k", 2 * np.ones(3, np.float32), 1)
+    np.testing.assert_allclose(c.request("pull", "k"), 3 * np.ones(3))
+    assert c.request("counts") == [1, 1]
+    with pytest.raises(KeyError):
+        c.request("pull", "missing")
+
+
+def test_pushes_apply_on_arrival_without_peers(server):
+    """The async contract: one worker's pushes land with no contribution
+    from (or waiting on) the other registered worker."""
+    c = _client(server)
+    c.request("init", "w", np.zeros(1, np.float32))
+    for _ in range(5):
+        c.request("push", "w", np.ones(1, np.float32), 0)
+    np.testing.assert_allclose(c.request("pull", "w"), [5.0])
+    assert c.request("counts") == [5, 0]
+
+
+def test_server_side_optimizer(server):
+    import pickle
+
+    from incubator_mxnet_tpu import optimizer as opt_mod
+
+    c = _client(server)
+    c.request("init", "w", np.ones(4, np.float32))
+    c.request("set_optimizer",
+              pickle.dumps(opt_mod.create("sgd", learning_rate=0.5)))
+    c.request("push", "w", np.ones(4, np.float32), 0)
+    np.testing.assert_allclose(c.request("pull", "w"), np.full(4, 0.5),
+                               rtol=1e-6)
+
+
+def test_ssp_staleness_bound():
+    """With staleness=2 a fast worker blocks once it leads the slowest
+    ACTIVE worker by the bound, until the straggler catches up (SSP, Ho et
+    al. 2013; bound applies only among workers that have pushed — a
+    pull-only rank must never deadlock the pushers)."""
+    ps = ParameterServer(num_workers=2, port=0, staleness=2)
+    try:
+        fast, slow = _client(ps), _client(ps)
+        fast.request("init", "k", np.zeros(1, np.float32))
+
+        # peer never pushed -> no bound engages (the no-deadlock rule)
+        for _ in range(3):
+            fast.request("push", "k", np.ones(1, np.float32), 0)
+        assert ps._push_counts == [3, 0]
+
+        slow.request("push", "k", np.ones(1, np.float32), 1)  # now active
+        t_done = {}
+
+        def fast_worker():
+            for _ in range(3):  # tries to reach 6; bound parks it at 1+2=3
+                fast.request("push", "k", np.ones(1, np.float32), 0)
+            t_done["fast"] = time.monotonic()
+
+        th = threading.Thread(target=fast_worker)
+        t0 = time.monotonic()
+        th.start()
+        time.sleep(0.6)
+        # fast is already 2 ahead of the active slow (3 vs 1): every further
+        # push must wait, so counts stay parked
+        assert ps._push_counts == [3, 1], ps._push_counts
+        for _ in range(3):
+            slow.request("push", "k", np.ones(1, np.float32), 1)
+        th.join(timeout=10)
+        assert not th.is_alive()
+        assert t_done["fast"] - t0 > 0.5  # it really did wait
+        np.testing.assert_allclose(fast.request("pull", "k"), [10.0])
+        assert ps._push_counts == [6, 4]
+    finally:
+        ps.stop()
+
+
+def test_unbounded_by_default():
+    ps = ParameterServer(num_workers=2, port=0)
+    try:
+        c = _client(ps)
+        c.request("init", "k", np.zeros(1, np.float32))
+        t0 = time.monotonic()
+        for _ in range(50):
+            c.request("push", "k", np.ones(1, np.float32), 0)
+        assert time.monotonic() - t0 < 5.0
+        assert ps._push_counts == [50, 0]
+    finally:
+        ps.stop()
