@@ -1,0 +1,70 @@
+"""Pallas fused BN epilogue (ops/pallas_bn.py) correctness vs the stock
+batch_norm op — interpret mode on CPU (the chip tier re-runs compiled)."""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from incubator_mxnet_tpu.ops.pallas_bn import bn_apply, bn_stats, fused_bn_relu
+
+
+@pytest.mark.parametrize("shape", [(4, 16, 14, 14), (2, 8, 7, 7), (3, 12, 5, 9)])
+def test_fused_bn_matches_reference(shape):
+    rng = np.random.RandomState(0)
+    N, C, H, W = shape
+    x = jnp.asarray(rng.randn(N, C, H, W).astype(np.float32))
+    g = jnp.asarray(rng.rand(C).astype(np.float32) + 0.5)
+    b = jnp.asarray(rng.randn(C).astype(np.float32))
+    out, mean, var = fused_bn_relu(x, g, b, interpret=True)
+    xm = np.asarray(x)
+    m = xm.mean(axis=(0, 2, 3))
+    v = xm.var(axis=(0, 2, 3))
+    want = ((xm - m[None, :, None, None]) / np.sqrt(v[None, :, None, None] + 1e-5)
+            * np.asarray(g)[None, :, None, None] + np.asarray(b)[None, :, None, None])
+    np.testing.assert_allclose(np.asarray(mean), m, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(var), v, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(out), np.maximum(want, 0.0),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_fused_bn_residual_and_dtype():
+    rng = np.random.RandomState(1)
+    N, C, H, W = 2, 8, 14, 14
+    x = jnp.asarray(rng.randn(N, C, H, W).astype(np.float32)).astype(jnp.bfloat16)
+    res = jnp.asarray(rng.randn(N, C, H, W).astype(np.float32)).astype(jnp.bfloat16)
+    g = jnp.ones(C, jnp.float32)
+    b = jnp.zeros(C, jnp.float32)
+    out, _, _ = fused_bn_relu(x, g, b, residual=res, interpret=True)
+    assert out.dtype == jnp.bfloat16
+    x32 = np.asarray(x, np.float32)
+    m = x32.mean(axis=(0, 2, 3))
+    v = x32.var(axis=(0, 2, 3))
+    want = np.maximum((x32 - m[None, :, None, None])
+                      / np.sqrt(v[None, :, None, None] + 1e-5)
+                      + np.asarray(res, np.float32), 0.0)
+    np.testing.assert_allclose(np.asarray(out, np.float32), want,
+                               rtol=5e-2, atol=5e-2)  # bf16 storage
+
+
+def test_bn_stats_one_pass_accumulation():
+    """The grid revisits the stats block across N — exact fp32 sums."""
+    rng = np.random.RandomState(2)
+    x = jnp.asarray(rng.randn(5, 6, 33).astype(np.float32))
+    s = bn_stats(x, interpret=True)
+    np.testing.assert_allclose(np.asarray(s[:, 0]),
+                               np.asarray(x).sum(axis=(0, 2)), rtol=1e-5,
+                               atol=1e-4)
+    np.testing.assert_allclose(np.asarray(s[:, 1]),
+                               (np.asarray(x) ** 2).sum(axis=(0, 2)),
+                               rtol=1e-5, atol=1e-4)
+
+
+def test_bn_apply_no_relu():
+    rng = np.random.RandomState(3)
+    x = jnp.asarray(rng.randn(2, 4, 10).astype(np.float32))
+    scale = jnp.asarray(rng.rand(4).astype(np.float32))
+    shift = jnp.asarray(rng.randn(4).astype(np.float32))
+    out = bn_apply(x, scale, shift, relu=False, interpret=True)
+    want = (np.asarray(x) * np.asarray(scale)[None, :, None]
+            + np.asarray(shift)[None, :, None])
+    np.testing.assert_allclose(np.asarray(out), want, rtol=1e-5, atol=1e-5)
