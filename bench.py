@@ -200,6 +200,7 @@ def bench_mnist(model="mlp"):
     backend = jax.default_backend()
     B = int(os.environ.get("MXNET_TPU_BENCH_BATCH", "1024"))
     warmup, steps = (3, 60) if backend != "cpu" else (1, 2)
+    steps = int(os.environ.get("MXNET_TPU_BENCH_STEPS", steps))
     from incubator_mxnet_tpu import amp
     if os.environ.get("MXNET_TPU_BENCH_AMP", "1") == "1":
         amp.init("bfloat16")
@@ -359,6 +360,7 @@ def bench_yolo3():
     backend = jax.default_backend()
     B = int(os.environ.get("MXNET_TPU_BENCH_BATCH", "16"))
     warmup, steps = (2, 20) if backend != "cpu" else (1, 1)
+    steps = int(os.environ.get("MXNET_TPU_BENCH_STEPS", steps))
     from incubator_mxnet_tpu import amp
     if os.environ.get("MXNET_TPU_BENCH_AMP", "1") == "1":
         amp.init("bfloat16")

@@ -603,3 +603,16 @@ class TestOnnxRNNFamily:
             h = o * np.tanh(c)
             want[t] = h
         np.testing.assert_allclose(out, want, rtol=1e-4, atol=1e-5)
+
+
+def test_detection_graph_export_documented_rejection(tmp_path):
+    """MultiBox/NMS graphs must be rejected with guidance, not silently
+    mistranslated (dynamic ONNX NonMaxSuppression vs static padded
+    layouts)."""
+    S.symbol._reset_naming()
+    data = S.var("data")
+    prior = S.contrib_MultiBoxPrior(data, sizes=(0.5,), ratios=(1.0,),
+                                    name="prior")
+    with pytest.raises(NotImplementedError, match="detection post-processing"):
+        onnx_mxnet.export_model(prior, {}, input_shape=(1, 3, 8, 8),
+                                onnx_file_path=str(tmp_path / "d.onnx"))
