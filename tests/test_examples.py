@@ -67,3 +67,10 @@ def test_word_language_model():
 def test_ctc_ocr():
     out = _run("ctc_ocr.py", "--smoke")
     assert "smoke ok" in out
+
+
+def test_lstm_bucketing():
+    """The sym.RNN mega-op + BucketingModule path ([U:example/rnn/
+    bucketing/] analog): perplexity must fall and buckets share weights."""
+    out = _run("lstm_bucketing.py", "--epochs", "2", timeout=420)
+    assert "final-perplexity" in out
