@@ -461,9 +461,15 @@ def _make_softmax_output(grad_scale, ignore_label, use_ignore, multi_output, nor
             grad = grad * jnp.expand_dims(keep, ax)
         if normalization == "batch":
             grad = grad / out.shape[0]
-        elif normalization == "valid" and use_ignore:
-            keep = (lab != int(ignore_label)).astype(out.dtype)
-            grad = grad / jnp.maximum(jnp.sum(keep), 1.0)
+        elif normalization == "valid":
+            # reference: divide by the VALID count — without use_ignore
+            # every label is valid, so this is the total label count (NOT
+            # a silent no-op; [U:src/operator/softmax_output-inl.h])
+            if use_ignore:
+                keep = (lab != int(ignore_label)).astype(out.dtype)
+                grad = grad / jnp.maximum(jnp.sum(keep), 1.0)
+            else:
+                grad = grad / float(lab.size)
         return (grad, _zero_cotangent(label))
 
     f.defvjp(fwd, bwd)

@@ -270,3 +270,39 @@ class TestEmbeddingAndHeads:
         sq = mx.nd.SliceChannel(_nd(x[:, :3]), num_outputs=3, axis=1,
                                 squeeze_axis=True)
         assert sq[0].shape == (2, 3)
+
+
+class TestSoftmaxOutputNormalization:
+    """Backward normalization modes of the legacy SoftmaxOutput head
+    ([U:src/operator/softmax_output-inl.h]): 'valid' divides by the valid
+    count — equal to the TOTAL label count when use_ignore is off (it is
+    NOT a no-op there)."""
+
+    def _grad(self, **kwargs):
+        x = np.arange(12, dtype=np.float32).reshape(4, 3) * 0.1
+        lab = np.array([0, 1, 2, 1], np.float32)
+        xa = _nd(x)
+        xa.attach_grad()
+        with autograd.record():
+            out = mx.nd.SoftmaxOutput(xa, _nd(lab), **kwargs)
+        out.backward()
+        return xa.grad.asnumpy(), out.asnumpy()
+
+    @with_seed()
+    def test_valid_without_ignore_divides_by_count(self):
+        g_null, p = self._grad()
+        g_valid, _ = self._grad(normalization="valid")
+        g_batch, _ = self._grad(normalization="batch")
+        assert_almost_equal(g_valid, g_null / 4.0, rtol=1e-5, atol=1e-7)
+        assert_almost_equal(g_batch, g_null / 4.0, rtol=1e-5, atol=1e-7)
+        oh = np.eye(3, dtype=np.float32)[[0, 1, 2, 1]]
+        assert_almost_equal(g_null, p - oh, rtol=1e-5, atol=1e-6)
+
+    @with_seed()
+    def test_valid_with_ignore_divides_by_valid_count(self):
+        g, p = self._grad(normalization="valid", use_ignore=True,
+                          ignore_label=1)
+        oh = np.eye(3, dtype=np.float32)[[0, 1, 2, 1]]
+        want = (p - oh)
+        want[[1, 3]] = 0.0  # ignored rows contribute nothing
+        assert_almost_equal(g, want / 2.0, rtol=1e-5, atol=1e-7)
