@@ -364,3 +364,75 @@ def test_new_optimizer_kernels_on_tpu():
          jnp.float32(1)])
     run(K.adamax_update, [w, g, z, z],
         [jnp.float32(0.9), jnp.float32(0.999)])
+
+
+# ---------------------------------------------------------------------------
+# round-5 op families on the chip (same check_consistency oracle)
+# ---------------------------------------------------------------------------
+
+
+def test_rnn_megaop_cpu_vs_tpu():
+    from incubator_mxnet_tpu.ops.rnn_ops import rnn_param_size
+
+    T, B, C, H = 5, 2, 3, 4
+    rng = np.random.RandomState(7)
+    x = rng.uniform(-1, 1, (T, B, C)).astype(np.float32)
+    for mode, bidir in (("lstm", True), ("gru", False)):
+        n = rnn_param_size(mode, C, H, 2, bidir)
+        p = rng.uniform(-0.3, 0.3, (n,)).astype(np.float32)
+        check_consistency(
+            lambda d, pp, _m=mode, _b=bidir: mx.nd.RNN(
+                d, pp, mode=_m, state_size=H, num_layers=2, bidirectional=_b),
+            [x, p], rtol=1e-3, atol=1e-4)
+
+
+def test_deformable_ops_cpu_vs_tpu():
+    rng = np.random.RandomState(8)
+    x = rng.randn(1, 4, 8, 8).astype(np.float32)
+    w = rng.randn(6, 4, 3, 3).astype(np.float32)
+    off = np.full((1, 18, 8, 8), 0.37, np.float32)
+    check_consistency(
+        lambda d, ww: mx.nd._contrib_DeformableConvolution(
+            d, mx.nd.array(off), ww, kernel=(3, 3), pad=(1, 1), num_filter=6,
+            no_bias=True), [x, w], rtol=1e-3, atol=1e-3)
+    C = 2 * 2 * 2
+    score = rng.randn(1, C, 8, 8).astype(np.float32)
+    rois = np.array([[0, 1, 1, 11, 13]], np.float32)
+    check_consistency(
+        lambda d: mx.nd._contrib_DeformablePSROIPooling(
+            d, mx.nd.array(rois), spatial_scale=0.5, output_dim=2,
+            group_size=2, pooled_size=2, sample_per_part=2, no_trans=True),
+        [score], rtol=1e-3, atol=1e-4)
+
+
+def test_scalar_special_cpu_vs_tpu():
+    x = np.random.RandomState(9).uniform(0.5, 4.0, (16,)).astype(np.float32)
+    check_consistency(lambda d: mx.nd.digamma(d), [x], rtol=1e-3, atol=1e-4)
+    check_consistency(lambda d: mx.nd.polygamma(d, n=1), [x],
+                      rtol=1e-3, atol=1e-3, grad=False)
+
+
+def test_pallas_fused_bn_on_tpu():
+    """The fused BN epilogue COMPILED on the chip (interpret-mode tests
+    cover CPU) vs the stock batch_norm op on the same device."""
+    import jax
+    import jax.numpy as jnp
+
+    if jax.default_backend() == "cpu":
+        pytest.skip("needs an accelerator backend")
+    from incubator_mxnet_tpu.ops.pallas_bn import fused_bn_relu
+    from incubator_mxnet_tpu.ops.nn import batch_norm
+
+    rng = np.random.RandomState(10)
+    x = jnp.asarray(rng.randn(2, 8, 14, 14).astype(np.float32))
+    g = jnp.asarray(rng.rand(8).astype(np.float32) + 0.5)
+    b = jnp.asarray(rng.randn(8).astype(np.float32))
+    got, m, v = fused_bn_relu(x, g, b, relu=False, interpret=False)
+    want, wm, wv = batch_norm(x, g, b, jnp.zeros(8), jnp.ones(8),
+                              fix_gamma=False)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-3, atol=1e-3)
+    np.testing.assert_allclose(np.asarray(m), np.asarray(wm), rtol=1e-4,
+                               atol=1e-4)
+    np.testing.assert_allclose(np.asarray(v), np.asarray(wv), rtol=1e-4,
+                               atol=1e-4)
