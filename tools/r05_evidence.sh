@@ -1,0 +1,59 @@
+#!/usr/bin/env bash
+# Round-5 on-chip evidence capture — run the COMPLETE measurement set the
+# moment the axon tunnel is healthy.  Each step appends to
+# docs/BENCH_EVIDENCE_r05.txt; nothing here stops the sequence (a step
+# failure records the error JSON and moves on).
+#
+# Usage: tools/r05_evidence.sh            # everything
+#        tools/r05_evidence.sh bench     # just the five-config bench set
+set -u
+cd "$(dirname "$0")/.."
+
+EV=docs/BENCH_EVIDENCE_r05.txt
+WHAT="${1:-all}"
+stamp() { date -u +%FT%TZ; }
+
+note() { echo "[$(stamp)] $*" | tee -a "$EV"; }
+
+run_bench() {
+    local tag="$1"; shift
+    note "== bench: $tag ($*)"
+    env "$@" timeout 3600 python bench.py 2>>"$EV".err | tee -a "$EV"
+}
+
+echo "# round-5 evidence, started $(stamp)" >> "$EV"
+
+if [ "$WHAT" = all ] || [ "$WHAT" = bench ]; then
+    # the five-config set (VERDICT item 1): BERT gate number first
+    run_bench bert
+    run_bench bert-repeat2
+    run_bench bert-repeat3
+    run_bench resnet50      MXNET_TPU_BENCH=resnet50
+    run_bench transformer   MXNET_TPU_BENCH=transformer
+    run_bench ssd-resnet18  MXNET_TPU_BENCH=ssd
+    run_bench ssd-vgg16     MXNET_TPU_BENCH=ssd MXNET_TPU_BENCH_SSD_BACKBONE=vgg16
+    run_bench yolo3         MXNET_TPU_BENCH=yolo3
+    run_bench mnist         MXNET_TPU_BENCH=mnist
+fi
+
+if [ "$WHAT" = all ] || [ "$WHAT" = sweep ]; then
+    note "== window sweep (VERDICT item 2)"
+    timeout 7200 python tools/bench_window_sweep.py 2>>"$EV".err | tee -a "$EV"
+fi
+
+if [ "$WHAT" = all ] || [ "$WHAT" = control ]; then
+    note "== raw-JAX ResNet-50 control (VERDICT item 4a)"
+    timeout 3600 python tools/resnet_control.py 2>>"$EV".err | tee -a "$EV"
+    note "== Pallas fused BN A/B, stages 2+3 (VERDICT item 4b)"
+    MXNET_TPU_BN_STAGE=2 timeout 1800 python tools/bench_fused_bn.py 2>>"$EV".err | tee -a "$EV"
+    MXNET_TPU_BN_STAGE=3 timeout 1800 python tools/bench_fused_bn.py 2>>"$EV".err | tee -a "$EV"
+fi
+
+if [ "$WHAT" = all ] || [ "$WHAT" = tier ]; then
+    note "== full-suite chip tier (VERDICT item 5) -> docs/TPU_TIER_LOG_r05.txt"
+    tools/run_tpu_tier.sh docs/TPU_TIER_LOG_r05.txt 420 | tee -a "$EV"
+    note "== tpu_tests family rows"
+    MXNET_TEST_CTX=tpu timeout 3600 python -m pytest tpu_tests/ -q 2>&1 | tail -3 | tee -a "$EV"
+fi
+
+note "== evidence capture complete"
