@@ -28,8 +28,10 @@ if [ "$WHAT" = all ] || [ "$WHAT" = bench ]; then
     run_bench bert
     run_bench bert-repeat2
     run_bench bert-repeat3
+    run_bench bert-ln-custom MXNET_TPU_LN_CUSTOM_BWD=1
     run_bench resnet50      MXNET_TPU_BENCH=resnet50
     run_bench transformer   MXNET_TPU_BENCH=transformer
+    run_bench transformer-ln-custom MXNET_TPU_BENCH=transformer MXNET_TPU_LN_CUSTOM_BWD=1
     run_bench ssd-resnet18  MXNET_TPU_BENCH=ssd
     run_bench ssd-vgg16     MXNET_TPU_BENCH=ssd MXNET_TPU_BENCH_SSD_BACKBONE=vgg16
     run_bench yolo3         MXNET_TPU_BENCH=yolo3
