@@ -242,6 +242,17 @@ def batch_norm(
     moving stats are used and returned unchanged.
     """
     ax = axis % data.ndim
+    pallas_mode = os.environ.get("MXNET_TPU_PALLAS_BN", "0")
+    if (pallas_mode in ("1", "interpret") and not use_global_stats
+            and ax == 1 and data.ndim == 4):
+        # opt-in A/B path (VERDICT r4 item 4b): Pallas 2-pass forward,
+        # reference-vjp backward; "interpret" runs the kernels in
+        # interpreter mode for CPU tests
+        from .pallas_bn import trainable_batch_norm
+
+        g = jnp.ones_like(gamma) if fix_gamma else gamma
+        return trainable_batch_norm(data, g, beta, eps=float(eps),
+                                    interpret=pallas_mode == "interpret")
     reduce_axes = tuple(i for i in range(data.ndim) if i != ax)
     bshape = tuple(data.shape[ax] if i == ax else 1 for i in range(data.ndim))
     x32 = data.astype(jnp.float32)

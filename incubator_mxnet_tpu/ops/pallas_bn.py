@@ -114,6 +114,47 @@ def bn_apply(x, scale, shift, residual=None, relu=True, c_block=8,
     )(x, scale2, shift2, residual)
 
 
+@functools.lru_cache(maxsize=None)
+def _make_trainable_bn(eps, interpret):
+    """Trainable wrapper: forward runs the two Pallas passes; backward is
+    jax.vjp of the reference formula (recompute — XLA fuses it into the
+    backward graph, and correctness is inherited rather than hand-derived).
+    Returns (out, mean, var) like ``ops.nn.batch_norm``."""
+    def _ref(x, gamma, beta):
+        x32 = x.astype(jnp.float32)
+        axes = (0, 2, 3)
+        mean = jnp.mean(x32, axis=axes)
+        var = jnp.maximum(jnp.mean(jnp.square(x32), axis=axes)
+                          - jnp.square(mean), 0.0)
+        inv = jax.lax.rsqrt(var + eps) * gamma.astype(jnp.float32)
+        out = ((x32 - mean[None, :, None, None]) * inv[None, :, None, None]
+               + beta.astype(jnp.float32)[None, :, None, None])
+        return out.astype(x.dtype), mean, var
+
+    @jax.custom_vjp
+    def f(x, gamma, beta):
+        return _ref(x, gamma, beta)
+
+    def fwd(x, gamma, beta):
+        out, mean, var = fused_bn_relu(x, gamma, beta, eps=eps, relu=False,
+                                       interpret=interpret)
+        return (out, mean, var), (x, gamma, beta)
+
+    def bwd(res, cts):
+        _, vjp_fn = jax.vjp(_ref, *res)
+        return vjp_fn(cts)
+
+    f.defvjp(fwd, bwd)
+    return f
+
+
+def trainable_batch_norm(x_nchw, gamma, beta, eps=1e-5, interpret=False):
+    """Train-mode NCHW BatchNorm with the Pallas forward and a reference
+    backward — the opt-in path ``ops.nn.batch_norm`` dispatches to under
+    ``MXNET_TPU_PALLAS_BN=1``."""
+    return _make_trainable_bn(float(eps), bool(interpret))(x_nchw, gamma, beta)
+
+
 def fused_bn_relu(x_nchw, gamma, beta, eps=1e-5, residual=None, relu=True,
                   interpret=False):
     """Train-mode BN+ReLU(+residual) over NCHW conv output via the two

@@ -68,3 +68,51 @@ def test_bn_apply_no_relu():
     want = (np.asarray(x) * np.asarray(scale)[None, :, None]
             + np.asarray(shift)[None, :, None])
     np.testing.assert_allclose(np.asarray(out), want, rtol=1e-5, atol=1e-5)
+
+
+class TestTrainableBN:
+    """MXNET_TPU_PALLAS_BN=interpret: the op-level dispatch must match the
+    stock batch_norm in value AND gradients (reference-vjp backward)."""
+
+    def test_value_and_grads_match(self, monkeypatch):
+        import jax
+
+        from incubator_mxnet_tpu.ops.nn import batch_norm
+
+        rng = np.random.RandomState(0)
+        x = jnp.asarray(rng.randn(3, 8, 6, 6).astype(np.float32))
+        g = jnp.asarray(rng.rand(8).astype(np.float32) + 0.5)
+        b = jnp.asarray(rng.randn(8).astype(np.float32))
+        mm, mv = jnp.zeros(8), jnp.ones(8)
+
+        def loss(x, g, b, env):
+            monkeypatch.setenv("MXNET_TPU_PALLAS_BN", env)
+            out, mean, var = batch_norm(x, g, b, mm, mv, fix_gamma=False)
+            monkeypatch.setenv("MXNET_TPU_PALLAS_BN", "0")
+            return jnp.sum(jnp.sin(out)) + jnp.sum(mean) + jnp.sum(var)
+
+        v1, g1 = jax.value_and_grad(lambda *a: loss(*a, "interpret"),
+                                    argnums=(0, 1, 2))(x, g, b)
+        v2, g2 = jax.value_and_grad(lambda *a: loss(*a, "0"),
+                                    argnums=(0, 1, 2))(x, g, b)
+        np.testing.assert_allclose(float(v1), float(v2), rtol=1e-5)
+        for a, c in zip(g1, g2):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(c),
+                                       rtol=1e-4, atol=1e-4)
+
+    def test_training_through_gluon_layer(self, monkeypatch):
+        monkeypatch.setenv("MXNET_TPU_PALLAS_BN", "interpret")
+        import incubator_mxnet_tpu as mx
+        from incubator_mxnet_tpu import autograd, gluon
+
+        net = gluon.nn.HybridSequential()
+        net.add(gluon.nn.Conv2D(4, 3, padding=1), gluon.nn.BatchNorm(),
+                gluon.nn.Activation("relu"))
+        net.initialize()
+        x = mx.nd.random.uniform(shape=(2, 3, 8, 8))
+        with autograd.record():
+            out = net(x)
+            loss = (out ** 2).mean()
+        loss.backward()
+        gsum = float(net[0].weight.grad().abs().sum().asscalar())
+        assert gsum > 0

@@ -30,12 +30,20 @@ if [ "$WHAT" = all ] || [ "$WHAT" = bench ]; then
     run_bench bert-repeat3
     run_bench bert-ln-custom MXNET_TPU_LN_CUSTOM_BWD=1
     run_bench resnet50      MXNET_TPU_BENCH=resnet50
+    run_bench resnet50-pallas-bn MXNET_TPU_BENCH=resnet50 MXNET_TPU_PALLAS_BN=1
     run_bench transformer   MXNET_TPU_BENCH=transformer
     run_bench transformer-ln-custom MXNET_TPU_BENCH=transformer MXNET_TPU_LN_CUSTOM_BWD=1
     run_bench ssd-resnet18  MXNET_TPU_BENCH=ssd
     run_bench ssd-vgg16     MXNET_TPU_BENCH=ssd MXNET_TPU_BENCH_SSD_BACKBONE=vgg16
     run_bench yolo3         MXNET_TPU_BENCH=yolo3
     run_bench mnist         MXNET_TPU_BENCH=mnist
+fi
+
+if [ "$WHAT" = all ] || [ "$WHAT" = profile ]; then
+    note "== BERT 20-step xprof trace -> /tmp/r05_prof (parsed summary below)"
+    MXNET_TPU_BENCH_PROFILE=/tmp/r05_prof MXNET_TPU_BENCH_STEPS=20 \
+        timeout 3600 python bench.py 2>>"$EV".err | tee -a "$EV"
+    timeout 600 python tools/parse_xplane.py /tmp/r05_prof 2>>"$EV".err | head -40 | tee -a "$EV" || true
 fi
 
 if [ "$WHAT" = all ] || [ "$WHAT" = sweep ]; then
