@@ -74,8 +74,7 @@ _RNN_ONNX_OP = {"lstm": "LSTM", "gru": "GRU",
                 "rnn_tanh": "RNN", "rnn_relu": "RNN"}
 
 
-def _truthy(v):
-    return v in (True, 1, "1", "True", "true")
+from ...symbol.symbol import _truthy  # shared string-bool acceptance set
 
 
 def _export_rnn(node, in_names, out_name, extra_inits):
@@ -520,11 +519,18 @@ def import_model(model_file):
     env = {}
     arg_params, aux_params = {}, {}
 
+    def _init_var(name_):
+        """Var backed by an initializer: carry its shape/dtype as hints so
+        bind-time inference never depends on a consumer rule (free-standing
+        constants feed generic elementwise ops)."""
+        arr = inits[name_]
+        return S.var(name_, shape=arr.shape, dtype=str(arr.dtype))
+
     for vi in g["input"]:
         if vi["name"] not in inits:
             env[vi["name"]] = S.var(vi["name"])
     for name, arr in inits.items():
-        env[name] = S.var(name)
+        env[name] = _init_var(name)
 
     def _init_or_reject(name_, what):
         if name_ not in inits:
@@ -569,7 +575,7 @@ def import_model(model_file):
             if w_arr is not inits[w_name]:
                 w_key = f"{nm}_weight_norm"
                 inits[w_key] = w_arr
-                env[w_key] = S.var(w_key)
+                env[w_key] = _init_var(w_key)
             b = None
             if len(node["input"]) > 2:
                 b_name = node["input"][2]
@@ -579,7 +585,7 @@ def import_model(model_file):
                             "Gemm beta!=1 with non-initializer bias input")
                     b_key = f"{nm}_bias_norm"
                     inits[b_key] = inits[b_name] * beta
-                    env[b_key] = S.var(b_key)
+                    env[b_key] = _init_var(b_key)
                 else:
                     b_key = b_name
                 b = env[b_key]
@@ -717,17 +723,118 @@ def import_model(model_file):
                 b_key = nm + "_beta0"
                 inits[b_key] = _np.zeros_like(inits[scale_name]) \
                     if scale_name in inits else _np.zeros(1, _np.float32)
-                env[b_key] = S.var(b_key)
+                env[b_key] = _init_var(b_key)
                 beta = env[b_key]
             out = sym_mod.LayerNorm(
                 env[node["input"][0]], env[scale_name], beta,
                 axis=-1, eps=_get_attr(node, "epsilon", 1e-5), name=nm)
         elif op == "Gather":
             w_name = node["input"][0]
-            w = inits[w_name]
-            out = sym_mod.Embedding(env[node["input"][1]], env[w_name],
-                                    input_dim=w.shape[0], output_dim=w.shape[1],
-                                    name=nm)
+            g_axis = int(_get_attr(node, "axis", 0))
+            if w_name in inits and inits[w_name].ndim == 2 and g_axis == 0:
+                # the embedding idiom: table lookup on a 2-D initializer
+                w = inits[w_name]
+                out = sym_mod.Embedding(env[node["input"][1]], env[w_name],
+                                        input_dim=w.shape[0],
+                                        output_dim=w.shape[1], name=nm)
+            else:
+                # mode="wrap": ONNX indices may be negative (index from the
+                # end); jnp.mod gives exactly that for the legal range
+                out = sym_mod.take(env[w_name], env[node["input"][1]],
+                                   axis=g_axis, mode="wrap", name=nm)
+        elif op == "Constant":
+            # fold the constant into the initializer table (the exact
+            # "fold Constant nodes first" case _init_or_reject points at)
+            t = _get_attr(node, "value", None)
+            if t is None:
+                raise NotImplementedError(
+                    "Constant without a tensor `value` attribute "
+                    "(value_float/value_ints sparse forms unsupported)")
+            arr = P.tensor_to_numpy(t)
+            key = node["output"][0]
+            inits[key] = arr
+            env[key] = _init_var(key)
+            continue
+        elif op == "Slice":
+            ins = node["input"]
+            starts = _get_attr(node, "starts", None)
+            ends = _get_attr(node, "ends", None)
+            axes = _get_attr(node, "axes", None)
+            steps = None
+            if starts is None and len(ins) > 1:  # opset>=10: inputs
+                starts = [int(v) for v in _init_or_reject(ins[1], "Slice starts")]
+                ends = [int(v) for v in _init_or_reject(ins[2], "Slice ends")]
+                _drop_if_unused(ins[1], g, inits, env, folded)
+                _drop_if_unused(ins[2], g, inits, env, folded)
+                if len(ins) > 3 and ins[3]:
+                    axes = [int(v) for v in _init_or_reject(ins[3], "Slice axes")]
+                    _drop_if_unused(ins[3], g, inits, env, folded)
+                if len(ins) > 4 and ins[4]:
+                    steps = [int(v) for v in _init_or_reject(ins[4], "Slice steps")]
+                    _drop_if_unused(ins[4], g, inits, env, folded)
+            if steps is not None and any(s != 1 for s in steps):
+                raise NotImplementedError("Slice with steps != 1")
+            if axes is None:
+                axes = list(range(len(starts)))
+            x = env[ins[0]]
+            _INT_MAX = 2 ** 31 - 1
+            for i, ax2 in enumerate(axes):
+                b_, e_ = int(starts[i]), int(ends[i])
+                e_ = None if e_ >= _INT_MAX else e_
+                x = sym_mod.slice_axis(
+                    x, axis=int(ax2), begin=b_, end=e_,
+                    name=f"{nm}_{i}" if len(axes) > 1 else nm)
+            env[node["output"][0]] = x
+            continue
+        elif op == "Split":
+            ins = node["input"]
+            sp_axis = int(_get_attr(node, "axis", 0))
+            split_sizes = _get_attr(node, "split", None)
+            if split_sizes is None and len(ins) > 1 and ins[1]:
+                split_sizes = [int(v) for v in _init_or_reject(ins[1], "Split sizes")]
+                _drop_if_unused(ins[1], g, inits, env, folded)
+            n_out = len(node["output"])
+            if split_sizes is not None and len(set(split_sizes)) != 1:
+                # unequal splits: emit slice_axis per output (static sizes)
+                off = 0
+                for i, (sz, oname) in enumerate(zip(split_sizes, node["output"])):
+                    env[oname] = sym_mod.slice_axis(
+                        env[ins[0]], axis=sp_axis, begin=off, end=off + int(sz),
+                        name=f"{nm}_{i}")
+                    off += int(sz)
+                continue
+            parts = sym_mod.split(env[ins[0]], num_outputs=n_out,
+                                  axis=sp_axis, name=nm)
+            for i, oname in enumerate(node["output"]):
+                env[oname] = parts[i] if n_out > 1 else parts
+            continue
+        elif op == "Pow":
+            b_name = node["input"][1]
+            if b_name in inits and inits[b_name].ndim == 0:
+                out = sym_mod._power_scalar(env[node["input"][0]],
+                                            scalar=float(inits[b_name]), name=nm)
+                _drop_if_unused(b_name, g, inits, env, folded)
+            else:
+                out = sym_mod.broadcast_power(env[node["input"][0]],
+                                              env[b_name], name=nm)
+        elif op == "Expand":
+            # ONNX Expand broadcasts BIDIRECTIONALLY (the target may have
+            # 1s or lower rank against larger input dims) — multiply by a
+            # ones tensor of the target shape instead of broadcast_to,
+            # which only grows dims
+            shp_name = node["input"][1]
+            shape = tuple(int(v) for v in _init_or_reject(shp_name, "Expand shape"))
+            ones_key = nm + "_expand_ones"
+            inits[ones_key] = _np.ones(shape, _np.float32)
+            env[ones_key] = _init_var(ones_key)
+            out = sym_mod.broadcast_mul(env[node["input"][0]], env[ones_key],
+                                        name=nm)
+            _drop_if_unused(shp_name, g, inits, env, folded)
+        elif op == "Where":
+            out = sym_mod.where(*[env[i] for i in node["input"]], name=nm)
+        elif op == "Equal":
+            out = sym_mod.broadcast_equal(env[node["input"][0]],
+                                          env[node["input"][1]], name=nm)
         elif op == "ConvTranspose":
             kernel = tuple(_get_attr(node, "kernel_shape"))
             pads = _check_symmetric_pads(node, len(kernel))
@@ -790,7 +897,7 @@ def import_model(model_file):
                     arr.transpose(perm) if perm else arr.T)
                 key = node["output"][0] + "_folded"
                 inits[key] = folded_arr
-                env[key] = S.var(key)
+                env[key] = _init_var(key)
                 env[node["output"][0]] = env[key]
                 continue
             out = sym_mod.transpose(env[node["input"][0]],
@@ -967,7 +1074,7 @@ def import_model(model_file):
                 chunks.append(_reorder(b[G_gates * H:]).ravel())
             pkey = nm + "_parameters"
             inits[pkey] = _np.concatenate(chunks).astype(_np.float32)
-            env[pkey] = S.var(pkey)
+            env[pkey] = _init_var(pkey)
             for iname in (ins[1], ins[2], ins[3] if Bv is not None else None):
                 if iname:
                     _drop_if_unused(iname, g, inits, env, folded)

@@ -81,6 +81,24 @@ _OPTIONAL_TENSOR = {
     "RNN": {"state": None, "state_cell": None},
 }
 
+# Ops whose SYMBOL carries multiple outputs (attrs -> count): the node's
+# fn returns a tuple and each element is addressable as sym[i] / by the
+# executor (MXNet's sym.split contract).  Ops not listed keep the default
+# single primary output even when the fn returns a tuple (e.g. BatchNorm's
+# (out, mean, var) — the extra entries are layer-internal).
+def _truthy(v):
+    """One acceptance set for stringly-typed boolean attrs (symbol JSON
+    round-trips stringify them)."""
+    return v in (True, 1, "1", "True", "true")
+
+
+_MULTI_OUTPUT = {
+    "split": lambda a: int(a.get("num_outputs", 1)),
+    "SliceChannel": lambda a: int(a.get("num_outputs", 1)),
+    "RNN": lambda a: ((3 if a.get("mode", "lstm") == "lstm" else 2)
+                      if _truthy(a.get("state_outputs")) else 1),
+}
+
 # Explicit tensor-input lists for ops where signature inspection is not
 # enough.  Everything else: parameters without a default are tensor inputs
 # — unless the caller passed them as non-Symbol kwargs (static attrs), see
@@ -537,7 +555,8 @@ def _apply_op(opname, args, kwargs, name=None, hint=None):
     node.attrs["__input_names__"] = input_names
     for k, v in scope_attrs.items():
         node.attrs.setdefault(_dunder(k), v)
-    return Symbol([(node, 0)])
+    n_out = _MULTI_OUTPUT.get(opname, lambda a: 1)(attrs)
+    return Symbol([(node, i) for i in range(n_out)])
 
 
 def _make_sym_op(opname):

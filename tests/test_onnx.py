@@ -616,3 +616,116 @@ def test_detection_graph_export_documented_rejection(tmp_path):
     with pytest.raises(NotImplementedError, match="detection post-processing"):
         onnx_mxnet.export_model(prior, {}, input_shape=(1, 3, 8, 8),
                                 onnx_file_path=str(tmp_path / "d.onnx"))
+
+
+class TestForeignImportBreadth2:
+    """Round-5 foreign-op importers: Constant folding, Slice, Split,
+    Gather(axis), Pow, Expand, Where/Equal."""
+
+    def test_constant_slice_pow(self, tmp_path):
+        from incubator_mxnet_tpu.contrib.onnx import _proto as P
+
+        cval = np.asarray([2.0], np.float32)
+        f = _foreign_model(tmp_path, [
+            {"op_type": "Constant", "name": "c", "input": [], "output": ["cv"],
+             "attribute": [{"name": "value", "type": P.ATTR_TENSOR,
+                            "t": {"name": "", "dims": cval.shape,
+                                  "data_type": P.TP_FLOAT,
+                                  "raw": cval.tobytes()}}]},
+            {"op_type": "Slice", "name": "s", "input": ["data"],
+             "output": ["s0"],
+             "attribute": [
+                 {"name": "starts", "type": P.ATTR_INTS, "ints": [1]},
+                 {"name": "ends", "type": P.ATTR_INTS, "ints": [3]},
+                 {"name": "axes", "type": P.ATTR_INTS, "ints": [1]}]},
+            {"op_type": "Pow", "name": "p", "input": ["s0", "pw"],
+             "output": ["p0"], "attribute": []},
+            {"op_type": "Mul", "name": "m", "input": ["p0", "cv"],
+             "output": ["y"], "attribute": []},
+        ], {"pw": np.asarray(2.0, np.float32)}, (2, 5))
+        sym2, args, aux = onnx_mxnet.import_model(f)
+        x = np.random.RandomState(0).rand(2, 5).astype(np.float32)
+        out = _bind_forward(sym2, args, x)
+        np.testing.assert_allclose(out, (x[:, 1:3] ** 2) * 2.0, rtol=1e-5,
+                                   atol=1e-6)
+
+    def test_slice_input_form_with_intmax_end(self, tmp_path):
+        from incubator_mxnet_tpu.contrib.onnx import _proto as P
+
+        f = _foreign_model(tmp_path, [
+            {"op_type": "Slice", "name": "s",
+             "input": ["data", "st", "en", "ax"], "output": ["y"],
+             "attribute": []},
+        ], {"st": np.asarray([1], np.int64),
+            "en": np.asarray([2 ** 31 - 1], np.int64),  # "to the end" idiom
+            "ax": np.asarray([0], np.int64)}, (4, 3))
+        sym2, args, aux = onnx_mxnet.import_model(f)
+        x = np.random.RandomState(1).rand(4, 3).astype(np.float32)
+        out = _bind_forward(sym2, args, x)
+        np.testing.assert_allclose(out, x[1:], rtol=1e-6)
+
+    def test_split_equal_and_unequal(self, tmp_path):
+        from incubator_mxnet_tpu.contrib.onnx import _proto as P
+
+        # equal split -> Add recombines
+        f = _foreign_model(tmp_path, [
+            {"op_type": "Split", "name": "sp", "input": ["data"],
+             "output": ["a", "b"],
+             "attribute": [{"name": "axis", "type": P.ATTR_INT, "i": 1}]},
+            {"op_type": "Add", "name": "ad", "input": ["a", "b"],
+             "output": ["y"], "attribute": []},
+        ], {}, (2, 6))
+        sym2, args, aux = onnx_mxnet.import_model(f)
+        x = np.random.RandomState(2).rand(2, 6).astype(np.float32)
+        out = _bind_forward(sym2, args, x)
+        np.testing.assert_allclose(out, x[:, :3] + x[:, 3:], rtol=1e-6)
+
+        # unequal split sizes via input
+        f2 = _foreign_model(tmp_path, [
+            {"op_type": "Split", "name": "sp", "input": ["data", "sz"],
+             "output": ["a", "b"],
+             "attribute": [{"name": "axis", "type": P.ATTR_INT, "i": 1}]},
+            {"op_type": "Concat", "name": "cc", "input": ["b", "a"],
+             "output": ["y"],
+             "attribute": [{"name": "axis", "type": P.ATTR_INT, "i": 1}]},
+        ], {"sz": np.asarray([2, 4], np.int64)}, (2, 6), name="f2")
+        sym3, args3, _ = onnx_mxnet.import_model(f2)
+        out = _bind_forward(sym3, args3, x)
+        np.testing.assert_allclose(
+            out, np.concatenate([x[:, 2:], x[:, :2]], axis=1), rtol=1e-6)
+
+    def test_gather_axis_expand_where_equal(self, tmp_path):
+        from incubator_mxnet_tpu.contrib.onnx import _proto as P
+
+        idx = np.asarray([2, 0], np.int64)
+        f = _foreign_model(tmp_path, [
+            # Gather over axis 1 of the data input (NOT the embedding idiom)
+            {"op_type": "Gather", "name": "g", "input": ["data", "idx"],
+             "output": ["g0"],
+             "attribute": [{"name": "axis", "type": P.ATTR_INT, "i": 1}]},
+            {"op_type": "Equal", "name": "e", "input": ["g0", "g0"],
+             "output": ["m"], "attribute": []},
+            {"op_type": "Where", "name": "w", "input": ["m", "g0", "zz"],
+             "output": ["w0"], "attribute": []},
+            {"op_type": "Expand", "name": "x", "input": ["w0", "shp"],
+             "output": ["y"], "attribute": []},
+        ], {"idx": idx, "zz": np.zeros((2, 2), np.float32),
+            "shp": np.asarray([2, 2], np.int64)}, (2, 4))
+        sym2, args, aux = onnx_mxnet.import_model(f)
+        x = np.random.RandomState(3).rand(2, 4).astype(np.float32)
+        out = _bind_forward(sym2, args, x)
+        np.testing.assert_allclose(out, x[:, [2, 0]], rtol=1e-6)
+
+
+def test_symbol_split_multi_output_api():
+    """sym.split now carries num_outputs outputs (the MXNet contract)."""
+    S.symbol._reset_naming()
+    x = S.var("data")
+    parts = S.split(x, num_outputs=3, axis=1)
+    assert len(parts) == 3
+    y = S.broadcast_add(parts[0], parts[2])
+    exe = y.simple_bind(data=(2, 6))
+    xv = np.arange(12).reshape(2, 6).astype(np.float32)
+    exe.arg_dict["data"][:] = xv
+    out = exe.forward(is_train=False)[0].asnumpy()
+    np.testing.assert_allclose(out, xv[:, :2] + xv[:, 4:], rtol=1e-6)
