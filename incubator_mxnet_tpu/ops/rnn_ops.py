@@ -114,7 +114,10 @@ def _unpack_rnn_params(parameters, mode, input_size, state_size, num_layers,
 @register("RNN")
 def rnn_mega(data, parameters, state=None, state_cell=None, *, mode="lstm",
              state_size=0, num_layers=1, bidirectional=False, p=0.0,
-             state_outputs=False, training=False, key=None):
+             state_outputs=False, training=False, key=None,
+             projection_size=None, lstm_state_clip_min=None,
+             lstm_state_clip_max=None, lstm_state_clip_nan=False,
+             use_sequence_length=False, sequence_length=None):
     """The reference's fused RNN mega-op under its real name/signature
     ([U:src/operator/rnn.cc]): ``data`` (T, N, C), ``parameters`` the packed
     flat vector (cuDNN layout — see ``_unpack_rnn_params``), ``state``
@@ -122,6 +125,19 @@ def rnn_mega(data, parameters, state=None, state_cell=None, *, mode="lstm",
     dropout.  Returns ``out`` alone, or with ``state_outputs=True``:
     ``(out, h_n)`` / ``(out, h_n, c_n)`` for LSTM.  A thin unpacking shim
     over the one-``lax.scan``-per-layer ``RNNFused`` kernel."""
+    if projection_size is not None:
+        raise NotImplementedError(
+            "RNN projection_size (LSTMP) is not supported; use an explicit "
+            "Dense projection after the layer")
+    if lstm_state_clip_min is not None or lstm_state_clip_max is not None \
+            or lstm_state_clip_nan:
+        raise NotImplementedError("RNN lstm_state_clip_* is not supported")
+    if use_sequence_length:
+        # flag OFF with a sequence_length tensor supplied is a no-op in the
+        # reference (the input is ignored) — only the flag itself rejects
+        raise NotImplementedError(
+            "RNN use_sequence_length is not supported; mask outputs with "
+            "SequenceMask instead")
     H = int(state_size)
     flat = _unpack_rnn_params(parameters, mode, data.shape[2], H,
                               num_layers, bidirectional)

@@ -193,3 +193,36 @@ def test_rnn_megaop_output_only_and_validation():
     out_nostate = mx.nd.RNN(x, mx.nd.zeros((rnn_param_size("lstm", C, H),)),
                             mode="lstm", state_size=H)
     assert out_nostate.shape == (T, B, H)
+
+
+def test_rnn_megaop_unsupported_reference_kwargs():
+    """Reference signature extras with no TPU equivalent must raise with
+    guidance, not TypeError or silent ignore."""
+    from incubator_mxnet_tpu.ops.rnn_ops import rnn_param_size
+
+    x = mx.nd.random.uniform(shape=(3, 2, 4))
+    p = mx.nd.zeros((rnn_param_size("lstm", 4, 5),))
+    for kw in ({"projection_size": 3}, {"lstm_state_clip_min": -8.0},
+               {"use_sequence_length": True}):
+        with pytest.raises(NotImplementedError):
+            mx.nd.RNN(x, p, mode="lstm", state_size=5, **kw)
+
+
+@with_seed()
+def test_rnn_megaop_bf16():
+    """bf16 inputs: fused path stays in bf16 and tracks the fp32 result."""
+    from incubator_mxnet_tpu.ops.rnn_ops import rnn_param_size
+
+    T, B, C, H = 5, 2, 3, 4
+    n = rnn_param_size("gru", C, H)
+    rng = np.random.RandomState(0)
+    xv = rng.uniform(-1, 1, (T, B, C)).astype(np.float32)
+    pv = rng.uniform(-0.3, 0.3, (n,)).astype(np.float32)
+    out32 = mx.nd.RNN(mx.nd.array(xv), mx.nd.array(pv),
+                      mode="gru", state_size=H).asnumpy()
+    x16 = mx.nd.array(xv, dtype="bfloat16")
+    p16 = mx.nd.array(pv, dtype="bfloat16")
+    out16 = mx.nd.RNN(x16, p16, mode="gru", state_size=H)
+    assert str(out16.dtype) in ("bfloat16",)
+    assert_almost_equal(out16.asnumpy().astype(np.float32), out32,
+                        rtol=5e-2, atol=5e-2)
