@@ -153,6 +153,13 @@ class ParameterServer:
                 self._push_counts[rank] += 1
                 self._cond.notify_all()
             return ("ok",)
+        if kind == "push_codes":
+            # gradient-compression wire format: int8 sign codes + threshold
+            # (4x smaller than fp32); decode server-side and apply as a
+            # normal push
+            _, key, codes, threshold, rank = msg
+            decoded = np.asarray(codes, np.float32) * float(threshold)
+            return self.dispatch(("push", key, decoded, rank))
         if kind == "pull":
             _, key = msg
             with self._lock:

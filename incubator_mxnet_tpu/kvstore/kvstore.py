@@ -170,11 +170,10 @@ class KVStore:
         Single-process base: identity.  Returns an int array."""
         return codes
 
-    def _compressed_reduce(self, key, grad):
-        """2-bit gradient compression with error-feedback residual, applied
-        worker-side BEFORE the cross-worker reduction (parity:
-        [U:src/kvstore/kvstore_dist.cc] compresses, then ZPushes).  The wire
-        carries int8 sign codes; the aggregate is ``sum(codes) · t``."""
+    def _quantize_2bit(self, key, grad):
+        """Worker-side 2-bit quantization with error-feedback residual
+        (parity: [U:src/kvstore/gradient_compression.cc]); returns the int8
+        sign codes and the threshold — the wire format."""
         import jax.numpy as jnp
 
         threshold = float(self._compression.get("threshold", 0.5))
@@ -188,9 +187,18 @@ class KVStore:
         residual._data = g - codes.astype(g.dtype) * threshold
         residual._version += 1
         self._store[res_key] = residual
-        wire = self._reduce_codes(codes)
         self._last_wire_dtype = str(codes.dtype)  # test/observability hook
-        return NDArray(wire.astype(grad.dtype) * threshold, ctx=grad.context)
+        return codes, threshold
+
+    def _compressed_reduce(self, key, grad):
+        """2-bit gradient compression with error-feedback residual, applied
+        worker-side BEFORE the cross-worker reduction (parity:
+        [U:src/kvstore/kvstore_dist.cc] compresses, then ZPushes).  The wire
+        carries int8 sign codes; the aggregate is ``sum(codes) · t``."""
+        codes, threshold = self._quantize_2bit(key, grad)
+        wire = self._reduce_codes(codes)
+        return NDArray(wire.astype(grad._data.dtype) * threshold,
+                       ctx=grad.context)
 
     # -- optimizer plumbing ---------------------------------------------
     def set_optimizer(self, optimizer):
@@ -390,9 +398,13 @@ class KVStoreDistAsync(KVStore):
             return
         agg = self._aggregate(value)
         if self._compression is not None:
-            # worker-side compression before the wire, as in dist_sync;
-            # the server adds decoded values, so reconstruct locally
-            agg = self._compressed_reduce(key, agg)
+            # the int8 CODES cross the TCP wire (the whole point of
+            # gradient compression is what crosses the process boundary);
+            # the server decodes as codes · threshold before applying
+            codes, threshold = self._quantize_2bit(key, agg)
+            self._client.request("push_codes", key, _np.asarray(codes),
+                                 threshold, self._rank)
+            return
         self._client.request("push", key, _np.asarray(agg.asnumpy()),
                              self._rank)
 

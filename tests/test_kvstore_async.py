@@ -112,3 +112,51 @@ def test_unbounded_by_default():
         assert ps._push_counts == [50, 0]
     finally:
         ps.stop()
+
+
+def test_push_codes_wire_compression(server):
+    """int8 codes + threshold over the wire; server decodes to codes*t."""
+    c = _client(server)
+    c.request("init", "k", np.zeros(4, np.float32))
+    codes = np.array([1, -1, 0, 1], np.int8)
+    c.request("push_codes", "k", codes, 0.5, 0)
+    np.testing.assert_allclose(c.request("pull", "k"),
+                               [0.5, -0.5, 0.0, 0.5])
+    assert c.request("counts") == [1, 0]
+
+
+def test_async_store_compression_end_to_end(monkeypatch):
+    """KVStoreDistAsync with gradient compression: error-feedback residual
+    on the worker, int8 codes on the wire, exact 2-bit semantics at the
+    server."""
+    import socket
+
+    import incubator_mxnet_tpu as mx
+
+    s = socket.socket(); s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]; s.close()
+    monkeypatch.setenv("MXNET_ASYNC_PS_PORT", str(port))
+    monkeypatch.setenv("DMLC_WORKER_ID", "0")
+    monkeypatch.setenv("DMLC_NUM_WORKER", "1")
+    # fresh server singleton for this port
+    from incubator_mxnet_tpu.kvstore import async_ps
+    monkeypatch.setattr(async_ps, "_SERVER", None)
+
+    kv = mx.kv.create("dist_async")
+    try:
+        kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+        kv.init("w", mx.nd.zeros((4,)))
+        g = mx.nd.array(np.array([0.7, -0.9, 0.2, 0.0], np.float32))
+        kv.push("w", g)
+        out = mx.nd.zeros((4,))
+        kv.pull("w", out=out)
+        # first push: codes [1,-1,0,0] * 0.5
+        np.testing.assert_allclose(out.asnumpy(), [0.5, -0.5, 0.0, 0.0])
+        assert kv._last_wire_dtype == "int8"
+        # second identical push: residuals [0.2,-0.4,0.2,0] accumulate ->
+        # g+res = [0.9,-1.3,0.4,0.0] -> codes [1,-1,0,0] again
+        kv.push("w", g)
+        kv.pull("w", out=out)
+        np.testing.assert_allclose(out.asnumpy(), [1.0, -1.0, 0.0, 0.0])
+    finally:
+        kv._server.stop()
