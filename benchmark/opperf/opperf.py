@@ -82,6 +82,17 @@ def _cases(rng, large):
         ("im2col", lambda: (t(B, C, H, W),), True, None),
         ("masked_softmax", lambda: (t(B, D), rng.rand(B, D) > 0.2), True, None),
         ("_sample_normal", lambda: (t(B), t(B)), False, _sample_normal_fn),
+        # round-5 families
+        ("RNN", lambda: (t(16, B, 32), _rnn_params(rng, 32, 32)),
+         True, None),
+        ("_contrib_DeformableConvolution",
+         lambda: (t(B, C, H, W), np.zeros((B, 18, H, W), f), t(C, C, 3, 3)),
+         True, None),
+        ("_contrib_DeformablePSROIPooling",
+         lambda: (t(B, 2 * 4, H, W),
+                  np.tile(np.array([0, 1, 1, H - 2, W - 2], f), (8, 1))),
+         True, None),
+        ("digamma", lambda: (t(B, D) + 0.5,), True, None),
     ]
 
 
@@ -92,7 +103,22 @@ _KW = {"Convolution": {"kernel": (3, 3), "num_filter": 0, "pad": (1, 1)},
        "_contrib_ROIAlign": {"pooled_size": (7, 7), "spatial_scale": 1.0,
                              "sample_ratio": 2},
        "_contrib_AdaptiveAvgPooling2D": {"output_size": (7, 7)},
-       "im2col": {"kernel": (3, 3), "stride": (1, 1), "pad": (1, 1)}}
+       "im2col": {"kernel": (3, 3), "stride": (1, 1), "pad": (1, 1)},
+       "RNN": {"mode": "lstm", "state_size": 32, "num_layers": 1},
+       "_contrib_DeformableConvolution": {"kernel": (3, 3), "pad": (1, 1),
+                                          "num_filter": 0, "no_bias": True},
+       "_contrib_DeformablePSROIPooling": {"spatial_scale": 1.0,
+                                           "output_dim": 2, "group_size": 2,
+                                           "pooled_size": 7,
+                                           "sample_per_part": 2,
+                                           "no_trans": True}}
+
+
+def _rnn_params(rng, C, H):
+    from incubator_mxnet_tpu.ops.rnn_ops import rnn_param_size
+
+    n = rnn_param_size("lstm", C, H)
+    return rng.uniform(-0.1, 0.1, (n,)).astype(np.float32)
 
 
 def _sample_normal_fn(mu, sigma):
