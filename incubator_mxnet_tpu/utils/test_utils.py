@@ -30,6 +30,8 @@ __all__ = [
     "rand_shape_nd",
     "check_numeric_gradient",
     "check_consistency",
+    "check_symbolic_forward",
+    "check_symbolic_backward",
     "simple_forward",
     "default_rtols",
 ]
@@ -211,3 +213,54 @@ def check_consistency(fn, inputs, ctx_list=None, rtol=None, atol=None, grad=True
             for k, (g, g0) in enumerate(zip(gs, grads[0])):
                 assert_almost_equal(g, g0, rtol=rtol, atol=atol, names=(f"grad{k}@ctx[{i}]", f"grad{k}@ctx[0]"))
     return results
+
+
+def check_symbolic_forward(sym, location, expected, rtol=None, atol=None,
+                           aux_states=None, ctx=None):
+    """Bind ``sym`` with ``location`` (list or dict of arrays in
+    ``list_arguments()`` order) and compare outputs against ``expected``
+    numpy arrays (parity: [U:python/mxnet/test_utils.py]
+    check_symbolic_forward).  Returns the executor outputs.
+
+    Inputs pass straight to the Executor, which accepts lists/dicts of
+    NDArray or numpy and PRESERVES dtypes (int indices, f16/f64 parity
+    tests all work)."""
+    from ..executor import Executor
+
+    exe = Executor(sym, ctx, args=location, grad_req="null",
+                   aux_states=aux_states)
+    outs = exe.forward(is_train=False)
+    assert len(outs) == len(expected), \
+        f"{len(outs)} outputs vs {len(expected)} expectations"
+    for i, (o, e) in enumerate(zip(outs, expected)):
+        assert_almost_equal(o.asnumpy(), _np.asarray(e), rtol=rtol, atol=atol,
+                            names=(f"output[{i}]", f"expected[{i}]"))
+    return outs
+
+
+def check_symbolic_backward(sym, location, out_grads, expected, rtol=None,
+                            atol=None, grad_req="write", aux_states=None,
+                            ctx=None):
+    """Bind, forward(train), backward with ``out_grads``, and compare the
+    argument gradients against ``expected`` (list or dict keyed by arg
+    name; args whose expected entry is absent/None are skipped) — parity:
+    [U:python/mxnet/test_utils.py] check_symbolic_backward.  Returns the
+    gradient dict."""
+    from ..executor import Executor
+
+    if isinstance(expected, (list, tuple)):
+        expected = dict(zip(sym.list_arguments(), expected))
+    exe = Executor(sym, ctx, args=location, grad_req=grad_req,
+                   aux_states=aux_states)
+    exe.forward(is_train=True)
+    if not isinstance(out_grads, (list, tuple)):
+        out_grads = [out_grads]  # a bare array would be iterated row-wise
+    exe.backward(out_grads=list(out_grads))
+    for name, want in expected.items():
+        if want is None:
+            continue
+        got = exe.grad_dict.get(name)
+        assert got is not None, f"no gradient computed for {name!r}"
+        assert_almost_equal(got.asnumpy(), _np.asarray(want), rtol=rtol,
+                            atol=atol, names=(f"grad[{name}]", f"expected[{name}]"))
+    return exe.grad_dict

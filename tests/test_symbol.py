@@ -392,3 +392,49 @@ class TestNamingAndAttrs:
         d2 = sym.Deconvolution(data, kernel=(2, 2), num_filter=3,
                                no_bias=False, name="d2")
         assert d2.list_arguments() == ["data", "d2_weight", "d2_bias"]
+
+
+class TestCheckSymbolicHelpers:
+    """check_symbolic_forward/backward — the reference test-utils idiom
+    (SURVEY §4) on this Symbol/Executor stack."""
+
+    def test_forward_against_numpy(self):
+        from incubator_mxnet_tpu.utils.test_utils import check_symbolic_forward
+
+        sym.symbol._reset_naming()
+        x = sym.Variable("x")
+        w = sym.Variable("w")
+        out = sym.FullyConnected(x, w, num_hidden=3, no_bias=True,
+                                 flatten=False, name="fc")
+        xv = np.random.RandomState(0).rand(4, 5).astype(np.float32)
+        wv = np.random.RandomState(1).rand(3, 5).astype(np.float32)
+        check_symbolic_forward(out, [xv, wv], [xv @ wv.T], rtol=1e-5,
+                               atol=1e-6)
+
+    def test_backward_against_closed_form(self):
+        from incubator_mxnet_tpu.utils.test_utils import check_symbolic_backward
+
+        sym.symbol._reset_naming()
+        a = sym.Variable("a")
+        b = sym.Variable("b")
+        out = sym.broadcast_mul(a, b, name="m")
+        av = np.random.RandomState(2).rand(3, 4).astype(np.float32)
+        bv = np.random.RandomState(3).rand(3, 4).astype(np.float32)
+        og = np.random.RandomState(4).rand(3, 4).astype(np.float32)
+        # d(a*b)/da = b * og;  d/db = a * og
+        check_symbolic_backward(out, [av, bv], [og],
+                                {"a": og * bv, "b": og * av},
+                                rtol=1e-5, atol=1e-6)
+
+    def test_backward_skips_none_expected(self):
+        from incubator_mxnet_tpu.utils.test_utils import check_symbolic_backward
+
+        sym.symbol._reset_naming()
+        a = sym.Variable("a")
+        b = sym.Variable("b")
+        out = sym.broadcast_add(a, b, name="s")
+        av = np.ones((2, 2), np.float32)
+        bv = np.ones((2, 2), np.float32)
+        check_symbolic_backward(out, [av, bv], [np.ones((2, 2), np.float32)],
+                                {"a": np.ones((2, 2), np.float32), "b": None},
+                                rtol=1e-6, atol=1e-7)
