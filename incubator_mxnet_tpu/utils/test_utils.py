@@ -223,8 +223,9 @@ def check_symbolic_forward(sym, location, expected, rtol=None, atol=None,
     check_symbolic_forward).  Returns the executor outputs.
 
     Inputs pass straight to the Executor, which accepts lists/dicts of
-    NDArray or numpy and PRESERVES dtypes (int indices, f16/f64 parity
-    tests all work)."""
+    NDArray or numpy and preserves dtypes within jax's default x32 set
+    (int32 indices, f16/bf16/f32 parity tests; f64/i64 downcast — jax
+    x64 is not enabled in this package)."""
     from ..executor import Executor
 
     exe = Executor(sym, ctx, args=location, grad_req="null",
@@ -253,9 +254,9 @@ def check_symbolic_backward(sym, location, out_grads, expected, rtol=None,
     exe = Executor(sym, ctx, args=location, grad_req=grad_req,
                    aux_states=aux_states)
     exe.forward(is_train=True)
-    if not isinstance(out_grads, (list, tuple)):
+    if out_grads is not None and not isinstance(out_grads, (list, tuple)):
         out_grads = [out_grads]  # a bare array would be iterated row-wise
-    exe.backward(out_grads=list(out_grads))
+    exe.backward(out_grads=list(out_grads) if out_grads is not None else None)
     for name, want in expected.items():
         if want is None:
             continue

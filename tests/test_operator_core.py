@@ -726,3 +726,101 @@ class TestCoreDtypes:
         idx = mx.nd.array(np.array([1, 5]), dtype="int32")
         out = mx.nd.Embedding(idx, w, input_dim=10, output_dim=4)
         assert out.dtype == w.dtype
+
+
+class TestIndexingEdgeSemantics:
+    """Reference edge semantics for the indexing/sorting family
+    ([U:tests/python/unittest/test_operator.py] idioms): every case has an
+    independent numpy expectation."""
+
+    @with_seed()
+    def test_topk_ret_typ_variants(self):
+        x = np.array([[3.0, 1.0, 2.0, 5.0], [0.0, 4.0, 2.0, 1.0]], np.float32)
+        xa = _nd(x)
+        idx = mx.nd.topk(xa, k=2).asnumpy()            # indices, descending
+        np.testing.assert_array_equal(idx, [[3, 0], [1, 2]])
+        val = mx.nd.topk(xa, k=2, ret_typ="value").asnumpy()
+        np.testing.assert_allclose(val, [[5, 3], [4, 2]])
+        both = mx.nd.topk(xa, k=2, ret_typ="both")
+        np.testing.assert_allclose(both[0].asnumpy(), val)
+        np.testing.assert_array_equal(both[1].asnumpy(), idx)
+        mask = mx.nd.topk(xa, k=2, ret_typ="mask").asnumpy()
+        np.testing.assert_array_equal(mask, [[1, 0, 0, 1], [0, 1, 1, 0]])
+        asc = mx.nd.topk(xa, k=1, ret_typ="value", is_ascend=True).asnumpy()
+        np.testing.assert_allclose(asc, [[1.0], [0.0]])
+        # axis=0
+        v0 = mx.nd.topk(xa, axis=0, k=1, ret_typ="value").asnumpy()
+        np.testing.assert_allclose(v0, [[3, 4, 2, 5]])
+
+    @with_seed()
+    def test_sort_argsort(self):
+        x = np.random.RandomState(0).randn(3, 5).astype(np.float32)
+        np.testing.assert_allclose(mx.nd.sort(_nd(x)).asnumpy(),
+                                   np.sort(x, axis=-1))
+        np.testing.assert_allclose(
+            mx.nd.sort(_nd(x), is_ascend=False).asnumpy(),
+            -np.sort(-x, axis=-1))
+        np.testing.assert_array_equal(mx.nd.argsort(_nd(x)).asnumpy(),
+                                      np.argsort(x, axis=-1))
+
+    @with_seed()
+    def test_pick_modes_and_axes(self):
+        x = np.arange(12, dtype=np.float32).reshape(3, 4)
+        idx = np.array([0, 9, 2], np.float32)  # 9 out of range -> clip to 3
+        got = mx.nd.pick(_nd(x), _nd(idx)).asnumpy()
+        np.testing.assert_allclose(got, [0.0, 7.0, 10.0])
+        keep = mx.nd.pick(_nd(x), _nd(np.array([1, 1, 1], np.float32)),
+                          keepdims=True)
+        assert keep.shape == (3, 1)
+        ax0 = mx.nd.pick(_nd(x), _nd(np.array([2, 0, 1, 2], np.float32)),
+                         axis=0).asnumpy()
+        np.testing.assert_allclose(ax0, [8.0, 1.0, 6.0, 11.0])
+
+    @with_seed()
+    def test_one_hot_on_off_dtype(self):
+        idx = np.array([1, 0, 2], np.float32)
+        oh = mx.nd.one_hot(_nd(idx), 3, on_value=5.0, off_value=-1.0,
+                           dtype="int32")
+        assert str(oh.dtype) == "int32"
+        np.testing.assert_array_equal(
+            oh.asnumpy(), [[-1, 5, -1], [5, -1, -1], [-1, -1, 5]])
+
+    @with_seed()
+    def test_gather_scatter_nd_roundtrip(self):
+        data = np.random.RandomState(1).randn(3, 4, 2).astype(np.float32)
+        indices = np.array([[0, 2, 1], [3, 1, 0]], np.float32)  # (M=2, N=3)
+        picked = mx.nd.gather_nd(_nd(data), _nd(indices)).asnumpy()
+        np.testing.assert_allclose(picked, data[[0, 2, 1], [3, 1, 0]])
+        back = mx.nd.scatter_nd(_nd(picked), _nd(indices), shape=(3, 4, 2))
+        want = np.zeros((3, 4, 2), np.float32)
+        want[[0, 2, 1], [3, 1, 0]] = picked
+        np.testing.assert_allclose(back.asnumpy(), want)
+
+    @with_seed()
+    def test_take_clip_and_wrap(self):
+        x = np.arange(10, dtype=np.float32).reshape(5, 2)
+        idx = np.array([-1, 0, 7], np.float32)
+        clip = mx.nd.take(_nd(x), _nd(idx)).asnumpy()
+        np.testing.assert_allclose(clip, x[[0, 0, 4]])
+        wrap = mx.nd.take(_nd(x), _nd(idx), mode="wrap").asnumpy()
+        np.testing.assert_allclose(wrap, x[[4, 0, 2]])
+
+    @with_seed()
+    def test_sequence_family_with_lengths(self):
+        # data [T=4, B=2, D=3]
+        x = np.random.RandomState(2).randn(4, 2, 3).astype(np.float32)
+        lens = np.array([2, 4], np.float32)
+        masked = mx.nd.SequenceMask(_nd(x), _nd(lens),
+                                    use_sequence_length=True,
+                                    value=-7.0).asnumpy()
+        want = x.copy()
+        want[2:, 0] = -7.0  # first batch element masked beyond length 2
+        np.testing.assert_allclose(masked, want)
+        last = mx.nd.SequenceLast(_nd(x), _nd(lens),
+                                  use_sequence_length=True).asnumpy()
+        np.testing.assert_allclose(last, np.stack([x[1, 0], x[3, 1]]))
+        rev = mx.nd.SequenceReverse(_nd(x), _nd(lens),
+                                    use_sequence_length=True).asnumpy()
+        np.testing.assert_allclose(rev[:2, 0], x[[1, 0], 0])
+        np.testing.assert_allclose(rev[2:, 0], x[2:, 0])  # tail untouched
+        np.testing.assert_allclose(rev[:, 1], x[::-1, 1])

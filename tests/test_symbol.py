@@ -438,3 +438,14 @@ class TestCheckSymbolicHelpers:
         check_symbolic_backward(out, [av, bv], [np.ones((2, 2), np.float32)],
                                 {"a": np.ones((2, 2), np.float32), "b": None},
                                 rtol=1e-6, atol=1e-7)
+
+    def test_backward_none_out_grads_means_ones(self):
+        from incubator_mxnet_tpu.utils.test_utils import check_symbolic_backward
+
+        sym.symbol._reset_naming()
+        a = sym.Variable("a")
+        out = sym._mul_scalar(a, scalar=3.0, name="m")
+        av = np.random.RandomState(5).rand(2, 3).astype(np.float32)
+        check_symbolic_backward(out, [av], None,
+                                {"a": np.full((2, 3), 3.0, np.float32)},
+                                rtol=1e-6, atol=1e-7)
