@@ -839,6 +839,57 @@ def _rpower_scalar(data, scalar=1.0):
     return data.dtype.type(scalar) ** data
 
 
+@register("_mod_scalar")
+def _mod_scalar(data, scalar=1.0):
+    return jnp.mod(data, data.dtype.type(scalar))
+
+
+@register("_rmod_scalar")
+def _rmod_scalar(data, scalar=1.0):
+    return jnp.mod(data.dtype.type(scalar), data)
+
+
+@register("_maximum_scalar")
+def _maximum_scalar(data, scalar=0.0):
+    return jnp.maximum(data, data.dtype.type(scalar))
+
+
+@register("_minimum_scalar")
+def _minimum_scalar(data, scalar=0.0):
+    return jnp.minimum(data, data.dtype.type(scalar))
+
+
+@register("_hypot_scalar")
+def _hypot_scalar(data, scalar=0.0):
+    return jnp.hypot(data, data.dtype.type(scalar))
+
+
+# comparisons return 1.0/0.0 in the INPUT dtype ([U:src/operator/tensor/
+# elemwise_binary_scalar_op_logic.cc] — the reference's float-mask
+# convention, not bool arrays)
+def _make_cmp_scalar(name, fn):
+    @register(name, differentiable=False)
+    def cmp_scalar(data, scalar=0.0, _fn=fn):
+        return _fn(data, data.dtype.type(scalar)).astype(data.dtype)
+
+    cmp_scalar.__name__ = name.lstrip("_")
+    return cmp_scalar
+
+
+for _name, _fn in [
+    ("_equal_scalar", jnp.equal),
+    ("_not_equal_scalar", jnp.not_equal),
+    ("_greater_scalar", jnp.greater),
+    ("_greater_equal_scalar", jnp.greater_equal),
+    ("_lesser_scalar", jnp.less),
+    ("_lesser_equal_scalar", jnp.less_equal),
+    ("_logical_and_scalar", jnp.logical_and),
+    ("_logical_or_scalar", jnp.logical_or),
+    ("_logical_xor_scalar", jnp.logical_xor),
+]:
+    _make_cmp_scalar(_name, _fn)
+
+
 @register("_sym_zeros")
 def _sym_zeros(shape=(), dtype="float32"):
     return jnp.zeros(tuple(shape), dtype=_as_np_dtype(dtype))

@@ -284,6 +284,63 @@ class Symbol:
     def __pow__(self, other):
         return _binary("broadcast_power", "_power_scalar", self, other)
 
+    def __mod__(self, other):
+        return _binary("broadcast_mod", "_mod_scalar", self, other)
+
+    def __rmod__(self, other):
+        return _binary("broadcast_mod", "_rmod_scalar", self, other, swap=True)
+
+    # comparisons build graph nodes returning the reference's 1.0/0.0
+    # float masks (NOT Python bools); non-numeric operands defer to
+    # Python's protocol (`sym == None` stays False, not a graph node)
+    @staticmethod
+    def _comparable(other):
+        import numbers
+
+        return isinstance(other, (Symbol, numbers.Number))
+
+    def __eq__(self, other):
+        if not self._comparable(other):
+            return NotImplemented
+        return _binary("broadcast_equal", "_equal_scalar", self, other)
+
+    def __ne__(self, other):
+        if not self._comparable(other):
+            return NotImplemented
+        return _binary("broadcast_not_equal", "_not_equal_scalar", self, other)
+
+    def __gt__(self, other):
+        if not self._comparable(other):
+            return NotImplemented
+        return _binary("broadcast_greater", "_greater_scalar", self, other)
+
+    def __ge__(self, other):
+        if not self._comparable(other):
+            return NotImplemented
+        return _binary("broadcast_greater_equal", "_greater_equal_scalar",
+                       self, other)
+
+    def __lt__(self, other):
+        if not self._comparable(other):
+            return NotImplemented
+        return _binary("broadcast_lesser", "_lesser_scalar", self, other)
+
+    def __le__(self, other):
+        if not self._comparable(other):
+            return NotImplemented
+        return _binary("broadcast_lesser_equal", "_lesser_equal_scalar",
+                       self, other)
+
+    __hash__ = object.__hash__  # __eq__ is symbolic; keep identity hashing
+
+    def __bool__(self):
+        # numpy-style: a graph node has no truth value — this also stops
+        # `a in [b]` from silently matching via a truthy __eq__ Symbol
+        # (parity: the reference raises NotImplementedForSymbol here)
+        raise TypeError(
+            "Symbol has no boolean value; comparisons build graph nodes. "
+            "Use `is`/`is not` for identity, or evaluate the comparison.")
+
     def __neg__(self):
         return self * -1.0
 

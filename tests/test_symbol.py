@@ -449,3 +449,68 @@ class TestCheckSymbolicHelpers:
         check_symbolic_backward(out, [av], None,
                                 {"a": np.full((2, 3), 3.0, np.float32)},
                                 rtol=1e-6, atol=1e-7)
+
+
+class TestScalarAndComparisonSugar:
+    """Scalar op family + Symbol comparison operators (reference: the
+    auto-generated _internal._*_scalar ops and Symbol __gt__ family —
+    comparisons are GRAPH ops returning 1.0/0.0 float masks)."""
+
+    def test_nd_scalar_family(self):
+        x = np.array([[1.5, -2.0, 3.0]], np.float32)
+        np.testing.assert_allclose(
+            mx.nd._mod_scalar(mx.nd.array(x), scalar=2.0).asnumpy(),
+            np.mod(x, 2.0))
+        np.testing.assert_allclose(
+            mx.nd._maximum_scalar(mx.nd.array(x), scalar=0.0).asnumpy(),
+            np.maximum(x, 0.0))
+        np.testing.assert_allclose(
+            mx.nd._greater_scalar(mx.nd.array(x), scalar=1.0).asnumpy(),
+            (x > 1.0).astype(np.float32))
+        g = mx.nd._greater_scalar(mx.nd.array(x), scalar=1.0)
+        assert str(g.dtype) == "float32"  # float mask, not bool
+
+    def test_symbol_comparison_builds_graph(self):
+        sym.symbol._reset_naming()
+        a = sym.Variable("a")
+        mask = a > 1.0
+        out = mask * a  # keep only entries > 1
+        exe = out.simple_bind(a=(2, 3))
+        av = np.array([[0.5, 1.5, 2.5], [-1.0, 1.0, 3.0]], np.float32)
+        exe.arg_dict["a"][:] = av
+        got = exe.forward(is_train=False)[0].asnumpy()
+        np.testing.assert_allclose(got, np.where(av > 1.0, av, 0.0))
+
+    def test_symbol_vs_symbol_comparison_and_mod(self):
+        sym.symbol._reset_naming()
+        a = sym.Variable("a")
+        b = sym.Variable("b")
+        eq = (a == b)
+        m = a % 3.0
+        g = sym.Group([eq, m])
+        exe = g.simple_bind(a=(4,), b=(4,))
+        av = np.array([1, 2, 3, 4], np.float32)
+        bv = np.array([1, 0, 3, 0], np.float32)
+        exe.arg_dict["a"][:] = av
+        exe.arg_dict["b"][:] = bv
+        outs = exe.forward(is_train=False)
+        np.testing.assert_allclose(outs[0].asnumpy(), (av == bv).astype(np.float32))
+        np.testing.assert_allclose(outs[1].asnumpy(), np.mod(av, 3.0))
+
+    def test_symbol_identity_semantics_preserved(self):
+        # dict/set membership must keep identity hashing despite __eq__
+        a = sym.Variable("a")
+        b = sym.Variable("b")
+        d = {a: 1, b: 2}
+        assert d[a] == 1 and d[b] == 2
+        assert a in d and b in d
+
+    def test_comparison_edge_protocol(self):
+        a = sym.Variable("a")
+        b = sym.Variable("b")
+        assert (a == None) is False          # noqa: E711 — protocol fallback
+        assert (a != "x") is True
+        with pytest.raises(TypeError):
+            bool(a == b)                     # graph nodes have no truth value
+        with pytest.raises(TypeError):
+            a in [b]                         # membership needs truthiness
