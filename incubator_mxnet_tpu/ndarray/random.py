@@ -13,6 +13,8 @@ from ..random import (  # noqa: F401
     gamma,
     exponential,
     poisson,
+    negative_binomial,
+    generalized_negative_binomial,
     seed,
 )
 
@@ -26,5 +28,7 @@ __all__ = [
     "gamma",
     "exponential",
     "poisson",
+    "negative_binomial",
+    "generalized_negative_binomial",
     "seed",
 ]

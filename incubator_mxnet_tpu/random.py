@@ -159,3 +159,37 @@ def poisson(lam=1.0, shape=(1,), dtype="float32", ctx=None, out=None):
         shape = (shape,)
     data = jax.random.poisson(get_key(), lam, shape).astype(_as_np_dtype(dtype))
     return _wrap(data, ctx, out)
+
+
+def negative_binomial(k=1, p=1.0, shape=(1,), dtype="float32", ctx=None,
+                      out=None):
+    """Parity: ``mx.nd.random.negative_binomial`` — wraps the registered
+    ``_random_negative_binomial`` sampler (gamma-Poisson mixture)."""
+    from .ops.random_ops import _random_negative_binomial
+
+    if not 0 < p <= 1:
+        raise ValueError(f"negative_binomial requires 0 < p <= 1, got {p}")
+    if k <= 0:
+        raise ValueError(f"negative_binomial requires k > 0, got {k}")
+    if isinstance(shape, int):
+        shape = (shape,)
+    data = _random_negative_binomial(k=k, p=p, shape=shape, dtype=dtype,
+                                     key=get_key())
+    return _wrap(data, ctx, out)
+
+
+def generalized_negative_binomial(mu=1.0, alpha=1.0, shape=(1,),
+                                  dtype="float32", ctx=None, out=None):
+    """Parity: ``mx.nd.random.generalized_negative_binomial``."""
+    from .ops.random_ops import _random_generalized_negative_binomial
+
+    if mu <= 0 or alpha < 0:
+        raise ValueError(
+            f"generalized_negative_binomial requires mu > 0 and alpha >= 0, "
+            f"got mu={mu}, alpha={alpha}")
+    if isinstance(shape, int):
+        shape = (shape,)
+    data = _random_generalized_negative_binomial(mu=mu, alpha=alpha,
+                                                 shape=shape, dtype=dtype,
+                                                 key=get_key())
+    return _wrap(data, ctx, out)

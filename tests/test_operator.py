@@ -1470,3 +1470,24 @@ class TestGroupAdaGrad:
         # state is per-row: 1/dim the elementwise AdaGrad state
         st = opt.create_state(0, _nd(w0.astype(np.float32)))
         assert st.shape == (6, 1)
+
+
+class TestRandomNamespaceParity:
+    @with_seed()
+    def test_negative_binomial_moments(self):
+        mx.random.seed(7)
+        s = mx.nd.random.negative_binomial(k=4, p=0.5, shape=(30000,)).asnumpy()
+        # mean k(1-p)/p = 4, var k(1-p)/p^2 = 8
+        assert abs(s.mean() - 4.0) < 0.15
+        assert abs(s.var() - 8.0) < 0.6
+        assert np.all(s >= 0) and np.allclose(s, np.round(s))
+
+    @with_seed()
+    def test_generalized_negative_binomial_moments(self):
+        mx.random.seed(8)
+        mu, alpha = 2.5, 0.3
+        s = mx.nd.random.generalized_negative_binomial(
+            mu=mu, alpha=alpha, shape=(30000,)).asnumpy()
+        assert abs(s.mean() - mu) < 0.15
+        # var = mu + alpha*mu^2
+        assert abs(s.var() - (mu + alpha * mu * mu)) < 0.5
