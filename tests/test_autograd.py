@@ -384,3 +384,67 @@ def test_leaf_survives_inplace_update():
         w -= 0.1 * w.grad
         losses.append(float(loss.asnumpy()))
     assert losses[-1] < 1e-2 * losses[0], losses[-1]
+
+
+def test_second_order_grad_through_rnn_megaop():
+    """Gradient-penalty (||d loss/d data||²) through the fused RNN scan vs
+    the functional jax oracle — create_graph must compose with lax.scan."""
+    import jax
+    import jax.numpy as jnp
+
+    from incubator_mxnet_tpu.ops.rnn_ops import rnn_mega, rnn_param_size
+
+    T, B, C, H = 3, 2, 2, 3
+    rng = np.random.RandomState(0)
+    xv = rng.uniform(-1, 1, (T, B, C)).astype(np.float32)
+    pv = rng.uniform(-0.3, 0.3, (rnn_param_size("gru", C, H),)).astype(np.float32)
+
+    def f(x):
+        return jnp.sum(rnn_mega(x, jnp.asarray(pv), mode="gru", state_size=H))
+
+    def pen(x):
+        return jnp.sum(jax.grad(f)(x) ** 2)
+
+    want = np.asarray(jax.grad(pen)(jnp.asarray(xv)))
+
+    x = mx.nd.array(xv)
+    x.attach_grad()
+    with autograd.record():
+        y = mx.nd.RNN(x, mx.nd.array(pv), mode="gru", state_size=H).sum()
+        g = autograd.grad(y, x, create_graph=True)
+        p = (g * g).sum()
+    p.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), want, rtol=1e-3, atol=1e-5)
+
+
+def test_second_order_grad_through_deformable_conv():
+    import jax
+    import jax.numpy as jnp
+
+    from incubator_mxnet_tpu.ops.spatial import deformable_convolution
+
+    rng = np.random.RandomState(1)
+    xv = rng.randn(1, 2, 5, 5).astype(np.float32)
+    wv = rng.randn(2, 2, 3, 3).astype(np.float32)
+    off = np.full((1, 18, 5, 5), 0.37, np.float32)
+
+    def f(x):
+        return jnp.sum(deformable_convolution(
+            x, jnp.asarray(off), jnp.asarray(wv), kernel=(3, 3), pad=(1, 1),
+            num_filter=2, no_bias=True))
+
+    def pen(x):
+        return jnp.sum(jax.grad(f)(x) ** 2)
+
+    want = np.asarray(jax.grad(pen)(jnp.asarray(xv)))
+
+    x = mx.nd.array(xv)
+    x.attach_grad()
+    with autograd.record():
+        y = mx.nd._contrib_DeformableConvolution(
+            x, mx.nd.array(off), mx.nd.array(wv), kernel=(3, 3), pad=(1, 1),
+            num_filter=2, no_bias=True).sum()
+        g = autograd.grad(y, x, create_graph=True)
+        p = (g * g).sum()
+    p.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), want, rtol=1e-3, atol=1e-4)
