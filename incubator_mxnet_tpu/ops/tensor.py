@@ -839,6 +839,26 @@ def _rpower_scalar(data, scalar=1.0):
     return data.dtype.type(scalar) ** data
 
 
+@register("split_v2")
+def split_v2(data, indices_or_sections=1, axis=0, squeeze_axis=False):
+    """Parity: [U:src/operator/tensor/matrix_op.cc] _split_v2 — int = N
+    equal sections, tuple = split points along ``axis``."""
+    spec = (int(indices_or_sections) if isinstance(indices_or_sections, int)
+            else [int(i) for i in indices_or_sections])
+    if not isinstance(spec, int):
+        # the reference rejects out-of-range/unsorted indices at shape
+        # inference; jnp.split would silently clamp to empty parts
+        if any(i < 0 or i > data.shape[axis] for i in spec) \
+                or sorted(spec) != spec:
+            raise ValueError(
+                f"split_v2 indices {spec} invalid for axis {axis} of "
+                f"size {data.shape[axis]} (must be sorted, in range)")
+    parts = jnp.split(data, spec, axis=axis)
+    if squeeze_axis:
+        parts = [jnp.squeeze(p, axis=axis) for p in parts]
+    return tuple(parts)  # tuple = multi-output contract (a list would stack)
+
+
 @register("_mod_scalar")
 def _mod_scalar(data, scalar=1.0):
     return jnp.mod(data, data.dtype.type(scalar))

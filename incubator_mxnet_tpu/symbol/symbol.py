@@ -92,9 +92,15 @@ def _truthy(v):
     return v in (True, 1, "1", "True", "true")
 
 
+def _split_v2_outputs(a):
+    spec = a.get("indices_or_sections", 1)
+    return int(spec) if isinstance(spec, int) else len(tuple(spec)) + 1
+
+
 _MULTI_OUTPUT = {
     "split": lambda a: int(a.get("num_outputs", 1)),
     "SliceChannel": lambda a: int(a.get("num_outputs", 1)),
+    "split_v2": _split_v2_outputs,
     "RNN": lambda a: ((3 if a.get("mode", "lstm") == "lstm" else 2)
                       if _truthy(a.get("state_outputs")) else 1),
 }

@@ -824,3 +824,41 @@ class TestIndexingEdgeSemantics:
         np.testing.assert_allclose(rev[:2, 0], x[[1, 0], 0])
         np.testing.assert_allclose(rev[2:, 0], x[2:, 0])  # tail untouched
         np.testing.assert_allclose(rev[:, 1], x[::-1, 1])
+
+
+class TestSplitV2:
+    @with_seed()
+    def test_sections_and_indices(self):
+        x = np.arange(24, dtype=np.float32).reshape(6, 4)
+        parts = mx.nd.split_v2(_nd(x), 3)
+        assert len(parts) == 3
+        np.testing.assert_allclose(parts[1].asnumpy(), x[2:4])
+        parts = mx.nd.split_v2(_nd(x), (1, 4), axis=0)
+        assert [p.shape[0] for p in parts] == [1, 3, 2]
+        np.testing.assert_allclose(parts[2].asnumpy(), x[4:])
+        sq = mx.nd.split_v2(_nd(x), 6, axis=0, squeeze_axis=True)
+        assert sq[0].shape == (4,)
+
+    def test_symbolic_multi_output(self):
+        import incubator_mxnet_tpu.symbol as S
+
+        S.symbol._reset_naming()
+        a = S.var("a")
+        parts = S.split_v2(a, indices_or_sections=(2,), axis=1, name="sp")
+        assert len(parts) == 2
+        y = S.broadcast_add(parts[0], S.slice_axis(parts[1], axis=1, begin=0,
+                                                   end=2), name="add")
+        exe = y.simple_bind(a=(3, 5))
+        av = np.random.RandomState(0).rand(3, 5).astype(np.float32)
+        exe.arg_dict["a"][:] = av
+        out = exe.forward(is_train=False)[0].asnumpy()
+        np.testing.assert_allclose(out, av[:, :2] + av[:, 2:4], rtol=1e-6)
+
+    def test_invalid_indices_rejected(self):
+        x = _nd(np.zeros((6, 4), np.float32))
+        with pytest.raises(ValueError):
+            mx.nd.split_v2(x, (1, 10), axis=0)
+        with pytest.raises(ValueError):
+            mx.nd.split_v2(x, (4, 2), axis=0)
+        with pytest.raises(ValueError):
+            mx.nd.split_v2(x, (-2,), axis=0)
