@@ -15,6 +15,7 @@ __all__ = [
     "Accuracy",
     "TopKAccuracy",
     "F1",
+    "MCC",
     "MAE",
     "MSE",
     "RMSE",
@@ -134,15 +135,16 @@ class TopKAccuracy(EvalMetric):
             self.num_inst += len(label)
 
 
-@register
-class F1(EvalMetric):
-    def __init__(self, name="f1", output_names=None, label_names=None, average="macro"):
+class _BinaryStats(EvalMetric):
+    """Shared binary confusion-matrix accumulation (F1/MCC base).  Labels
+    must be binary — multi-class input raises, matching the reference."""
+
+    def __init__(self, name, output_names=None, label_names=None):
         super().__init__(name, output_names, label_names)
-        self.average = average
         self.reset_stats()
 
     def reset_stats(self):
-        self.tp = self.fp = self.fn = 0.0
+        self.tp = self.fp = self.fn = self.tn = 0.0
 
     def reset(self):
         super().reset()
@@ -156,16 +158,47 @@ class F1(EvalMetric):
             if pred.ndim > 1:
                 pred = pred.argmax(axis=-1)
             pred = pred.reshape(-1).astype("int32")
+            if ((label < 0) | (label > 1)).any() or ((pred < 0) | (pred > 1)).any():
+                raise ValueError(
+                    f"{type(self).__name__} requires binary labels/predictions")
             self.tp += float(((pred == 1) & (label == 1)).sum())
             self.fp += float(((pred == 1) & (label == 0)).sum())
             self.fn += float(((pred == 0) & (label == 1)).sum())
+            self.tn += float(((pred == 0) & (label == 0)).sum())
             self.num_inst += 1
+
+
+@register
+class F1(_BinaryStats):
+    def __init__(self, name="f1", output_names=None, label_names=None, average="macro"):
+        super().__init__(name, output_names, label_names)
+        self.average = average
 
     def get(self):
         prec = self.tp / max(self.tp + self.fp, 1e-12)
         rec = self.tp / max(self.tp + self.fn, 1e-12)
         f1 = 2 * prec * rec / max(prec + rec, 1e-12)
         return (self.name, f1 if self.num_inst else float("nan"))
+
+
+@register
+class MCC(_BinaryStats):
+    """Matthews correlation coefficient for binary classification (parity:
+    ``mx.metric.MCC``): (tp·tn − fp·fn) / √((tp+fp)(tp+fn)(tn+fp)(tn+fn));
+    0 when any denominator factor is 0, the reference convention."""
+
+    def __init__(self, name="mcc", output_names=None, label_names=None):
+        super().__init__(name, output_names, label_names)
+
+    def get(self):
+        if not self.num_inst:
+            return (self.name, float("nan"))
+        denom = ((self.tp + self.fp) * (self.tp + self.fn)
+                 * (self.tn + self.fp) * (self.tn + self.fn))
+        if denom == 0:
+            return (self.name, 0.0)
+        return (self.name,
+                (self.tp * self.tn - self.fp * self.fn) / _np.sqrt(denom))
 
 
 @register

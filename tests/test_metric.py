@@ -74,3 +74,31 @@ def test_loss_metric():
     m = mx.metric.Loss()
     m.update([], [mx.nd.array([2.0, 4.0])])
     assert m.get()[1] == pytest.approx(3.0)
+
+
+def test_mcc_against_sklearn_formula():
+    m = mx.metric.MCC()
+    pred = mx.nd.array([[0.2, 0.8], [0.9, 0.1], [0.3, 0.7], [0.6, 0.4]])
+    label = mx.nd.array([1, 0, 0, 1])
+    m.update([label], [pred])
+    # tp=1 (i0), tn=1 (i1), fp=1 (i2), fn=1 (i3)
+    name, val = m.get()
+    np.testing.assert_allclose(val, (1 * 1 - 1 * 1) / np.sqrt(2 * 2 * 2 * 2))
+    m.reset()
+    perfect = mx.nd.array([[0.1, 0.9], [0.8, 0.2]])
+    m.update([mx.nd.array([1, 0])], [perfect])
+    assert m.get()[1] == 1.0
+    # degenerate (all one class predicted): 0 by convention
+    m.reset()
+    m.update([mx.nd.array([1, 1])], [mx.nd.array([[0.1, 0.9], [0.2, 0.8]])])
+    assert m.get()[1] == 0.0
+    # reachable through the registry
+    assert isinstance(mx.metric.create("mcc"), mx.metric.MCC)
+
+
+def test_binary_metrics_reject_multiclass():
+    for name in ("f1", "mcc"):
+        m = mx.metric.create(name)
+        with pytest.raises(ValueError):
+            m.update([mx.nd.array([0, 1, 2])],
+                     [mx.nd.array([[0.2, 0.3, 0.5]] * 3)])
