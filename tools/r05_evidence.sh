@@ -67,3 +67,10 @@ if [ "$WHAT" = all ] || [ "$WHAT" = tier ]; then
 fi
 
 note "== evidence capture complete"
+
+# commit the evidence so a round-end snapshot can never race past it
+git add docs/BENCH_EVIDENCE_r05.txt docs/TPU_TIER_LOG_r05.txt 2>/dev/null
+git add "$EV".err 2>/dev/null || true
+git -c user.name="$(git config user.name)" commit -q \
+    -m "Round-5 on-chip evidence capture ($(stamp))" || true
+echo "evidence committed (if changed)"
