@@ -245,7 +245,88 @@ class SPMDTrainer:
         return NDArray(loss)
 
     # ------------------------------------------------------------------
+    def step_bulk(self, data, label, k, batch_size=None):
+        """Run ``k`` fused optimizer steps in ONE device dispatch
+        (``lax.scan`` over the jitted step) — the TPU-native analog of the
+        reference engine's bulked execution (``MXNET_EXEC_BULK_EXEC_TRAIN``
+        and CachedOp's bulking segments, [U:src/imperative/cached_op.cc]):
+        for small programs the per-dispatch host→device round trip
+        dominates, and queueing k steps as one program amortizes it.
+
+        The batch is reused for all ``k`` steps (callers feeding real data
+        should call once per batch; the win is for dispatch-bound
+        programs).  Numerically identical to ``k`` successive ``step()``
+        calls with the same batch (same per-step num_update/lr/PRNG-key
+        schedule); returns the LAST step's mean loss as an NDArray.
+        """
+        if k < 1:
+            raise ValueError(f"step_bulk needs k >= 1, got {k}")
+        inputs = data if isinstance(data, (list, tuple)) else (data,)
+        arrays = self.shard_batch(*inputs, label)
+        if batch_size is None:
+            batch_size = arrays[0].shape[0]
+        sig = (tuple((a.shape, str(a.dtype)) for a in arrays), int(k))
+        fn = self._step_cache.get(sig)
+        if fn is None:
+            fn = self._build_bulk(arrays, int(k))
+            self._step_cache[sig] = fn
+        ts, lrs, keys = [], [], []
+        for _ in range(k):
+            self._t += 1
+            self._optimizer.num_update = self._t
+            ts.append(float(self._t))
+            lrs.append(self.learning_rate())
+            keys.append(get_key())
+        rescale = self._optimizer.rescale_grad / batch_size
+        new_params, new_states, loss = fn(
+            jnp.stack(keys),
+            jnp.asarray(ts, jnp.float32),
+            jnp.asarray(lrs, jnp.float32),
+            jnp.float32(rescale),
+            self._param_arrays,
+            self._opt_states,
+            *arrays,
+        )
+        self._param_arrays = new_params
+        self._opt_states = new_states
+        return NDArray(loss)
+
+    def _build_bulk(self, example_arrays, k):
+        pure_step = self._build_pure(example_arrays)
+
+        def bulk_step(keys, ts, lrs, rescale, param_arrs, opt_states, *batch):
+            def body(carry, xs):
+                pa, os = carry
+                key, t, lr = xs
+                pa, os, loss = pure_step(key, t, lr, rescale, pa, os, *batch)
+                return (pa, os), loss
+
+            (pa, os), losses = jax.lax.scan(
+                body, (param_arrs, opt_states), (keys, ts, lrs), length=k
+            )
+            return pa, os, losses[-1]
+
+        return self._jit_wrapped(bulk_step)
+
+    # ------------------------------------------------------------------
     def _build_step(self, example_arrays):
+        return self._jit_wrapped(self._build_pure(example_arrays))
+
+    def _jit_wrapped(self, step_fn):
+        """jit a (keys, t(s), lr(s), rescale, params, states, *batch) step
+        with param/state donation and the trainer's output shardings."""
+        out_shardings = (
+            list(self._param_shardings),
+            list(self._state_shardings),
+            NamedSharding(self._mesh, P()),
+        )
+        donate = (4, 5) if self._donate else ()
+        with self._mesh:
+            return jax.jit(
+                step_fn, donate_argnums=donate, out_shardings=out_shardings
+            )
+
+    def _build_pure(self, example_arrays):
         block = self._block
         loss_fn = self._loss_fn
         opt = self._optimizer
@@ -340,19 +421,7 @@ class SPMDTrainer:
                 new_full[k] = v.astype(new_full[k].dtype)
             return new_full, new_states, loss_mean
 
-        out_shardings = (
-            list(self._param_shardings),
-            list(self._state_shardings),
-            NamedSharding(self._mesh, P()),
-        )
-        donate = (4, 5) if self._donate else ()
-        with self._mesh:
-            fn = jax.jit(
-                pure_step,
-                donate_argnums=donate,
-                out_shardings=out_shardings,
-            )
-        return fn
+        return pure_step
 
     # ------------------------------------------------------------------
     def sync_to_block(self):

@@ -106,6 +106,34 @@ class TestSPMDTrainer:
 
         _assert_params_close(net_a, net_b)
 
+    def test_step_bulk_matches_sequential(self):
+        """k bulked steps (one lax.scan dispatch — the engine-bulking
+        analog) must equal k sequential step() calls: same params, same
+        num_update, same key schedule."""
+        x, y = _data()
+        loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+        net_a = _mlp(seed=23)
+        net_b = _mlp(seed=23)
+        xa, ya = mx.nd.array(x), mx.nd.array(y)
+
+        mx.random.seed(5)
+        seq = SPMDTrainer(net_a, loss_fn, "adam", {"learning_rate": 0.01},
+                          mesh=make_mesh())
+        for _ in range(6):
+            seq.step(xa, ya)
+        seq.sync_to_block()
+
+        mx.random.seed(5)
+        blk = SPMDTrainer(net_b, loss_fn, "adam", {"learning_rate": 0.01},
+                          mesh=make_mesh())
+        blk.step_bulk(xa, ya, 3)
+        blk.step_bulk(xa, ya, 3)
+        blk.sync_to_block()
+
+        assert blk.num_update == seq.num_update == 6
+        _assert_params_close(net_a, net_b)
+
     def test_adam_bias_correction_not_frozen(self):
         """t must be traced, not baked: two Adam steps from zero state give
         different deltas than one (catches a constant-t recompile bug)."""
