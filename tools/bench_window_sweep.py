@@ -3,8 +3,9 @@
 
 Runs the BERT bench at MXNET_TPU_BENCH_STEPS = 60/120/180/360 (or
 --steps ...), recovers the measured wall time per run from the reported
-samples/s (dt = B·steps / (value·chips)), and fits dt = intercept +
-slope·steps.  The claim under test: per-step time (the slope) is
+throughput (dt = items_per_step·steps / (value·chips), where
+items_per_step is B for samples/s metrics and 2·B·S for the transformer's
+tokens/s — mirroring bench.py:305), and fits dt = intercept + slope·steps.  The claim under test: per-step time (the slope) is
 window-invariant and the intercept equals the fence's fixed D2H cost —
 i.e. the 180-step window amortizes measurement overhead without touching
 the steady-state rate.  If the slope drifts with window, the gate number
@@ -43,9 +44,16 @@ def run_once(steps, batch, n_chips):
     if rec.get("value") in (None, 0):
         raise RuntimeError(f"bench failed at steps={steps}: {rec.get('error')}")
     # bench reports per-CHIP throughput (global/dt/n_chips); undo the chip
-    # division or the intercept inflates n_chips-fold
-    dt = batch * steps / (rec["value"] * n_chips)
-    return rec["value"], dt
+    # division or the intercept inflates n_chips-fold.  The transformer
+    # config reports tokens/s = 2·B·S·steps/dt (src+tgt, bench.py:305), so
+    # recover dt with the per-step token count or the fit's intercept is
+    # off by 2·S and loses its D2H-fixed-cost reading.
+    per_step = batch * 1.0
+    unit = rec.get("unit", "samples/sec/chip")
+    if "tokens" in unit:
+        per_step *= 2 * int(os.environ.get("MXNET_TPU_BENCH_SEQ", "256"))
+    dt = per_step * steps / (rec["value"] * n_chips)
+    return rec["value"], dt, unit, per_step
 
 
 def main():
@@ -58,31 +66,33 @@ def main():
 
     n_chips = _chip_count()
     rows = []
+    unit, per_step = "samples/sec/chip", float(args.batch)
     for s in args.steps:
         for _ in range(args.repeats):
-            val, dt = run_once(s, args.batch, n_chips)
+            val, dt, unit, per_step = run_once(s, args.batch, n_chips)
             rows.append((s, val, dt))
-            print(f"# steps={s}: {val} samples/s, dt={dt:.3f} s", flush=True)
+            print(f"# steps={s}: {val} {unit}, dt={dt:.3f} s", flush=True)
 
     xs = np.array([r[0] for r in rows], float)
     ys = np.array([r[2] for r in rows], float)
     slope, intercept = np.polyfit(xs, ys, 1)
     resid = ys - (intercept + slope * xs)
 
-    print("\n| steps | samples/s | dt (s) | fit residual (ms) |")
+    print(f"\n| steps | {unit} | dt (s) | fit residual (ms) |")
     print("|---|---|---|---|")
     for (s, val, dt), r in zip(rows, resid):
         print(f"| {s} | {val} | {dt:.3f} | {r * 1e3:+.1f} |")
     per_step_ms = slope * 1e3
-    steady = args.batch / slope
+    steady = per_step / slope / n_chips
     print(f"\nfit: dt = {intercept:.3f} s + {per_step_ms:.3f} ms/step "
-          f"(window-invariant steady rate = {steady:.1f} samples/s; "
+          f"(window-invariant steady rate = {steady:.1f} {unit}; "
           f"intercept = fixed fence/D2H cost)")
     print(json.dumps({
         "metric": "bench_window_fit",
+        "unit": unit,
         "slope_ms_per_step": round(per_step_ms, 4),
         "intercept_s": round(intercept, 4),
-        "steady_samples_per_sec": round(steady, 1),
+        "steady_per_sec_per_chip": round(steady, 1),
         "max_abs_residual_ms": round(float(np.abs(resid).max() * 1e3), 2),
     }))
 

@@ -15,6 +15,23 @@ stamp() { date -u +%FT%TZ; }
 
 note() { echo "[$(stamp)] $*" | tee -a "$EV"; }
 
+# commit evidence after EVERY section: the round-5 box was reset mid-capture
+# once already, wiping an uncommitted evidence file (ROUND5_NOTES.md)
+checkpoint() {
+    # per-file add (git add is all-or-nothing on a missing pathspec, and
+    # TPU_TIER_LOG_r05.txt does not exist until the tier section runs);
+    # commit constrained to the evidence paths so staged code can't be
+    # swept into a log-only commit
+    local f paths=()
+    for f in docs/BENCH_EVIDENCE_r05.txt docs/TPU_TIER_LOG_r05.txt "$EV".err; do
+        [ -e "$f" ] && { git add -- "$f" 2>/dev/null || true; paths+=("$f"); }
+    done
+    [ "${#paths[@]}" -gt 0 ] || return 0
+    git commit -q -m "Evidence checkpoint: $1 ($(stamp))" \
+        -m "No-Verification-Needed: evidence log checkpoint, no code change" \
+        -- "${paths[@]}" || true
+}
+
 run_bench() {
     local tag="$1"; shift
     note "== bench: $tag ($*)"
@@ -37,6 +54,7 @@ if [ "$WHAT" = all ] || [ "$WHAT" = bench ]; then
     run_bench ssd-vgg16     MXNET_TPU_BENCH=ssd MXNET_TPU_BENCH_SSD_BACKBONE=vgg16
     run_bench yolo3         MXNET_TPU_BENCH=yolo3
     run_bench mnist         MXNET_TPU_BENCH=mnist
+    checkpoint bench
 fi
 
 if [ "$WHAT" = all ] || [ "$WHAT" = profile ]; then
@@ -44,11 +62,16 @@ if [ "$WHAT" = all ] || [ "$WHAT" = profile ]; then
     MXNET_TPU_BENCH_PROFILE=/tmp/r05_prof MXNET_TPU_BENCH_STEPS=20 \
         timeout 3600 python bench.py 2>>"$EV".err | tee -a "$EV"
     timeout 600 python tools/parse_xplane.py /tmp/r05_prof 2>>"$EV".err | head -40 | tee -a "$EV" || true
+    checkpoint profile
 fi
 
 if [ "$WHAT" = all ] || [ "$WHAT" = sweep ]; then
     note "== window sweep (VERDICT item 2)"
     timeout 7200 python tools/bench_window_sweep.py 2>>"$EV".err | tee -a "$EV"
+    note "== transformer window sweep (gate corroboration at S=256)"
+    MXNET_TPU_BENCH=transformer MXNET_TPU_BENCH_BATCH=32 \
+        timeout 7200 python tools/bench_window_sweep.py 2>>"$EV".err | tee -a "$EV"
+    checkpoint sweep
 fi
 
 if [ "$WHAT" = all ] || [ "$WHAT" = control ]; then
@@ -57,6 +80,7 @@ if [ "$WHAT" = all ] || [ "$WHAT" = control ]; then
     note "== Pallas fused BN A/B, stages 2+3 (VERDICT item 4b)"
     MXNET_TPU_BN_STAGE=2 timeout 1800 python tools/bench_fused_bn.py 2>>"$EV".err | tee -a "$EV"
     MXNET_TPU_BN_STAGE=3 timeout 1800 python tools/bench_fused_bn.py 2>>"$EV".err | tee -a "$EV"
+    checkpoint control
 fi
 
 if [ "$WHAT" = all ] || [ "$WHAT" = tier ]; then
@@ -64,6 +88,7 @@ if [ "$WHAT" = all ] || [ "$WHAT" = tier ]; then
     tools/run_tpu_tier.sh docs/TPU_TIER_LOG_r05.txt 420 | tee -a "$EV"
     note "== tpu_tests family rows"
     MXNET_TEST_CTX=tpu timeout 3600 python -m pytest tpu_tests/ -q 2>&1 | tail -3 | tee -a "$EV"
+    checkpoint tier
 fi
 
 note "== evidence capture complete"
