@@ -56,10 +56,20 @@ _ACT_MAP = {"relu": "Relu", "sigmoid": "Sigmoid", "tanh": "Tanh",
 _ELEMWISE = {"elemwise_add": "Add", "broadcast_add": "Add", "_plus": "Add",
              "elemwise_sub": "Sub", "broadcast_sub": "Sub", "_sub": "Sub",
              "elemwise_mul": "Mul", "broadcast_mul": "Mul", "_mul": "Mul",
-             "elemwise_div": "Div", "broadcast_div": "Div", "_div": "Div"}
+             "elemwise_div": "Div", "broadcast_div": "Div", "_div": "Div",
+             "broadcast_maximum": "Max", "broadcast_minimum": "Min",
+             "maximum": "Max", "minimum": "Min",
+             "broadcast_power": "Pow", "_power": "Pow"}
 _UNARY = {"tanh": "Tanh", "sigmoid": "Sigmoid", "relu": "Relu",
           "exp": "Exp", "sqrt": "Sqrt", "log": "Log", "negative": "Neg",
-          "abs": "Abs", "erf": "Erf"}
+          "abs": "Abs", "erf": "Erf", "floor": "Floor", "ceil": "Ceil",
+          "sign": "Sign", "reciprocal": "Reciprocal", "sin": "Sin",
+          "cos": "Cos", "tan": "Tan", "arcsin": "Asin", "arccos": "Acos",
+          "arctan": "Atan", "sinh": "Sinh", "cosh": "Cosh",
+          "arcsinh": "Asinh", "arccosh": "Acosh", "arctanh": "Atanh"}
+# NOTE: mx `round` is half-away-from-zero ([U:src/operator/mshadow_op.h])
+# but ONNX Round is half-to-even — deliberately NOT in this map; the
+# values diverge on every .5 input.
 # op -> (onnx op, scalar operand position: 1 = x∘c, 0 = c∘x)
 _SCALAR = {"_plus_scalar": ("Add", 1), "_mul_scalar": ("Mul", 1),
            "_minus_scalar": ("Sub", 1), "_div_scalar": ("Div", 1),
@@ -400,6 +410,197 @@ def _export_node(node, in_names, out_name, extra_inits):
         ins = in_names + [c_name] if pos == 1 else [c_name] + in_names
         return [{"op_type": onnx_op, "name": nm, "input": ins,
                  "output": [out_name], "attribute": []}]
+
+    def _i64_init(suffix, values):
+        iname = nm + suffix
+        arr = _np.asarray(values, _np.int64)
+        extra_inits.append({"name": iname, "dims": arr.shape,
+                            "data_type": P.TP_INT64, "raw": arr.tobytes()})
+        return iname
+
+    if op == "clip":
+        # opset 11+: min/max are optional inputs
+        ins = list(in_names)
+        for key, suffix in (("a_min", "_min"), ("a_max", "_max")):
+            v = a.get(key)
+            if v is None:
+                ins.append("")
+            else:
+                cname = nm + suffix
+                extra_inits.append({"name": cname, "dims": (),
+                                    "data_type": P.TP_FLOAT,
+                                    "raw": _np.float32(v).tobytes()})
+                ins.append(cname)
+        while ins and ins[-1] == "":
+            ins.pop()
+        return [{"op_type": "Clip", "name": nm, "input": ins,
+                 "output": [out_name], "attribute": []}]
+    if op in ("cast", "Cast"):
+        dt = _np.dtype(a.get("dtype", "float32"))
+        if dt not in P.DTYPE_TO_TP:
+            raise NotImplementedError(f"cast to {dt} has no ONNX dtype")
+        return [{"op_type": "Cast", "name": nm, "input": in_names,
+                 "output": [out_name],
+                 "attribute": [_attr_i("to", P.DTYPE_TO_TP[dt])]}]
+    if op == "slice":
+        begin, end = a.get("begin", ()), a.get("end", ())
+        step = a.get("step") or [None] * len(begin)
+        if any(s is not None and s < 0 for s in step):
+            raise NotImplementedError("slice with negative step")
+        starts = [0 if b is None else b for b in begin]
+        ends = [2**63 - 1 if e is None else e for e in end]
+        steps = [1 if s is None else s for s in step]
+        ins = in_names + [_i64_init("_starts", starts), _i64_init("_ends", ends),
+                          _i64_init("_axes", list(range(len(starts)))),
+                          _i64_init("_steps", steps)]
+        return [{"op_type": "Slice", "name": nm, "input": ins,
+                 "output": [out_name], "attribute": []}]
+    if op == "slice_axis":
+        end = a.get("end")
+        ins = in_names + [_i64_init("_starts", [a.get("begin", 0)]),
+                          _i64_init("_ends", [2**63 - 1 if end is None else end]),
+                          _i64_init("_axes", [a["axis"]])]
+        return [{"op_type": "Slice", "name": nm, "input": ins,
+                 "output": [out_name], "attribute": []}]
+    if op == "squeeze":
+        ax = a.get("axis")
+        ins = list(in_names)
+        if ax is not None:
+            ins.append(_i64_init("_axes", [ax] if isinstance(ax, int) else list(ax)))
+        return [{"op_type": "Squeeze", "name": nm, "input": ins,
+                 "output": [out_name], "attribute": []}]
+    if op == "expand_dims":
+        return [{"op_type": "Unsqueeze", "name": nm,
+                 "input": in_names + [_i64_init("_axes", [a["axis"]])],
+                 "output": [out_name], "attribute": []}]
+    if op in ("sum", "mean", "prod", "max", "min",
+              "sum_axis", "max_axis", "min_axis"):
+        if _truthy(a.get("exclude", False)):
+            raise NotImplementedError(
+                f"{op} with exclude=True needs the input rank, which the "
+                "exporter does not infer; rewrite with explicit axes")
+        onnx_op = {"sum": "ReduceSum", "sum_axis": "ReduceSum",
+                   "mean": "ReduceMean", "prod": "ReduceProd",
+                   "max": "ReduceMax", "max_axis": "ReduceMax",
+                   "min": "ReduceMin", "min_axis": "ReduceMin"}[op]
+        ax = a.get("axis")
+        if ax is not None and not isinstance(ax, (tuple, list)):
+            ax = (ax,)
+        attrs = [_attr_i("keepdims", 1 if _truthy(a.get("keepdims", False)) else 0)]
+        ins = list(in_names)
+        if onnx_op == "ReduceSum":  # opset 13: axes is an input
+            if ax is not None:
+                ins.append(_i64_init("_axes", list(ax)))
+        elif ax is not None:
+            attrs.append(_attr_ints("axes", tuple(ax)))
+        return [{"op_type": onnx_op, "name": nm, "input": ins,
+                 "output": [out_name], "attribute": attrs}]
+    if op in ("argmax", "argmin"):
+        if a.get("axis") is None:
+            raise NotImplementedError(
+                f"{op} over the flattened array (axis=None) has no ONNX "
+                "ArgMax form; flatten explicitly first")
+        # mx returns float32 indices; ONNX returns int64 — append a Cast
+        # so the roundtrip preserves mx dtype semantics
+        raw = nm + "_i64"
+        return [{"op_type": "ArgMax" if op == "argmax" else "ArgMin",
+                 "name": nm, "input": in_names, "output": [raw],
+                 "attribute": [_attr_i("axis", a["axis"]),
+                               _attr_i("keepdims", 1 if _truthy(a.get("keepdims", False)) else 0)]},
+                {"op_type": "Cast", "name": nm + "_cast", "input": [raw],
+                 "output": [out_name],
+                 "attribute": [_attr_i("to", P.TP_FLOAT)]}]
+    if op == "tile":
+        # ONNX Tile requires len(repeats) == rank(input); mx tile pads/
+        # promotes mismatched reps.  The exporter has shapes only for
+        # initializer inputs (same limit as the `dot` branch) — reject the
+        # provably-invalid case, trust the rest.
+        reps = a.get("reps", ())
+        for entry in extra_inits:
+            if entry["name"] in in_names and len(entry["dims"]) != len(reps):
+                raise NotImplementedError(
+                    f"tile: reps rank {len(reps)} != input rank "
+                    f"{len(entry['dims'])} has no ONNX Tile form; pass reps "
+                    "matching the input rank")
+        return [{"op_type": "Tile", "name": nm,
+                 "input": in_names + [_i64_init("_reps", list(reps))],
+                 "output": [out_name], "attribute": []}]
+    if op == "one_hot":
+        on = float(a.get("on_value", 1.0))
+        off = float(a.get("off_value", 0.0))
+        vname = nm + "_values"
+        extra_inits.append({"name": vname, "dims": (2,),
+                            "data_type": P.TP_FLOAT,
+                            "raw": _np.asarray([off, on], _np.float32).tobytes()})
+        return [{"op_type": "OneHot", "name": nm,
+                 "input": in_names + [_i64_init("_depth", a["depth"]), vname],
+                 "output": [out_name],
+                 "attribute": [_attr_i("axis", -1)]}]
+    if op == "where":
+        # ONNX Where needs a bool condition; mx treats nonzero as true
+        cond = nm + "_cond"
+        return [{"op_type": "Cast", "name": nm + "_bool",
+                 "input": [in_names[0]], "output": [cond],
+                 "attribute": [_attr_i("to", P.TP_BOOL)]},
+                {"op_type": "Where", "name": nm,
+                 "input": [cond, in_names[1], in_names[2]],
+                 "output": [out_name], "attribute": []}]
+    if op == "stack":
+        ax = a.get("axis", 0)
+        nodes, unsq = [], []
+        for i, iname in enumerate(in_names):
+            oname = f"{nm}_unsq{i}"
+            nodes.append({"op_type": "Unsqueeze", "name": oname,
+                          "input": [iname, _i64_init(f"_ax{i}", [ax])],
+                          "output": [oname], "attribute": []})
+            unsq.append(oname)
+        nodes.append({"op_type": "Concat", "name": nm, "input": unsq,
+                      "output": [out_name],
+                      "attribute": [_attr_i("axis", ax)]})
+        return nodes
+    if op == "log_softmax":
+        return [{"op_type": "LogSoftmax", "name": nm, "input": in_names,
+                 "output": [out_name],
+                 "attribute": [_attr_i("axis", a.get("axis", -1))]}]
+    if op == "SoftmaxOutput":
+        # inference form: the label input and loss-time attrs drop away
+        # (the reference exporter does the same)
+        if _truthy(a.get("multi_output", False)):
+            raise NotImplementedError(
+                "SoftmaxOutput multi_output=True (softmax over axis 1 of a "
+                "4-D map) has no direct ONNX Softmax form at export time")
+        return [{"op_type": "Softmax", "name": nm, "input": in_names[:1],
+                 "output": [out_name], "attribute": [_attr_i("axis", -1)]}]
+    if op == "L2Normalization":
+        if a.get("mode", "instance") != "channel":
+            raise NotImplementedError(
+                "L2Normalization: only mode='channel' maps to ONNX "
+                "LpNormalization(axis=1); instance/spatial reduce over "
+                "multiple axes")
+        return [{"op_type": "LpNormalization", "name": nm, "input": in_names,
+                 "output": [out_name],
+                 "attribute": [_attr_i("axis", 1), _attr_i("p", 2)]}]
+    if op == "InstanceNorm":
+        return [{"op_type": "InstanceNormalization", "name": nm,
+                 "input": in_names, "output": [out_name],
+                 "attribute": [_attr_f("epsilon", a.get("eps", 1e-3))]}]
+    if op in ("pad", "Pad"):
+        pw = tuple(a.get("pad_width", ()))
+        mode = a.get("mode", "constant")
+        if mode not in ("constant", "edge", "reflect"):
+            raise NotImplementedError(f"pad mode {mode!r}")
+        n_ax = len(pw) // 2
+        pads = [pw[2 * i] for i in range(n_ax)] + [pw[2 * i + 1] for i in range(n_ax)]
+        ins = list(in_names) + [_i64_init("_pads", pads)]
+        if mode == "constant":
+            cname = nm + "_cval"
+            extra_inits.append({"name": cname, "dims": (),
+                                "data_type": P.TP_FLOAT,
+                                "raw": _np.float32(a.get("constant_value", 0.0)).tobytes()})
+            ins.append(cname)
+        return [{"op_type": "Pad", "name": nm, "input": ins,
+                 "output": [out_name],
+                 "attribute": [_attr_s("mode", mode)]}]
     raise NotImplementedError(f"no ONNX converter for op {op!r}")
 
 
@@ -1107,6 +1308,63 @@ def import_model(model_file):
                         f"{op} state outputs (Y_h/Y_c) are consumed by the "
                         "graph; only Y import is supported")
             continue
+        elif op == "Tile":
+            ins = node["input"]
+            reps = [int(v) for v in _init_or_reject(ins[1], "Tile repeats")]
+            _drop_if_unused(ins[1], g, inits, env, folded)
+            out = sym_mod.tile(env[ins[0]], reps=tuple(reps), name=nm)
+        elif op in ("ArgMax", "ArgMin"):
+            if _get_attr(node, "select_last_index", 0):
+                raise NotImplementedError(f"{op} select_last_index=1 (mx "
+                                          "argmax/argmin take the first)")
+            fn = sym_mod.argmax if op == "ArgMax" else sym_mod.argmin
+            out = fn(env[node["input"][0]], axis=_get_attr(node, "axis", 0),
+                     keepdims=bool(_get_attr(node, "keepdims", 1)), name=nm)
+        elif op == "OneHot":
+            ins = node["input"]
+            axis = _get_attr(node, "axis", -1)
+            if axis != -1:
+                raise NotImplementedError("OneHot: only axis=-1 (the mx "
+                                          "one_hot layout) is supported")
+            depth = int(_np.asarray(_init_or_reject(ins[1], "OneHot depth")).reshape(()))
+            off_on = _np.asarray(_init_or_reject(ins[2], "OneHot values")).reshape(2)
+            for extra in (ins[1], ins[2]):
+                _drop_if_unused(extra, g, inits, env, folded)
+            out = sym_mod.one_hot(env[ins[0]], depth=depth,
+                                  on_value=float(off_on[1]),
+                                  off_value=float(off_on[0]), name=nm)
+        elif op == "InstanceNormalization":
+            ins = node["input"]
+            out = sym_mod.InstanceNorm(env[ins[0]], env[ins[1]], env[ins[2]],
+                                       eps=_get_attr(node, "epsilon", 1e-5),
+                                       name=nm)
+        elif op == "LpNormalization":
+            if _get_attr(node, "p", 2) != 2 or _get_attr(node, "axis", -1) != 1:
+                raise NotImplementedError(
+                    "LpNormalization: only p=2, axis=1 (mx L2Normalization "
+                    "mode='channel') is supported")
+            out = sym_mod.L2Normalization(env[node["input"][0]],
+                                          mode="channel", name=nm)
+        elif op == "LogSoftmax":
+            out = sym_mod.log_softmax(env[node["input"][0]],
+                                      axis=_get_attr(node, "axis", -1), name=nm)
+        elif op in ("Max", "Min"):
+            fn = (sym_mod.broadcast_maximum if op == "Max"
+                  else sym_mod.broadcast_minimum)
+            out = env[node["input"][0]]
+            for extra_in in node["input"][1:]:
+                out = fn(out, env[extra_in], name=nm)
+        elif op in ("Greater", "Less"):
+            fn = (sym_mod.broadcast_greater if op == "Greater"
+                  else sym_mod.broadcast_lesser)
+            out = fn(env[node["input"][0]], env[node["input"][1]], name=nm)
+        elif op == "Not":
+            out = sym_mod.logical_not(env[node["input"][0]], name=nm)
+        elif op in ("And", "Or", "Xor"):
+            fn = {"And": sym_mod.broadcast_logical_and,
+                  "Or": sym_mod.broadcast_logical_or,
+                  "Xor": sym_mod.broadcast_logical_xor}[op]
+            out = fn(env[node["input"][0]], env[node["input"][1]], name=nm)
         elif op in _REV_UNARY:
             out = getattr(sym_mod, _REV_UNARY[op])(env[node["input"][0]],
                                                    name=nm)

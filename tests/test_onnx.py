@@ -729,3 +729,164 @@ def test_symbol_split_multi_output_api():
     exe.arg_dict["data"][:] = xv
     out = exe.forward(is_train=False)[0].asnumpy()
     np.testing.assert_allclose(out, xv[:, :2] + xv[:, 4:], rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# round-5 breadth 3: shape/reduce/elementwise/normalization converter
+# families, both directions ([U:python/mxnet/contrib/onnx/mx2onnx/
+# _op_translations.py] families not yet covered)
+# ---------------------------------------------------------------------------
+
+
+class TestOnnxBreadth3:
+    def _roundtrip(self, tmp_path, out_sym, data_np, params=None, rtol=1e-5,
+                   atol=1e-6):
+        params = params or {}
+        ref = _bind_forward(out_sym, params, data_np)
+        f = str(tmp_path / "b3.onnx")
+        onnx_mxnet.export_model(out_sym, params, input_shape=data_np.shape,
+                                onnx_file_path=f)
+        sym2, arg2, aux2 = onnx_mxnet.import_model(f)
+        arg2.update(aux2)
+        out = _bind_forward(sym2, arg2, data_np)
+        np.testing.assert_allclose(out, ref, rtol=rtol, atol=atol)
+        return out
+
+    def test_shape_family_roundtrip(self, tmp_path):
+        """slice / slice_axis / squeeze / expand_dims / tile / pad chain."""
+        S.symbol._reset_naming()
+        data = S.var("data")
+        x = S.slice(data, begin=(0, 1), end=(2, None), name="sl1")
+        x = S.slice_axis(x, axis=1, begin=0, end=2, name="sa1")
+        x = S.expand_dims(x, axis=1, name="ed1")
+        x = S.tile(x, reps=(2, 1, 1), name="ti1")  # reps rank == input rank
+        x = S.pad(x, mode="constant", pad_width=(0, 0, 0, 0, 1, 1),
+                  constant_value=0.5, name="pd1")
+        out_sym = S.squeeze(S.slice_axis(x, axis=1, begin=0, end=1, name="sa2"),
+                            axis=1, name="sq1")
+        data_np = np.random.RandomState(5).rand(3, 4).astype(np.float32)
+        out = self._roundtrip(tmp_path, out_sym, data_np)
+        assert out.shape == (4, 4)
+
+    def test_reduce_argmax_roundtrip(self, tmp_path):
+        S.symbol._reset_naming()
+        data = S.var("data")
+        m = S.mean(data, axis=2, name="me1")
+        s = S.sum(m, axis=(1,), keepdims=True, name="su1")  # ReduceSum-13 axes input
+        am = S.argmax(data, axis=1, keepdims=True, name="am1")
+        out_sym = S.broadcast_add(s, S.max(am, axis=1, keepdims=True, name="mx1"),
+                                  name="out1")
+        data_np = np.random.RandomState(6).rand(2, 3, 4).astype(np.float32)
+        self._roundtrip(tmp_path, out_sym, data_np)
+
+    def test_unary_elemwise_breadth_roundtrip(self, tmp_path):
+        S.symbol._reset_naming()
+        data = S.var("data")
+        x = S.clip(data, a_min=-0.8, a_max=0.8, name="cl1")
+        x = S.sin(x, name="si1") + S.cos(x, name="co1")
+        x = S.floor(x * 3.0) + S.ceil(x * 2.0) + S.sign(x, name="sg1")
+        x = S.broadcast_maximum(x, S.broadcast_minimum(x * 0.5, x * 0.25,
+                                                       name="mi1"), name="ma1")
+        out_sym = S.reciprocal(x + 4.0, name="re1")
+        data_np = (np.random.RandomState(7).rand(2, 5).astype(np.float32) - 0.5)
+        self._roundtrip(tmp_path, out_sym, data_np)
+
+    def test_where_cast_roundtrip(self, tmp_path):
+        S.symbol._reset_naming()
+        data = S.var("data")
+        cond = S.floor(S.clip(data * 2.0, a_min=0.0, a_max=1.0, name="cc1"),
+                       name="fl1")
+        w = S.where(cond, data * 2.0, data - 1.0, name="wh1")
+        out_sym = S.cast(w, dtype="float32", name="ca1")
+        data_np = (np.random.RandomState(8).rand(3, 4).astype(np.float32) - 0.3)
+        self._roundtrip(tmp_path, out_sym, data_np)
+
+    def test_onehot_logsoftmax_roundtrip(self, tmp_path):
+        S.symbol._reset_naming()
+        data = S.var("data")
+        idx = S.argmax(data, axis=1, name="am1")          # float indices
+        oh = S.one_hot(idx, depth=3, on_value=2.0, off_value=-1.0, name="oh1")
+        out_sym = S.log_softmax(oh, axis=-1, name="ls1")
+        data_np = np.random.RandomState(9).rand(4, 3).astype(np.float32)
+        self._roundtrip(tmp_path, out_sym, data_np)
+
+    def test_instance_norm_l2norm_roundtrip(self, tmp_path):
+        S.symbol._reset_naming()
+        data = S.var("data")
+        inorm = S.InstanceNorm(data, S.var("g1"), S.var("b1"), eps=1e-3,
+                               name="in1")
+        out_sym = S.L2Normalization(inorm, mode="channel", name="l2n1")
+        data_np = np.random.RandomState(10).rand(2, 3, 4, 4).astype(np.float32)
+        params = {"g1": mx.nd.array(np.array([1.0, 2.0, 0.5], np.float32)),
+                  "b1": mx.nd.array(np.array([0.1, -0.2, 0.0], np.float32))}
+        self._roundtrip(tmp_path, out_sym, data_np, params=params, rtol=1e-4,
+                        atol=1e-5)
+
+    def test_softmax_output_inference_export(self, tmp_path):
+        S.symbol._reset_naming()
+        data = S.var("data")
+        fc = S.FullyConnected(data, num_hidden=5, name="fc1")
+        out_sym = S.SoftmaxOutput(fc, S.var("label"), name="so1")
+        data_np = np.random.RandomState(11).rand(3, 4).astype(np.float32)
+        params = _rand_params(out_sym, data_np.shape)
+        params = {k: v for k, v in params.items() if k != "label"}
+        ref = _bind_forward(out_sym, params, data_np)
+        f = str(tmp_path / "so.onnx")
+        onnx_mxnet.export_model(out_sym, params, input_shape=data_np.shape,
+                                onnx_file_path=f)
+        sym2, arg2, aux2 = onnx_mxnet.import_model(f)
+        arg2.update(aux2)
+        out = _bind_forward(sym2, arg2, data_np)
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+    def test_export_rejections(self, tmp_path):
+        S.symbol._reset_naming()
+        data = S.var("data")
+        for bad in (S.round(data, name="ro1"),
+                    S.argmax(data, name="am0"),          # axis=None
+                    S.L2Normalization(data, mode="instance", name="l2i"),
+                    S.sum(data, axis=1, exclude=True, name="sx1")):
+            with pytest.raises(NotImplementedError):
+                onnx_mxnet.export_model(bad, {}, input_shape=(2, 3),
+                                        onnx_file_path=str(tmp_path / "x.onnx"))
+
+    def test_foreign_variadic_max_logical(self, tmp_path):
+        from incubator_mxnet_tpu.contrib.onnx import _proto as P
+
+        f = _foreign_model(tmp_path, [
+            {"op_type": "Max", "name": "m", "input": ["data", "c1", "c2"],
+             "output": ["m0"], "attribute": []},
+            {"op_type": "Greater", "name": "g", "input": ["m0", "c1"],
+             "output": ["g0"], "attribute": []},
+            {"op_type": "Not", "name": "n", "input": ["g0"],
+             "output": ["n0"], "attribute": []},
+            {"op_type": "Or", "name": "o", "input": ["n0", "g0"],
+             "output": ["y"], "attribute": []},
+        ], {"c1": np.full((2, 3), 0.5, np.float32),
+            "c2": np.full((2, 3), 0.25, np.float32)}, (2, 3))
+        sym2, args, aux = onnx_mxnet.import_model(f)
+        x = np.random.RandomState(12).rand(2, 3).astype(np.float32)
+        out = _bind_forward(sym2, args, x)
+        np.testing.assert_allclose(out, np.ones((2, 3), np.float32), rtol=1e-6)
+
+    def test_foreign_tile_onehot_argmax(self, tmp_path):
+        from incubator_mxnet_tpu.contrib.onnx import _proto as P
+
+        f = _foreign_model(tmp_path, [
+            {"op_type": "ArgMax", "name": "a", "input": ["data"],
+             "output": ["a0"],
+             "attribute": [{"name": "axis", "type": P.ATTR_INT, "i": 1},
+                           {"name": "keepdims", "type": P.ATTR_INT, "i": 0}]},
+            {"op_type": "OneHot", "name": "h", "input": ["a0", "dep", "val"],
+             "output": ["h0"],
+             "attribute": [{"name": "axis", "type": P.ATTR_INT, "i": -1}]},
+            {"op_type": "Tile", "name": "t", "input": ["h0", "rep"],
+             "output": ["y"], "attribute": []},
+        ], {"dep": np.asarray(3, np.int64),
+            "val": np.asarray([0.0, 1.0], np.float32),
+            "rep": np.asarray([2, 1], np.int64)}, (2, 3))
+        sym2, args, aux = onnx_mxnet.import_model(f)
+        x = np.random.RandomState(13).rand(2, 3).astype(np.float32)
+        out = _bind_forward(sym2, args, x)
+        expect = np.eye(3, dtype=np.float32)[x.argmax(1)]
+        np.testing.assert_allclose(out, np.tile(expect, (2, 1)), rtol=1e-6)

@@ -17,9 +17,20 @@ EV=docs/BENCH_EVIDENCE_r05.txt
 
 stamp() { date -u +%FT%TZ; }
 
+LAUNCHES=0
 while true; do
-    if grep -qs "evidence capture complete" "$EV"; then
-        echo "[$(stamp)] capture complete -> watcher exiting"
+    if [ "$LAUNCHES" -ge 4 ]; then
+        echo "[$(stamp)] relaunch cap (4) reached -> watcher exiting; inspect $EV"
+        exit 1
+    fi
+    # r05_evidence.sh writes the completion marker unconditionally (it
+    # records per-section errors and moves on), so the marker alone does
+    # not mean the capture succeeded: require at least one real metric
+    # AND the tier log (the last section) before standing down.
+    if grep -qs "evidence capture complete" "$EV" \
+            && grep -qs '"value":' "$EV" \
+            && [ -s docs/TPU_TIER_LOG_r05.txt ]; then
+        echo "[$(stamp)] capture complete with results -> watcher exiting"
         exit 0
     fi
     if pgrep -f "r05_evidence.sh" >/dev/null 2>&1; then
@@ -38,6 +49,7 @@ b = jnp.einsum('bij,bjk->bik', a[:8], a[:8]); b.block_until_ready()
 print('load probe ok')
 " 2>/dev/null; then
             echo "[$(stamp)] tunnel healthy under load -> launching capture"
+            LAUNCHES=$((LAUNCHES + 1))
             nohup bash tools/r05_evidence.sh all >>/tmp/r05_evidence_run.log 2>&1 &
             sleep 600
             continue
