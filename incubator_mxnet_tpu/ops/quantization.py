@@ -119,3 +119,25 @@ def quantized_conv(data, weight, bias,
     if bias is not None and not no_bias:
         out = out + bias.astype(jnp.float32).reshape((1, -1) + (1,) * n)
     return out
+
+
+@register("quantize")
+def quantize(data, min_range, max_range, out_type="uint8"):
+    """Legacy explicit-range quantize (parity:
+    [U:src/operator/quantization/quantize.cc] — quantize_v2 is the
+    calibrated successor).  uint8: affine over [min, max]; int8:
+    symmetric over max(|min|, |max|).  Returns (q, min, max)."""
+    x = data.astype(jnp.float32)
+    min_r = jnp.asarray(min_range, jnp.float32).reshape(())
+    max_r = jnp.asarray(max_range, jnp.float32).reshape(())
+    if out_type == "uint8":
+        scale = jnp.where(max_r > min_r, 255.0 / (max_r - min_r), 1.0)
+        q = jnp.clip(jnp.round((jnp.clip(x, min_r, max_r) - min_r) * scale),
+                     0, 255).astype(jnp.uint8)
+    elif out_type == "int8":
+        scale = 127.0 / jnp.maximum(jnp.maximum(jnp.abs(min_r),
+                                                jnp.abs(max_r)), 1e-30)
+        q = jnp.clip(jnp.round(x * scale), -127, 127).astype(jnp.int8)
+    else:
+        raise NotImplementedError(f"quantize out_type {out_type!r}")
+    return q, min_r.reshape(1), max_r.reshape(1)

@@ -793,3 +793,33 @@ def ctc_loss(data, label, data_lengths=None, label_lengths=None,
 alias("ctc_loss", "CTCLoss")
 alias("_contrib_CTCLoss", "CTCLoss")
 alias("_contrib_ctc_loss", "CTCLoss")
+
+
+@register("Crop")
+def crop_op(*inputs, offset=(0, 0), h_w=(0, 0), center_crop=False,
+            num_args=None):
+    """Parity: [U:src/operator/crop.cc] — NCHW spatial crop (the FCN-era
+    op).  One input: crop to ``h_w`` at ``offset`` (or centered).  Two
+    inputs: crop the first to the second's H×W."""
+    data = inputs[0]
+    if len(inputs) == 2:
+        th, tw = inputs[1].shape[2], inputs[1].shape[3]
+    else:
+        th, tw = int(h_w[0]), int(h_w[1])
+        if th == 0 or tw == 0:
+            raise ValueError("Crop: give h_w or a second (crop_like) input")
+    h, w = data.shape[2], data.shape[3]
+    if th > h or tw > w:
+        raise ValueError(f"Crop: target {th}x{tw} exceeds input {h}x{w}")
+    if center_crop:
+        oy, ox = (h - th) // 2, (w - tw) // 2
+    else:
+        oy, ox = int(offset[0]), int(offset[1])
+    if oy < 0 or ox < 0:
+        raise ValueError(f"Crop: negative offset ({oy},{ox})")
+    if oy + th > h or ox + tw > w:
+        raise ValueError(f"Crop: offset {oy},{ox} + {th}x{tw} exceeds {h}x{w}")
+    return data[:, :, oy:oy + th, ox:ox + tw]
+# NOTE: lowercase `crop` is the reference's legacy alias for `slice`
+# ([U:src/operator/tensor/matrix_op.cc] add_alias("crop")), registered in
+# tensor.py — NOT an alias of this op.

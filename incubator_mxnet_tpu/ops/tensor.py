@@ -10,6 +10,8 @@ scripts/tests port unchanged.
 """
 from __future__ import annotations
 
+import functools
+
 import numpy as _np
 
 import jax
@@ -918,3 +920,69 @@ def _sym_zeros(shape=(), dtype="float32"):
 @register("_sym_ones")
 def _sym_ones(shape=(), dtype="float32"):
     return jnp.ones(tuple(shape), dtype=_as_np_dtype(dtype))
+
+
+alias("broadcast_axes", "broadcast_axis")
+alias("crop", "slice")  # [U:src/operator/tensor/matrix_op.cc] add_alias("crop")
+
+
+# ---------------------------------------------------------------------------
+# legacy ndarray functions (parity: [U:src/ndarray/ndarray_function.cc] —
+# the pre-Gluon RL/embedding-era API; choose_element_0index is the old
+# name for pick along axis 1)
+# ---------------------------------------------------------------------------
+
+
+@register("choose_element_0index")
+def choose_element_0index(lhs, rhs):
+    """out[i] = lhs[i, rhs[i]] — the old name for pick along axis 1."""
+    return pick(lhs, rhs, axis=1)
+
+
+@register("fill_element_0index")
+def fill_element_0index(lhs, mhs, rhs):
+    """out = lhs with out[i, rhs[i]] = mhs[i] (functional, not in-place —
+    the buffer-swap NDArray layer applies the mutation)."""
+    idx = jnp.clip(rhs.astype(jnp.int32), 0, lhs.shape[1] - 1)
+    return lhs.at[jnp.arange(lhs.shape[0]), idx].set(mhs)
+
+
+@register("one_hot_encode")
+def one_hot_encode(indices, out):
+    """Legacy 2-arg form: the second operand supplies the [N, C] shape."""
+    idx = indices.astype(jnp.int32)
+    return jax.nn.one_hot(idx, out.shape[1], dtype=out.dtype)
+
+
+# ---------------------------------------------------------------------------
+# AMP graph-pass ops (parity: [U:src/operator/tensor/amp_cast.cc]) — the
+# reference inserts these around float ops during the AMP symbol pass;
+# they exist here so reference-era symbol graphs execute unchanged
+# ---------------------------------------------------------------------------
+
+
+@register("amp_cast")
+def amp_cast(x, dtype="float32"):
+    """Cast floating inputs; pass integer/bool tensors through unchanged
+    (the reference op's contract)."""
+    if not jnp.issubdtype(x.dtype, jnp.floating):
+        return x
+    return x.astype(_as_np_dtype(dtype))
+
+
+@register("amp_multicast")
+def amp_multicast(*data, num_outputs=0, cast_narrow=False):
+    """Cast every floating operand to a common width: the widest among the
+    inputs (or the narrowest with ``cast_narrow``)."""
+    floats = [d.dtype for d in data if jnp.issubdtype(d.dtype, jnp.floating)]
+    if not floats:
+        return tuple(data)
+    if cast_narrow:
+        # deterministic tie-break (f16 vs bf16): sort by (bits, name)
+        target = min(floats, key=lambda dt: (jnp.finfo(dt).bits, dt.name))
+    else:
+        # promote_types is order-invariant and lifts f16+bf16 to f32
+        target = functools.reduce(jnp.promote_types, floats)
+    return tuple(d.astype(target)
+                 if jnp.issubdtype(d.dtype, jnp.floating) else d
+                 for d in data)

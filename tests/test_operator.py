@@ -1491,3 +1491,57 @@ class TestRandomNamespaceParity:
         assert abs(s.mean() - mu) < 0.15
         # var = mu + alpha*mu^2
         assert abs(s.var() - (mu + alpha * mu * mu)) < 0.5
+
+
+class TestLegacyNdFunctions:
+    """The pre-Gluon ndarray-function trio + AMP pass ops + Crop
+    ([U:src/ndarray/ndarray_function.cc], [U:src/operator/tensor/amp_cast.cc],
+    [U:src/operator/crop.cc])."""
+
+    def test_choose_fill_element_0index(self):
+        x = np.arange(12, dtype=np.float32).reshape(3, 4)
+        idx = np.array([0, 2, 3], np.float32)
+        picked = mx.nd.choose_element_0index(_nd(x), _nd(idx)).asnumpy()
+        np.testing.assert_allclose(picked, x[np.arange(3), idx.astype(int)])
+        vals = np.array([9.0, 8.0, 7.0], np.float32)
+        filled = mx.nd.fill_element_0index(_nd(x), _nd(vals), _nd(idx)).asnumpy()
+        expect = x.copy()
+        expect[np.arange(3), idx.astype(int)] = vals
+        np.testing.assert_allclose(filled, expect)
+
+    def test_one_hot_encode_legacy(self):
+        out = mx.nd.one_hot_encode(_nd(np.array([1, 0, 2], np.float32)),
+                                   mx.nd.zeros((3, 4))).asnumpy()
+        np.testing.assert_allclose(out, np.eye(4, dtype=np.float32)[[1, 0, 2]])
+
+    def test_amp_cast_and_multicast(self):
+        f32 = mx.nd.array(np.ones(3), dtype="float32")
+        i32 = mx.nd.array(np.ones(3), dtype="int32")
+        assert mx.nd.amp_cast(f32, dtype="float16").dtype == np.float16
+        assert mx.nd.amp_cast(i32, dtype="float16").dtype == np.int32  # passthrough
+        h, f, i = mx.nd.amp_multicast(
+            mx.nd.array(np.ones(3), dtype="float16"), f32, i32, num_outputs=3)
+        assert h.dtype == np.float32 and f.dtype == np.float32
+        assert i.dtype == np.int32
+        h2, f2 = mx.nd.amp_multicast(
+            mx.nd.array(np.ones(3), dtype="float16"), f32,
+            num_outputs=2, cast_narrow=True)
+        assert h2.dtype == np.float16 and f2.dtype == np.float16
+
+    def test_crop_spatial_and_slice_alias(self):
+        x = np.arange(2 * 3 * 5 * 6, dtype=np.float32).reshape(2, 3, 5, 6)
+        out = mx.nd.Crop(_nd(x), h_w=(3, 4), offset=(1, 2)).asnumpy()
+        np.testing.assert_allclose(out, x[:, :, 1:4, 2:6])
+        like = mx.nd.zeros((2, 3, 2, 2))
+        out = mx.nd.Crop(_nd(x), like, center_crop=True).asnumpy()
+        np.testing.assert_allclose(out, x[:, :, 1:3, 2:4])
+        with pytest.raises(ValueError):
+            mx.nd.Crop(_nd(x), h_w=(9, 9))
+        # lowercase crop is the reference's alias for slice, NOT Crop
+        out = mx.nd.crop(_nd(x), begin=(0, 1, 0, 0), end=(2, 3, 2, 3)).asnumpy()
+        np.testing.assert_allclose(out, x[0:2, 1:3, 0:2, 0:3])
+
+    def test_broadcast_axes_alias(self):
+        out = mx.nd.broadcast_axes(mx.nd.zeros((1, 3, 1)), axis=(0, 2),
+                                   size=(4, 2))
+        assert out.shape == (4, 3, 2)

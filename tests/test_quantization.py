@@ -164,3 +164,18 @@ class TestEntropyCalibration:
             pass
         else:
             raise AssertionError("expected ValueError for unknown calib_mode")
+
+
+def test_legacy_quantize_explicit_range():
+    """quantize (v1, explicit range) vs numpy for both out_types
+    ([U:src/operator/quantization/quantize.cc])."""
+    x = np.linspace(-2.0, 2.0, 9).astype(np.float32)[None]
+    q, mn, mx_ = mx.nd.quantize(mx.nd.array(x), mx.nd.array([-1.0]),
+                                mx.nd.array([1.0]), out_type="uint8")
+    expect = np.clip(np.round((np.clip(x, -1, 1) + 1) * 127.5), 0, 255)
+    np.testing.assert_allclose(q.asnumpy().astype(np.float32), expect)
+    assert float(mn.asnumpy()[0]) == -1.0 and float(mx_.asnumpy()[0]) == 1.0
+    q8, _, _ = mx.nd.quantize(mx.nd.array(x), mx.nd.array([-1.0]),
+                              mx.nd.array([1.0]), out_type="int8")
+    np.testing.assert_allclose(q8.asnumpy().astype(np.float32),
+                               np.clip(np.round(x * 127.0), -127, 127))
