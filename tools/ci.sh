@@ -4,6 +4,7 @@
 # Runs the evidence tiers in order and prints a per-tier summary:
 #   1. unit1     — CPU suite, operator/gluon half (8-device virtual mesh)
 #   2. unit2     — CPU suite, remaining fast tiers
+#   2b. zoo      — all vision-zoo entries (own tier: ~8 min on 1 core)
 #   3. dist      — multi-process kvstore/launcher tier (incl. dist_async)
 #   4. examples  — example-script smoke tier
 #   5. bench     — bench.py smoke on whatever backend is present (CPU-safe)
@@ -17,7 +18,7 @@
 # is ALSO written to ci_logs/last_summary.txt, so a round's evidence
 # survives a dead terminal.
 #
-# Usage:  tools/ci.sh [tier ...]   # default: unit1 unit2 dist examples bench
+# Usage:  tools/ci.sh [tier ...]   # default: unit1 unit2 zoo dist examples bench
 # Env:    CI_TPU=1 adds the tpu tier; CI_PYTEST_ARGS extra pytest flags.
 set -u -o pipefail
 
@@ -48,7 +49,7 @@ TIERS=()
 for t in "$@"; do
     if [ "$t" = unit ]; then TIERS+=(unit1 unit2); else TIERS+=("$t"); fi
 done
-[ ${#TIERS[@]} -eq 0 ] && TIERS=(unit1 unit2 dist examples bench)
+[ ${#TIERS[@]} -eq 0 ] && TIERS=(unit1 unit2 zoo dist examples bench)
 [ "${CI_TPU:-0}" = "1" ] && TIERS+=(tpu)
 
 declare -A RESULT
@@ -87,7 +88,15 @@ for tier in "${TIERS[@]}"; do
             run_tier unit2 "${CPU_ENV[@]}" python -m pytest tests/ -q \
                 "${IGNORE1[@]}" \
                 --ignore=tests/test_examples.py --ignore=tests/test_dist.py \
+                --ignore=tests/test_gluon_model_zoo.py \
                 ${CI_PYTEST_ARGS:-}
+            ;;
+        zoo)
+            # all 34 vision-zoo entries (eval_shape at full size + one
+            # numeric forward per family) — ~8 min on a 1-core box, so a
+            # tier of its own
+            run_tier zoo "${CPU_ENV[@]}" python -m pytest \
+                tests/test_gluon_model_zoo.py -q ${CI_PYTEST_ARGS:-}
             ;;
         dist)
             run_tier dist "${CPU_ENV[@]}" python -m pytest tests/test_dist.py -q \

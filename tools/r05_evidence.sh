@@ -49,6 +49,11 @@ if [ "$WHAT" = all ] || [ "$WHAT" = bench ]; then
     run_bench resnet50      MXNET_TPU_BENCH=resnet50
     run_bench resnet50-pallas-bn MXNET_TPU_BENCH=resnet50 MXNET_TPU_PALLAS_BN=1
     run_bench transformer   MXNET_TPU_BENCH=transformer
+    # 360-step window: same amortization argument as the BERT 180-step
+    # window, valid only alongside the transformer window-sweep fit below
+    run_bench transformer-360 MXNET_TPU_BENCH=transformer MXNET_TPU_BENCH_STEPS=360
+    # engine-bulking A/B: does scanning 8 steps per dispatch move tokens/s?
+    run_bench transformer-bulk8 MXNET_TPU_BENCH=transformer MXNET_TPU_BENCH_BULK=8
     run_bench transformer-ln-custom MXNET_TPU_BENCH=transformer MXNET_TPU_LN_CUSTOM_BWD=1
     run_bench ssd-resnet18  MXNET_TPU_BENCH=ssd
     run_bench ssd-vgg16     MXNET_TPU_BENCH=ssd MXNET_TPU_BENCH_SSD_BACKBONE=vgg16
