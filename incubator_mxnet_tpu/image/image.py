@@ -24,7 +24,10 @@ __all__ = [
     "imdecode", "imread", "imresize", "resize_short", "fixed_crop",
     "center_crop", "random_crop", "color_normalize", "HorizontalFlipAug",
     "CastAug", "ColorNormalizeAug", "ResizeAug", "ForceResizeAug",
-    "RandomCropAug", "CenterCropAug", "CreateAugmenter", "ImageIter",
+    "RandomCropAug", "CenterCropAug", "RandomSizedCropAug",
+    "BrightnessJitterAug", "ContrastJitterAug", "SaturationJitterAug",
+    "HueJitterAug", "ColorJitterAug", "LightingAug", "RandomGrayAug",
+    "SequentialAug", "RandomOrderAug", "CreateAugmenter", "ImageIter",
 ]
 
 
@@ -61,12 +64,28 @@ def imresize(src, w, h, interp=1):
     from PIL import Image
 
     arr = src.asnumpy() if isinstance(src, NDArray) else _np.asarray(src)
-    squeeze = arr.ndim == 3 and arr.shape[2] == 1
-    img = Image.fromarray(arr[..., 0] if squeeze else arr)
     method = Image.NEAREST if interp == 0 else Image.BILINEAR
-    out = _np.asarray(img.resize((w, h), method))
-    if squeeze:
-        out = out[..., None]
+    if arr.dtype == _np.uint8:
+        squeeze = arr.ndim == 3 and arr.shape[2] == 1
+        img = Image.fromarray(arr[..., 0] if squeeze else arr)
+        out = _np.asarray(img.resize((w, h), method))
+        if squeeze:
+            out = out[..., None]
+    else:
+        # float images (mid-pipeline augs): PIL only takes mode-'F'
+        # single-channel floats — resize per channel and restack
+        f = arr.astype(_np.float32)
+        if f.ndim == 2:
+            f = f[..., None]
+        chans = [_np.asarray(Image.fromarray(f[..., c], mode="F")
+                             .resize((w, h), method))
+                 for c in range(f.shape[2])]
+        out = _np.stack(chans, axis=2)
+        if _np.issubdtype(arr.dtype, _np.integer):
+            out = _np.rint(out)
+        out = out.astype(arr.dtype)
+        if arr.ndim == 2:
+            out = out[..., 0]
     return nd.array(out, dtype=str(arr.dtype))
 
 
@@ -187,17 +206,208 @@ class ColorNormalizeAug(Augmenter):
                                nd.array(self.std) if self.std is not None else None)
 
 
-def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_mirror=False,
-                    mean=None, std=None, interp=1, **kwargs):
-    """Build the standard augmenter list (parity: ``CreateAugmenter``)."""
+def _as_float_np(src):
+    arr = src.asnumpy() if isinstance(src, NDArray) else _np.asarray(src)
+    return arr.astype(_np.float32, copy=True)
+
+
+_GRAY_COEF = _np.array([0.299, 0.587, 0.114], dtype=_np.float32)  # RGB
+
+
+class RandomSizedCropAug(Augmenter):
+    """Random area+aspect crop then resize — the GoogLeNet/ImageNet
+    training crop ([U:python/mxnet/image/image.py] random_size_crop)."""
+
+    def __init__(self, size, area, ratio, interp=1):
+        self.size = size
+        self.area = (area, 1.0) if _np.isscalar(area) else tuple(area)
+        self.ratio = tuple(ratio)
+        self._log_ratio = (_np.log(self.ratio[0]), _np.log(self.ratio[1]))
+        self.interp = interp
+
+    def __call__(self, src):
+        arr = src.asnumpy() if isinstance(src, NDArray) else _np.asarray(src)
+        h, w = arr.shape[:2]
+        src_area = h * w
+        for _ in range(10):
+            target_area = _pyrandom.uniform(*self.area) * src_area
+            aspect = _np.exp(_pyrandom.uniform(*self._log_ratio))
+            new_w = int(round((target_area * aspect) ** 0.5))
+            new_h = int(round((target_area / aspect) ** 0.5))
+            if new_w <= w and new_h <= h:
+                x0 = _pyrandom.randint(0, w - new_w)
+                y0 = _pyrandom.randint(0, h - new_h)
+                return fixed_crop(arr, x0, y0, new_w, new_h,
+                                  self.size, self.interp)
+        # fallback: center crop to the largest fitting square, then resize
+        s = min(h, w)
+        return fixed_crop(arr, (w - s) // 2, (h - s) // 2, s, s,
+                          self.size, self.interp)
+
+
+class BrightnessJitterAug(Augmenter):
+    def __init__(self, brightness):
+        self.brightness = brightness
+
+    def __call__(self, src):
+        alpha = 1.0 + _pyrandom.uniform(-self.brightness, self.brightness)
+        return nd.array(_as_float_np(src) * alpha)
+
+
+class ContrastJitterAug(Augmenter):
+    def __init__(self, contrast):
+        self.contrast = contrast
+
+    def __call__(self, src):
+        alpha = 1.0 + _pyrandom.uniform(-self.contrast, self.contrast)
+        arr = _as_float_np(src)
+        gray_mean = (arr * _GRAY_COEF).sum(axis=2).mean()
+        return nd.array(arr * alpha + gray_mean * (1.0 - alpha))
+
+
+class SaturationJitterAug(Augmenter):
+    def __init__(self, saturation):
+        self.saturation = saturation
+
+    def __call__(self, src):
+        alpha = 1.0 + _pyrandom.uniform(-self.saturation, self.saturation)
+        arr = _as_float_np(src)
+        gray = (arr * _GRAY_COEF).sum(axis=2, keepdims=True)
+        return nd.array(arr * alpha + gray * (1.0 - alpha))
+
+
+class HueJitterAug(Augmenter):
+    """YIQ-rotation hue jitter (the reference's tyiq/ityiq formulation)."""
+
+    _TYIQ = _np.array([[0.299, 0.587, 0.114],
+                       [0.596, -0.274, -0.321],
+                       [0.211, -0.523, 0.311]], dtype=_np.float32)
+    # exact inverse (the reference hard-codes a 3-decimal truncation,
+    # which makes hue=0 a visible non-identity; the inverse is the intent)
+    _ITYIQ = _np.linalg.inv(_TYIQ.astype(_np.float64)).astype(_np.float32)
+
+    def __init__(self, hue):
+        self.hue = hue
+
+    def __call__(self, src):
+        alpha = _pyrandom.uniform(-self.hue, self.hue)
+        theta = _np.pi * alpha
+        u, w = _np.cos(theta), _np.sin(theta)
+        bt = _np.array([[1.0, 0.0, 0.0],
+                        [0.0, u, -w],
+                        [0.0, w, u]], dtype=_np.float32)
+        t = self._ITYIQ @ bt @ self._TYIQ
+        arr = _as_float_np(src)
+        return nd.array(arr @ t.T)
+
+
+class ColorJitterAug(Augmenter):
+    """Random-order brightness/contrast/saturation jitter."""
+
+    def __init__(self, brightness=0, contrast=0, saturation=0):
+        self._augs = []
+        if brightness:
+            self._augs.append(BrightnessJitterAug(brightness))
+        if contrast:
+            self._augs.append(ContrastJitterAug(contrast))
+        if saturation:
+            self._augs.append(SaturationJitterAug(saturation))
+
+    def __call__(self, src):
+        order = list(self._augs)
+        _pyrandom.shuffle(order)
+        for a in order:
+            src = a(src)
+        return src
+
+
+class LightingAug(Augmenter):
+    """AlexNet-style PCA lighting noise."""
+
+    def __init__(self, alphastd, eigval, eigvec):
+        self.alphastd = alphastd
+        self.eigval = _np.asarray(eigval, dtype=_np.float32)
+        self.eigvec = _np.asarray(eigvec, dtype=_np.float32)
+
+    def __call__(self, src):
+        alpha = _np.random.normal(0, self.alphastd, size=(3,)).astype(_np.float32)
+        rgb = self.eigvec @ (alpha * self.eigval)
+        return nd.array(_as_float_np(src) + rgb)
+
+
+class RandomGrayAug(Augmenter):
+    def __init__(self, p):
+        self.p = p
+
+    def __call__(self, src):
+        if _pyrandom.random() < self.p:
+            arr = _as_float_np(src)
+            gray = (arr * _GRAY_COEF).sum(axis=2, keepdims=True)
+            return nd.array(_np.broadcast_to(gray, arr.shape).copy())
+        return src
+
+
+class SequentialAug(Augmenter):
+    def __init__(self, ts):
+        self.ts = list(ts)
+
+    def __call__(self, src):
+        for t in self.ts:
+            src = t(src)
+        return src
+
+
+class RandomOrderAug(Augmenter):
+    def __init__(self, ts):
+        self.ts = list(ts)
+
+    def __call__(self, src):
+        order = list(self.ts)
+        _pyrandom.shuffle(order)
+        for t in order:
+            src = t(src)
+        return src
+
+
+# ImageNet PCA statistics (the reference's CreateAugmenter defaults)
+_PCA_EIGVAL = _np.array([55.46, 4.794, 1.148], dtype=_np.float32)
+_PCA_EIGVEC = _np.array([[-0.5675, 0.7192, 0.4009],
+                         [-0.5808, -0.0045, -0.8140],
+                         [-0.5836, -0.6948, 0.4203]], dtype=_np.float32)
+
+
+def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
+                    rand_mirror=False, mean=None, std=None, brightness=0,
+                    contrast=0, saturation=0, hue=0, pca_noise=0, rand_gray=0,
+                    interp=1, inter_method=None, **kwargs):
+    """Build the standard augmenter list with the reference's FULL kwarg
+    surface (parity: ``CreateAugmenter`` [U:python/mxnet/image/image.py]):
+    resize → sized/random/center crop → color jitter → hue → pca lighting
+    → random gray → mirror → cast → normalize."""
+    if inter_method is not None:
+        interp = inter_method
     auglist = []
     if resize > 0:
         auglist.append(ResizeAug(resize, interp))
     crop_size = (data_shape[2], data_shape[1])
-    if rand_crop:
+    if rand_resize:
+        if not rand_crop:
+            raise ValueError("rand_resize requires rand_crop=True "
+                             "(the reference asserts the same)")
+        auglist.append(RandomSizedCropAug(crop_size, 0.08, (3 / 4.0, 4 / 3.0),
+                                          interp))
+    elif rand_crop:
         auglist.append(RandomCropAug(crop_size, interp))
     else:
         auglist.append(CenterCropAug(crop_size, interp))
+    if brightness or contrast or saturation:
+        auglist.append(ColorJitterAug(brightness, contrast, saturation))
+    if hue:
+        auglist.append(HueJitterAug(hue))
+    if pca_noise > 0:
+        auglist.append(LightingAug(pca_noise, _PCA_EIGVAL, _PCA_EIGVEC))
+    if rand_gray > 0:
+        auglist.append(RandomGrayAug(rand_gray))
     if rand_mirror:
         auglist.append(HorizontalFlipAug(0.5))
     auglist.append(CastAug())

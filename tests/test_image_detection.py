@@ -1,6 +1,7 @@
 """Detection image pipeline (parity: [U:python/mxnet/image/detection.py]
 tests — augmenters must transform images and boxes TOGETHER)."""
 import numpy as np
+import pytest
 
 import incubator_mxnet_tpu as mx
 from incubator_mxnet_tpu.image import (CreateDetAugmenter,
@@ -94,8 +95,6 @@ class TestImageDetIter:
         assert (batches[-1].label[0].asnumpy()[:, :, 0] == -1).all()
 
     def test_batch_larger_than_dataset_raises(self):
-        import pytest as pytest_
-
         img, label = _sample()
-        with pytest_.raises(ValueError, match="exceeds dataset size"):
+        with pytest.raises(ValueError, match="exceeds dataset size"):
             ImageDetIter([(label, img)], batch_size=4, data_shape=(3, 16, 16))

@@ -251,3 +251,109 @@ class TestReviewRegressions:
         it.reset()
         e2 = next(iter(it)).data[0].asnumpy()
         assert not np.allclose(e1, e2), "augment RNG must advance across epochs"
+
+
+# ---------------------------------------------------------------------------
+# classification augmenter family (round 5 — the color/PCA/gray/sized-crop
+# augs of [U:python/mxnet/image/image.py])
+# ---------------------------------------------------------------------------
+
+
+class TestClassificationAugmenters:
+    def _img(self, h=32, w=48, seed=0):
+        rng = np.random.RandomState(seed)
+        return mx.nd.array(rng.randint(0, 255, (h, w, 3)).astype(np.float32))
+
+    def test_brightness_contrast_saturation_formulas(self):
+        import random
+        from incubator_mxnet_tpu import image as img_mod
+
+        src = self._img()
+        arr = src.asnumpy()
+        coef = np.array([0.299, 0.587, 0.114], np.float32)
+
+        random.seed(3)
+        out = img_mod.BrightnessJitterAug(0.5)(src).asnumpy()
+        random.seed(3)
+        alpha = 1.0 + random.uniform(-0.5, 0.5)
+        np.testing.assert_allclose(out, arr * alpha, rtol=1e-5)
+
+        random.seed(4)
+        out = img_mod.ContrastJitterAug(0.5)(src).asnumpy()
+        random.seed(4)
+        alpha = 1.0 + random.uniform(-0.5, 0.5)
+        gray_mean = (arr * coef).sum(2).mean()
+        np.testing.assert_allclose(out, arr * alpha + gray_mean * (1 - alpha),
+                                   rtol=1e-4)
+
+        random.seed(5)
+        out = img_mod.SaturationJitterAug(0.5)(src).asnumpy()
+        random.seed(5)
+        alpha = 1.0 + random.uniform(-0.5, 0.5)
+        gray = (arr * coef).sum(2, keepdims=True)
+        np.testing.assert_allclose(out, arr * alpha + gray * (1 - alpha),
+                                   rtol=1e-4)
+
+    def test_hue_preserves_luma_and_identity_at_zero(self):
+        from incubator_mxnet_tpu import image as img_mod
+
+        src = self._img()
+        out = img_mod.HueJitterAug(0.0)(src).asnumpy()
+        np.testing.assert_allclose(out, src.asnumpy(), atol=1e-3)
+        # the YIQ rotation leaves the Y (luma) channel invariant
+        out = img_mod.HueJitterAug(0.4)(src).asnumpy()
+        coef = np.array([0.299, 0.587, 0.114], np.float32)
+        np.testing.assert_allclose((out * coef).sum(2), (src.asnumpy() * coef).sum(2),
+                                   rtol=1e-3, atol=1e-2)
+
+    def test_lighting_gray_and_sized_crop(self):
+        from incubator_mxnet_tpu import image as img_mod
+
+        from incubator_mxnet_tpu.image.image import _PCA_EIGVAL, _PCA_EIGVEC
+
+        src = self._img()
+        np.random.seed(0)
+        out = img_mod.LightingAug(10.0, _PCA_EIGVAL, _PCA_EIGVEC)(src).asnumpy()
+        # per-pixel shift is constant across the image
+        delta = out - src.asnumpy()
+        assert np.allclose(delta, delta[0, 0], atol=1e-4)
+
+        gray = img_mod.RandomGrayAug(1.0)(src).asnumpy()
+        assert np.allclose(gray[..., 0], gray[..., 1])
+        assert np.allclose(gray[..., 1], gray[..., 2])
+
+        crop = img_mod.RandomSizedCropAug((16, 16), 0.3, (0.75, 1.333))(src)
+        assert crop.shape == (16, 16, 3)
+
+    def test_create_augmenter_full_surface(self):
+        import random
+        from incubator_mxnet_tpu import image as img_mod
+
+        random.seed(0)
+        np.random.seed(0)
+        augs = img_mod.CreateAugmenter(
+            (3, 24, 24), resize=28, rand_crop=True, rand_resize=True,
+            rand_mirror=True, mean=True, std=True, brightness=0.2,
+            contrast=0.2, saturation=0.2, hue=0.1, pca_noise=0.05,
+            rand_gray=0.05)
+        kinds = [type(a).__name__ for a in augs]
+        assert "RandomSizedCropAug" in kinds and "ColorJitterAug" in kinds
+        assert "HueJitterAug" in kinds and "LightingAug" in kinds
+        src = self._img(40, 40)
+        for a in augs:
+            src = a(src)
+        assert src.shape == (24, 24, 3)
+        with pytest.raises(ValueError):
+            img_mod.CreateAugmenter((3, 24, 24), rand_resize=True)
+
+    def test_sequential_and_random_order(self):
+        from incubator_mxnet_tpu import image as img_mod
+
+        src = self._img()
+        seq = img_mod.SequentialAug([img_mod.CastAug("float32"),
+                                     img_mod.BrightnessJitterAug(0.0)])
+        out = seq(src)
+        np.testing.assert_allclose(out.asnumpy(), src.asnumpy(), rtol=1e-6)
+        ro = img_mod.RandomOrderAug([img_mod.BrightnessJitterAug(0.0),
+                                     img_mod.SaturationJitterAug(0.0)])
+        np.testing.assert_allclose(ro(src).asnumpy(), src.asnumpy(), rtol=1e-5)
