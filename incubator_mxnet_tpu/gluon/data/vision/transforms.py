@@ -21,6 +21,8 @@ __all__ = [
     "RandomBrightness",
     "RandomContrast",
     "RandomSaturation",
+    "RandomHue",
+    "RandomColorJitter",
     "RandomLighting",
 ]
 
@@ -236,3 +238,44 @@ class RandomLighting(Block):
         noise = (self._eigvec * a * self._eigval).sum(axis=1)
         out = img + noise
         return array(_np.clip(out, 0, 255).astype(_to_np(x).dtype))
+
+
+class RandomHue(_RandomJitter):
+    """YIQ-rotation hue jitter (parity: ``transforms.RandomHue``); the
+    rotation matrix comes from ``image.HueJitterAug.hue_matrix``."""
+
+    def forward(self, x):
+        from ....image.image import HueJitterAug
+
+        src = _to_np(x)
+        alpha = _np.random.uniform(-self._amount, self._amount)
+        t = HueJitterAug.hue_matrix(alpha)
+        out = src.astype("float32") @ t.T
+        if _np.issubdtype(src.dtype, _np.integer):
+            out = _np.rint(out)
+        return array(_np.clip(out, 0, 255).astype(src.dtype))
+
+
+class RandomColorJitter(Block):
+    """Random-order brightness/contrast/saturation/hue jitter (parity:
+    ``transforms.RandomColorJitter``)."""
+
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0):
+        super().__init__()
+        self._ts = []
+        if brightness:
+            self._ts.append(RandomBrightness(brightness))
+        if contrast:
+            self._ts.append(RandomContrast(contrast))
+        if saturation:
+            self._ts.append(RandomSaturation(saturation))
+        if hue:
+            self._ts.append(RandomHue(hue))
+
+    def forward(self, x):
+        # numpy's global RNG orders AND draws every jitter, so one
+        # np.random.seed reproduces the whole augmentation
+        order = [self._ts[i] for i in _np.random.permutation(len(self._ts))]
+        for t in order:
+            x = t(x)
+        return x

@@ -289,14 +289,20 @@ class HueJitterAug(Augmenter):
     def __init__(self, hue):
         self.hue = hue
 
-    def __call__(self, src):
-        alpha = _pyrandom.uniform(-self.hue, self.hue)
+    @classmethod
+    def hue_matrix(cls, alpha):
+        """RGB-space rotation for a hue shift of ``pi*alpha`` (shared with
+        ``gluon.data.vision.transforms.RandomHue``)."""
         theta = _np.pi * alpha
         u, w = _np.cos(theta), _np.sin(theta)
         bt = _np.array([[1.0, 0.0, 0.0],
                         [0.0, u, -w],
                         [0.0, w, u]], dtype=_np.float32)
-        t = self._ITYIQ @ bt @ self._TYIQ
+        return cls._ITYIQ @ bt @ cls._TYIQ
+
+    def __call__(self, src):
+        alpha = _pyrandom.uniform(-self.hue, self.hue)
+        t = self.hue_matrix(alpha)
         arr = _as_float_np(src)
         return nd.array(arr @ t.T)
 

@@ -357,3 +357,24 @@ class TestClassificationAugmenters:
         ro = img_mod.RandomOrderAug([img_mod.BrightnessJitterAug(0.0),
                                      img_mod.SaturationJitterAug(0.0)])
         np.testing.assert_allclose(ro(src).asnumpy(), src.asnumpy(), rtol=1e-5)
+
+
+def test_transforms_hue_and_color_jitter():
+    """transforms.RandomHue / RandomColorJitter (round-5 parity tail)."""
+    from incubator_mxnet_tpu.gluon.data.vision import transforms as T
+
+    rng = np.random.RandomState(0)
+    x = mx.nd.array(rng.randint(0, 255, (8, 8, 3)).astype(np.uint8),
+                    dtype="uint8")
+    # hue=0 is the identity (exact inverse YIQ matrix + integer rounding)
+    np.testing.assert_array_equal(T.RandomHue(0.0)(x).asnumpy(), x.asnumpy())
+    out = T.RandomColorJitter(0.3, 0.3, 0.3, 0.1)(x)
+    assert out.shape == x.shape and out.dtype == np.uint8
+    # luma is preserved by a pure hue rotation (within rounding) — use
+    # mid-range pixels so the [0,255] clip never engages
+    mid = mx.nd.array(rng.randint(80, 176, (8, 8, 3)).astype(np.uint8),
+                      dtype="uint8")
+    coef = np.array([0.299, 0.587, 0.114], np.float32)
+    h = T.RandomHue(0.2)(mid).asnumpy().astype(np.float32)
+    np.testing.assert_allclose((h * coef).sum(-1),
+                               (mid.asnumpy() * coef).sum(-1), atol=2.0)
