@@ -436,3 +436,61 @@ def test_pallas_fused_bn_on_tpu():
                                atol=1e-4)
     np.testing.assert_allclose(np.asarray(v), np.asarray(wv), rtol=1e-4,
                                atol=1e-4)
+
+
+def test_round5_tail_ops_cpu_vs_tpu():
+    """Round-5 tail: Crop, legacy quantize, amp casts, element_0index trio
+    — cpu-as-oracle rows for the chip tier."""
+    rng = np.random.RandomState(11)
+    img = rng.randn(2, 3, 8, 8).astype(np.float32)
+    check_consistency(
+        lambda d: mx.nd.Crop(d, h_w=(4, 4), offset=(1, 2)), [img])
+    check_consistency(
+        lambda d: mx.nd.Crop(d, mx.nd.zeros((2, 3, 5, 5)), center_crop=True),
+        [img])
+
+    x = rng.randn(3, 4).astype(np.float32)
+    idx = np.array([0, 2, 3], np.float32)
+    check_consistency(
+        lambda d: mx.nd.choose_element_0index(d, mx.nd.array(idx)), [x])
+    check_consistency(
+        lambda d: mx.nd.fill_element_0index(
+            d, mx.nd.array([9.0, 8.0, 7.0]), mx.nd.array(idx)), [x])
+
+    check_consistency(lambda d: mx.nd.amp_cast(d, dtype="float16"), [x],
+                      rtol=1e-3, atol=1e-3, grad=False)
+
+    q = rng.rand(2, 8).astype(np.float32) * 2 - 1
+    check_consistency(
+        lambda d: mx.nd.quantize(d, mx.nd.array([-1.0]), mx.nd.array([1.0]),
+                                 out_type="uint8")[0], [q], grad=False)
+
+
+def test_onnx_breadth3_roundtrip_on_tpu():
+    """The breadth-3 ONNX roundtrip executed with the TPU as the bind
+    target (export/import themselves are host-side)."""
+    import tempfile
+
+    import incubator_mxnet_tpu.symbol as S
+    from incubator_mxnet_tpu.contrib import onnx as onnx_mxnet
+
+    S.symbol._reset_naming()
+    data = S.var("data")
+    x = S.clip(data, a_min=-0.8, a_max=0.8)
+    x = S.expand_dims(S.sum(x, axis=1), axis=1)
+    out_sym = S.log_softmax(S.tile(x, reps=(1, 4)), axis=-1)
+    xv = np.random.RandomState(12).rand(3, 5).astype(np.float32) - 0.5
+
+    exe = out_sym.simple_bind(data=xv.shape)
+    exe.arg_dict["data"][:] = xv
+    ref = exe.forward(is_train=False)[0].asnumpy()
+
+    with tempfile.TemporaryDirectory() as td:
+        f = td + "/b3.onnx"
+        onnx_mxnet.export_model(out_sym, {}, input_shape=xv.shape,
+                                onnx_file_path=f)
+        sym2, arg2, aux2 = onnx_mxnet.import_model(f)
+    exe2 = sym2.simple_bind(data=xv.shape)
+    exe2.arg_dict["data"][:] = xv
+    out = exe2.forward(is_train=False)[0].asnumpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
