@@ -74,3 +74,8 @@ def test_lstm_bucketing():
     bucketing/] analog): perplexity must fall and buckets share weights."""
     out = _run("lstm_bucketing.py", "--epochs", "2", timeout=420)
     assert "final-perplexity" in out
+
+
+def test_onnx_roundtrip_example():
+    out = _run("onnx_roundtrip.py", "--epochs", "1", "--n", "256")
+    assert "ONNX_ROUNDTRIP_OK" in out
