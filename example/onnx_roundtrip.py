@@ -103,10 +103,15 @@ def main():
     log.info("roundtrip max |delta| = %.3g", err)
     assert err < 1e-4, "imported model diverged from the exported one"
 
-    # ... and keeps training: attach the loss head to the imported body
+    # ... and keeps training: the imported tip is already a Softmax node
+    # (SoftmaxOutput exports as inference-form Softmax), so attach the new
+    # loss head to the PRE-softmax internal output, as the reference ONNX
+    # finetune flow does — stacking SoftmaxOutput on probabilities would
+    # train a mis-specified double-softmax
     import incubator_mxnet_tpu.symbol as S
-    tip = sym2 if len(sym2) == 1 else sym2[0]
-    ft_sym = S.SoftmaxOutput(tip, S.var("softmax_label"), name="softmax")
+    internals = sym2.get_internals()
+    logits = internals[internals.list_outputs()[-2]]
+    ft_sym = S.SoftmaxOutput(logits, S.var("softmax_label"), name="softmax")
     fit(ft_sym, X, y, 1, args.batch_size, arg_params=arg2, aux_params=aux2)
     log.info("finetune on the imported graph: OK")
     print("ONNX_ROUNDTRIP_OK", err)

@@ -381,6 +381,22 @@ class NDArray:
     def tile(self, reps):
         return _op("tile", self, reps=reps)
 
+    def pad(self, mode="constant", pad_width=(), constant_value=0.0):
+        return _op("pad", self, mode=mode, pad_width=pad_width,
+                   constant_value=constant_value)
+
+    def round(self):
+        return _op("round", self)
+
+    def floor(self):
+        return _op("floor", self)
+
+    def ceil(self):
+        return _op("ceil", self)
+
+    def diag(self, k=0):
+        return _op("diag", self, k=k)
+
     def repeat(self, repeats, axis=None):
         return _op("repeat", self, repeats=repeats, axis=axis)
 

@@ -16,7 +16,10 @@ from .symbol import (
     zeros,
     ones,
     _make_sym_op,
+    _bind_fluent_methods,
 )
+
+_bind_fluent_methods()  # registry is fully populated by the ..ops import
 
 __all__ = [
     "Symbol",

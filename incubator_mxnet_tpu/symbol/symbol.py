@@ -123,6 +123,10 @@ _TENSOR_PARAMS = {
     "transpose": ("data",),
     "repeat": ("data",),
     "flip": ("data",),
+    # num_outputs/depth are required static attrs, never tensor inputs
+    "split": ("x",),
+    "SliceChannel": ("x",),
+    "one_hot": ("indices",),
 }
 
 
@@ -631,6 +635,77 @@ def _make_sym_op(opname):
     op = get_op(opname)
     sym_op.__doc__ = op.doc
     return sym_op
+
+
+# Fluent methods (parity: the reference Symbol's op-backed methods —
+# `sym.reshape(...)`, `sym.sum(axis=1)`, ... mirror NDArray's so ported
+# scripts keep their chained style).  Bound lazily AFTER the op registry
+# is populated; existing class attributes are never overridden.
+_FLUENT_METHODS = (
+    "reshape", "reshape_like", "flatten", "squeeze", "expand_dims", "tile",
+    "pad", "repeat", "flip", "transpose", "swapaxes", "broadcast_to",
+    "broadcast_like", "split", "slice", "slice_axis", "slice_like", "take",
+    "pick", "one_hot", "sum", "mean", "max", "min", "prod", "nansum",
+    "nanprod", "argmax", "argmin", "norm", "clip", "abs", "exp", "log",
+    "sqrt", "square", "sign", "round", "floor", "ceil", "sigmoid", "tanh",
+    "relu", "softmax", "log_softmax", "sort", "argsort", "topk", "diag",
+    "zeros_like", "ones_like",
+)
+
+
+def _make_fluent(opname):
+    fn = get_op(opname).fn
+    tps = _tensor_params(opname, fn) or ()
+    # non-tensor params: everything after the tensor-input slots in
+    # signature order (BY POSITION — the _TENSOR_PARAMS table's names are
+    # descriptive, not guaranteed to match the fn's parameter spelling).
+    # These are the targets for positional attrs, so reference chained
+    # forms like sym.reshape((0, -1)) / sym.split(3) work exactly like
+    # their NDArray twins.
+    _named = [p.name for p in inspect.signature(fn).parameters.values()
+              if p.kind not in (p.VAR_POSITIONAL, p.VAR_KEYWORD)]
+    attr_names = _named[len(tps):]
+
+    # ops whose first attr is a tuple the reference lets callers splat:
+    # x.reshape(0, -1), x.transpose(1, 0), x.tile(2, 3) (NDArray.reshape
+    # accepts the same splat)
+    splat = opname in ("reshape", "transpose", "tile", "broadcast_to")
+
+    def method(self, *args, name=None, **kwargs):
+        sym_args = [self]
+        rest = list(args)
+        while rest and isinstance(rest[0], Symbol):
+            sym_args.append(rest.pop(0))
+        if (splat and rest and attr_names
+                and all(isinstance(v, int) for v in rest)
+                and attr_names[0] not in kwargs):
+            rest = [tuple(rest)]
+        for i, v in enumerate(rest):
+            if i >= len(attr_names):
+                raise TypeError(f"{opname}: too many positional arguments")
+            if attr_names[i] in kwargs:
+                raise TypeError(
+                    f"{opname}: got multiple values for {attr_names[i]!r}")
+            kwargs[attr_names[i]] = v
+        return _apply_op(opname, tuple(sym_args), kwargs, name=name)
+
+    method.__name__ = opname
+    method.__qualname__ = f"Symbol.{opname}"
+    method.__doc__ = f"Fluent form of ``sym.{opname}`` applied to this symbol."
+    return method
+
+
+def _bind_fluent_methods():
+    from ..ops.registry import list_ops
+
+    ops = set(list_ops())
+    for n in _FLUENT_METHODS:
+        if n in ops and not hasattr(Symbol, n):
+            setattr(Symbol, n, _make_fluent(n))
+    if not hasattr(Symbol, "astype"):
+        def astype(self, dtype, name=None):
+            return _apply_op("cast", (self,), {"dtype": dtype}, name=name)
+        Symbol.astype = astype
 
 
 def load_json(json_str):

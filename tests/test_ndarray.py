@@ -231,3 +231,16 @@ def test_mx_np_namespace():
 def test_gamma_is_gamma_function():
     assert abs(float(mx.nd.gamma(mx.nd.array([3.0])).asscalar()) - 2.0) < 1e-4
     assert abs(float(mx.nd.gammaln(mx.nd.array([3.0])).asscalar()) - np.log(2.0)) < 1e-4
+
+
+def test_method_tail_pad_round_floor_ceil_diag():
+    """Round-5 NDArray method tail mirrors the reference's fluent set."""
+    a = mx.nd.array(np.array([[1.5, -2.5], [0.4, 3.6]], np.float32))
+    np.testing.assert_allclose(a.round().asnumpy(),
+                               [[2.0, -3.0], [0.0, 4.0]])  # half away from 0
+    np.testing.assert_allclose(a.floor().asnumpy(), np.floor(a.asnumpy()))
+    np.testing.assert_allclose(a.ceil().asnumpy(), np.ceil(a.asnumpy()))
+    p = a.pad(pad_width=(0, 0, 1, 1), constant_value=9.0)
+    assert p.shape == (2, 4) and p.asnumpy()[0, 0] == 9.0
+    d = mx.nd.array(np.array([1.0, 2.0])).diag()
+    np.testing.assert_allclose(d.asnumpy(), np.diag([1.0, 2.0]))

@@ -514,3 +514,38 @@ class TestScalarAndComparisonSugar:
             bool(a == b)                     # graph nodes have no truth value
         with pytest.raises(TypeError):
             a in [b]                         # membership needs truthiness
+
+
+def test_fluent_methods():
+    """Op-backed fluent methods on Symbol (reference chained style)."""
+    from incubator_mxnet_tpu.symbol.symbol import _reset_naming
+    _reset_naming()
+    x = sym.var("data")
+    y = (x.reshape(shape=(0, -1)).sum(axis=1, keepdims=True)
+         .sqrt().clip(a_min=0.0, a_max=5.0))
+    exe = y.simple_bind(data=(2, 3, 4))
+    exe.arg_dict["data"][:] = np.ones((2, 3, 4), np.float32)
+    out = exe.forward(is_train=False)[0].asnumpy()
+    np.testing.assert_allclose(out, np.full((2, 1), np.sqrt(12.0)), rtol=1e-6)
+
+    parts = x.split(num_outputs=3, axis=2)
+    assert len(parts) == 3
+    z = x.astype("float16").transpose(axes=(1, 0, 2)).flatten()
+    assert z.infer_shape(data=(2, 3, 4))[0]  # shapes flow through the chain
+
+    # reference positional forms map onto the op's static params —
+    # including the splat style (x.reshape(0, -1) == x.reshape((0, -1)))
+    assert (x.reshape(0, -1).infer_shape(data=(2, 3, 4))[1]
+            == x.reshape((0, -1)).infer_shape(data=(2, 3, 4))[1])
+    assert x.transpose(1, 0, 2).infer_shape(data=(2, 3, 4))[1] == [(3, 2, 4)]
+    z2 = x.reshape((0, -1)).transpose((1, 0)).slice_axis(0, 0, 2)
+    assert z2.infer_shape(data=(2, 3, 4))[0]
+    assert len(x.split(3, 1)) == 3
+    with pytest.raises(TypeError):
+        x.sqrt(1)          # too many positionals
+    with pytest.raises(TypeError):
+        x.sum(1, axis=1)   # duplicate via positional + kwarg
+
+    # fluent binding never clobbers core Symbol API
+    assert callable(sym.var("w").attr_dict)
+    assert sym.var("w").attr("__dtype__") is None
