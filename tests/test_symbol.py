@@ -549,3 +549,36 @@ def test_fluent_methods():
     # fluent binding never clobbers core Symbol API
     assert callable(sym.var("w").attr_dict)
     assert sym.var("w").attr("__dtype__") is None
+
+
+def test_fluent_methods_all_bound():
+    """Every name in _FLUENT_METHODS is bound and builds a working node
+    (shape inference flows) on a standard input."""
+    from incubator_mxnet_tpu.symbol.symbol import _FLUENT_METHODS, _reset_naming
+
+    _reset_naming()
+    x = sym.var("data")
+    # per-op kwargs where the bare call needs them
+    needs = {
+        "reshape": {"shape": (0, -1)}, "reshape_like": None,  # 2-tensor
+        "expand_dims": {"axis": 0}, "tile": {"reps": (2, 1)},
+        "pad": {"pad_width": (0, 0, 1, 1)}, "repeat": {"repeats": 2},
+        "flip": {"axis": 0}, "broadcast_to": {"shape": (4, 6)},
+        "broadcast_like": None, "split": {"num_outputs": 2, "axis": 1},
+        "slice": {"begin": (0,), "end": (2,)},
+        "slice_axis": {"axis": 0, "begin": 0, "end": 2},
+        "slice_like": None, "take": None, "pick": None,
+        "one_hot": {"depth": 3}, "clip": {"a_min": 0.0, "a_max": 1.0},
+        "diag": {},
+    }
+    for name in _FLUENT_METHODS:
+        assert hasattr(x, name), f"{name} not bound"
+        kw = needs.get(name, {})
+        if kw is None:  # needs a second tensor operand
+            out = getattr(x, name)(sym.var("aux0"))
+        else:
+            out = getattr(x, name)(**kw)
+        first = out[0] if isinstance(out, (list, tuple)) or len(out) > 1 else out
+        shapes = first.infer_shape_partial(data=(4, 6))[1]
+        assert shapes is not None, f"{name}: no shape inference"
+    assert x.astype("float16") is not None
