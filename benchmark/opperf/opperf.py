@@ -93,6 +93,14 @@ def _cases(rng, large):
                   np.tile(np.array([0, 1, 1, H - 2, W - 2], f), (8, 1))),
          True, None),
         ("digamma", lambda: (t(B, D) + 0.5,), True, None),
+        # round-5 tail
+        ("Crop", lambda: (t(B, C, H, W),), True, None),
+        ("quantize", lambda: (t(B, D), np.array([-1.0], f), np.array([1.0], f)),
+         False, None),
+        ("amp_multicast", lambda: (t(B, D).astype(np.float16), t(B, D)),
+         False, None),
+        ("choose_element_0index",
+         lambda: (t(B, D), rng.randint(0, D, (B,)).astype(f)), True, None),
     ]
 
 
@@ -111,7 +119,10 @@ _KW = {"Convolution": {"kernel": (3, 3), "num_filter": 0, "pad": (1, 1)},
                                            "output_dim": 2, "group_size": 2,
                                            "pooled_size": 7,
                                            "sample_per_part": 2,
-                                           "no_trans": True}}
+                                           "no_trans": True},
+       "Crop": {"h_w": (7, 7), "offset": (1, 1)},
+       "quantize": {"out_type": "uint8"},
+       "amp_multicast": {"num_outputs": 2}}
 
 
 def _rnn_params(rng, C, H):
