@@ -80,6 +80,8 @@ if [ "$WHAT" = all ] || [ "$WHAT" = sweep ]; then
 fi
 
 if [ "$WHAT" = all ] || [ "$WHAT" = control ]; then
+    note "== long-context flash vs XLA crossover (exceeds-reference row)"
+    timeout 1800 python tools/bench_longcontext.py 2>>"$EV".err | tee -a "$EV"
     note "== raw-JAX ResNet-50 control (VERDICT item 4a)"
     timeout 3600 python tools/resnet_control.py 2>>"$EV".err | tee -a "$EV"
     note "== Pallas fused BN A/B, stages 2+3 (VERDICT item 4b)"
