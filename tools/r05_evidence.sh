@@ -80,6 +80,8 @@ if [ "$WHAT" = all ] || [ "$WHAT" = sweep ]; then
 fi
 
 if [ "$WHAT" = all ] || [ "$WHAT" = control ]; then
+    note "== pipeline time-sliced single-chip bound (VERDICT weak #6)"
+    timeout 1800 python tools/bench_pipeline.py 4 512 2>>"$EV".err | tee -a "$EV"
     note "== long-context flash vs XLA crossover (exceeds-reference row)"
     timeout 1800 python tools/bench_longcontext.py 2>>"$EV".err | tee -a "$EV"
     note "== raw-JAX ResNet-50 control (VERDICT item 4a)"
