@@ -28,7 +28,7 @@ def __getattr__(name):
         return fn
     from . import _make_wrapper
 
-    for candidate in (f"contrib_{name}", name):
+    for candidate in (f"contrib_{name}", f"_contrib_{name}", name):
         try:
             op = _registry.get_op(candidate)
         except KeyError:

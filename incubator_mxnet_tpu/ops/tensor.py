@@ -986,3 +986,28 @@ def amp_multicast(*data, num_outputs=0, cast_narrow=False):
     return tuple(d.astype(target)
                  if jnp.issubdtype(d.dtype, jnp.floating) else d
                  for d in data)
+
+
+@register("_contrib_boolean_mask")
+def boolean_mask(data, index, axis=0):
+    """Select the slices of ``data`` along ``axis`` where ``index`` is
+    nonzero (parity: [U:src/operator/contrib/boolean_mask.cc]).  The
+    output length depends on the MASK's values, so the mask must be
+    concrete: with a concrete mask the op lowers to ``take`` over the
+    precomputed indices (static shape, differentiable — the autograd tape
+    keeps no-grad inputs concrete, so ``data`` may be traced); a traced
+    mask raises with guidance."""
+    import jax.core as _core
+
+    if isinstance(index, _core.Tracer):
+        raise NotImplementedError(
+            "boolean_mask needs a CONCRETE mask (its output length is the "
+            "mask's popcount); inside jit use jnp.where-style masked "
+            "compute or mask-and-pad instead")
+    mask = _np.asarray(index)
+    if mask.ndim != 1 or mask.shape[0] != data.shape[axis]:
+        raise ValueError(
+            f"boolean_mask: mask shape {mask.shape} must be 1-D of length "
+            f"data.shape[{axis}]={data.shape[axis]}")
+    idx = _np.nonzero(mask != 0)[0]
+    return jnp.take(data, idx, axis=axis)

@@ -21,6 +21,8 @@ from .symbol import (
 
 _bind_fluent_methods()  # registry is fully populated by the ..ops import
 
+from . import contrib  # noqa: E402  (mx.sym.contrib namespace)
+
 __all__ = [
     "Symbol",
     "Variable",

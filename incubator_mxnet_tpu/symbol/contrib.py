@@ -1,0 +1,40 @@
+"""``mx.sym.contrib`` namespace (parity: [U:python/mxnet/contrib/symbol.py]).
+
+Same name resolution as ``nd.contrib``: ops registered with a
+``contrib_``/``_contrib_`` prefix are reachable without it, and every
+top-level op is also visible.  Control-flow ops (foreach/while_loop/cond)
+take subgraph callables and live on the nd side only — under Symbol, use
+the op graph directly.
+"""
+from __future__ import annotations
+
+from ..ops import registry as _registry
+from .symbol import _make_sym_op
+
+_CACHE = {}
+
+
+def __getattr__(name):
+    if name.startswith("__"):
+        raise AttributeError(name)
+    if name in _CACHE:
+        return _CACHE[name]
+    for candidate in (f"contrib_{name}", f"_contrib_{name}", name):
+        try:
+            _registry.get_op(candidate)
+        except KeyError:
+            continue
+        w = _make_sym_op(candidate)
+        _CACHE[name] = w
+        return w
+    raise AttributeError(f"sym.contrib has no op {name!r}")
+
+
+def __dir__():
+    names = set()
+    for n in _registry.list_ops():
+        names.add(n)
+        for pre in ("contrib_", "_contrib_"):
+            if n.startswith(pre):
+                names.add(n[len(pre):])
+    return sorted(names)

@@ -244,3 +244,43 @@ def test_method_tail_pad_round_floor_ceil_diag():
     assert p.shape == (2, 4) and p.asnumpy()[0, 0] == 9.0
     d = mx.nd.array(np.array([1.0, 2.0])).diag()
     np.testing.assert_allclose(d.asnumpy(), np.diag([1.0, 2.0]))
+
+
+def test_contrib_boolean_mask():
+    """[U:src/operator/contrib/boolean_mask.cc]: eager data-dependent
+    selection, differentiable through the kept rows; traced masks raise."""
+    from incubator_mxnet_tpu import autograd
+
+    a = mx.nd.array(np.arange(12.0).reshape(4, 3))
+    m = mx.nd.array(np.array([1, 0, 1, 0], np.float32))
+    out = mx.nd.contrib.boolean_mask(a, m)
+    np.testing.assert_allclose(out.asnumpy(), a.asnumpy()[[0, 2]])
+    # axis=1
+    mc = mx.nd.array(np.array([0, 1, 1], np.float32))
+    out = mx.nd.contrib.boolean_mask(a, mc, axis=1)
+    np.testing.assert_allclose(out.asnumpy(), a.asnumpy()[:, 1:])
+    # gradient scatters into the kept rows only
+    a.attach_grad()
+    with autograd.record():
+        y = mx.nd.contrib.boolean_mask(a, m).sum()
+    y.backward()
+    np.testing.assert_allclose(a.grad.asnumpy().sum(1), [3.0, 0.0, 3.0, 0.0])
+    # traced mask -> actionable error
+    import jax
+    import pytest as _pytest
+    with _pytest.raises(NotImplementedError, match="CONCRETE mask"):
+        jax.jit(lambda d, mm: mx.nd.contrib.boolean_mask(
+            mx.nd.NDArray(d), mx.nd.NDArray(mm))._data)(a._data, m._data)
+    # explicit bool-dtype mask indexing on NDArray also works eagerly
+    mask = (a > 6).astype("bool")
+    assert a[mask].shape == (5,)
+
+
+def test_sym_contrib_namespace():
+    import incubator_mxnet_tpu.symbol as S
+
+    S.symbol._reset_naming()
+    x = S.var("x")
+    y = S.contrib.AdaptiveAvgPooling2D(x, output_size=(2, 2))
+    _, outs, _ = y.infer_shape(x=(1, 3, 8, 8))
+    assert outs == [(1, 3, 2, 2)]
