@@ -51,6 +51,7 @@ from . import gluon
 from . import model
 from . import symbol
 from . import symbol as sym
+from . import rnn
 from .executor import Executor
 from . import io
 from . import module

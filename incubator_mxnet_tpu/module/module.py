@@ -140,6 +140,12 @@ class Module(BaseModule):
         if isinstance(initializer, str):
             initializer = init_mod.create(initializer)
 
+        # per-variable attrs (e.g. __init__ = Initializer.dumps() set via
+        # sym.var(init=...)) must reach the initializer through InitDesc,
+        # as the reference does
+        var_attrs = {n.name: dict(n.attrs) for n in self.symbol._topo()
+                     if n.op is None and n.attrs}
+
         for name in self._param_names:
             arr = self._exec.arg_dict[name]
             if arg_params is not None and name in arg_params:
@@ -150,7 +156,7 @@ class Module(BaseModule):
             else:
                 if arg_params is not None and not allow_missing:
                     raise RuntimeError(f"param {name} missing from arg_params")
-                initializer(init_mod.InitDesc(name), arr)
+                initializer(init_mod.InitDesc(name, var_attrs.get(name)), arr)
         for name in self._aux_names:
             arr = self._exec.aux_dict[name]
             if aux_params is not None and name in aux_params:

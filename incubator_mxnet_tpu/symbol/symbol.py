@@ -483,7 +483,9 @@ def Variable(name, attr=None, shape=None, lr_mult=None, wd_mult=None,
     if dtype is not None:
         attrs["__dtype__"] = str(dtype)
     if init is not None:
-        attrs["__init__"] = init if isinstance(init, str) else init.__class__.__name__
+        # the init consumer (initializer.Initializer.__call__) parses the
+        # attr as Initializer.dumps() JSON — store that form
+        attrs["__init__"] = init if isinstance(init, str) else init.dumps()
     if lr_mult is not None:
         attrs["__lr_mult__"] = str(lr_mult)
     if wd_mult is not None:

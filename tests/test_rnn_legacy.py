@@ -1,0 +1,210 @@
+"""mx.rnn — the legacy symbolic cell API (parity:
+[U:tests/python/unittest/test_rnn.py], the pre-Gluon tier)."""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+import incubator_mxnet_tpu.symbol as S
+
+
+def _bind_fill(out_sym, data, seed=0, **extra):
+    exe = out_sym.simple_bind(data=data.shape)
+    rng = np.random.RandomState(seed)
+    for k in exe.arg_dict:
+        if k == "data":
+            exe.arg_dict[k][:] = data
+        elif k in extra:
+            exe.arg_dict[k][:] = extra[k]
+        else:
+            exe.arg_dict[k][:] = rng.randn(*exe.arg_dict[k].shape).astype(np.float32) * 0.1
+    return exe
+
+
+class TestLegacyCells:
+    def test_unroll_shares_parameters(self):
+        S.symbol._reset_naming()
+        stack = mx.rnn.SequentialRNNCell()
+        stack.add(mx.rnn.LSTMCell(num_hidden=8, prefix="lstm_l0_"))
+        stack.add(mx.rnn.LSTMCell(num_hidden=8, prefix="lstm_l1_"))
+        out, states = stack.unroll(5, inputs=S.var("data"), merge_outputs=True)
+        args = out.list_arguments()
+        assert len(args) == len(set(args))
+        assert "lstm_l0_i2h_weight" in args and "lstm_l1_h2h_bias" in args
+        assert len(states) == 4  # 2 layers x (h, c)
+        x = np.random.RandomState(1).randn(2, 5, 4).astype(np.float32)
+        assert _bind_fill(out, x).forward(is_train=False)[0].shape == (2, 5, 8)
+
+    def test_lstm_cell_matches_numpy(self):
+        S.symbol._reset_naming()
+        cell = mx.rnn.LSTMCell(num_hidden=4, prefix="l_", forget_bias=0.0)
+        out, _ = cell.unroll(3, inputs=S.var("data"), merge_outputs=True)
+        x = np.random.RandomState(2).randn(2, 3, 5).astype(np.float32)
+        exe = _bind_fill(out, x, seed=3)
+        got = exe.forward(is_train=False)[0].asnumpy()
+
+        w_i = exe.arg_dict["l_i2h_weight"].asnumpy()
+        b_i = exe.arg_dict["l_i2h_bias"].asnumpy()
+        w_h = exe.arg_dict["l_h2h_weight"].asnumpy()
+        b_h = exe.arg_dict["l_h2h_bias"].asnumpy()
+        sig = lambda v: 1 / (1 + np.exp(-v))
+        h = np.zeros((2, 4), np.float32)
+        c = np.zeros((2, 4), np.float32)
+        outs = []
+        for t in range(3):
+            g = x[:, t] @ w_i.T + b_i + h @ w_h.T + b_h
+            i, f, cc, o = np.split(g, 4, axis=1)
+            c = sig(f) * c + sig(i) * np.tanh(cc)
+            h = sig(o) * np.tanh(c)
+            outs.append(h)
+        np.testing.assert_allclose(got, np.stack(outs, 1), rtol=1e-5, atol=1e-6)
+
+    def test_gru_cell_matches_numpy(self):
+        S.symbol._reset_naming()
+        cell = mx.rnn.GRUCell(num_hidden=4, prefix="g_")
+        out, _ = cell.unroll(3, inputs=S.var("data"), merge_outputs=True)
+        x = np.random.RandomState(4).randn(2, 3, 5).astype(np.float32)
+        exe = _bind_fill(out, x, seed=5)
+        got = exe.forward(is_train=False)[0].asnumpy()
+
+        w_i = exe.arg_dict["g_i2h_weight"].asnumpy()
+        b_i = exe.arg_dict["g_i2h_bias"].asnumpy()
+        w_h = exe.arg_dict["g_h2h_weight"].asnumpy()
+        b_h = exe.arg_dict["g_h2h_bias"].asnumpy()
+        sig = lambda v: 1 / (1 + np.exp(-v))
+        h = np.zeros((2, 4), np.float32)
+        outs = []
+        for t in range(3):
+            gi = x[:, t] @ w_i.T + b_i
+            gh = h @ w_h.T + b_h
+            ir, iz, inn = np.split(gi, 3, 1)
+            hr, hz, hn = np.split(gh, 3, 1)
+            r, z = sig(ir + hr), sig(iz + hz)
+            n = np.tanh(inn + r * hn)
+            h = (1 - z) * n + z * h
+            outs.append(h)
+        np.testing.assert_allclose(got, np.stack(outs, 1), rtol=1e-5, atol=1e-6)
+
+    def test_fused_matches_unfused_with_packed_weights(self):
+        rng = np.random.RandomState(6)
+        x = rng.randn(2, 5, 4).astype(np.float32)
+        cell_args = {"lstm_l0_i2h_weight": rng.randn(32, 4).astype(np.float32) * 0.1,
+                     "lstm_l0_h2h_weight": rng.randn(32, 8).astype(np.float32) * 0.1,
+                     "lstm_l0_i2h_bias": rng.randn(32).astype(np.float32) * 0.1,
+                     "lstm_l0_h2h_bias": rng.randn(32).astype(np.float32) * 0.1}
+
+        S.symbol._reset_naming()
+        fused = mx.rnn.FusedRNNCell(8, num_layers=1, mode="lstm", prefix="lstm_")
+        fo, _ = fused.unroll(5, inputs=S.var("data"), layout="NTC")
+        packed = fused.pack_weights(
+            {k: mx.nd.array(v) for k, v in cell_args.items()})
+        fexe = _bind_fill(fo, x, lstm_parameters=packed["lstm_parameters"].asnumpy())
+        fout = fexe.forward(is_train=False)[0].asnumpy()
+
+        S.symbol._reset_naming()
+        single = mx.rnn.LSTMCell(num_hidden=8, prefix="lstm_l0_", forget_bias=0.0)
+        so, _ = single.unroll(5, inputs=S.var("data"), merge_outputs=True)
+        sexe = _bind_fill(so, x, **cell_args)
+        sout = sexe.forward(is_train=False)[0].asnumpy()
+        np.testing.assert_allclose(fout, sout, rtol=1e-5, atol=1e-6)
+
+        # pack -> unpack roundtrip is exact
+        rt = fused.unpack_weights(packed)
+        for k, v in cell_args.items():
+            np.testing.assert_allclose(rt[k].asnumpy(), v)
+
+    def test_bidirectional_and_modifiers(self):
+        S.symbol._reset_naming()
+        bi = mx.rnn.BidirectionalCell(
+            mx.rnn.LSTMCell(num_hidden=4, prefix="fw_"),
+            mx.rnn.LSTMCell(num_hidden=4, prefix="bw_"))
+        out, states = bi.unroll(3, inputs=S.var("data"), merge_outputs=True)
+        x = np.random.RandomState(7).randn(2, 3, 5).astype(np.float32)
+        got = _bind_fill(out, x).forward(is_train=False)[0]
+        assert got.shape == (2, 3, 8)  # fw|bw concat
+        assert len(states) == 4
+
+        S.symbol._reset_naming()
+        stack = mx.rnn.SequentialRNNCell()
+        stack.add(mx.rnn.ResidualCell(mx.rnn.RNNCell(num_hidden=5, prefix="r0_")))
+        stack.add(mx.rnn.DropoutCell(0.0))
+        out, _ = stack.unroll(3, inputs=S.var("data"), merge_outputs=True)
+        got = _bind_fill(out, np.random.RandomState(8).randn(2, 3, 5)
+                         .astype(np.float32)).forward(is_train=False)[0]
+        assert got.shape == (2, 3, 5)
+
+    def test_begin_state_contract(self):
+        cell = mx.rnn.LSTMCell(num_hidden=4, prefix="bs_")
+        states = cell.begin_state(batch_size=3)
+        assert len(states) == 2
+        with pytest.raises(ValueError, match="batch_size"):
+            cell.begin_state()
+
+
+def test_bucket_sentence_iter():
+    sentences = [[1, 2, 3], [4, 5], [6, 7, 8, 9], [1, 1, 1], [2, 2],
+                 [3, 3, 3, 3], [5, 5, 5], [7, 7]]
+    it = mx.rnn.BucketSentenceIter(sentences, batch_size=2, buckets=[3, 4],
+                                   invalid_label=-1)
+    seen = 0
+    for batch in it:
+        assert batch.data[0].shape[0] == 2
+        assert batch.data[0].shape[1] == batch.bucket_key
+        assert batch.label[0].shape == batch.data[0].shape
+        # label is data shifted left, padded with invalid
+        d = batch.data[0].asnumpy()
+        l = batch.label[0].asnumpy()
+        np.testing.assert_allclose(l[:, :-1], d[:, 1:])
+        assert (l[:, -1] == -1).all()
+        seen += 1
+    assert seen >= 2
+    it.reset()
+    assert next(iter(it)) is not None
+
+
+def test_forget_bias_via_initializer_and_fused_parity_default():
+    """forget_bias flows through the LSTMBias init attr (reference
+    semantics — forward adds nothing), so fused/unfused parity holds at
+    the DEFAULT forget_bias too."""
+    S.symbol._reset_naming()
+    cell = mx.rnn.LSTMCell(num_hidden=4, prefix="fb_", forget_bias=2.5)
+    out, _ = cell.unroll(2, inputs=S.var("data"), merge_outputs=True)
+    pred = S.FullyConnected(S.Reshape(out, shape=(-1, 4)), num_hidden=2,
+                            name="p")
+    smx = S.SoftmaxOutput(pred, S.var("softmax_label"), name="softmax")
+    mod = mx.mod.Module(smx, data_names=("data",),
+                        label_names=("softmax_label",))
+    mod.bind(data_shapes=[("data", (2, 2, 3))],
+             label_shapes=[("softmax_label", (2, 2))])
+    mod.init_params(mx.initializer.Xavier())
+    b = mod.get_params()[0]["fb_i2h_bias"].asnumpy()
+    assert (b[4:8] == 2.5).all() and (b[:4] == 0).all()
+
+    # default-forget-bias cells share weights with the fused kernel exactly
+    rng = np.random.RandomState(9)
+    x = rng.randn(2, 4, 3).astype(np.float32)
+    cell_args = {"lstm_l0_i2h_weight": rng.randn(16, 3).astype(np.float32) * 0.1,
+                 "lstm_l0_h2h_weight": rng.randn(16, 4).astype(np.float32) * 0.1,
+                 "lstm_l0_i2h_bias": rng.randn(16).astype(np.float32) * 0.1,
+                 "lstm_l0_h2h_bias": rng.randn(16).astype(np.float32) * 0.1}
+    S.symbol._reset_naming()
+    fused = mx.rnn.FusedRNNCell(4, num_layers=1, mode="lstm", prefix="lstm_")
+    fo, _ = fused.unroll(4, inputs=S.var("data"), layout="NTC")
+    packed = fused.pack_weights({k: mx.nd.array(v) for k, v in cell_args.items()})
+    fexe = _bind_fill(fo, x, lstm_parameters=packed["lstm_parameters"].asnumpy())
+    fout = fexe.forward(is_train=False)[0].asnumpy()
+    S.symbol._reset_naming()
+    single = mx.rnn.LSTMCell(num_hidden=4, prefix="lstm_l0_")  # default fb
+    so, _ = single.unroll(4, inputs=S.var("data"), merge_outputs=True)
+    sexe = _bind_fill(so, x, **cell_args)
+    np.testing.assert_allclose(fexe.forward(is_train=False)[0].asnumpy(),
+                               sexe.forward(is_train=False)[0].asnumpy(),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_fused_begin_state_shapes():
+    fused = mx.rnn.FusedRNNCell(6, num_layers=2, mode="lstm", prefix="f2_")
+    states = fused.begin_state(batch_size=3)
+    assert len(states) == 2
+    for st in states:
+        _, outs, _ = st.infer_shape_partial()
+        assert outs == [(2, 3, 6)], outs
