@@ -273,3 +273,31 @@ class TestReviewRegressions:
             np.asarray(ref_state[0].asnumpy() if hasattr(ref_state[0], 'asnumpy') else ref_state[0]),
             np.asarray(new_state[0].asnumpy() if hasattr(new_state[0], 'asnumpy') else new_state[0]),
             rtol=1e-6)
+
+
+def test_feedforward_legacy_api(tmp_path):
+    """mx.model.FeedForward — the pre-Module wrapper ([U:python/mxnet/
+    model.py]): fit on arrays, predict (ragged last batch), save/load."""
+    import incubator_mxnet_tpu.symbol as S
+
+    S.symbol._reset_naming()
+    net = S.SoftmaxOutput(
+        S.FullyConnected(S.var("data"), num_hidden=2, name="fc"),
+        S.var("softmax_label"), name="softmax")
+    rng = np.random.RandomState(0)
+    X = rng.rand(150, 4).astype(np.float32)
+    y = (X.sum(1) > 2).astype(np.float32)
+    model = mx.model.FeedForward(net, num_epoch=8, optimizer="sgd",
+                                 learning_rate=0.5, numpy_batch_size=32)
+    model.fit(X, y)
+    pred = model.predict(X)
+    assert pred.shape == (150, 2)
+    assert (pred.argmax(1) == y).mean() > 0.8
+
+    model.save(str(tmp_path / "ff"), 8)
+    m2 = mx.model.FeedForward.load(str(tmp_path / "ff"), 8)
+    np.testing.assert_allclose(m2.predict(X), pred, rtol=1e-5)
+
+    m3 = mx.model.FeedForward.create(net, X, y, num_epoch=2,
+                                     learning_rate=0.5)
+    assert m3.arg_params is not None
