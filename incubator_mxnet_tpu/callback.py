@@ -5,7 +5,7 @@ from __future__ import annotations
 import logging
 import time
 
-__all__ = ["Speedometer", "do_checkpoint", "log_train_metric", "ProgressBar", "LogValidationMetricsCallback"]
+__all__ = ["Speedometer", "do_checkpoint", "module_checkpoint", "log_train_metric", "ProgressBar", "LogValidationMetricsCallback"]
 
 
 class Speedometer:
@@ -95,3 +95,16 @@ class LogValidationMetricsCallback:
             return
         for name, value in param.eval_metric.get_name_value():
             logging.info("Epoch[%d] Validation-%s=%f", param.epoch, name, value)
+
+
+def module_checkpoint(mod, prefix, period=1, save_optimizer_states=False):
+    """Epoch-end callback saving a Module's checkpoint (parity:
+    ``mx.callback.module_checkpoint``)."""
+    period = int(max(1, period))
+
+    def _callback(iter_no, sym=None, arg=None, aux=None):
+        if (iter_no + 1) % period == 0:
+            mod.save_checkpoint(prefix, iter_no + 1,
+                                save_optimizer_states=save_optimizer_states)
+
+    return _callback

@@ -16,6 +16,9 @@ from ..trainer import Trainer
 
 __all__ = [
     "Estimator",
+    "MetricHandler",
+    "ValidationHandler",
+    "StoppingHandler",
     "TrainBegin",
     "TrainEnd",
     "EpochBegin",
@@ -127,6 +130,70 @@ class EarlyStoppingHandler(EpochEnd):
                 logging.info("Early stopping: %s did not improve for %d epochs", name, self.wait)
 
 
+class MetricHandler(EpochBegin, BatchEnd):
+    """Owns an INDEPENDENT train-metric list (parity:
+    estimator.MetricHandler): resets at epoch begin, updates from the
+    batch the estimator just processed (``estimator._last_batch``)."""
+
+    def __init__(self, train_metrics):
+        self.train_metrics = _as_metrics(train_metrics)
+
+    def epoch_begin(self, estimator):
+        for m in self.train_metrics:
+            m.reset()
+
+    def batch_end(self, estimator):
+        label, pred, loss = estimator._last_batch
+        for m in self.train_metrics:
+            if isinstance(m, metric_mod.Loss):
+                m.update([], [loss])
+            else:
+                m.update([label], [pred])
+
+
+class ValidationHandler(EpochEnd):
+    """Runs validation every ``epoch_period`` epochs (parity:
+    estimator.ValidationHandler)."""
+
+    def __init__(self, val_data, eval_fn=None, epoch_period=1,
+                 val_metrics=None):
+        self.val_data = val_data
+        self.eval_fn = eval_fn
+        self.epoch_period = max(1, int(epoch_period))
+        self.val_metrics = _as_metrics(val_metrics)
+
+    def epoch_end(self, estimator):
+        if (estimator.current_epoch + 1) % self.epoch_period:
+            return
+        if self.eval_fn is not None:
+            self.eval_fn(self.val_data)
+        else:
+            estimator.evaluate(self.val_data, self.val_metrics)
+
+
+class StoppingHandler(TrainBegin, EpochEnd, BatchEnd):
+    """Stop at ``max_epoch`` epochs or ``max_batch`` total batches
+    (parity: estimator.StoppingHandler)."""
+
+    def __init__(self, max_epoch=None, max_batch=None):
+        self.max_epoch = max_epoch
+        self.max_batch = max_batch
+        self._batches = 0
+
+    def train_begin(self, estimator):
+        self._batches = 0
+
+    def batch_end(self, estimator):
+        self._batches += 1
+        if self.max_batch is not None and self._batches >= self.max_batch:
+            estimator.stop_training = True
+
+    def epoch_end(self, estimator):
+        if (self.max_epoch is not None
+                and estimator.current_epoch + 1 >= self.max_epoch):
+            estimator.stop_training = True
+
+
 class Estimator:
     """Parity: ``gluon.contrib.estimator.Estimator``."""
 
@@ -157,6 +224,7 @@ class Estimator:
         from ... import autograd
 
         handlers = event_handlers or [LoggingHandler()]
+        self.stop_training = False  # a reused Estimator/handler starts clean
         for h in handlers:
             if isinstance(h, TrainBegin):
                 h.train_begin(self)
@@ -180,6 +248,7 @@ class Estimator:
                     loss = self.loss(pred, label)
                 loss.backward()
                 self.trainer.step(data.shape[0])
+                self._last_batch = (label, pred, loss)
                 for m in self.train_metrics:
                     if isinstance(m, metric_mod.Loss):
                         m.update([], [loss])
@@ -191,6 +260,12 @@ class Estimator:
                 n_batches += 1
                 if batches is not None and n_batches >= batches:
                     break
+                if self.stop_training:
+                    break
+            if self.stop_training:
+                # mid-epoch stop (max_batch): no end-of-epoch validation,
+                # checkpointing or logging over a truncated epoch
+                break
             if val_data is not None:
                 self.evaluate(val_data)
             for h in handlers:
