@@ -10,3 +10,6 @@ from .activations import __all__ as _act_all
 from .transformer import __all__ as _tfm_all
 
 __all__ = list(_basic_all) + list(_conv_all) + list(_act_all) + list(_tfm_all)
+
+# the reference re-exports the block bases through gluon.nn too
+from ..block import Block, HybridBlock, SymbolBlock  # noqa: E402,F401

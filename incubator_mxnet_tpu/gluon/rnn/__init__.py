@@ -26,3 +26,7 @@ __all__ = [
     "LSTM",
     "GRU",
 ]
+
+# reference alias: the hybridizable sequential container shares the
+# implementation here (cells are already hybrid-safe)
+HybridSequentialRNNCell = SequentialRNNCell  # noqa: F405
