@@ -1,6 +1,6 @@
 """``gluon.data`` (parity: [U:python/mxnet/gluon/data/])."""
 from .dataset import Dataset, ArrayDataset, SimpleDataset, RecordFileDataset
-from .sampler import Sampler, SequentialSampler, RandomSampler, BatchSampler
+from .sampler import Sampler, SequentialSampler, RandomSampler, BatchSampler, FilterSampler
 from .dataloader import DataLoader
 from . import vision
 
@@ -12,6 +12,7 @@ __all__ = [
     "Sampler",
     "SequentialSampler",
     "RandomSampler",
+    "FilterSampler",
     "BatchSampler",
     "DataLoader",
     "vision",

@@ -3,7 +3,7 @@ from __future__ import annotations
 
 import numpy as _np
 
-__all__ = ["Sampler", "SequentialSampler", "RandomSampler", "BatchSampler"]
+__all__ = ["Sampler", "SequentialSampler", "RandomSampler", "BatchSampler", "FilterSampler"]
 
 
 class Sampler:
@@ -73,3 +73,17 @@ class BatchSampler(Sampler):
         if self._last_batch == "rollover":
             return (len(self._prev) + len(self._sampler)) // self._batch_size
         raise ValueError(self._last_batch)
+
+
+class FilterSampler(Sampler):
+    """Yield indices whose dataset item satisfies ``fn`` (parity:
+    ``gluon.data.FilterSampler``)."""
+
+    def __init__(self, fn, dataset):
+        self._indices = [i for i in range(len(dataset)) if fn(dataset[i])]
+
+    def __iter__(self):
+        return iter(self._indices)
+
+    def __len__(self):
+        return len(self._indices)

@@ -375,3 +375,17 @@ class TestExportJittable:
         # deterministic: same key → same output
         c = np.asarray(fn_train(params, x._data))
         np.testing.assert_allclose(b, c, rtol=0, atol=0)
+
+
+def test_filter_sampler():
+    """gluon.data.FilterSampler (round-5 parity tail)."""
+    from incubator_mxnet_tpu.gluon import data
+
+    ds = data.SimpleDataset(list(range(10)))
+    s = data.FilterSampler(lambda x: x % 2 == 0, ds)
+    assert list(s) == [0, 2, 4, 6, 8]
+    assert len(s) == 5
+    loader = data.DataLoader(ds, batch_size=2,
+                             sampler=data.FilterSampler(lambda x: x < 4, ds))
+    got = [b.asnumpy().tolist() for b in loader]
+    assert got == [[0, 1], [2, 3]]
