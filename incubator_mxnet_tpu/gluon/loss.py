@@ -28,6 +28,7 @@ __all__ = [
     "PoissonNLLLoss",
     "CosineEmbeddingLoss",
     "CTCLoss",
+    "SDMLLoss",
 ]
 
 
@@ -212,6 +213,35 @@ class TripletLoss(Loss):
         loss = F.sum(F.square(positive - pred) - F.square(negative - pred), axis=self._batch_axis, exclude=True)
         loss = F.relu(loss + self._margin)
         return _apply_weighting(F, loss, self._weight, sample_weight)
+
+
+class SDMLLoss(Loss):
+    """Smoothed deep metric learning loss (parity: ``gluon.loss.SDMLLoss``,
+    1.6+): paired batches (x1[i] ~ x2[i]) — cross-entropy between the
+    row-softmax of negative pairwise L2 distances and a label-smoothed
+    identity, so each x1[i] should be closest to its own x2[i] among the
+    batch."""
+
+    def __init__(self, smoothing_parameter=0.3, weight=1.0, batch_axis=0,
+                 **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._smoothing = smoothing_parameter
+
+    def hybrid_forward(self, F, x1, x2):
+        n = x1.shape[0]
+        if n < 2:
+            raise ValueError("SDMLLoss needs batch size >= 2 (in-batch "
+                             "negatives)")
+        # pairwise squared L2: [N, N]
+        a = F.expand_dims(x1, axis=1)   # [N, 1, D]
+        b = F.expand_dims(x2, axis=0)   # [1, N, D]
+        dist = F.sum(F.square(F.broadcast_sub(a, b)), axis=2)
+        logp = F.log_softmax(-dist, axis=1)
+        eye = F.one_hot(F.arange(0, n), depth=n)
+        labels = (eye * (1.0 - self._smoothing)
+                  + (1.0 - eye) * (self._smoothing / (n - 1)))
+        loss = -F.sum(labels * logp, axis=1)
+        return _apply_weighting(F, loss, self._weight, None)
 
 
 class PoissonNLLLoss(Loss):

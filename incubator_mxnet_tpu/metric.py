@@ -16,6 +16,7 @@ __all__ = [
     "TopKAccuracy",
     "F1",
     "MCC",
+    "PCC",
     "MAE",
     "MSE",
     "RMSE",
@@ -199,6 +200,64 @@ class MCC(_BinaryStats):
             return (self.name, 0.0)
         return (self.name,
                 (self.tp * self.tn - self.fp * self.fn) / _np.sqrt(denom))
+
+
+@register
+class PCC(EvalMetric):
+    """Multiclass Pearson correlation coefficient over a running k x k
+    confusion matrix (parity: ``mx.metric.PCC`` — the R_k statistic;
+    reduces to MCC for binary problems)."""
+
+    def __init__(self, name="pcc", output_names=None, label_names=None):
+        super().__init__(name, output_names, label_names)
+
+    def reset(self):
+        super().reset()
+        self._cm = _np.zeros((0, 0), dtype=_np.float64)
+
+    def _grow(self, k):
+        if k > self._cm.shape[0]:
+            cm = _np.zeros((k, k), dtype=_np.float64)
+            old = self._cm.shape[0]
+            cm[:old, :old] = self._cm
+            self._cm = cm
+
+    def update(self, labels, preds):
+        for label, pred in zip(_as_list(labels), _as_list(preds)):
+            label, pred = _to_np(label), _to_np(pred)
+            if pred.ndim > 1 and pred.shape[-1] > 1:
+                pred = pred.argmax(-1)
+            pred = pred.reshape(-1)
+            if _np.issubdtype(pred.dtype, _np.floating):
+                if pred.size and 0.0 <= pred.min() and pred.max() <= 1.0:
+                    pred = (pred >= 0.5)  # binary probabilities
+                else:
+                    pred = _np.rint(pred)
+            label = label.reshape(-1).astype(_np.int64)
+            pred = pred.astype(_np.int64)
+            if label.size and (label.min() < 0 or pred.min() < 0):
+                raise ValueError(
+                    "PCC: negative class index (mask out ignore labels "
+                    "before updating)")
+            k = int(max(label.max(initial=0), pred.max(initial=0))) + 1
+            self._grow(k)
+            _np.add.at(self._cm, (label, pred), 1.0)
+            self.num_inst += label.size
+
+    def get(self):
+        if not self.num_inst:
+            return (self.name, float("nan"))
+        cm = self._cm
+        s = cm.sum()
+        c = _np.trace(cm)
+        t = cm.sum(axis=1)  # true-class counts
+        p = cm.sum(axis=0)  # predicted-class counts
+        cov_yy = s * s - (p * p).sum()
+        cov_xx = s * s - (t * t).sum()
+        if cov_yy == 0 or cov_xx == 0:
+            return (self.name, 0.0)
+        return (self.name, float((c * s - (t * p).sum())
+                                 / _np.sqrt(cov_xx * cov_yy)))
 
 
 @register

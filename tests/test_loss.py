@@ -102,3 +102,24 @@ def test_loss_weight_and_sample_weight():
     loss2 = gluon.loss.L1Loss()
     sw = mx.nd.array([[0.0]])
     assert float(loss2(pred, label, sw).asscalar()) == pytest.approx(0.0)
+
+
+def test_sdml_loss():
+    """SDMLLoss (round-5 tail): smoothed in-batch contrastive CE — matched
+    pairs beat shuffled pairs; gradient flows; training pulls pairs
+    together."""
+    from incubator_mxnet_tpu import autograd
+
+    rng = np.random.RandomState(0)
+    x1 = mx.nd.array(rng.randn(6, 8).astype(np.float32))
+    L = gluon.loss.SDMLLoss(smoothing_parameter=0.3)
+    matched = float(L(x1, x1 * 1.01).asnumpy().mean())
+    shuffled = float(L(x1, mx.nd.array(x1.asnumpy()[::-1].copy())).asnumpy().mean())
+    assert matched < shuffled
+    x1.attach_grad()
+    with autograd.record():
+        val = L(x1, x1 * 0.99).sum()
+    val.backward()
+    assert np.isfinite(x1.grad.asnumpy()).all()
+    with pytest.raises(ValueError):
+        L(mx.nd.zeros((1, 4)), mx.nd.zeros((1, 4)))

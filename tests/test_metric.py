@@ -102,3 +102,23 @@ def test_binary_metrics_reject_multiclass():
         with pytest.raises(ValueError):
             m.update([mx.nd.array([0, 1, 2])],
                      [mx.nd.array([[0.2, 0.3, 0.5]] * 3)])
+
+
+def test_pcc_metric():
+    """metric.PCC: equals MCC for binary, 1.0 for perfect multiclass,
+    streaming across updates."""
+    rng = np.random.RandomState(1)
+    y = rng.randint(0, 2, 300)
+    p = np.where(rng.rand(300) < 0.75, y, 1 - y)
+    probs = np.eye(2)[p]
+    pcc, mcc = mx.metric.PCC(), mx.metric.MCC()
+    # stream in two chunks — confusion matrix accumulates
+    for sl in (slice(0, 100), slice(100, 300)):
+        pcc.update([mx.nd.array(y[sl])], [mx.nd.array(probs[sl])])
+        mcc.update([mx.nd.array(y[sl])], [mx.nd.array(probs[sl])])
+    assert abs(pcc.get()[1] - mcc.get()[1]) < 1e-9
+    pcc4 = mx.metric.PCC()
+    y4 = rng.randint(0, 4, 200)
+    pcc4.update([mx.nd.array(y4)], [mx.nd.array(np.eye(4)[y4])])
+    assert pcc4.get()[1] == 1.0
+    assert mx.metric.create("pcc").name == "pcc"
