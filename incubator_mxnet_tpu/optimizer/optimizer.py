@@ -835,12 +835,8 @@ class LBSGD(Optimizer):
         return 1.0 + (self.batch_scale - 1.0) * frac
 
     def _lars_ratio(self, weight, grad, wd):
-        w32 = weight._data.astype(jnp.float32)
-        g32 = grad._data.astype(jnp.float32) * _f32(self.rescale_grad)
-        w_norm = jnp.linalg.norm(w32)
-        g_norm = jnp.linalg.norm(g32)
-        ratio = self.lars_eta * w_norm / (g_norm + wd * w_norm + self.lars_eps)
-        return jnp.where((w_norm > 0) & (g_norm > 0), ratio, 1.0)
+        return _lars_trust(weight, grad, wd, self.lars_eta, self.lars_eps,
+                           self.rescale_grad)
 
     def update(self, index, weight, grad, state):
         self._update_count(index)
@@ -852,6 +848,56 @@ class LBSGD(Optimizer):
             lr = _f32(lr) * self._lars_ratio(weight, grad, wd)
         else:
             lr = _f32(lr) * self._warmup_scale(t)
+        if state is None:
+            new_w = K.sgd_update(
+                weight._data, grad._data, lr, _f32(wd),
+                _f32(self.rescale_grad), _f32(self.clip_gradient))
+            _swap(weight, new_w)
+        else:
+            new_w, new_mom = K.sgd_mom_update(
+                weight._data, grad._data, state._data, lr, _f32(wd),
+                _f32(self.rescale_grad), _f32(self.clip_gradient),
+                _f32(self.momentum))
+            _swap(weight, new_w)
+            _swap(state, new_mom)
+
+
+def _lars_trust(weight, grad, wd, eta, eps, rescale_grad):
+    """eta*||w|| / (||g|| + wd*||w|| + eps), 1.0 when either norm is 0
+    (shared by LARS and LBSGD's lars warmup strategy)."""
+    w32 = weight._data.astype(jnp.float32)
+    g32 = grad._data.astype(jnp.float32) * _f32(rescale_grad)
+    w_norm = jnp.linalg.norm(w32)
+    g_norm = jnp.linalg.norm(g32)
+    ratio = eta * w_norm / (g_norm + wd * w_norm + eps)
+    return jnp.where((w_norm > 0) & (g_norm > 0), ratio, 1.0)
+
+
+@register
+class LARS(Optimizer):
+    """Layerwise-adaptive-rate SGD (parity: ``mx.optimizer.LARS``, 1.6+):
+    momentum SGD where each layer's lr is scaled by the trust ratio
+    eta*||w|| / (||g|| + wd*||w|| + eps); layers whose norm is 0 fall
+    back to the plain lr (the reference convention)."""
+
+    def __init__(self, momentum=0.0, eta=0.001, epsilon=1e-8, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.eta = eta
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return zeros(weight.shape, dtype="float32", ctx=weight.ctx)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        # trust-scaled lr into the SAME fused kernels every optimizer uses
+        # (uniform clip_gradient semantics, one compiled update)
+        lr = _f32(lr) * _lars_trust(weight, grad, wd, self.eta, self.epsilon,
+                                    self.rescale_grad)
         if state is None:
             new_w = K.sgd_update(
                 weight._data, grad._data, lr, _f32(wd),

@@ -156,3 +156,32 @@ def test_optimizer_lr_wd_mult():
     opt.set_lr_mult({"a_weight": 0.5})
     assert opt._get_lr(0) == pytest.approx(0.5)
     assert opt._get_lr(1) == pytest.approx(1.0)
+
+
+def test_lars_optimizer():
+    """LARS (round-5 tail): trust-ratio-scaled momentum SGD vs a numpy
+    replication; zero-norm fallback; full-zoo export check."""
+    from incubator_mxnet_tpu import optimizer as opt
+
+    o = opt.create("lars", learning_rate=0.1, momentum=0.9, eta=0.01, wd=1e-4)
+    rng = np.random.RandomState(0)
+    w = mx.nd.array(rng.randn(5, 4).astype(np.float32))
+    g = mx.nd.array(rng.randn(5, 4).astype(np.float32))
+    st = o.create_state(0, w)
+    w0, g0 = w.asnumpy().copy(), g.asnumpy().copy()
+    o.update(0, w, g, st)
+    wn, gn = np.linalg.norm(w0), np.linalg.norm(g0)
+    trust = 0.01 * wn / (gn + 1e-4 * wn + 1e-8)
+    mom = trust * 0.1 * (g0 + 1e-4 * w0)
+    np.testing.assert_allclose(w.asnumpy(), w0 - mom, rtol=1e-5)
+
+    # zero weight norm -> plain-lr fallback, no NaN
+    wz = mx.nd.zeros((3,))
+    o2 = opt.create("lars", learning_rate=0.1)
+    o2.update(1, wz, mx.nd.array(np.ones(3, np.float32)), None)
+    assert np.isfinite(wz.asnumpy()).all()
+    np.testing.assert_allclose(wz.asnumpy(), -0.1 * np.ones(3), rtol=1e-6)
+
+    # the full optimizer zoo is importable by its reference names
+    from incubator_mxnet_tpu.optimizer import (  # noqa: F401
+        Nadam, FTML, SGLD, DCASGD, Adamax, LBSGD, LARS, GroupAdaGrad)
