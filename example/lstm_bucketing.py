@@ -46,20 +46,36 @@ def synthetic_sequences(n=600, seed=0):
     return seqs
 
 
+USE_CELL_API = False  # --cell-api: build with mx.rnn cells instead of sym.RNN
+
+
 def sym_gen(seq_len):
     """Per-bucket symbol; every bucket reads the SAME parameter vars."""
     data = sym.Variable("data")            # [B, T] int tokens
     label = sym.Variable("softmax_label")  # [B, T] next tokens
     embed = sym.Embedding(data, sym.Variable("embed_weight"),
                           input_dim=VOCAB, output_dim=EMBED, name="embed")
-    tnc = sym.swapaxes(embed, dim1=0, dim2=1, name="to_tnc")  # [T, B, E]
-    out = sym.RNN(tnc, sym.Variable("lstm_parameters"), mode="lstm",
-                  state_size=HIDDEN, num_layers=LAYERS, name="lstm")
-    flat = sym.reshape(out, shape=(-1, HIDDEN), name="flat")  # [T*B, H]
+    if USE_CELL_API:
+        # the legacy mx.rnn path: unrolled LSTMCell stack, shared
+        # parameters across buckets by name ([U:example/rnn/bucketing])
+        stack = mx.rnn.SequentialRNNCell()
+        for i in range(LAYERS):
+            stack.add(mx.rnn.LSTMCell(num_hidden=HIDDEN,
+                                      prefix=f"lstm_l{i}_"))
+        outs, _ = stack.unroll(seq_len, inputs=embed, layout="NTC",
+                               merge_outputs=True)        # [B, T, H]
+        flat = sym.reshape(outs, shape=(-1, HIDDEN), name="flat")
+        lab_t = sym.reshape(label, shape=(-1,), name="lab")
+    else:
+        tnc = sym.swapaxes(embed, dim1=0, dim2=1, name="to_tnc")  # [T, B, E]
+        out = sym.RNN(tnc, sym.Variable("lstm_parameters"), mode="lstm",
+                      state_size=HIDDEN, num_layers=LAYERS, name="lstm")
+        flat = sym.reshape(out, shape=(-1, HIDDEN), name="flat")  # [T*B, H]
+        lab_t = sym.reshape(sym.swapaxes(label, dim1=0, dim2=1), shape=(-1,),
+                            name="lab")
     logits = sym.FullyConnected(flat, sym.Variable("pred_weight"),
                                 sym.Variable("pred_bias"),
                                 num_hidden=VOCAB, flatten=False, name="pred")
-    lab_t = sym.reshape(sym.swapaxes(label, dim1=0, dim2=1), shape=(-1,), name="lab")
     net = sym.SoftmaxOutput(logits, label=lab_t, name="softmax")
     return net, ("data",), ("softmax_label",)
 
@@ -91,7 +107,11 @@ def main():
     ap.add_argument("--epochs", type=int, default=5)
     ap.add_argument("--batch-size", type=int, default=16)
     ap.add_argument("--lr", type=float, default=0.05)
+    ap.add_argument("--cell-api", action="store_true",
+                    help="build with mx.rnn cells instead of the fused sym.RNN")
     args = ap.parse_args()
+    global USE_CELL_API
+    USE_CELL_API = args.cell_api
 
     rng = np.random.RandomState(1)
     seqs = synthetic_sequences()
@@ -108,7 +128,8 @@ def main():
     mod.init_optimizer(optimizer="adam",
                        optimizer_params={"learning_rate": args.lr})
 
-    n_params = rnn_param_size("lstm", EMBED, HIDDEN, LAYERS)
+    n_params = (None if USE_CELL_API
+                else rnn_param_size("lstm", EMBED, HIDDEN, LAYERS))
     first_ppl = None
     for epoch in range(args.epochs):
         total_nll, total_tok = 0.0, 0
@@ -116,7 +137,8 @@ def main():
             mod.forward(batch, is_train=True)
             probs = mod.get_outputs()[0].asnumpy()  # [T*B, V]
             lab = np.asarray(batch.label[0].asnumpy(), np.int64)
-            lab_t = lab.T.reshape(-1)
+            # fused path flattens T-major ([T, B]); cell path B-major
+            lab_t = lab.reshape(-1) if USE_CELL_API else lab.T.reshape(-1)
             nll = -np.log(np.maximum(probs[np.arange(lab_t.size), lab_t], 1e-12))
             total_nll += float(nll.sum())
             total_tok += lab_t.size
@@ -125,15 +147,19 @@ def main():
         ppl = math.exp(total_nll / total_tok)
         if first_ppl is None:
             first_ppl = ppl
-        print(f"epoch {epoch}: perplexity {ppl:.3f} "
-              f"(packed LSTM params: {n_params})")
+        tag = ("cell-API" if USE_CELL_API
+               else f"packed LSTM params: {n_params}")
+        print(f"epoch {epoch}: perplexity {ppl:.3f} ({tag})")
     if args.epochs >= 2:
         assert ppl < first_ppl, "perplexity did not improve"
     # the shared-parameter contract: training through MIXED buckets left
     # ONE parameter set (the public view merges every bucket's executor)
     arg_params, _ = mod.get_params()
-    assert "lstm_parameters" in arg_params
-    assert arg_params["lstm_parameters"].shape == (n_params,)
+    if USE_CELL_API:
+        assert "lstm_l0_i2h_weight" in arg_params  # shared across buckets
+    else:
+        assert "lstm_parameters" in arg_params
+        assert arg_params["lstm_parameters"].shape == (n_params,)
     print(f"final-perplexity {ppl:.3f}")
 
 

@@ -79,3 +79,8 @@ def test_lstm_bucketing():
 def test_onnx_roundtrip_example():
     out = _run("onnx_roundtrip.py", "--epochs", "1", "--n", "256")
     assert "ONNX_ROUNDTRIP_OK" in out
+
+
+def test_lstm_bucketing_cell_api():
+    out = _run("lstm_bucketing.py", "--epochs", "2", "--cell-api")
+    assert "final-perplexity" in out
