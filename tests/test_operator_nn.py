@@ -357,3 +357,37 @@ class TestLayerNormCustomBwd:
         v = x.var(axis=1, keepdims=True)
         assert_almost_equal(out.asnumpy(), (x - m) / np.sqrt(v + 1e-5),
                             rtol=1e-4, atol=1e-5)
+
+
+def test_attn_score_layout_ab_equivalence():
+    """MXNET_TPU_ATTN_SCORE_LAYOUT=bqhk (the TPU relayout A/B) is
+    numerically identical to the default bhqk — fwd and grads, causal."""
+    import subprocess
+    import sys
+    import os as os_mod
+
+    script = r"""
+import numpy as np
+import jax, jax.numpy as jnp
+import incubator_mxnet_tpu.ops.attention as att
+rng = np.random.RandomState(0)
+q = jnp.asarray(rng.randn(2, 16, 4, 8).astype(np.float32))
+k = jnp.asarray(rng.randn(2, 16, 4, 8).astype(np.float32))
+v = jnp.asarray(rng.randn(2, 16, 4, 8).astype(np.float32))
+def f(q, k, v):
+    return (att._flash_bshd(q, k, v, True, 0.35) * jnp.arange(8)).sum()
+val, grads = jax.value_and_grad(f, argnums=(0, 1, 2))(q, k, v)
+print(repr(float(val)))
+print(repr(float(np.abs(np.asarray(grads[0])).sum())))
+print(repr(float(np.abs(np.asarray(grads[2])).sum())))
+"""
+    outs = {}
+    for layout in ("bhqk", "bqhk"):
+        env = dict(os_mod.environ)
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env.update(JAX_PLATFORMS="cpu", MXNET_TPU_ATTN_SCORE_LAYOUT=layout)
+        r = subprocess.run([sys.executable, "-c", script], env=env,
+                           capture_output=True, text=True, timeout=300)
+        assert r.returncode == 0, r.stderr[-800:]
+        outs[layout] = [float(x) for x in r.stdout.strip().splitlines()]
+    np.testing.assert_allclose(outs["bhqk"], outs["bqhk"], rtol=1e-5)

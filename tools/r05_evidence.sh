@@ -54,6 +54,10 @@ if [ "$WHAT" = all ] || [ "$WHAT" = bench ]; then
     run_bench transformer-360 MXNET_TPU_BENCH=transformer MXNET_TPU_BENCH_STEPS=360
     # engine-bulking A/B: does scanning 8 steps per dispatch move tokens/s?
     run_bench transformer-bulk8 MXNET_TPU_BENCH=transformer MXNET_TPU_BENCH_BULK=8
+    # score-layout A/B: does the bqhk score tensor avoid the profiled
+    # head-split relayout copies? (numerics pinned identical by test)
+    run_bench transformer-attn-bqhk MXNET_TPU_BENCH=transformer MXNET_TPU_ATTN_SCORE_LAYOUT=bqhk
+    run_bench bert-attn-bqhk MXNET_TPU_ATTN_SCORE_LAYOUT=bqhk
     run_bench transformer-ln-custom MXNET_TPU_BENCH=transformer MXNET_TPU_LN_CUSTOM_BWD=1
     run_bench ssd-resnet18  MXNET_TPU_BENCH=ssd
     run_bench ssd-vgg16     MXNET_TPU_BENCH=ssd MXNET_TPU_BENCH_SSD_BACKBONE=vgg16
