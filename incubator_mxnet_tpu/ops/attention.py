@@ -516,14 +516,19 @@ def _causal_mask(s):
 # Score-tensor layout for the bshd XLA path: 'bhqk' (default — heads on
 # the major axes) or 'bqhk' (heads inboard; an A/B candidate for the
 # profiled head-split relayout copies on TPU — numerically identical,
-# pinned by test).
-_ATTN_SCORE_LAYOUT = os.environ.get("MXNET_TPU_ATTN_SCORE_LAYOUT", "bhqk")
+# pinned by test).  Fixed at import; ONE code path parameterized by the
+# einsum subscript so the math cannot diverge between layouts.
+_SL = ("bqhk" if os.environ.get("MXNET_TPU_ATTN_SCORE_LAYOUT", "bhqk")
+       == "bqhk" else "bhqk")
 
 
 def _causal_mask_bqhk(s):
     sq, sk = s.shape[1], s.shape[-1]
     mask = (jnp.arange(sq)[:, None, None] >= jnp.arange(sk)[None, None, :])
     return jnp.where(mask, s, -jnp.inf)
+
+
+_SCORE_MASK = _causal_mask_bqhk if _SL == "bqhk" else _causal_mask
 
 
 def attention_reference_bshd(q, k, v, causal=False, scale=None):
@@ -534,22 +539,12 @@ def attention_reference_bshd(q, k, v, causal=False, scale=None):
         scale = 1.0 / math.sqrt(q.shape[-1])
     prec = (jax.lax.Precision.HIGHEST if q.dtype == jnp.float32
             else jax.lax.Precision.DEFAULT)
-    if _ATTN_SCORE_LAYOUT == "bqhk":
-        s = jnp.einsum("bqhd,bkhd->bqhk", q, k,
-                       preferred_element_type=jnp.float32,
-                       precision=prec) * scale
-        if causal:
-            s = _causal_mask_bqhk(s)
-        p = jax.nn.softmax(s, axis=-1)
-        return jnp.einsum("bqhk,bkhd->bqhd", p.astype(v.dtype), v,
-                          preferred_element_type=jnp.float32,
-                          precision=prec).astype(v.dtype)
-    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+    s = jnp.einsum(f"bqhd,bkhd->{_SL}", q, k,
                    preferred_element_type=jnp.float32, precision=prec) * scale
     if causal:
-        s = _causal_mask(s)
+        s = _SCORE_MASK(s)
     p = jax.nn.softmax(s, axis=-1)
-    return jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v,
+    return jnp.einsum(f"{_SL},bkhd->bqhd", p.astype(v.dtype), v,
                       preferred_element_type=jnp.float32,
                       precision=prec).astype(v.dtype)
 
@@ -583,18 +578,11 @@ def _flash_bshd_fwd(q, k, v, causal, scale):
             else jax.lax.Precision.DEFAULT)
     mm = functools.partial(jnp.einsum, preferred_element_type=jnp.float32,
                            precision=prec)
-    if _ATTN_SCORE_LAYOUT == "bqhk":  # saved probs must match the bwd layout
-        s = mm("bqhd,bkhd->bqhk", q, k) * scale
-        if causal:
-            s = _causal_mask_bqhk(s)
-        pc = jax.nn.softmax(s, axis=-1).astype(v.dtype)
-        o = mm("bqhk,bkhd->bqhd", pc, v).astype(v.dtype)
-        return o, (q, k, v, pc)
-    s = mm("bqhd,bkhd->bhqk", q, k) * scale
+    s = mm(f"bqhd,bkhd->{_SL}", q, k) * scale
     if causal:
-        s = _causal_mask(s)
+        s = _SCORE_MASK(s)
     pc = jax.nn.softmax(s, axis=-1).astype(v.dtype)  # bf16 probs, saved
-    o = mm("bhqk,bkhd->bqhd", pc, v).astype(v.dtype)
+    o = mm(f"{_SL},bkhd->bqhd", pc, v).astype(v.dtype)
     return o, (q, k, v, pc)
 
 
@@ -609,37 +597,21 @@ def _flash_bshd_bwd(causal, scale, res, do):
             else jax.lax.Precision.DEFAULT)
     mm = functools.partial(jnp.einsum, preferred_element_type=jnp.float32,
                            precision=prec)
-    if _ATTN_SCORE_LAYOUT == "bqhk":
-        if pc is None:
-            s = mm("bqhd,bkhd->bqhk", q, k) * scale
-            if causal:
-                s = _causal_mask_bqhk(s)
-            p = jax.nn.softmax(s, axis=-1)           # fp32 [B, Sq, H, Sk]
-            pc = p.astype(v.dtype)
-        else:
-            p = pc
-        dv = mm("bqhk,bqhd->bkhd", pc, do)
-        dp = mm("bqhd,bkhd->bqhk", do, v)
-        delta = jnp.sum(dp * p, axis=-1, keepdims=True)
-        ds = (p * (dp - delta)).astype(q.dtype)
-        dq = mm("bqhk,bkhd->bqhd", ds, k) * scale
-        dk = mm("bqhk,bqhd->bkhd", ds, q) * scale
-        return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
     if pc is None:
-        s = mm("bqhd,bkhd->bhqk", q, k) * scale
+        s = mm(f"bqhd,bkhd->{_SL}", q, k) * scale
         if causal:
-            s = _causal_mask(s)
-        p = jax.nn.softmax(s, axis=-1)               # fp32 [B, H, Sq, Sk]
+            s = _SCORE_MASK(s)
+        p = jax.nn.softmax(s, axis=-1)               # fp32, _SL layout
         pc = p.astype(v.dtype)
     else:
         p = pc
-    dv = mm("bhqk,bqhd->bkhd", pc, do)
-    dp = mm("bqhd,bkhd->bhqk", do, v)
+    dv = mm(f"{_SL},bqhd->bkhd", pc, do)
+    dp = mm(f"bqhd,bkhd->{_SL}", do, v)
     # delta_q = Σ_k dp∘p  (== Σ_d do∘o, the flash identity — saves o)
-    delta = jnp.sum(dp * p, axis=-1, keepdims=True)  # [B, H, Sq, 1]
+    delta = jnp.sum(dp * p, axis=-1, keepdims=True)
     ds = (p * (dp - delta)).astype(q.dtype)
-    dq = mm("bhqk,bkhd->bqhd", ds, k) * scale
-    dk = mm("bhqk,bqhd->bkhd", ds, q) * scale
+    dq = mm(f"{_SL},bkhd->bqhd", ds, k) * scale
+    dk = mm(f"{_SL},bqhd->bkhd", ds, q) * scale
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 

@@ -377,17 +377,23 @@ v = jnp.asarray(rng.randn(2, 16, 4, 8).astype(np.float32))
 def f(q, k, v):
     return (att._flash_bshd(q, k, v, True, 0.35) * jnp.arange(8)).sum()
 val, grads = jax.value_and_grad(f, argnums=(0, 1, 2))(q, k, v)
+for g in grads:
+    print(repr(float(np.abs(np.asarray(g)).sum())))
 print(repr(float(val)))
-print(repr(float(np.abs(np.asarray(grads[0])).sum())))
-print(repr(float(np.abs(np.asarray(grads[2])).sum())))
 """
     outs = {}
-    for layout in ("bhqk", "bqhk"):
+    # also pin the saved-probs branch (MAX_ELEMS large enough to engage)
+    for layout in ("bhqk", "bqhk", "bhqk-save", "bqhk-save"):
         env = dict(os_mod.environ)
         env.pop("PALLAS_AXON_POOL_IPS", None)
-        env.update(JAX_PLATFORMS="cpu", MXNET_TPU_ATTN_SCORE_LAYOUT=layout)
+        env.update(JAX_PLATFORMS="cpu",
+                   MXNET_TPU_ATTN_SCORE_LAYOUT=layout.split("-")[0])
+        if layout.endswith("-save"):
+            env["MXNET_TPU_ATTN_SAVE_PROBS_MAX_ELEMS"] = "10000000"
         r = subprocess.run([sys.executable, "-c", script], env=env,
                            capture_output=True, text=True, timeout=300)
         assert r.returncode == 0, r.stderr[-800:]
         outs[layout] = [float(x) for x in r.stdout.strip().splitlines()]
-    np.testing.assert_allclose(outs["bhqk"], outs["bqhk"], rtol=1e-5)
+    for variant in ("bqhk", "bhqk-save", "bqhk-save"):
+        np.testing.assert_allclose(outs["bhqk"], outs[variant], rtol=1e-5,
+                                   err_msg=variant)
