@@ -208,3 +208,21 @@ def test_fused_begin_state_shapes():
     for st in states:
         _, outs, _ = st.infer_shape_partial()
         assert outs == [(2, 3, 6)], outs
+
+
+def test_cell_graph_json_roundtrip():
+    """An unrolled cell graph serializes/deserializes (tojson/load_json)
+    with identical numerics AND the LSTMBias __init__ attr intact."""
+    S.symbol._reset_naming()
+    cell = mx.rnn.LSTMCell(num_hidden=4, prefix="l_")
+    out, _ = cell.unroll(3, inputs=S.var("data"), merge_outputs=True)
+    out2 = S.load_json(out.tojson())
+    x = np.random.RandomState(0).randn(2, 3, 5).astype(np.float32)
+
+    def run(sym):
+        exe = _bind_fill(sym, x, seed=1)
+        return exe.forward(is_train=False)[0].asnumpy()
+
+    np.testing.assert_allclose(run(out), run(out2), rtol=1e-6)
+    attrs = {n.name: n.attrs for n in out2._topo() if n.op is None}
+    assert "__init__" in attrs["l_i2h_bias"]
