@@ -79,3 +79,54 @@ def resolve_platform(x=None):
         dd = getattr(jax.config, "jax_default_device", None)
         platform = getattr(dd, "platform", None) or jax.default_backend()
     return platform
+
+
+def makedirs(d):
+    """Recursive mkdir that tolerates existing dirs (parity:
+    ``mx.util.makedirs`` — pre-exist_ok-era helper)."""
+    import os
+
+    os.makedirs(os.path.expanduser(d), exist_ok=True)
+
+
+def getenv(name):
+    """Read an MXNET_* env var through the C runtime in the reference;
+    plain os.environ here (parity: ``mx.util.getenv``)."""
+    import os
+
+    return os.environ.get(name)
+
+
+def setenv(name, value):
+    """Parity: ``mx.util.setenv`` (process-wide)."""
+    import os
+
+    if value is None:
+        os.environ.pop(name, None)
+    else:
+        os.environ[name] = str(value)
+
+
+def get_gpu_count():
+    """Parity: ``mx.util.get_gpu_count`` — accelerator count on this
+    host (TPU chips play the gpu role)."""
+    from . import context
+
+    return context.num_tpus() or 0
+
+
+def get_gpu_memory(dev_id=0):
+    """Parity: ``mx.util.get_gpu_memory`` -> (free, total) bytes for the
+    accelerator, via the backend's memory stats when available."""
+    import jax
+
+    devs = [d for d in jax.devices() if d.platform != "cpu"]
+    if not devs:
+        raise RuntimeError("no accelerator device visible")
+    d = devs[min(dev_id, len(devs) - 1)]
+    stats = d.memory_stats() if hasattr(d, "memory_stats") else None
+    if not stats:
+        return (0, 0)
+    total = int(stats.get("bytes_limit", 0))
+    used = int(stats.get("bytes_in_use", 0))
+    return (total - used, total)
