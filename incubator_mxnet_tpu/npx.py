@@ -13,7 +13,36 @@ from __future__ import annotations
 from .ops.registry import get_op
 from .util import is_np_array, is_np_shape, reset_np, set_np  # noqa: F401
 
-__all__ = ["set_np", "reset_np", "is_np_array", "is_np_shape"]
+__all__ = ["set_np", "reset_np", "is_np_array", "is_np_shape",
+           "seed", "waitall", "save", "load"]
+
+
+def seed(seed_state):
+    """Parity: ``npx.seed`` — re-exported ``mx.random.seed``."""
+    from . import random as _random
+
+    _random.seed(seed_state)
+
+
+def waitall():
+    """Parity: ``npx.waitall`` — engine drain."""
+    from . import engine as _engine
+
+    _engine.waitall()
+
+
+def save(fname, data):
+    """Parity: ``npx.save`` — the ndarray container format."""
+    from .ndarray.utils import save as _save
+
+    _save(fname, data)
+
+
+def load(fname):
+    """Parity: ``npx.load``."""
+    from .ndarray.utils import load as _load
+
+    return _load(fname)
 
 # npx spells several ops in snake_case where the legacy registry uses
 # CamelCase (the reference keeps both registries; here it's one table
@@ -38,6 +67,9 @@ _ALIASES = {
     "sigmoid": "sigmoid",
     "softmax": "softmax",
     "log_softmax": "log_softmax",
+    "multibox_detection": "contrib_MultiBoxDetection",
+    "multibox_prior": "contrib_MultiBoxPrior",
+    "multibox_target": "contrib_MultiBoxTarget",
     "sequence_mask": "SequenceMask",
     "reshape_like": "reshape_like",
     "gamma": "gamma",
