@@ -1545,3 +1545,39 @@ class TestLegacyNdFunctions:
         out = mx.nd.broadcast_axes(mx.nd.zeros((1, 3, 1)), axis=(0, 2),
                                    size=(4, 2))
         assert out.shape == (4, 3, 2)
+
+
+class TestRound5TailGradients:
+    """Finite-difference gradient rows for the round-5 tail ops
+    (the reference's check_numeric_gradient idiom)."""
+
+    def test_crop_gradient(self):
+        from incubator_mxnet_tpu.utils.test_utils import check_numeric_gradient
+
+        x = np.random.RandomState(0).rand(1, 2, 6, 6)
+        check_numeric_gradient(
+            lambda d: mx.nd.Crop(d, h_w=(3, 3), offset=(1, 2)).sum(), [x])
+        check_numeric_gradient(
+            lambda d: mx.nd.Crop(d, mx.nd.zeros((1, 2, 4, 4)),
+                                 center_crop=True).sum(), [x])
+
+    def test_fill_and_choose_element_gradients(self):
+        from incubator_mxnet_tpu.utils.test_utils import check_numeric_gradient
+
+        rng = np.random.RandomState(1)
+        x = rng.rand(4, 5)
+        vals = rng.rand(4)
+        idx = mx.nd.array(np.array([0, 2, 4, 1], np.float32))
+        check_numeric_gradient(
+            lambda d: mx.nd.choose_element_0index(d, idx).sum(), [x])
+        check_numeric_gradient(
+            lambda d, v: mx.nd.fill_element_0index(d, v, idx).sum(),
+            [x, vals])
+
+    def test_boolean_mask_gradient(self):
+        from incubator_mxnet_tpu.utils.test_utils import check_numeric_gradient
+
+        x = np.random.RandomState(2).rand(5, 3)
+        m = mx.nd.array(np.array([1, 0, 1, 1, 0], np.float32))
+        check_numeric_gradient(
+            lambda d: (mx.nd.contrib.boolean_mask(d, m) ** 2).sum(), [x])
