@@ -8,6 +8,10 @@ from .amp import (
     scale_loss,
     unscale,
     convert_hybrid_block,
+    convert_symbol,
+    convert_model,
+    list_lp16_ops,
+    list_fp32_ops,
     LossScaler,
 )
 from . import lists
@@ -21,6 +25,10 @@ __all__ = [
     "scale_loss",
     "unscale",
     "convert_hybrid_block",
+    "convert_symbol",
+    "convert_model",
+    "list_lp16_ops",
+    "list_fp32_ops",
     "LossScaler",
     "lists",
 ]

@@ -229,3 +229,101 @@ def convert_hybrid_block(block, target_dtype="bfloat16"):
             continue  # integer params (embedding indices etc.) stay put
         p.cast(target)  # Parameter.cast also rebuilds the grad buffer
     return block
+
+
+def list_lp16_ops(target_dtype="bfloat16"):
+    """Parity: ``amp.list_lp16_ops`` — ops run in the low-precision
+    target dtype."""
+    from .lists import TARGET_OPS
+
+    return sorted(TARGET_OPS)
+
+
+def list_fp32_ops(target_dtype="bfloat16"):
+    """Parity: ``amp.list_fp32_ops``."""
+    from .lists import FP32_OPS
+
+    return sorted(FP32_OPS)
+
+
+def convert_symbol(sym, target_dtype="bfloat16", target_dtype_ops=None,
+                   fp32_ops=None, excluded_sym_names=(), **kwargs):
+    """Offline AMP graph conversion (parity: ``amp.convert_symbol`` — the
+    reference's nnvm ``low_precision_pass``): rewrite the Symbol DAG,
+    inserting ``amp_cast`` nodes so TARGET_OPS consume the low-precision
+    dtype and FP32_OPS consume float32.  ``amp_cast`` passes integer
+    tensors through unchanged, so index inputs (Embedding/labels) are
+    safe.  XLA folds back-to-back casts, so the inserted nodes cost
+    nothing where dtypes already agree."""
+    from ..symbol.symbol import Symbol, _Node
+    from .lists import FP32_OPS, TARGET_OPS
+
+    lp16 = set(target_dtype_ops) if target_dtype_ops is not None else set(TARGET_OPS)
+    fp32 = set(fp32_ops) if fp32_ops is not None else set(FP32_OPS)
+    excluded = set(excluded_sym_names)
+
+    mapping = {}
+    for node in sym._topo():
+        if node.op is None:
+            mapping[id(node)] = node
+            continue
+        new_inputs = [(mapping[id(n)], i) for n, i in node.inputs]
+        cast_to = None
+        if node.op in lp16 and node.name not in excluded:
+            cast_to = target_dtype
+        elif node.op in fp32 and node.name not in excluded:
+            cast_to = "float32"
+        if cast_to is not None:
+            wrapped = []
+            for j, (src, idx) in enumerate(new_inputs):
+                cn = _Node("amp_cast", f"{node.name}_in{j}_amp_cast",
+                           [(src, idx)], {"dtype": cast_to})
+                wrapped.append((cn, 0))
+            new_inputs = wrapped
+        mapping[id(node)] = _Node(node.op, node.name, new_inputs,
+                                  dict(node.attrs))
+    return Symbol([(mapping[id(n)], i) for n, i in sym._outputs])
+
+
+def convert_model(sym, arg_params, aux_params, target_dtype="bfloat16",
+                  excluded_sym_names=(), **kwargs):
+    """Parity: ``amp.convert_model`` — convert the graph with
+    :func:`convert_symbol` and cast float parameters to the target dtype,
+    EXCEPT parameters feeding FP32-listed ops directly (they stay fp32,
+    as the reference's cast_optional_params=False default does)."""
+    import numpy as _np
+
+    from ..ndarray.ndarray import array as _arr
+    from .lists import FP32_OPS
+
+    from .lists import TARGET_OPS
+
+    out_sym = convert_symbol(sym, target_dtype=target_dtype,
+                             excluded_sym_names=excluded_sym_names, **kwargs)
+    lp16 = (set(kwargs["target_dtype_ops"])
+            if kwargs.get("target_dtype_ops") is not None else set(TARGET_OPS))
+    fp32 = (set(kwargs["fp32_ops"])
+            if kwargs.get("fp32_ops") is not None else set(FP32_OPS))
+    excluded = set(excluded_sym_names)
+    # cast ONLY parameters consumed by effective-lp16, non-excluded nodes
+    # — and never one that ALSO feeds an fp32/excluded consumer
+    castable, pinned = set(), set()
+    for node in sym._topo():
+        if node.op is None:
+            continue
+        eff_lp16 = node.op in lp16 and node.name not in excluded
+        for src, _ in node.inputs:
+            if src.op is None:
+                (castable if eff_lp16 else pinned).add(src.name)
+    castable -= pinned
+
+    def cast_dict(d):
+        out = {}
+        for k, v in (d or {}).items():
+            a = v.asnumpy() if hasattr(v, "asnumpy") else _np.asarray(v)
+            if _np.issubdtype(a.dtype, _np.floating) and k in castable:
+                a = a.astype(target_dtype)
+            out[k] = _arr(a, dtype=str(a.dtype))
+        return out
+
+    return out_sym, cast_dict(arg_params), cast_dict(aux_params)

@@ -116,13 +116,28 @@ def _clean_attrs(attrs):
 _INT_DTYPES = ("int32", "int64", "uint8", "int8", "bool")
 
 
+_CAST_WRAPPERS = ("amp_cast", "cast", "Cast")
+
+
+def _behind_casts(src):
+    """Deref a chain of shape-preserving cast wrappers to the underlying
+    node (AMP-converted graphs interpose amp_cast between parameter
+    variables and their consumers)."""
+    while src.op in _CAST_WRAPPERS and src.inputs:
+        src = src.inputs[0][0]
+    return src
+
+
 def _graph_infer(symbol, shape_hints, dtype_hints, allow_unknown=False):
-    """One forward topo pass.  Returns (node → tuple-of-ShapeDtypeStruct,
+    """Topo sweeps to fixpoint (cast wrappers over deferred-init variables
+    need a second pass: the consumer's rule resolves the var, then the
+    wrapper).  Returns (node → tuple-of-ShapeDtypeStruct,
     var_name → ShapeDtypeStruct)."""
     values = {}   # id(node) -> tuple of ShapeDtypeStruct
     varspec = {}  # var name -> ShapeDtypeStruct
+    topo = symbol._topo()
 
-    for node in symbol._topo():
+    for node in topo:
         if node.op is None:
             shape = shape_hints.get(node.name, node.attrs.get("__shape__"))
             dtype = dtype_hints.get(node.name, node.attrs.get("__dtype__", "float32"))
@@ -132,51 +147,70 @@ def _graph_infer(symbol, shape_hints, dtype_hints, allow_unknown=False):
                 spec = jax.ShapeDtypeStruct(tuple(shape), _as_np_dtype(dtype))
                 values[id(node)] = (spec,)
                 varspec[node.name] = spec
-            continue
 
-        rules = PARAM_SHAPE_RULES.get(node.op, {})
-        input_names = node.attrs.get("__input_names__") or []
-        data_spec = None
-        if node.inputs:
-            first = values.get(id(node.inputs[0][0]))
-            if first is not None:
-                data_spec = first[node.inputs[0][1]]
-        # derive unknown parameter-variable shapes from the data shape
-        for (src, idx), pname in zip(node.inputs, input_names):
-            if values.get(id(src)) is None and src.op is None:
-                rule = rules.get(pname)
-                if rule is not None and data_spec is not None:
-                    shape = tuple(rule(data_spec.shape, node.attrs))
-                    dtype = dtype_hints.get(src.name,
-                                            src.attrs.get("__dtype__", str(data_spec.dtype)))
-                    spec = jax.ShapeDtypeStruct(shape, _as_np_dtype(dtype))
-                    values[id(src)] = (spec,)
-                    varspec[src.name] = spec
-
-        in_specs = []
-        missing = False
-        for src, idx in node.inputs:
-            v = values.get(id(src))
-            if v is None:
-                missing = True
-                break
-            in_specs.append(v[idx])
-        if missing:
-            if allow_unknown:
-                values[id(node)] = None
+    progress = True
+    while progress:
+        progress = False
+        for node in topo:
+            if node.op is None or values.get(id(node)) is not None:
                 continue
-            unknown = [s.name for s, _ in node.inputs if values.get(id(s)) is None]
+
+            rules = PARAM_SHAPE_RULES.get(node.op, {})
+            input_names = node.attrs.get("__input_names__") or []
+            data_spec = None
+            if node.inputs:
+                first = values.get(id(node.inputs[0][0]))
+                if first is not None:
+                    data_spec = first[node.inputs[0][1]]
+            # derive unknown parameter-variable shapes from the data shape
+            # (through any cast wrappers an AMP-converted graph inserted)
+            for (src, idx), pname in zip(node.inputs, input_names):
+                tgt = _behind_casts(src)
+                if values.get(id(tgt)) is None and tgt.op is None:
+                    rule = rules.get(pname)
+                    if rule is not None and data_spec is not None:
+                        shape = tuple(rule(data_spec.shape, node.attrs))
+                        dtype = dtype_hints.get(
+                            tgt.name,
+                            tgt.attrs.get("__dtype__", str(data_spec.dtype)))
+                        spec = jax.ShapeDtypeStruct(shape, _as_np_dtype(dtype))
+                        values[id(tgt)] = (spec,)
+                        varspec[tgt.name] = spec
+                        progress = True
+
+            in_specs = []
+            missing = False
+            for src, idx in node.inputs:
+                v = values.get(id(src))
+                if v is None:
+                    missing = True
+                    break
+                in_specs.append(v[idx])
+            if missing:
+                continue  # maybe resolvable next sweep
+
+            op = get_op(node.op)
+            attrs = _clean_attrs(node.attrs)
+            out = jax.eval_shape(lambda *a: op.fn(*a, **attrs), *in_specs)
+            values[id(node)] = (tuple(out) if isinstance(out, (list, tuple))
+                                else (out,))
+            progress = True
+
+    if not allow_unknown:
+        stuck = [n for n in topo
+                 if n.op is not None and values.get(id(n)) is None]
+        if stuck:
+            # report a non-wrapper node (an amp_cast is not actionable —
+            # its consumer and the underlying variable are), and name the
+            # underlying VARIABLES behind any cast chain
+            node = next((n for n in stuck if n.op not in _CAST_WRAPPERS),
+                        stuck[0])
+            unknown = sorted({_behind_casts(s).name
+                              for s, _ in node.inputs
+                              if values.get(id(s)) is None})
             raise ValueError(
                 f"infer_shape: cannot infer inputs {unknown} of node "
                 f"{node.name!r} (op {node.op}); provide their shapes")
-
-        op = get_op(node.op)
-        attrs = _clean_attrs(node.attrs)
-        out = jax.eval_shape(lambda *a: op.fn(*a, **attrs), *in_specs)
-        if isinstance(out, (list, tuple)):
-            values[id(node)] = tuple(out)
-        else:
-            values[id(node)] = (out,)
     return values, varspec
 
 

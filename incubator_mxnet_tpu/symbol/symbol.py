@@ -386,7 +386,34 @@ class Symbol:
 
     # -- serialization ---------------------------------------------------
     def tojson(self, remove_amp_cast=True):
-        nodes = self._topo()
+        sym = self
+        if remove_amp_cast:
+            # the reference contract: checkpoint symbols are cast-free —
+            # bypass amp_cast nodes (rewire consumers to their input)
+            def deref(entry):
+                src, idx = entry
+                while src.op == "amp_cast" and src.inputs:
+                    src, idx = src.inputs[0]
+                return (src, idx)
+
+            mapping = {}
+            for n in self._topo():
+                if n.op == "amp_cast":
+                    continue
+                if n.op is None:
+                    mapping[id(n)] = n
+                    continue
+                new_in = []
+                for e in n.inputs:
+                    src, idx = deref(e)
+                    new_in.append((mapping.get(id(src), src), idx))
+                mapping[id(n)] = _Node(n.op, n.name, new_in, dict(n.attrs))
+            outs = []
+            for e in self._outputs:
+                src, idx = deref(e)
+                outs.append((mapping.get(id(src), src), idx))
+            sym = Symbol(outs)
+        nodes = sym._topo()
         nid = {id(n): i for i, n in enumerate(nodes)}
         payload = {
             "nodes": [
@@ -399,7 +426,7 @@ class Symbol:
                 for n in nodes
             ],
             "arg_nodes": [i for i, n in enumerate(nodes) if n.op is None],
-            "heads": [[nid[id(n)], idx] for n, idx in self._outputs],
+            "heads": [[nid[id(n)], idx] for n, idx in sym._outputs],
             "attrs": {"mxnet_version": ["int", 10700], "format": "incubator_mxnet_tpu"},
         }
         return json.dumps(payload, indent=2)

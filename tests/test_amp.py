@@ -238,3 +238,56 @@ def test_scoped_disable():
         assert mx.nd.FullyConnected(x, w, None, num_hidden=3, no_bias=True).dtype == np.dtype("bfloat16")
     finally:
         amp.disable()
+
+
+def test_convert_symbol_and_model_offline():
+    """amp.convert_symbol/convert_model (round-5: the offline
+    low_precision_pass analog over the new amp_cast ops): casts inserted
+    around TARGET/FP32 ops, deferred shape inference flows through the
+    wrappers, numerics within bf16 tolerance, FP32-op params stay fp32."""
+    import incubator_mxnet_tpu.symbol as S
+
+    S.symbol._reset_naming()
+    data = S.var("data")
+    c = S.Convolution(data, kernel=(3, 3), num_filter=4, pad=(1, 1), name="c1")
+    r = S.Activation(c, act_type="relu", name="r1")
+    f = S.FullyConnected(S.Flatten(r), num_hidden=3, name="fc1")
+    net = S.SoftmaxOutput(f, S.var("softmax_label"), name="sm")
+
+    rng = np.random.RandomState(0)
+    x = rng.rand(2, 3, 8, 8).astype(np.float32)
+    args = {"c1_weight": mx.nd.array(rng.randn(4, 3, 3, 3).astype(np.float32) * 0.1),
+            "c1_bias": mx.nd.zeros(4),
+            "fc1_weight": mx.nd.array(rng.randn(3, 256).astype(np.float32) * 0.1),
+            "fc1_bias": mx.nd.zeros(3)}
+
+    def fwd(sym, params):
+        exe = sym.simple_bind(data=x.shape)
+        exe.arg_dict["data"][:] = x
+        for k, v in params.items():
+            exe.arg_dict[k][:] = v.asnumpy()
+        return exe.forward(is_train=False)[0].asnumpy()
+
+    ref = fwd(net, args)
+    csym, carg, caux = amp.convert_model(net, args, {},
+                                         target_dtype="bfloat16")
+    ops = [n.op for n in csym._topo() if n.op]
+    assert ops.count("amp_cast") >= 4  # conv + fc inputs, softmax fp32 ins
+    assert carg["fc1_weight"].dtype == np.dtype("bfloat16")
+    out = fwd(csym, carg)
+    assert np.abs(out - ref).max() < 0.05
+    assert sorted(amp.list_lp16_ops())  # accessors exist and are non-empty
+    assert "SoftmaxOutput" in amp.list_fp32_ops()
+    # exclusion honors names — in the graph AND the param cast set
+    csym2 = amp.convert_symbol(net, excluded_sym_names=("c1", "fc1", "sm"))
+    assert [n.op for n in csym2._topo()].count("amp_cast") == 0
+    _, carg3, _ = amp.convert_model(net, args, {},
+                                    excluded_sym_names=("fc1",))
+    assert carg3["fc1_weight"].dtype == np.float32
+    assert carg3["c1_weight"].dtype == np.dtype("bfloat16")
+    # checkpoint contract: tojson strips amp_cast by default
+    import json as _json
+    assert sum(1 for n in _json.loads(csym.tojson())["nodes"]
+               if n["op"] == "amp_cast") == 0
+    assert sum(1 for n in _json.loads(csym.tojson(remove_amp_cast=False))
+               ["nodes"] if n["op"] == "amp_cast") > 0
