@@ -51,7 +51,9 @@ def _state():
 
 
 def is_recording():
-    return _state().recording
+    # getattr with default instead of _state(): one C call on the dispatch
+    # hot path (ndarray.invoke asks on every eager op)
+    return getattr(_tls, "recording", False)
 
 
 def is_training():
@@ -108,6 +110,22 @@ def predict_mode():
 
 _node_counter = itertools.count()
 
+# engine/registry handles bound on first recorded op (import-cycle dodge —
+# the deferral is about import order, not per-call reload)
+_engine_mod = None
+_registry_mod = None
+
+
+def _dispatch_mods():
+    global _engine_mod, _registry_mod
+    if _engine_mod is None:
+        from . import engine as _e
+        from .ops import registry as _r
+
+        _engine_mod = _e
+        _registry_mod = _r
+    return _engine_mod, _registry_mod
+
 
 class _Node:
     """One recorded op: holds the vjp closure and provenance of its inputs."""
@@ -138,6 +156,25 @@ def record_op(fn, raw_inputs, input_arrays, kwargs, name=""):
     if not any(needs):
         return None, None
 
+    prov = [_provenance(a) for a, n in zip(input_arrays, needs) if n]
+
+    # Level-1 dispatch cache (ops/registry.py): for registered ops the
+    # forward replays a compiled executable and the tape node's vjp closure
+    # replays a compiled forward+backward (rematerializing — no residuals
+    # beyond the input arrays themselves survive on the node).
+    _engine, _registry = (_engine_mod, _registry_mod) \
+        if _engine_mod is not None else _dispatch_mods()
+
+    if not _engine.is_naive():
+        cached = _registry.lookup_recorded(fn, raw_inputs, kwargs, tuple(needs))
+        if cached is not None:
+            outs, vjp_fn, pure, diff_in = cached
+            node = _Node(vjp_fn, prov, len(outs), name=name)
+            node._avals = [jax.ShapeDtypeStruct(o.shape, o.dtype) for o in outs]
+            node._replay_fn = pure
+            node._replay_raw = diff_in
+            return outs, node
+
     def pure(*diff_args):
         it = iter(diff_args)
         full = [next(it) if n else r for n, r in zip(needs, raw_inputs)]
@@ -146,7 +183,6 @@ def record_op(fn, raw_inputs, input_arrays, kwargs, name=""):
 
     diff_in = [r for n, r in zip(needs, raw_inputs) if n]
     outs, vjp_fn = jax.vjp(pure, *diff_in)
-    prov = [_provenance(a) for a, n in zip(input_arrays, needs) if n]
     node = _Node(vjp_fn, prov, len(outs), name=name)
     node._avals = [jax.ShapeDtypeStruct(o.shape, o.dtype) for o in outs]
     # keep what a second-order backward needs to re-derive this op's vjp
@@ -225,6 +261,9 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
             g = jnp.ones_like(h._data)
         else:
             g = hg._data if isinstance(hg, NDArray) else jnp.asarray(hg)
+            # a head grad built inside an engine.bulk() scope may still be a
+            # pending DeferredArray — vjp closures need a real jax.Array
+            g = _dispatch_mods()[0].resolve(g)
         seed(prov, g)
 
     # Process nodes in reverse creation order; creation order is a valid

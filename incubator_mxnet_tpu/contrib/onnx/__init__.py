@@ -1352,8 +1352,13 @@ def import_model(model_file):
             fn = (sym_mod.broadcast_maximum if op == "Max"
                   else sym_mod.broadcast_minimum)
             out = env[node["input"][0]]
-            for extra_in in node["input"][1:]:
-                out = fn(out, env[extra_in], name=nm)
+            rest = node["input"][1:]
+            for i, extra_in in enumerate(rest):
+                # chained intermediates need unique names — reusing `nm` for
+                # every fold collides in the symbol graph with 3+ inputs;
+                # only the last fold carries the ONNX node's own name
+                fold_nm = nm if i == len(rest) - 1 else f"{nm}_fold{i}"
+                out = fn(out, env[extra_in], name=fold_nm)
         elif op in ("Greater", "Less"):
             fn = (sym_mod.broadcast_greater if op == "Greater"
                   else sym_mod.broadcast_lesser)

@@ -1,51 +1,668 @@
-"""Engine facade.
+"""Execution engine: op-bulking, sync fences, and engine-type selection.
 
 Parity target: [U:src/engine/] + [U:python/mxnet/engine.py].  The reference's
-ThreadedEnginePerDevice (async dataflow scheduler over per-device worker
-threads and CUDA streams) is played here by XLA/PJRT's async dispatch: every
-op returns a future-backed ``jax.Array`` and XLA orders execution by data
-dependence, which is exactly the engine's var-version dependency rule.  What
-remains of the engine API:
+ThreadedEnginePerDevice is an async dataflow scheduler: ops are pushed with
+read/write var lists, execute out-of-line on per-device worker threads, and
+``MXNET_EXEC_BULK_EXEC_MAX_NODE_TRAIN`` lets it segment the dependency graph
+into *bulks* dispatched as one unit to amortize per-op overhead.  Here the
+async half is played by XLA/PJRT (every op returns a future-backed
+``jax.Array``; data dependence orders execution), and this module supplies
+the other half for real:
 
-* ``waitall`` — fence (``Engine::WaitForAll``)
-* ``bulk(size)`` — op-bulking hint; XLA fusion subsumes it, kept as a no-op
-  scope for script compat
+* ``bulk(size)`` — **op-bulking scope**.  Eligible eager op calls inside the
+  scope are NOT dispatched one by one; they are appended to a per-thread
+  micro-graph whose outputs are lightweight :class:`DeferredArray`
+  placeholders (shape/dtype known via a cached ``jax.eval_shape``, no
+  compute issued).  The whole micro-graph is compiled ONCE per graph shape
+  (LRU-cached ``jax.jit``) and executed as a single XLA program when a
+  flush trigger fires:
+
+    - the bulk scope exits,
+    - the accumulated op count reaches the bulk size cap,
+    - a value is demanded (``wait_to_read``/``asnumpy``/``__repr__``/
+      ``float()``… — anything that touches a DeferredArray's data),
+    - an ineligible call consumes a deferred input (autograd recording,
+      tracers, unregistered closures, PRNG-consuming ops, AMP),
+    - ``waitall()``.
+
+  Steady-state training loops therefore pay one cached-executable launch
+  per ``bulk_size`` eager ops — the engine-parity semantics the old stub
+  only documented.  ``MXNET_EAGER_BULK=1`` turns ambient bulking on outside
+  explicit scopes (cap = ``MXNET_EXEC_BULK_EXEC_MAX_NODE_TRAIN``).
+
+* ``waitall`` — fence (``Engine::WaitForAll``): flushes pending bulks, then
+  blocks on every local device queue.
+
 * naive/sync mode — ``set_engine_type('NaiveEngine')`` maps to
-  ``jax.disable_jit`` + eager blocking, the reference's ``MXNET_ENGINE_TYPE``
-  debug bisection knob
+  ``jax.disable_jit`` + eager blocking dispatch and BYPASSES both the
+  level-1 dispatch cache (ops/registry.py) and bulking, the reference's
+  ``MXNET_ENGINE_TYPE`` debug-bisection knob.
+
+See docs/eager_dispatch.md for the full dispatch-path decision tree.
 """
 from __future__ import annotations
 
 import contextlib
 import os
+import threading
+import weakref
+from collections import OrderedDict
 
-__all__ = ["waitall", "bulk", "set_bulk_size", "engine_type", "set_engine_type"]
+import jax as _jax
+import numpy as _np
+
+from . import profiler as _profiler
+
+__all__ = ["waitall", "bulk", "set_bulk_size", "engine_type", "set_engine_type",
+           "DeferredArray", "active_queue", "flush_pending", "flush_all",
+           "resolve"]
 
 _engine_type = os.environ.get("MXNET_ENGINE_TYPE", "ThreadedEnginePerDevice")
 _bulk_size = int(os.environ.get("MXNET_EXEC_BULK_EXEC_MAX_NODE_TRAIN", 15))
+_ambient = os.environ.get("MXNET_EAGER_BULK", "0") == "1"
+_MAX_FLUSH_JITS = int(os.environ.get("MXNET_EAGER_BULK_CACHE_SIZE", "128"))
+
+_tls = threading.local()
+
+# number of live bulk() scopes across all threads — a one-attr-read
+# pre-filter for ndarray.invoke so the no-bulking hot path pays nothing
+_bulk_scopes = 0
+_scope_lock = threading.Lock()
+
+_JArray = _jax.Array
+_JTracer = _jax.core.Tracer
+# exact-type scalar set mirroring registry._SCALAR_TYPES: scalars are the
+# second-most-common enqueue argument after pending deferreds, and an exact
+# type test dodges the jax.Array ABC __instancecheck__ in _wire_value
+_SCALAR_TYPES = frozenset((bool, int, float, complex, str, type(None)))
+
+
+def is_naive():
+    return _engine_type == "NaiveEngine"
+
+
+# ---------------------------------------------------------------------------
+# Deferred arrays
+# ---------------------------------------------------------------------------
+
+
+class DeferredArray:
+    """Placeholder for one output of a pending bulked op.
+
+    Knows its aval (shape/dtype) without any compute; any access to the
+    actual data (``__array__``, ``block_until_ready``, or attribute
+    delegation) flushes the owning micro-graph first.  ``ndarray.invoke``
+    swaps the concrete array into the owning NDArray on first touch, so the
+    indirection disappears after resolution.
+    """
+
+    __slots__ = ("_queue", "_aval", "_concrete", "_src", "_tok",
+                 "__weakref__")
+
+    def __init__(self, queue, aval, src=None, tok=None):
+        self._queue = queue
+        self._aval = aval
+        self._concrete = None
+        self._src = src  # (op index, output index) within the pending graph
+        self._tok = tok  # precomputed (shape, dtype, weak_type) key token
+
+    # -- lazy metadata (no flush) ------------------------------------
+    @property
+    def shape(self):
+        return tuple(self._aval.shape)
+
+    @property
+    def dtype(self):
+        return self._aval.dtype
+
+    @property
+    def ndim(self):
+        return len(self._aval.shape)
+
+    @property
+    def size(self):
+        s = 1
+        for d in self._aval.shape:
+            s *= d
+        return s
+
+    @property
+    def aval(self):
+        return self._aval
+
+    # -- forcing ------------------------------------------------------
+    def _resolve(self):
+        if self._concrete is None:
+            self._queue.flush()
+            if self._concrete is None:
+                # the flush that should have produced this value failed (its
+                # exception surfaced to whoever triggered it) and the queue
+                # is already drained — fail loudly instead of returning None
+                raise RuntimeError(
+                    "bulked op failed: this DeferredArray belongs to a "
+                    "micro-graph whose flush raised; its value was never "
+                    "computed")
+        return self._concrete
+
+    def block_until_ready(self):
+        return self._resolve().block_until_ready()
+
+    def __array__(self, dtype=None, copy=None):
+        import numpy as np
+
+        a = np.asarray(self._resolve())
+        return a.astype(dtype) if dtype is not None else a
+
+    def __getattr__(self, name):
+        # anything beyond the lazy surface delegates to the concrete array
+        # (forcing a flush): .at, .astype, .devices, arithmetic helpers …
+        if name.startswith("__"):
+            raise AttributeError(name)
+        return getattr(self._resolve(), name)
+
+    def __repr__(self):
+        if self._concrete is not None:
+            return repr(self._concrete)
+        return f"<DeferredArray {self.shape} {self.dtype} pending>"
+
+
+def _forward_dunder(name):
+    # implicit special-method lookup skips __getattr__ (the interpreter
+    # resolves dunders on the type), so each one needs a real class attr;
+    # deferred operands are resolved directly instead of round-tripping
+    # through __array__ (which would detour via host numpy)
+    def fwd(self, *args):
+        args = tuple(a._resolve() if type(a) is DeferredArray else a
+                     for a in args)
+        return getattr(self._resolve(), name)(*args)
+    fwd.__name__ = name
+    fwd.__qualname__ = f"DeferredArray.{name}"
+    return fwd
+
+
+# container/conversion/operator protocol for direct consumers of
+# NDArray._data (sparse kernels, autograd grad accumulation, executor copy
+# paths) that index or combine the raw array without going through invoke().
+# __eq__/__ne__ are installed by setattr AFTER class creation deliberately:
+# an in-class __eq__ would null out __hash__, and the engine keys pending
+# deferreds by identity (weakrefs in _PendingOp.outs).
+for _nm in (
+    "__getitem__", "__len__", "__iter__", "__contains__",
+    "__bool__", "__float__", "__int__", "__index__", "__complex__",
+    "__neg__", "__pos__", "__abs__", "__invert__",
+    "__add__", "__radd__", "__sub__", "__rsub__", "__mul__", "__rmul__",
+    "__truediv__", "__rtruediv__", "__floordiv__", "__rfloordiv__",
+    "__mod__", "__rmod__", "__pow__", "__rpow__",
+    "__matmul__", "__rmatmul__", "__divmod__", "__rdivmod__",
+    "__and__", "__rand__", "__or__", "__ror__", "__xor__", "__rxor__",
+    "__lshift__", "__rlshift__", "__rshift__", "__rrshift__",
+    "__lt__", "__le__", "__gt__", "__ge__", "__eq__", "__ne__",
+):
+    setattr(DeferredArray, _nm, _forward_dunder(_nm))
+del _nm
+
+
+def resolve(x):
+    """Concrete jax.Array for ``x`` (flushing if it is a pending deferred)."""
+    if isinstance(x, DeferredArray):
+        return x._resolve()
+    return x
+
+
+# ---------------------------------------------------------------------------
+# Bulk queue
+# ---------------------------------------------------------------------------
+
+_flush_jits: OrderedDict = OrderedDict()  # graph key -> jitted program
+_aval_cache: dict = {}                    # per-op key -> output avals
+_flush_lock = threading.Lock()
+# every live _BulkQueue (one per thread that ever bulked), so waitall() can
+# fence other threads' pending micro-graphs; guarded by _scope_lock
+_all_queues: weakref.WeakSet = weakref.WeakSet()
+
+_registry_mod = None
+
+
+def _registry():
+    """ops.registry, imported on first bulked enqueue (module-level import
+    would drag the whole ops package in before engine config is read)."""
+    global _registry_mod
+    if _registry_mod is None:
+        from .ops import registry as _r
+
+        _registry_mod = _r
+    return _registry_mod
+
+
+class _PendingOp:
+    __slots__ = ("fn", "wiring", "static_kw", "dyn_kw", "n_out", "key",
+                 "outs", "avals")
+
+    def __init__(self, fn, wiring, static_kw, dyn_kw, n_out, key):
+        self.fn = fn
+        self.wiring = wiring        # per positional arg: ('d',op,out)|('c',slot)|('s',value)
+        self.static_kw = static_kw  # dict of baked kwargs
+        self.dyn_kw = dyn_kw        # list of (name, ('c',slot)|('d',op,out))
+        self.n_out = n_out
+        self.key = key              # hashable token incl. fn + wiring + avals
+        self.outs = None            # weakref per output DeferredArray
+        self.avals = None           # output avals (outlive the deferreds)
+
+
+def _spec_of(ops):
+    """Graph spec reused by the jitted program — holds no DeferredArray
+    references, so cached programs don't pin flushed buffers."""
+    return tuple((op.fn, op.wiring, tuple(sorted(op.static_kw.items())),
+                  tuple(op.dyn_kw), op.n_out) for op in ops)
+
+
+def _run_spec(spec, consts, live):
+    """Execute the graph, returning only the ``live``-masked outputs.
+
+    Every op still runs in trace order (they are pure registered fns), but
+    only outputs whose DeferredArray is still referenced are returned — so
+    under jit XLA dead-code-eliminates the intermediates and the 64-op
+    chain compiles to one fused kernel with one output buffer instead of
+    materializing all 64."""
+    env = []
+    for fn, wiring, static_kw, dyn_kw, n_out in spec:
+        args = []
+        for w in wiring:
+            if w[0] == "d":
+                args.append(env[w[1]][w[2]])
+            elif w[0] == "c":
+                args.append(consts[w[1]])
+            else:
+                args.append(w[1])
+        kw = dict(static_kw)
+        for name, src in dyn_kw:
+            kw[name] = env[src[1]][src[2]] if src[0] == "d" else consts[src[1]]
+        out = fn(*args, **kw)
+        env.append(out if isinstance(out, tuple) else (out,))
+    return [o for outs, lv in zip(env, live) for o, alive in zip(outs, lv)
+            if alive]
+
+
+def _program(spec, live):
+    def run(consts):
+        return _run_spec(spec, consts, live)
+    return run
+
+
+class _BulkQueue:
+    """Per-thread micro-graph of deferred eager ops."""
+
+    def __init__(self):
+        self.ops = []
+        self.consts = []
+        self._lock = threading.RLock()
+
+    # -- classification helpers --------------------------------------
+    def _wire_value(self, v, jax, key_parts):
+        """Wiring + key token for one dynamic input value, or None if the
+        value can't participate."""
+        if isinstance(v, DeferredArray):
+            if v._concrete is not None:
+                v = v._concrete  # fall through to the concrete case
+            elif v._queue is self and v._src is not None:
+                # _src is the ("d", i, j) wiring tuple and _tok the aval
+                # token, both precomputed at creation: the hot chain case
+                # (op output feeding the next op) appends two existing
+                # refs instead of rebuilding tuples from property reads.
+                # The aval token matters: the per-op _aval_cache key must
+                # stand alone, and (i, j) alone says nothing about the
+                # upstream output's shape in a different graph prefix.
+                src = v._src
+                key_parts.append((src, v._tok))
+                return src
+            else:
+                v = v._resolve()  # cross-thread deferred: force it
+        tv = type(v)
+        if tv in _SCALAR_TYPES:
+            # STATIC, keyed by type+value — matching level 1's
+            # _classify_args: shipping scalars as jit operands costs one
+            # host->device buffer commit per scalar per flush (~64 puts for
+            # a 64-op chain, dwarfing the whole dispatch win); distinct
+            # literals recompile, bounded by the _flush_jits LRU
+            if (tv is float or tv is complex) and v == 0:
+                key_parts.append(("s", tv, v, str(v)))  # -0.0 vs 0.0
+            else:
+                key_parts.append(("s", tv, v))
+            return ("s", v)
+        if isinstance(v, _JTracer):
+            return None
+        if isinstance(v, _JArray):
+            self.consts.append(v)
+            key_parts.append(("a", v.shape, v.dtype,
+                              v.aval.weak_type, v.sharding))
+            return ("c", len(self.consts) - 1)
+        if isinstance(v, _np.ndarray):
+            self.consts.append(v)
+            key_parts.append(("n", v.shape, v.dtype.str))
+            return ("c", len(self.consts) - 1)
+        if isinstance(v, (bool, int, float, complex, str, _np.generic)):
+            # scalar SUBCLASS (IntEnum, np.float64 — a float subclass …) or
+            # numpy scalar: the shared level-1/level-2 keying rule
+            key_parts.append(("s", _registry()._scalar_token(type(v), v)))
+            return ("s", v)
+        try:
+            key_parts.append(("s", _registry()._static_token(v)))
+        except TypeError:
+            return None
+        return ("s", v)
+
+    def enqueue(self, fn, raw_args, kwargs):
+        """Try to defer ``fn(*raw_args, **kwargs)``.  Returns a tuple of
+        DeferredArray outputs, or None when the call must run eagerly."""
+        reg = _registry_mod
+        if reg is None:
+            reg = _registry()
+        prng = reg._PRNG_FNS.get(fn)
+        if prng is None:
+            if fn not in reg._CACHEABLE_FNS:
+                return None
+            prng = reg._PRNG_FNS[fn] = reg._reads_ambient_prng(fn)
+        if prng and kwargs.get("key") is None:
+            return None
+        jax = _jax
+
+        # Resolve foreign (other-queue) and poisoned deferreds BEFORE taking
+        # our lock: v._resolve() flushes the OWNING queue under ITS lock, and
+        # doing that while holding ours is an ABBA deadlock when two threads
+        # consume each other's pending outputs.  After this scan, everything
+        # _wire_value sees under the lock is own-queue-pending or concrete.
+        for a in raw_args:
+            if type(a) is DeferredArray and a._concrete is None \
+                    and (a._queue is not self or a._src is None):
+                a._resolve()
+        if kwargs:
+            for v in kwargs.values():
+                if type(v) is DeferredArray and v._concrete is None \
+                        and (v._queue is not self or v._src is None):
+                    v._resolve()
+
+        with self._lock:
+            n_consts0 = len(self.consts)
+            key_parts = [fn]  # head: fn identity (never a tuple, no collision)
+            wiring = []
+            for a in raw_args:
+                # inlined _wire_value fast cases — a pending deferred from
+                # this queue (op output feeding the next op, the shape of
+                # every chain) costs two ref appends, and a python scalar
+                # (the other operand of nearly every chain op) one exact
+                # type test — no function call, no ABC isinstance cascade
+                ta = type(a)
+                if ta is DeferredArray:
+                    if a._concrete is None and a._queue is self \
+                            and a._src is not None:
+                        key_parts.append((a._src, a._tok))
+                        wiring.append(a._src)
+                        continue
+                elif ta in _SCALAR_TYPES:
+                    if (ta is float or ta is complex) and a == 0:
+                        # -0.0 == 0.0 and they hash alike, but baking the
+                        # wrong zero flips signs (x / -0.0); str() splits them
+                        key_parts.append(("s", ta, a, str(a)))
+                    else:
+                        key_parts.append(("s", ta, a))
+                    wiring.append(("s", a))
+                    continue
+                w = self._wire_value(a, jax, key_parts)
+                if w is None:
+                    del self.consts[n_consts0:]
+                    return None
+                wiring.append(w)
+            static_kw, dyn_kw = {}, []
+            if kwargs:
+                for k in sorted(kwargs):
+                    v = kwargs[k]
+                    if isinstance(v, (_JArray, DeferredArray)) \
+                            and not isinstance(v, _JTracer):
+                        key_parts.append(("kw", k))
+                        w = self._wire_value(v, jax, key_parts)
+                        if w is None or w[0] == "s":
+                            del self.consts[n_consts0:]
+                            return None
+                        dyn_kw.append((k, w))
+                    else:
+                        try:
+                            key_parts.append(("ks", k, reg._static_token(v)))
+                        except TypeError:
+                            del self.consts[n_consts0:]
+                            return None
+                        static_kw[k] = v
+
+            op_key = tuple(key_parts)
+            inferred = self._infer_avals(fn, wiring, static_kw, dyn_kw, op_key, jax)
+            if inferred is None:
+                del self.consts[n_consts0:]
+                return None
+            avals, is_tuple, toks = inferred
+
+            op = _PendingOp(fn, tuple(wiring), static_kw, dyn_kw,
+                            len(avals), op_key)
+            i = len(self.ops)
+            # the queue holds only WEAK refs to its outputs: a deferred the
+            # caller has dropped by flush time is provably unreadable, so
+            # the flush program need not return it (XLA DCEs the buffer)
+            if len(avals) == 1:  # single output: skip the genexpr machinery
+                d = DeferredArray(self, avals[0], ("d", i, 0), toks[0])
+                outs = (d,)
+                op.outs = (weakref.ref(d),)
+            else:
+                outs = tuple(DeferredArray(self, av, ("d", i, j), tok)
+                             for j, (av, tok) in enumerate(zip(avals, toks)))
+                op.outs = tuple(weakref.ref(o) for o in outs)
+            op.avals = avals
+            self.ops.append(op)
+            # effective cap: the per-thread scope cap when inside bulk(),
+            # else the global ambient cap (enqueue only runs on the owner
+            # thread, so _tls here is the right thread's state)
+            cap = (_tls.bulk_cap if getattr(_tls, "bulk_depth", 0) > 0
+                   else _bulk_size)
+            full = i + 1 >= cap
+        if full:
+            self.flush()
+        return outs, is_tuple
+
+    def _infer_avals(self, fn, wiring, static_kw, dyn_kw, op_key, jax):
+        """Output avals via a cached eval_shape keyed like the flush jit —
+        steady-state enqueues are a dict hit, no tracing."""
+        cached = _aval_cache.get(op_key)
+        if cached is not None:
+            return cached
+
+        def arg_aval(w):
+            if w[0] == "d":
+                return self.ops[w[1]].avals[w[2]]
+            if w[0] == "c":
+                v = self.consts[w[1]]
+                if isinstance(v, jax.Array):
+                    return jax.ShapeDtypeStruct(v.shape, v.dtype,
+                                                weak_type=bool(v.aval.weak_type))
+                return v  # numpy / python scalar: eval_shape takes it as-is
+            return None
+
+        dyn_avals = []
+        for w in wiring:
+            if w[0] != "s":
+                dyn_avals.append(arg_aval(w))
+        kw_avals = [arg_aval(w) for _, w in dyn_kw]
+
+        def probe(dyn, kw_vals):
+            it = iter(dyn)
+            args = [next(it) if w[0] != "s" else w[1] for w in wiring]
+            kw = dict(static_kw)
+            kw.update((name, v) for (name, _), v in zip(dyn_kw, kw_vals))
+            return fn(*args, **kw)
+
+        try:
+            res = jax.eval_shape(probe, tuple(dyn_avals), tuple(kw_avals))
+        except Exception:
+            return None
+        is_tuple = isinstance(res, tuple)
+        avals = res if is_tuple else (res,)
+        if not all(hasattr(a, "shape") and hasattr(a, "dtype") for a in avals):
+            return None  # exotic output structure: stay on the raw path
+        if len(_aval_cache) > 8192:
+            _aval_cache.clear()
+        # key tokens cached alongside so enqueue hands them to the output
+        # DeferredArrays for free (downstream wiring appends them as refs)
+        toks = tuple((tuple(a.shape), a.dtype,
+                      bool(getattr(a, "weak_type", False))) for a in avals)
+        inferred = (tuple(avals), is_tuple, toks)
+        _aval_cache[op_key] = inferred
+        return inferred
+
+    def flush(self):
+        jax = _jax
+        profiler = _profiler
+
+        # The queue lock is held through execution AND result assignment:
+        # the owner thread's enqueue can never observe a half-flushed queue
+        # (it would wire ('d', i, j) indices into a cleared ops list), and a
+        # cross-thread _resolve blocks here until the concrete it needs is
+        # assigned.  Lock order is queue lock -> _flush_lock, and no code
+        # path touches a FOREIGN queue's lock while holding its own
+        # (enqueue resolves foreign deferreds before locking), so no cycle
+        # is possible.
+        with self._lock:
+            if not self.ops:
+                return
+            ops, consts = self.ops, self.consts
+            self.ops, self.consts = [], []
+            # liveness snapshot: dereffed again at assignment, so a deferred
+            # dying between here and there just wastes one program output
+            live = tuple(tuple(wr() is not None for wr in op.outs)
+                         for op in ops)
+            graph_key = (tuple(op.key for op in ops), live)
+            with _flush_lock:
+                jitted = _flush_jits.get(graph_key)
+                if jitted is None:
+                    # spec built only on compile (and fallback below): the
+                    # steady-state flush is just this dict hit + one pjit call
+                    jitted = jax.jit(_program(_spec_of(ops), live))
+                    _flush_jits[graph_key] = jitted
+                    while len(_flush_jits) > _MAX_FLUSH_JITS:
+                        _flush_jits.popitem(last=False)
+                else:
+                    _flush_jits.move_to_end(graph_key)
+            try:
+                results = jitted(consts)
+            except Exception:
+                # jit artifact or genuine user error: re-run the graph
+                # eagerly; genuine errors surface with eager semantics
+                profiler.incr("bulk_fallback")
+                with _flush_lock:
+                    _flush_jits.pop(graph_key, None)
+                try:
+                    results = _run_spec(_spec_of(ops), consts, live)
+                except Exception:
+                    # the flush is lost (ops already drained): poison the
+                    # surviving outputs so a later enqueue can't wire their
+                    # stale ('d', i, j) indices into a fresh graph — reads
+                    # hit _resolve()'s RuntimeError guard instead
+                    for op in ops:
+                        for wr in op.outs:
+                            d = wr()
+                            if d is not None:
+                                d._src = None
+                    raise
+            profiler.incr("bulk_flush")
+            profiler.incr("bulk_ops_flushed", len(ops))
+            k = 0
+            for op, lv in zip(ops, live):
+                for wr, alive in zip(op.outs, lv):
+                    if alive:
+                        d = wr()
+                        if d is not None:
+                            d._concrete = results[k]
+                        k += 1
+
+
+def active_queue():
+    """This thread's bulk queue when eager ops should accumulate, else None.
+    One merged check for ndarray.invoke (which pre-filters on
+    ``_bulk_scopes``/``_ambient`` so the no-bulking hot path never gets
+    here): engine type, scope depth, cap, and the TLS queue in one call.
+    The cap is per-thread inside explicit ``bulk()`` scopes (concurrent
+    scopes on different threads must not clobber each other) and the global
+    ``set_bulk_size`` value in ambient mode."""
+    if _engine_type == "NaiveEngine":
+        return None
+    if getattr(_tls, "bulk_depth", 0) > 0:
+        cap = _tls.bulk_cap
+    elif _ambient:
+        cap = _bulk_size
+    else:
+        return None
+    if cap <= 1:
+        return None
+    q = getattr(_tls, "queue", None)
+    if q is None:
+        q = _tls.queue = _BulkQueue()
+        with _scope_lock:
+            _all_queues.add(q)
+    return q
+
+
+def flush_pending():
+    """Flush this thread's pending bulk (sync points, recording starts)."""
+    q = getattr(_tls, "queue", None)
+    if q is not None:
+        q.flush()
+
+
+def flush_all():
+    """Flush EVERY thread's pending bulk — the ``waitall`` fence and global
+    semantic flips (``set_engine_type``) must not leave another thread's
+    deferred micro-graph undispatched."""
+    with _scope_lock:
+        queues = list(_all_queues)
+    for q in queues:
+        q.flush()
+
+
+# ---------------------------------------------------------------------------
+# Public engine API
+# ---------------------------------------------------------------------------
 
 
 def waitall():
     from .ndarray.ndarray import waitall as _w
 
-    _w()
+    _w()  # its first act is flush_all(): every thread's bulk dispatches
 
 
 @contextlib.contextmanager
 def bulk(size):
-    """Bulk-execution scope (parity: ``mx.engine.bulk``).  XLA fuses traced
-    regions automatically; this scope is retained for API compatibility."""
-    global _bulk_size
-    prev, _bulk_size = _bulk_size, size
+    """Bulk-execution scope (parity: ``mx.engine.bulk``): inside the scope
+    eligible eager ops accumulate into a micro-graph flushed as ONE compiled
+    program at scope exit, at the ``size`` cap, or at any read of a pending
+    value.  ``size <= 1`` makes the scope a no-op.  The cap is THREAD-LOCAL:
+    concurrent scopes on other threads keep their own caps."""
+    global _bulk_scopes
+    prev_cap = getattr(_tls, "bulk_cap", 0)
+    _tls.bulk_cap = int(size)
+    _tls.bulk_depth = getattr(_tls, "bulk_depth", 0) + 1
+    with _scope_lock:
+        _bulk_scopes += 1
     try:
         yield
     finally:
-        _bulk_size = prev
+        with _scope_lock:
+            _bulk_scopes -= 1
+        _tls.bulk_depth -= 1
+        flush_pending()
+        _tls.bulk_cap = prev_cap
 
 
 def set_bulk_size(size):
+    """Set the AMBIENT bulk cap (the flush threshold under
+    ``MXNET_EAGER_BULK=1``; explicit ``bulk(size)`` scopes carry their own
+    per-thread cap); returns the previous value."""
     global _bulk_size
-    prev, _bulk_size = _bulk_size, size
+    prev, _bulk_size = _bulk_size, int(size)
     return prev
 
 
@@ -54,10 +671,12 @@ def engine_type():
 
 
 def set_engine_type(name):
-    """'NaiveEngine' => synchronous, jit-free debug mode."""
+    """'NaiveEngine' => synchronous, jit-free debug mode: disables jax jit,
+    the dispatch cache, and op-bulking in one switch."""
     global _engine_type
     import jax
 
+    flush_all()
     prev = _engine_type
     _engine_type = name
     if name == "NaiveEngine":

@@ -32,6 +32,7 @@ import numpy as _np
 from .. import autograd
 from .. import ndarray as nd_mod
 from ..context import current_context
+from ..engine import DeferredArray as _Deferred
 from ..ndarray.ndarray import NDArray
 from ..random import get_key, push_traced_key, pop_traced_key
 from .parameter import Parameter, ParameterDict
@@ -508,6 +509,13 @@ class HybridBlock(Block):
         key = get_key()
         raw_params = [p._data for p in params]  # NDArray leaves (tape prov)
         all_inputs = list(args) + raw_params
+        # inputs produced inside an engine.bulk() scope may hold pending
+        # DeferredArrays — jit_fn consumes raw jax arrays directly (this path
+        # bypasses ndarray.invoke's resolve loop), so force them here
+        for a in all_inputs:
+            d = a._data
+            if isinstance(d, _Deferred):
+                a._data = d._resolve()
 
         def fn(*arrs, _jit=jit_fn, _key=key):
             return _jit(_key, *arrs)

@@ -100,7 +100,8 @@ class FeedForward:
         it = self._as_iter(X, y, shuffle=True)
         if self.epoch_size is not None:
             it = io_mod.ResizeIter(it, self.epoch_size)
-        num_epoch = self.num_epoch if self.num_epoch is not None else             self.begin_epoch + 1
+        num_epoch = (self.num_epoch if self.num_epoch is not None
+                     else self.begin_epoch + 1)
         if num_epoch <= self.begin_epoch:
             logging.getLogger(__name__).warning(
                 "FeedForward.fit: num_epoch (%d) <= begin_epoch (%d) — "

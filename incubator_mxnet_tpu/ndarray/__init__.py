@@ -62,7 +62,11 @@ def _rewrap_nested(x):
     return x
 
 
-def _make_wrapper(op):
+def _make_wrapper(op, name=None):
+    # `name` is the registry name this wrapper was reached by — aliases
+    # share one Op object (see ops/registry.alias), so op.name may be the
+    # canonical spelling while the wrapper keeps the requested one.
+    name = name or op.name
     if not op.wrap_ndarray:
         # raw kernels (multi-tensor optimizer updates, all_finite …): accept
         # NDArrays anywhere — including inside list arguments — and return
@@ -74,13 +78,13 @@ def _make_wrapper(op):
             kwargs = {k: _unwrap_nested(v) for k, v in kwargs.items()}
             return _rewrap_nested(op.fn(*args, **kwargs))
 
-        raw_wrapper.__name__ = op.name
-        raw_wrapper.__qualname__ = f"nd.{op.name}"
+        raw_wrapper.__name__ = name
+        raw_wrapper.__qualname__ = f"nd.{name}"
         raw_wrapper.__doc__ = op.doc
         return raw_wrapper
 
     def wrapper(*args, out=None, **kwargs):
-        res = invoke(op.fn, args, kwargs, name=op.name)
+        res = invoke(op.fn, args, kwargs, name=op.name)  # canonical name: one amp/profile bucket per fn
         if out is not None:
             if isinstance(res, list):
                 raise ValueError("out= unsupported for multi-output ops")
@@ -89,8 +93,8 @@ def _make_wrapper(op):
             return out
         return res
 
-    wrapper.__name__ = op.name
-    wrapper.__qualname__ = f"nd.{op.name}"
+    wrapper.__name__ = name
+    wrapper.__qualname__ = f"nd.{name}"
     wrapper.__doc__ = op.doc
     return wrapper
 
@@ -121,7 +125,7 @@ def __getattr__(name):
         op = _registry.get_op(name)
     except KeyError:
         raise AttributeError(f"module 'nd' has no operator {name!r}") from None
-    w = _make_wrapper(op)
+    w = _make_wrapper(op, name)
     _WRAPPER_CACHE[name] = w
     return w
 

@@ -25,7 +25,10 @@ import jax.numpy as jnp
 from ..base import _as_np_dtype
 from ..context import Context, current_context, cpu
 from .. import autograd
-from ..ops.registry import get_op
+from .. import engine as _engine
+from ..engine import DeferredArray as _Deferred
+from ..ops import registry as _registry
+from ..ops.registry import MISS as _MISS, get_op
 
 _amp = None  # set by mx.amp.init(); consulted in invoke()
 
@@ -38,8 +41,8 @@ def _is_tracer(x):
 
 def _place(data, ctx):
     """Commit ``data`` to ``ctx``'s jax device when they differ (no-op for
-    tracers and already-resident arrays)."""
-    if ctx is None or _is_tracer(data):
+    tracers, pending deferred bulk outputs, and already-resident arrays)."""
+    if ctx is None or _is_tracer(data) or isinstance(data, _Deferred):
         return data
     dev = ctx.jax_device()
     try:
@@ -62,7 +65,15 @@ class NDArray:
     def __init__(self, data, ctx=None, dtype=None):
         if isinstance(data, NDArray):
             data = data._data
-        if not isinstance(data, (jax.Array, jax.core.Tracer)):
+        if isinstance(data, _Deferred):
+            if data._concrete is not None:
+                data = data._concrete
+            elif ctx is not None:
+                # explicit placement request (as_in_context / copyto(Context)
+                # / copy()): deferred values are never device-placed, so
+                # force the flush and let _place below honor the ctx
+                data = data._resolve()
+        if not isinstance(data, (jax.Array, jax.core.Tracer, _Deferred)):
             data = jnp.asarray(data, dtype=_as_np_dtype(dtype))
         elif dtype is not None and data.dtype != _as_np_dtype(dtype):
             data = data.astype(_as_np_dtype(dtype))
@@ -129,7 +140,10 @@ class NDArray:
     # ------------------------------------------------------------------
     def wait_to_read(self):
         """Block until the value is materialized (parity:
-        ``Engine::WaitForVar`` via [U:src/ndarray/ndarray.cc])."""
+        ``Engine::WaitForVar`` via [U:src/ndarray/ndarray.cc]).  A pending
+        bulked op is flushed first (engine.bulk flush-on-read)."""
+        if isinstance(self._data, _Deferred):
+            self._data = self._data._resolve()
         if not _is_tracer(self._data):
             self._data.block_until_ready()
         return self
@@ -140,6 +154,8 @@ class NDArray:
     # host transfer
     # ------------------------------------------------------------------
     def asnumpy(self):
+        if isinstance(self._data, _Deferred):
+            self._data = self._data._resolve()
         return _np.asarray(self._data)
 
     def asscalar(self):
@@ -193,7 +209,9 @@ class NDArray:
         raise TypeError(f"cannot copy to {type(other)}")
 
     def copy(self):
-        return NDArray(self._data, ctx=self._ctx)
+        # same-ctx duplicate of an immutable buffer: no placement needed, so
+        # a pending deferred stays deferred (NDArray.__init__ would flush)
+        return _wrap_fast(self._data, self._ctx)
 
     def as_in_context(self, ctx):
         if ctx == self._ctx:
@@ -204,8 +222,9 @@ class NDArray:
     to_device = as_in_context
 
     def detach(self):
-        out = NDArray(self._data, ctx=self._ctx)
-        return out
+        # drops provenance only; same ctx, no placement — keep a pending
+        # deferred pending (detach inside a bulk scope must not flush)
+        return _wrap_fast(self._data, self._ctx)
 
     # ------------------------------------------------------------------
     # autograd
@@ -530,6 +549,20 @@ def _convert_key(key):
     return key
 
 
+def _wrap_fast(data, ctx):
+    """NDArray over already-placed data without __init__'s conversion and
+    placement probes — used for pending DeferredArrays (probing would force
+    a flush) and dispatch-cache-hit outputs (already on the inputs' device)."""
+    out = object.__new__(NDArray)
+    out._data = data
+    out._ctx = ctx
+    out._grad = None
+    out._grad_req = "null"
+    out._prov = None
+    out._version = 0
+    return out
+
+
 def invoke(fn, arrays, kwargs, name="", ctx=None):
     """Execute a pure function over NDArray/scalar inputs, wrapping outputs
     and recording on the autograd tape when active.
@@ -537,33 +570,92 @@ def invoke(fn, arrays, kwargs, name="", ctx=None):
     This is the single dispatch point every operator call funnels through —
     the analog of ``MXImperativeInvokeEx → Imperative::Invoke``
     ([U:src/c_api/c_api_ndarray.cc], [U:src/imperative/imperative.cc]).
+
+    Dispatch decision tree (docs/eager_dispatch.md):
+
+    1. bulking scope active, not recording, no AMP, not NaiveEngine →
+       try to append to the engine's deferred micro-graph (level 2);
+    2. otherwise resolve any deferred inputs, then
+       recording → cached-jit vjp path in autograd.record_op, else
+       eager → cached-jit forward in ops/registry.lookup_eager (level 1);
+    3. anything ineligible (tracers inside hybridize/SPMD traces,
+       unregistered closures, PRNG-consuming ops without an explicit key,
+       unhashable kwargs, NaiveEngine) falls through to the raw fn.
     """
     raw = [a._data if isinstance(a, NDArray) else a for a in arrays]
-    # optional tensor parameters arrive as kwargs (sequence_length=,
-    # data_lengths=, mask=…): unwrap them too — they are vjp constants
-    # (no gradient flows to kwarg tensors, matching the reference's
-    # treatment of auxiliary inputs)
-    kwargs = {k: (v._data if isinstance(v, NDArray) else v)
-              for k, v in kwargs.items()}
-    if _amp is not None:
-        # mx.amp dispatch hook: per-op-list dtype casting (covers eager,
-        # hybridize traces, Symbol executors and SPMDTrainer alike, since
-        # every op funnels through here)
-        raw = _amp.cast_inputs(name, raw)
-    if ctx is None:
+    if kwargs:
+        # optional tensor parameters arrive as kwargs (sequence_length=,
+        # data_lengths=, mask=…): unwrap them too — they are vjp constants
+        # (no gradient flows to kwarg tensors, matching the reference's
+        # treatment of auxiliary inputs)
+        kwargs = {k: (v._data if isinstance(v, NDArray) else v)
+                  for k, v in kwargs.items()}
+    inferred_ctx = ctx is None
+    if inferred_ctx:
         for a in arrays:
             if isinstance(a, NDArray):
                 ctx = a._ctx
                 break
         else:
             ctx = current_context()
-    if autograd.is_recording():
+    recording = autograd.is_recording()
+
+    # _bulk_scopes/_ambient pre-check: one module-attr read in the common
+    # (no bulking anywhere) case instead of a function call per dispatch.
+    # An explicit ctx= skips bulking: deferred outputs are never placed, so
+    # honoring a cross-device request needs the probing constructor below.
+    if (not recording and _amp is None and inferred_ctx
+            and (_engine._bulk_scopes or _engine._ambient)):
+        q = _engine.active_queue()
+        deferred = q.enqueue(fn, raw, kwargs) if q is not None else None
+        if deferred is not None:
+            outs, is_tuple = deferred
+            if is_tuple:
+                return [_wrap_fast(o, ctx) for o in outs]
+            return _wrap_fast(outs[0], ctx)
+
+    # normal path: force any pending bulk outputs feeding this op, and
+    # self-heal the owning NDArrays so the indirection disappears
+    for i, r in enumerate(raw):
+        if isinstance(r, _Deferred):
+            raw[i] = r._resolve()
+            a = arrays[i]
+            if isinstance(a, NDArray) and a._data is r:
+                a._data = raw[i]
+    if kwargs:
+        for k, v in kwargs.items():
+            if isinstance(v, _Deferred):
+                kwargs[k] = v._resolve()
+
+    if _amp is not None:
+        # mx.amp dispatch hook: per-op-list dtype casting (covers eager,
+        # hybridize traces, Symbol executors and SPMDTrainer alike, since
+        # every op funnels through here)
+        raw = _amp.cast_inputs(name, raw)
+    if recording:
         outs, node = autograd.record_op(fn, raw, arrays, kwargs, name=name)
         if node is not None:
             results = [NDArray(o, ctx=ctx) for o in outs]
             for i, r in enumerate(results):
                 r._prov = (node, i)
             return results[0] if len(results) == 1 else results
+        # node is None: no input needs grad (labels, masks, metric math
+        # inside record()) — an ordinary eager call, so the level-1 cache
+        # below still applies
+    if _engine._engine_type != "NaiveEngine":
+        out = _registry.dispatch_eager(fn, raw, kwargs)
+        if out is not _MISS:
+            # compiled-entry outputs live on the inputs' device already;
+            # when ctx came from those same inputs the placement probe in
+            # NDArray.__init__ is provably a no-op — skip it.  An explicit
+            # ctx= still takes the probing constructor.
+            if inferred_ctx:
+                if isinstance(out, tuple):
+                    return [_wrap_fast(o, ctx) for o in out]
+                return _wrap_fast(out, ctx)
+            if isinstance(out, tuple):
+                return [NDArray(o, ctx=ctx) for o in out]
+            return NDArray(out, ctx=ctx)
     out = fn(*raw, **kwargs)
     if isinstance(out, tuple):
         return [NDArray(o, ctx=ctx) for o in out]
@@ -626,6 +718,7 @@ def waitall():
     earlier on that device completed.  Fence EVERY local device (the old
     single-device probe said nothing about the others), then drain any
     host-side effects."""
+    _engine.flush_all()  # dispatch every thread's deferred bulked ops first
     probes = [
         (jax.device_put(0.0, d) + 0)  # the add runs on d's compute queue
         for d in jax.local_devices()

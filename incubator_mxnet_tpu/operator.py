@@ -184,4 +184,7 @@ def _nd_custom(*args, op_type=None, **kwargs):
 # registry; inside a jitted executor the forward runs via pure_callback.
 from .ops.registry import register as _register  # noqa: E402
 
-_register("Custom", differentiable=False)(_custom_entry)
+# cacheable=False: the body runs the user's CustomOp.forward (arbitrary
+# stateful python) — it must never be frozen into a dispatch-cache entry or
+# a bulked micro-graph
+_register("Custom", differentiable=False, cacheable=False)(_custom_entry)

@@ -13,12 +13,13 @@ from __future__ import annotations
 
 import atexit
 import os
+import threading as _threading
 import time
 
 import jax
 
 __all__ = ["set_config", "start", "stop", "dump", "dumps", "pause", "resume",
-           "scope", "Marker", "state"]
+           "scope", "Marker", "state", "counters", "reset_counters", "incr"]
 
 _config = {
     "filename": "profile.json",   # reference default profile_output.json-ish
@@ -37,6 +38,45 @@ def _tally(name, dur):
     cnt_tot = _agg.setdefault(name, [0, 0.0])
     cnt_tot[0] += 1
     cnt_tot[1] += dur
+
+
+# -- dispatch/engine event counters -----------------------------------------
+# The eager dispatch accelerator (ops/registry.py cache + engine.py bulking)
+# reports its behavior here so the win is observable: cache hits/misses,
+# raw-path bypasses, jit fallbacks, and bulk flush sizes.  Plain int adds —
+# cheap enough to stay on even when tracing is off.
+
+_counters = {
+    "dispatch_cache_hit": 0,
+    "dispatch_cache_miss": 0,
+    "dispatch_cache_bypass": 0,
+    "dispatch_cache_fallback": 0,
+    "bulk_flush": 0,
+    "bulk_ops_flushed": 0,
+    "bulk_fallback": 0,
+}
+_counter_lock = _threading.Lock()
+
+
+def incr(name, n=1):
+    # locked: the engine supports concurrent per-thread bulk queues, and a
+    # bare read-modify-write would drop increments across threads (tests
+    # pin exact counts); ~100ns next to a ~10us dispatch
+    with _counter_lock:
+        _counters[name] = _counters.get(name, 0) + n
+
+
+def counters():
+    """Snapshot of the dispatch/bulking counters (parity-adjacent to the
+    reference's engine op counters; see docs/eager_dispatch.md)."""
+    with _counter_lock:
+        return dict(_counters)
+
+
+def reset_counters():
+    with _counter_lock:
+        for k in _counters:
+            _counters[k] = 0
 
 
 def set_config(**kwargs):
@@ -159,6 +199,11 @@ def dumps(reset=False):
              f"{'Name':<40}{'Count':>8}{'Total(ms)':>12}{'Avg(ms)':>12}"]
     for name, (cnt, tot) in sorted(_agg.items(), key=lambda kv: -kv[1][1]):
         lines.append(f"{name:<40}{cnt:>8}{tot * 1e3:>12.3f}{tot / cnt * 1e3:>12.3f}")
+    if any(_counters.values()):
+        lines.append("")
+        lines.append("Dispatch counters:")
+        for name, v in sorted(_counters.items()):
+            lines.append(f"{name:<40}{v:>8}")
     if _state["dir"]:
         dev = _device_op_stats(_state["dir"])
         if dev:
@@ -171,6 +216,10 @@ def dumps(reset=False):
             lines.append(f"(no device-op detail captured; trace dir: {_state['dir']})")
     if reset:
         _agg.clear()
+        # the dump shows the dispatch/bulk counters too, so a reset must
+        # cover them — otherwise per-interval dumps mix fresh marker stats
+        # with cumulative cache/bulk numbers
+        reset_counters()
     return "\n".join(lines)
 
 

@@ -472,8 +472,13 @@ class ZoneoutCell(ModifierCell):
         out, next_states = self.base_cell(inputs, states)
 
         def mask(rate, new, old):
-            if not rate or old is None:
+            if not rate:
                 return new
+            if old is None:
+                # step 0 blends with zeros, matching the reference's
+                # prev_output=zeros initialization — skipping zoneout at
+                # step 0 would shift the regularizer's noise distribution
+                old = S.zeros_like(new)
             # Dropout is inverted (kept values are 1/(1-p)); rescale back
             # to an exact 0/1 keep mask so this is a SELECT, not a blend
             keep = S.Dropout(S.ones_like(new), p=rate) * (1.0 - rate)
@@ -481,7 +486,8 @@ class ZoneoutCell(ModifierCell):
 
         prev = self._prev_out
         out_z = mask(self._zo, out, prev)
-        self._prev_out = out
+        # the reference carries the MIXED output forward, not the raw one
+        self._prev_out = out_z
         states_z = [mask(self._zs, n, o) for n, o in zip(next_states, states)]
         return out_z, states_z
 
