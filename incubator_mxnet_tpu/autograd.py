@@ -321,6 +321,8 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
             leaf._grad._data = leaf._grad._data + g
         else:  # write
             leaf._grad._data = g.astype(leaf._grad._data.dtype) if g.dtype != leaf._grad._data.dtype else g
+        # freshness signal for Trainer's ignore_stale_grad tracking
+        leaf._grad._version += 1
     _np  # silence linters
 
 

@@ -101,6 +101,17 @@ class Parameter:
     def stype(self):
         return self._stype
 
+    @property
+    def grad_version(self):
+        """Monotonic version of the gradient buffer (bumped by backward()
+        and in-place grad writes).  ``Trainer.step(ignore_stale_grad=True)``
+        compares it against the version it saw at the previous update to
+        skip parameters whose grad was never refreshed — the reference's
+        ``_fresh_grad`` tracking.  -1 when no grad buffer exists."""
+        if self._data is None or self._data._grad is None:
+            return -1
+        return self._data._grad._version
+
     # -- init ------------------------------------------------------------
     def initialize(self, init=None, ctx=None, default_init=None, force_reinit=False):
         """Allocate and initialize (parity: ``Parameter.initialize``).

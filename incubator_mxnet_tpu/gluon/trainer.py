@@ -7,10 +7,22 @@ KVStore for cross-device aggregation; here single-process gradients already
 live on one (possibly mesh-sharded) array, and multi-host aggregation rides
 the kvstore facade ('dist_sync' → psum inside the compiled step — see
 kvstore/ and parallel/).
+
+Two grouped fast paths (docs/optimizer_fusion.md):
+
+* ``_update`` routes supported optimizers through the fused whole-group
+  step (optimizer/fused.py): one jitted, buffer-donating dispatch per
+  parameter group instead of one kernel launch + buffer swap per tensor.
+* ``allreduce_grads`` against a dist kvstore buckets gradients into
+  size-capped flat buffers (kvstore.bucketed_pushpull), so the wire sees a
+  few large pushpulls instead of one per parameter.
 """
 from __future__ import annotations
 
+import warnings
+
 from .. import optimizer as opt_mod
+from ..optimizer import fused as _fused
 from .parameter import Parameter, ParameterDict
 
 __all__ = ["Trainer"]
@@ -48,6 +60,8 @@ class Trainer:
         self._kv_initialized = False
         self._update_on_kvstore = update_on_kvstore
         self._states = {}
+        self._last_scale_set = None   # last rescale_grad THIS trainer wrote
+        self._grad_versions = {}      # index -> grad buffer version at last update
 
     def _init_optimizer(self, optimizer, optimizer_params):
         param_dict = {i: p for i, p in enumerate(self._params)}
@@ -86,36 +100,77 @@ class Trainer:
         self._optimizer.set_learning_rate(lr)
 
     def _check_and_rescale_grad(self, scale):
+        """Set ``optimizer.rescale_grad`` for this step, warning when a
+        user-set value is about to be clobbered (parity: the reference warns
+        instead of silently overwriting a manual ``rescale_grad``).  Before
+        the first step the expected value is ``self._scale`` (what
+        ``_init_optimizer`` installed), so a pre-step manual edit warns too."""
+        expected = (self._last_scale_set if self._last_scale_set is not None
+                    else self._scale)
+        if self._optimizer.rescale_grad != expected:
+            warnings.warn(
+                "Optimizer.rescale_grad was changed outside Trainer.step; "
+                "Trainer recomputes it as trainer._scale/batch_size every "
+                "step, overriding your value. Construct the Trainer with "
+                "optimizer_params={'rescale_grad': ...} instead.",
+                UserWarning, stacklevel=3)
         self._optimizer.rescale_grad = scale
+        self._last_scale_set = scale
 
     def step(self, batch_size, ignore_stale_grad=False):
         """Gradient allreduce + optimizer update (parity: ``Trainer.step``)."""
         if not self._kv_initialized:
             self._init_kvstore()
-        self._optimizer.rescale_grad = self._scale / batch_size
+        self._check_and_rescale_grad(self._scale / batch_size)
+        # staleness must be judged BEFORE allreduce: the kvstore writes into
+        # every grad buffer (bumping its version), which is transport, not
+        # a fresh backward
+        stale = self._stale_indices() if ignore_stale_grad else frozenset()
         self.allreduce_grads()
-        self._update(ignore_stale_grad)
+        self._update(ignore_stale_grad, stale)
 
     def allreduce_grads(self):
         """Aggregate gradients across devices/hosts via the kvstore facade
         (single-replica SPMD: aggregation happened inside the compiled step
-        via psum, so this is a no-op unless a dist kvstore is attached)."""
+        via psum, so this is a no-op unless a dist kvstore is attached).
+        Against a dist store the grads travel as size-capped flat buckets —
+        a few big pushpulls instead of one per parameter."""
         if not self._kv_initialized:
             self._init_kvstore()
         if self._kvstore is None:
             return
-        for i, p in enumerate(self._params):
-            if p.grad_req != "null" and p._data is not None and p._data._grad is not None:
-                self._kvstore.pushpull(i, p.grad(), out=p.grad())
+        from .. import kvstore as kv_mod
+
+        pairs = [(i, p) for i, p in enumerate(self._params)
+                 if p.grad_req != "null" and p._data is not None
+                 and p._data._grad is not None]
+        if (len(pairs) > 1 and kv_mod.bucket_bytes() > 0
+                and self._kvstore.supports_grad_bucketing()):
+            kv_mod.bucketed_pushpull(self._kvstore,
+                                     [(i, p.grad()) for i, p in pairs])
+            return
+        for i, p in pairs:
+            self._kvstore.pushpull(i, p.grad(), out=p.grad())
 
     def update(self, batch_size, ignore_stale_grad=False):
         """Optimizer update only (assumes grads already aggregated)."""
         if not self._kv_initialized:
             self._init_kvstore()
-        self._optimizer.rescale_grad = self._scale / batch_size
-        self._update(ignore_stale_grad)
+        self._check_and_rescale_grad(self._scale / batch_size)
+        self._update(ignore_stale_grad,
+                     self._stale_indices() if ignore_stale_grad else frozenset())
 
-    def _update(self, ignore_stale_grad=False):
+    def _stale_indices(self):
+        """Params whose grad buffer was NOT rewritten since their last
+        update (no backward ran for them) — the reference's ``_fresh_grad``
+        complement."""
+        return {i for i, p in enumerate(self._params)
+                if p.grad_req != "null" and p._data is not None
+                and p._data._grad is not None
+                and self._grad_versions.get(i) == p.grad_version}
+
+    def _update(self, ignore_stale_grad=False, stale=frozenset()):
+        touched = []
         for i, p in enumerate(self._params):
             if p.grad_req == "null" or p._data is None:
                 continue
@@ -123,19 +178,43 @@ class Trainer:
                 if ignore_stale_grad:
                     continue
                 raise UserWarning(f"Gradient of Parameter `{p.name}` has no grad buffer")
+            if ignore_stale_grad and i in stale:
+                continue
             if i not in self._states:
                 self._states[i] = self._optimizer.create_state_multi_precision(i, p.data())
-            self._optimizer.update_multi_precision(i, p.data(), p.grad(), self._states[i])
+            touched.append((i, p))
+        # fused whole-group fast path; leftovers (unsupported optimizer,
+        # lazy row-sparse params, NaiveEngine, aggregation disabled) take
+        # the per-tensor loop below
+        rest = _fused.fused_update(
+            self._optimizer,
+            [(i, p.data(), p.grad()) for i, p in touched],
+            self._states)
+        for i, w, g in rest:
+            self._optimizer.update_multi_precision(i, w, g, self._states[i])
+        # snapshot CURRENT versions for EVERY grad-bearing param (updated,
+        # skipped-stale, or left alone): only a future backward/user write
+        # may flip a param back to fresh, never this step's own transport
+        for i, p in enumerate(self._params):
+            if p.grad_req != "null" and p._data is not None \
+                    and p._data._grad is not None:
+                self._grad_versions[i] = p.grad_version
 
     def save_states(self, fname):
-        """Parity: ``Trainer.save_states`` (optimizer state snapshot)."""
+        """Parity: ``Trainer.save_states`` (optimizer state snapshot).
+        Persists the per-index update counts too — Adam's bias-correction
+        counter ``t`` must stay monotonic across a save/load roundtrip."""
         import pickle
 
         flat = {}
         for i, st in self._states.items():
             flat[i] = _states_to_numpy(st)
         with open(fname, "wb") as f:
-            pickle.dump({"states": flat, "num_update": self._optimizer.num_update}, f)
+            pickle.dump({
+                "states": flat,
+                "num_update": self._optimizer.num_update,
+                "update_counts": dict(self._optimizer._index_update_count),
+            }, f)
 
     def load_states(self, fname):
         import pickle
@@ -146,8 +225,16 @@ class Trainer:
             if i not in self._states:
                 self._states[i] = self._optimizer.create_state_multi_precision(i, self._params[i].data())
             _numpy_to_states(self._states[i], st)
-        self._optimizer.num_update = payload.get("num_update", self._optimizer.num_update)
-        self._optimizer.begin_num_update = self._optimizer.num_update
+        num_update = payload.get("num_update", self._optimizer.num_update)
+        counts = payload.get("update_counts")
+        if counts is None:
+            # older snapshots carry no per-index counts: reconstruct them
+            # from num_update (the begin_num_update convention) so Adam's t
+            # resumes at the restored step, not at 1
+            counts = {i: num_update for i in payload["states"]}
+        self._optimizer._index_update_count = dict(counts)
+        self._optimizer.num_update = num_update
+        self._optimizer.begin_num_update = num_update
 
 
 def _states_to_numpy(st):

@@ -1,3 +1,5 @@
-from .kvstore import KVStore, KVStoreLocal, KVStoreDist, create
+from .kvstore import (KVStore, KVStoreLocal, KVStoreDist, KVStoreDistAsync,
+                      bucket_bytes, bucketed_pushpull, create)
 
-__all__ = ["KVStore", "KVStoreLocal", "KVStoreDist", "create"]
+__all__ = ["KVStore", "KVStoreLocal", "KVStoreDist", "KVStoreDistAsync",
+           "bucket_bytes", "bucketed_pushpull", "create"]

@@ -41,7 +41,113 @@ import numpy as _np
 from ..ndarray.ndarray import NDArray, array, zeros
 
 __all__ = ["KVStore", "KVStoreLocal", "KVStoreDist", "KVStoreDistAsync",
-           "create"]
+           "bucket_bytes", "bucketed_pushpull", "create"]
+
+
+# -- bucketed gradient allreduce --------------------------------------------
+# MLPerf-scale TPU training aggregates gradients in size-capped flat buckets
+# (arxiv 1909.09756); ps-lite sharded big tensors for the same reason.  The
+# Trainer flattens same-dtype gradients into a few capped buffers and the
+# dist store sees ONE pushpull per bucket instead of one per parameter.
+
+def bucket_bytes():
+    """Per-bucket byte cap for bucketed gradient allreduce
+    (``MXNET_KVSTORE_BUCKET_BYTES``, default 4 MiB; 0 disables bucketing)."""
+    try:
+        return int(_os.environ.get("MXNET_KVSTORE_BUCKET_BYTES", str(4 << 20)))
+    except ValueError:
+        return 4 << 20
+
+
+_UNFLATTEN_CACHE = {}
+
+
+def _unflatten(flat, shapes):
+    """Scatter a reduced flat bucket back into per-grad arrays — ONE jitted
+    dispatch per bucket signature (static offsets), not a slice per param."""
+    import jax
+
+    key = (tuple(shapes), str(flat.dtype))
+    fn = _UNFLATTEN_CACHE.get(key)
+    if fn is None:
+        spans, off = [], 0
+        for s in shapes:
+            n = 1
+            for d in s:
+                n *= d
+            spans.append((off, n, s))
+            off += n
+
+        def split(buf):
+            return [buf[o:o + n].reshape(s) for o, n, s in spans]
+
+        fn = _UNFLATTEN_CACHE[key] = jax.jit(split)
+    return fn(flat)
+
+
+_FLATTEN_JIT = None
+
+
+def _flatten(raws):
+    # one persistent jitted gather: jit's own aval cache keys the per-bucket
+    # signatures (a fresh jit wrapper per call would recompile every step)
+    global _FLATTEN_JIT
+    if _FLATTEN_JIT is None:
+        import jax
+        import jax.numpy as jnp
+
+        _FLATTEN_JIT = jax.jit(
+            lambda xs: jnp.concatenate([x.reshape(-1) for x in xs]))
+    return _FLATTEN_JIT(list(raws))
+
+
+def bucketed_pushpull(kv, items, cap_bytes=None):
+    """Allreduce ``items`` (list of ``(key, grad_nd)``) through ``kv`` as
+    size-capped flattened buckets, writing the reduced values back into each
+    grad buffer in place.  Bucket assignment is deterministic (input order,
+    split per dtype), so bucket keys — and any compression residual state a
+    store hangs off them — are stable across steps."""
+    import numpy as np
+
+    from .. import profiler as _profiler
+    from ..engine import DeferredArray
+
+    cap = bucket_bytes() if cap_bytes is None else cap_bytes
+    by_group = {}
+    for key, g in items:
+        raw = g._data
+        if isinstance(raw, DeferredArray):  # pending bulk op: flush first
+            raw = raw._resolve()
+            g._data = raw
+        # group by (dtype, context): a flat bucket lives on ONE device, and
+        # the scattered pieces are written back without a placement probe
+        by_group.setdefault((str(raw.dtype), str(g.context)),
+                            []).append((key, g, raw))
+    bucket_id = 0
+    for (dt, _ctx), members in by_group.items():
+        itemsize = np.dtype(dt).itemsize
+        start = 0
+        while start < len(members):
+            end, nbytes = start, 0
+            while end < len(members):
+                sz = members[end][2].size * itemsize
+                if end > start and nbytes + sz > cap:
+                    break
+                nbytes += sz
+                end += 1
+            chunk = members[start:end]
+            start = end
+            grads = [g for _, g, _ in chunk]
+            raws = [r for _, _, r in chunk]
+            flat = NDArray(_flatten(raws), ctx=grads[0].context)
+            kv.pushpull(f"__grad_bucket__:{dt}:{bucket_id}", flat, out=flat)
+            bucket_id += 1
+            pieces = _unflatten(flat._data, [r.shape for r in raws])
+            for g, piece in zip(grads, pieces):
+                g._data = piece
+                g._version += 1
+            _profiler.incr("allreduce_bucket")
+            _profiler.incr("allreduce_bucket_params", len(chunk))
 
 
 def create(name="local"):
@@ -153,6 +259,14 @@ class KVStore:
         # dense-on-TPU: equivalent to pull (documented divergence)
         self.pull(key, out, priority)
 
+    def supports_grad_bucketing(self):
+        """Whether ``bucketed_pushpull`` is sound against this store: only a
+        pure allreduce tier qualifies — a store applying a per-key optimizer
+        (updater/server-side optimizer) or per-key compression residual
+        semantics must keep one key per parameter.  Local stores skip
+        bucketing too: in-process pushpull is already free of wire cost."""
+        return False
+
     # -- helpers ---------------------------------------------------------
     def _aggregate(self, value):
         if isinstance(value, (list, tuple)):
@@ -257,6 +371,10 @@ class KVStoreDist(KVStore):
         self._mesh_cache = None
         self._reduce_fn_cache = None
         self._ensure_dist()
+
+    def supports_grad_bucketing(self):
+        return (self._updater is None and self._optimizer is None
+                and self._compression is None)
 
     def _ensure_dist(self):
         if self._initialized_dist:
@@ -373,6 +491,13 @@ class KVStoreDistAsync(KVStore):
         host = _os.environ.get("DMLC_PS_ROOT_URI", "127.0.0.1")
         self._server = async_ps.serve_if_rank0(self._rank, self._num_workers)
         self._client = async_ps.AsyncClient(host, async_ps.server_port())
+
+    def supports_grad_bucketing(self):
+        # never: the async server ACCUMULATES pushes to an existing key
+        # (no per-step reset), so a reused bucket key would pull back the
+        # running sum of every previous step's gradients.  The async
+        # contract is a server-side optimizer per key, not an allreduce.
+        return False
 
     @property
     def rank(self):

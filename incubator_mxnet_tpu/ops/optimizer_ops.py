@@ -9,6 +9,8 @@ weights + fp32 master copy) mirrors the reference's mp_* variants.
 """
 from __future__ import annotations
 
+import warnings
+
 import jax
 import jax.numpy as jnp
 
@@ -322,110 +324,201 @@ def group_adagrad_update(weight, grad, history, lr, rescale, clip, eps):
     return (weight.astype(jnp.float32) - upd).astype(weight.dtype), new_hist
 
 
-# -- multi-tensor (grouped) updates -----------------------------------------
+# -- multi-tensor (grouped) update machinery --------------------------------
 # Parity: [U:src/operator/optimizer_op.cc] multi_sgd_update /
 # multi_sgd_mom_update / multi_mp_sgd_* — ONE fused kernel updating a whole
-# parameter group.  On TPU each per-tensor update is elementwise and XLA
-# fuses the group into few HBM passes; the value of the grouped form is one
-# dispatch (and one lr/wd broadcast) for hundreds of small tensors.
+# parameter group.  The group is passed as list pytrees (weights, grads,
+# per-param state tuples) with per-param lr/wd/t as stacked device arrays
+# and scalar hypers as dynamic 0-d args, so neither lr-schedule changes nor
+# hyper changes retrace; jit's aval cache keys on the group's shapes.  With
+# ``donate=True`` XLA reuses the weight and state buffers in place (the
+# Trainer fused-step path; see docs/optimizer_fusion.md for the aliasing
+# caveat).  One dispatch (and one lr/wd transfer) for hundreds of tensors.
+
+_GROUP_JIT = {}
 
 
-def multi_sgd_update(weights, grads, lrs, wds, rescale_grad=1.0, clip_gradient=-1.0):
-    clip = jnp.float32(clip_gradient if clip_gradient > 0 else jnp.inf)
-    return [
-        sgd_update(w, g, jnp.float32(lr), jnp.float32(wd), jnp.float32(rescale_grad), clip)
-        for w, g, lr, wd in zip(weights, grads, lrs, wds)
-    ]
+def _group_fn(step, donate):
+    fn = _GROUP_JIT.get((step, donate))
+    if fn is None:
+        if donate:
+            # backends without real donation warn per compile; semantics are
+            # unchanged (XLA falls back to copying), so keep the fused path
+            # quiet.  Installed lazily on the FIRST donating group build —
+            # never for the non-donating multi_* ops or with
+            # MXNET_OPTIMIZER_DONATE=0 — so user jits keep the diagnostic
+            # until they opt into this machinery.
+            warnings.filterwarnings(
+                "ignore", message="Some donated buffers were not usable")
+        def body(weights, grads, states, lrs, wds, ts, scalars):
+            new_w, new_s = [], []
+            for i in range(len(weights)):
+                nw, ns = step(weights[i], grads[i], states[i],
+                              lrs[i], wds[i], ts[i], scalars)
+                new_w.append(nw)
+                new_s.append(list(ns))
+            return new_w, new_s
+        fn = jax.jit(body, donate_argnums=(0, 2) if donate else ())
+        _GROUP_JIT[(step, donate)] = fn
+    return fn
+
+
+def group_apply(step, weights, grads, states, lrs, wds, ts, scalars,
+                donate=False):
+    """Apply a per-tensor ``step(w, g, state_tuple, lr, wd, t, scalars)``
+    adapter to a whole parameter group in ONE jitted dispatch.
+
+    ``states`` is a list of per-param state tuples (flat arrays), ``lrs`` /
+    ``wds`` / ``ts`` are per-param sequences stacked into device arrays, and
+    ``scalars`` is a dict of group-wide hypers traced as 0-d arrays.  When
+    ``donate`` is set the weight and state buffers are donated to XLA
+    (in-place reuse); callers must guarantee no live aliases."""
+    weights, grads = list(weights), list(grads)
+    states = [list(s) for s in states]
+    lrs = jnp.asarray(lrs, jnp.float32)
+    wds = jnp.asarray(wds, jnp.float32)
+    ts = jnp.asarray(ts, jnp.float32)
+    scalars = {k: jnp.asarray(v, jnp.float32) for k, v in scalars.items()}
+    return _group_fn(step, donate)(weights, grads, states, lrs, wds, ts,
+                                   scalars)
+
+
+# Per-tensor step adapters over the fused kernels above — the shared
+# vocabulary of group_apply: the public multi_* ops and the Trainer fused
+# step (optimizer/fused.py) compose the SAME adapters, so their numerics
+# cannot drift from the per-tensor kernels they inline.
+
+def sgd_step(w, g, st, lr, wd, t, S):
+    return sgd_update(w, g, lr, wd, S["rescale"], S["clip"]), ()
+
+
+def sgd_mom_step(w, g, st, lr, wd, t, S):
+    nw, nm = sgd_mom_update(w, g, st[0], lr, wd, S["rescale"], S["clip"],
+                            S["momentum"])
+    return nw, (nm,)
+
+
+def mp_sgd_step(w, g, st, lr, wd, t, S):
+    nw, nw32 = mp_sgd_update(w, g, st[0], lr, wd, S["rescale"], S["clip"])
+    return nw, (nw32,)
+
+
+def mp_sgd_mom_step(w, g, st, lr, wd, t, S):
+    nw, nm, nw32 = mp_sgd_mom_update(w, g, st[0], st[1], lr, wd, S["rescale"],
+                                     S["clip"], S["momentum"])
+    return nw, (nm, nw32)
+
+
+def nag_mom_step(w, g, st, lr, wd, t, S):
+    nw, nm = nag_mom_update(w, g, st[0], lr, wd, S["rescale"], S["clip"],
+                            S["momentum"])
+    return nw, (nm,)
+
+
+def mp_nag_mom_step(w, g, st, lr, wd, t, S):
+    nw, nm, nw32 = mp_nag_mom_update(w, g, st[0], st[1], lr, wd, S["rescale"],
+                                     S["clip"], S["momentum"])
+    return nw, (nm, nw32)
+
+
+def adam_step(w, g, st, lr, wd, t, S):
+    nw, nm, nv = adam_update(w, g, st[0], st[1], lr, wd, S["rescale"],
+                             S["clip"], S["beta1"], S["beta2"], S["epsilon"], t)
+    return nw, (nm, nv)
+
+
+def mp_adam_step(w, g, st, lr, wd, t, S):
+    nw, nm, nv, nw32 = mp_adam_update(w, g, st[0], st[1], st[2], lr, wd,
+                                      S["rescale"], S["clip"], S["beta1"],
+                                      S["beta2"], S["epsilon"], t)
+    return nw, (nm, nv, nw32)
+
+
+def adamw_step(w, g, st, lr, wd, t, S):
+    nw, nm, nv = adamw_update(w, g, st[0], st[1], lr, wd, S["eta"],
+                              S["rescale"], S["clip"], S["beta1"], S["beta2"],
+                              S["epsilon"], t)
+    return nw, (nm, nv)
+
+
+# The public grouped ops, now genuinely single-dispatch.  clip_gradient
+# keeps the REFERENCE sentinel everywhere: ``< 0`` = no clipping, ``0``
+# clamps gradients to zero (the old ``> 0``-to-inf mapping silently
+# disabled clipping for clip_gradient=0.0, diverging from _gclip).
+
+
+def multi_sgd_update(weights, grads, lrs, wds, rescale_grad=1.0,
+                     clip_gradient=-1.0):
+    weights = list(weights)
+    new_w, _ = group_apply(
+        sgd_step, weights, grads, [()] * len(weights), lrs, wds,
+        [0.0] * len(weights),
+        {"rescale": rescale_grad, "clip": clip_gradient})
+    return new_w
 
 
 def multi_sgd_mom_update(weights, grads, moms, lrs, wds, momentum=0.0,
                          rescale_grad=1.0, clip_gradient=-1.0):
-    clip = jnp.float32(clip_gradient if clip_gradient > 0 else jnp.inf)
-    out = [
-        sgd_mom_update(w, g, m, jnp.float32(lr), jnp.float32(wd),
-                       jnp.float32(rescale_grad), clip, jnp.float32(momentum))
-        for w, g, m, lr, wd in zip(weights, grads, moms, lrs, wds)
-    ]
-    return [o[0] for o in out], [o[1] for o in out]
+    weights = list(weights)
+    new_w, new_s = group_apply(
+        sgd_mom_step, weights, grads, [(m,) for m in moms], lrs, wds,
+        [0.0] * len(weights),
+        {"rescale": rescale_grad, "clip": clip_gradient, "momentum": momentum})
+    return new_w, [s[0] for s in new_s]
 
 
 def multi_mp_sgd_update(weights, grads, weights32, lrs, wds,
                         rescale_grad=1.0, clip_gradient=-1.0):
-    clip = jnp.float32(clip_gradient if clip_gradient > 0 else jnp.inf)
-    out = [
-        mp_sgd_update(w, g, w32, jnp.float32(lr), jnp.float32(wd),
-                      jnp.float32(rescale_grad), clip)
-        for w, g, w32, lr, wd in zip(weights, grads, weights32, lrs, wds)
-    ]
-    return [o[0] for o in out], [o[1] for o in out]
+    weights = list(weights)
+    new_w, new_s = group_apply(
+        mp_sgd_step, weights, grads, [(w32,) for w32 in weights32], lrs, wds,
+        [0.0] * len(weights),
+        {"rescale": rescale_grad, "clip": clip_gradient})
+    return new_w, [s[0] for s in new_s]
 
 
 def multi_mp_sgd_mom_update(weights, grads, moms, weights32, lrs, wds,
                             momentum=0.0, rescale_grad=1.0, clip_gradient=-1.0):
-    clip = jnp.float32(clip_gradient if clip_gradient > 0 else jnp.inf)
-    out = [
-        mp_sgd_mom_update(w, g, m, w32, jnp.float32(lr), jnp.float32(wd),
-                          jnp.float32(rescale_grad), clip, jnp.float32(momentum))
-        for w, g, m, w32, lr, wd in zip(weights, grads, moms, weights32, lrs, wds)
-    ]
-    return [o[0] for o in out], [o[1] for o in out], [o[2] for o in out]
+    weights = list(weights)
+    new_w, new_s = group_apply(
+        mp_sgd_mom_step, weights, grads,
+        [(m, w32) for m, w32 in zip(moms, weights32)], lrs, wds,
+        [0.0] * len(weights),
+        {"rescale": rescale_grad, "clip": clip_gradient, "momentum": momentum})
+    return new_w, [s[0] for s in new_s], [s[1] for s in new_s]
 
 
 # -- preloaded (device-resident lr/wd) group variants ------------------------
 # Parity: [U:src/operator/contrib/preloaded_multi_sgd-inl.h] — identical to
 # multi_sgd_* except learning rates and weight decays arrive as device
 # ARRAYS (one element per tensor), not host scalars, so a training loop can
-# update lr on-device without a host sync.
+# update lr on-device without a host sync.  group_apply already stacks lr/wd
+# into device arrays, so these are the same single-dispatch calls.
 
 
 def preloaded_multi_sgd_update(weights, grads, lrs, wds,
                                rescale_grad=1.0, clip_gradient=-1.0):
-    clip = jnp.float32(clip_gradient)  # kernels decode the <0 no-clip sentinel
-    lrs, wds = jnp.asarray(lrs), jnp.asarray(wds)
-    return [
-        sgd_update(w, g, lrs[i].astype(jnp.float32), wds[i].astype(jnp.float32),
-                   jnp.float32(rescale_grad), clip)
-        for i, (w, g) in enumerate(zip(weights, grads))
-    ]
+    return multi_sgd_update(weights, grads, lrs, wds, rescale_grad,
+                            clip_gradient)
 
 
 def preloaded_multi_sgd_mom_update(weights, grads, moms, lrs, wds, momentum=0.0,
                                    rescale_grad=1.0, clip_gradient=-1.0):
-    clip = jnp.float32(clip_gradient)  # kernels decode the <0 no-clip sentinel
-    lrs, wds = jnp.asarray(lrs), jnp.asarray(wds)
-    out = [
-        sgd_mom_update(w, g, m, lrs[i].astype(jnp.float32),
-                       wds[i].astype(jnp.float32), jnp.float32(rescale_grad),
-                       clip, jnp.float32(momentum))
-        for i, (w, g, m) in enumerate(zip(weights, grads, moms))
-    ]
-    return [o[0] for o in out], [o[1] for o in out]
+    return multi_sgd_mom_update(weights, grads, moms, lrs, wds, momentum,
+                                rescale_grad, clip_gradient)
 
 
 def preloaded_multi_mp_sgd_update(weights, grads, weights32, lrs, wds,
                                   rescale_grad=1.0, clip_gradient=-1.0):
-    clip = jnp.float32(clip_gradient)  # kernels decode the <0 no-clip sentinel
-    lrs, wds = jnp.asarray(lrs), jnp.asarray(wds)
-    out = [
-        mp_sgd_update(w, g, w32, lrs[i].astype(jnp.float32),
-                      wds[i].astype(jnp.float32), jnp.float32(rescale_grad), clip)
-        for i, (w, g, w32) in enumerate(zip(weights, grads, weights32))
-    ]
-    return [o[0] for o in out], [o[1] for o in out]
+    return multi_mp_sgd_update(weights, grads, weights32, lrs, wds,
+                               rescale_grad, clip_gradient)
 
 
 def preloaded_multi_mp_sgd_mom_update(weights, grads, moms, weights32, lrs, wds,
                                       momentum=0.0, rescale_grad=1.0,
                                       clip_gradient=-1.0):
-    clip = jnp.float32(clip_gradient)  # kernels decode the <0 no-clip sentinel
-    lrs, wds = jnp.asarray(lrs), jnp.asarray(wds)
-    out = [
-        mp_sgd_mom_update(w, g, m, w32, lrs[i].astype(jnp.float32),
-                          wds[i].astype(jnp.float32), jnp.float32(rescale_grad),
-                          clip, jnp.float32(momentum))
-        for i, (w, g, m, w32) in enumerate(zip(weights, grads, moms, weights32))
-    ]
-    return [o[0] for o in out], [o[1] for o in out], [o[2] for o in out]
+    return multi_mp_sgd_mom_update(weights, grads, moms, weights32, lrs, wds,
+                                   momentum, rescale_grad, clip_gradient)
 
 
 def multi_sum_sq(*arrays):

@@ -1,0 +1,162 @@
+"""Fused whole-group optimizer step — the Trainer fast path.
+
+Parity motivation: the reference ships grouped kernels (``multi_sgd_update``
+et al., [U:src/operator/optimizer_op.cc]) because a model with hundreds of
+small parameters otherwise pays one kernel launch per tensor per step.  Here
+the same idea rides ``ops/optimizer_ops.group_apply``: parameters are
+grouped by (optimizer class, weight dtype, multi-precision, lazy/row-sparse,
+context) and each group is updated by ONE jitted pytree call —
+
+* weights / grads / states travel as list pytrees (jit's aval cache keys on
+  the group's shapes, so steady-state steps are a single cached dispatch);
+* per-param lr / wd / t arrive as stacked device arrays, so lr-schedule
+  progress and Adam's bias-correction counters never retrace;
+* scalar hypers (momentum, betas, rescale_grad, clip_gradient, eps, eta)
+  are dynamic 0-d args — hyper changes never retrace either;
+* weight and state buffers are DONATED to XLA (in-place reuse, no fresh
+  HBM allocations per step) unless ``MXNET_OPTIMIZER_DONATE=0``.
+
+Escape hatches (docs/optimizer_fusion.md): ``MXNET_OPTIMIZER_AGGREGATION=0``
+(or ``Optimizer(aggregate_num=0)``) restores the per-tensor loop, and
+``NaiveEngine`` bypasses fusion entirely (jit is globally disabled there).
+Unsupported optimizers and lazy row-sparse parameters fall back per-tensor,
+preserving their kernels' lazy semantics.
+"""
+from __future__ import annotations
+
+import os as _os
+
+from .. import engine as _engine
+from .. import profiler as _profiler
+from ..ops import optimizer_ops as K
+from .optimizer import SGD, NAG, Adam, AdamW, _swap
+
+__all__ = ["fused_update", "supports", "donation_enabled"]
+
+
+def donation_enabled():
+    """Buffer donation escape hatch (``MXNET_OPTIMIZER_DONATE=0``): donated
+    weight/state buffers are reused in place by XLA, which invalidates any
+    user-held alias of the OLD buffer (e.g. ``w = p.data().copy()`` shares
+    the jax buffer).  See docs/optimizer_fusion.md."""
+    return _os.environ.get("MXNET_OPTIMIZER_DONATE", "1") != "0"
+
+
+def _select(opt, index, weight, state):
+    """Map one (optimizer, param, state) to its group-step adapter and the
+    flat tuple of state NDArrays, or None when this param must take the
+    per-tensor path.  Exact-type checks: a subclass overriding ``update``
+    must not silently inherit a fused kernel it no longer matches."""
+    t = type(opt)
+    mp = opt._use_mp(weight)
+    if t is SGD:
+        if opt._lazy_for(index):
+            return None  # lazy row-sparse: per-tensor lazy kernels
+        if mp:
+            if opt.momentum != 0.0:
+                mom, w32 = state
+                return K.mp_sgd_mom_step, (mom, w32)
+            _inner, w32 = state
+            return K.mp_sgd_step, (w32,)
+        if state is None:
+            return K.sgd_step, ()
+        return K.sgd_mom_step, (state,)
+    if t is NAG:
+        if mp:
+            inner, w32 = state
+            if opt.momentum == 0.0:
+                # base _update_mp runs plain SGD on the fp32 master copy
+                return K.mp_sgd_step, (w32,)
+            return K.mp_nag_mom_step, (inner, w32)
+        if state is None:
+            return K.sgd_step, ()
+        return K.nag_mom_step, (state,)
+    if t in (Adam, AdamW):
+        if opt._lazy_for(index):
+            return None
+        if mp:
+            # AdamW inherits Adam._update_mp (mp_adam_update) unfused; the
+            # fused path matches that exactly
+            (mean, var), w32 = state
+            return K.mp_adam_step, (mean, var, w32)
+        mean, var = state
+        return (K.adamw_step if t is AdamW else K.adam_step), (mean, var)
+    return None
+
+
+def supports(opt):
+    """Whether this optimizer instance has fused group kernels at all."""
+    return type(opt) in (SGD, NAG, Adam, AdamW)
+
+
+def _scalars(opt):
+    S = {"rescale": opt.rescale_grad, "clip": opt.clip_gradient}
+    if type(opt) in (SGD, NAG):
+        S["momentum"] = opt.momentum
+    else:
+        S["beta1"], S["beta2"] = opt.beta1, opt.beta2
+        S["epsilon"] = opt.epsilon
+        if type(opt) is AdamW:
+            S["eta"] = opt.eta
+    return S
+
+
+def _concrete(nd):
+    """Resolve a pending bulk-deferred buffer in place (grads produced
+    inside an engine.bulk scope must flush before donation/jit)."""
+    raw = nd._data
+    if isinstance(raw, _engine.DeferredArray):
+        raw = raw._resolve()
+        nd._data = raw
+    return raw
+
+
+def fused_update(optimizer, items, states):
+    """Update every supported ``(index, weight, grad)`` in ``items`` via
+    grouped single-dispatch jitted calls; returns the leftover items the
+    caller must update per-tensor.  ``states`` maps index -> the state the
+    per-tensor path would use — the SAME NDArray objects are swapped in
+    place, so fused and per-tensor steps are interchangeable mid-training.
+    """
+    agg = int(getattr(optimizer, "aggregate_num", 0) or 0)
+    if agg <= 1 or not items or _engine._engine_type == "NaiveEngine":
+        return items
+    groups, rest = {}, []
+    for item in items:
+        i, w, g = item
+        sel = _select(optimizer, i, w, states[i])
+        if sel is None:
+            rest.append(item)
+            continue
+        step, flat = sel
+        key = (step, str(w.dtype), str(w.context))
+        groups.setdefault(key, []).append((i, w, g, flat))
+    if groups:
+        donate = donation_enabled()
+        scalars = _scalars(optimizer)
+        for (step, _, _), members in groups.items():
+            for start in range(0, len(members), agg):
+                chunk = members[start:start + agg]
+                # bump ALL counts first, then read lr/wd — matches the
+                # per-tensor loop for synchronized params (every param sees
+                # the same num_update) and the reference's aggregate path
+                for i, _, _, _ in chunk:
+                    optimizer._update_count(i)
+                lrs = [optimizer._get_lr(i) for i, _, _, _ in chunk]
+                wds = [optimizer._get_wd(i) for i, _, _, _ in chunk]
+                ts = [optimizer._index_update_count[i] for i, _, _, _ in chunk]
+                new_w, new_s = K.group_apply(
+                    step,
+                    [_concrete(w) for _, w, _, _ in chunk],
+                    [_concrete(g) for _, _, g, _ in chunk],
+                    [[s._data for s in flat] for _, _, _, flat in chunk],
+                    lrs, wds, ts, scalars, donate=donate)
+                for m, (_, w, _, flat) in enumerate(chunk):
+                    _swap(w, new_w[m])
+                    for s_nd, s_new in zip(flat, new_s[m]):
+                        _swap(s_nd, s_new)
+                _profiler.incr("fused_step_call")
+                _profiler.incr("fused_step_params", len(chunk))
+    if rest:
+        _profiler.incr("fused_step_fallback_params", len(rest))
+    return rest

@@ -13,6 +13,7 @@ pure kernels directly inside one compiled step.
 from __future__ import annotations
 
 import math
+import os as _os
 
 import jax.numpy as jnp
 import numpy as _np
@@ -45,6 +46,24 @@ def _f32(x):
     return jnp.float32(x)
 
 
+def _default_aggregate_num():
+    """Resolve the fused-update group cap from ``MXNET_OPTIMIZER_AGGREGATION``
+    (the env escape hatch of docs/optimizer_fusion.md): 0/off/false disables
+    the fused Trainer step entirely, an integer caps params per fused
+    dispatch, unset/on means fuse aggressively (reference ``aggregate_num``
+    is 4 because CUDA kernels take fixed-arity pointer lists; a jitted
+    pytree call has no such limit)."""
+    v = _os.environ.get("MXNET_OPTIMIZER_AGGREGATION", "").strip().lower()
+    if v in ("0", "off", "false", "no", "none"):
+        return 0
+    if v in ("", "on", "true", "yes", "auto"):
+        return 256
+    try:
+        return max(0, int(v))
+    except ValueError:
+        return 256
+
+
 class Optimizer:
     """Base optimizer (parity: ``mx.optimizer.Optimizer``)."""
 
@@ -59,8 +78,14 @@ class Optimizer:
         begin_num_update=0,
         multi_precision=False,
         param_dict=None,
+        aggregate_num=None,
         **kwargs,
     ):
+        # max parameters per fused whole-group update (Trainer fast path;
+        # parity-adjacent to the reference's aggregate_num).  <= 1 keeps the
+        # per-tensor loop.
+        self.aggregate_num = (_default_aggregate_num() if aggregate_num is None
+                              else max(0, int(aggregate_num)))
         self.rescale_grad = rescale_grad
         self.lr = learning_rate
         self.lr_scheduler = lr_scheduler

@@ -42,9 +42,11 @@ def _tally(name, dur):
 
 # -- dispatch/engine event counters -----------------------------------------
 # The eager dispatch accelerator (ops/registry.py cache + engine.py bulking)
-# reports its behavior here so the win is observable: cache hits/misses,
-# raw-path bypasses, jit fallbacks, and bulk flush sizes.  Plain int adds —
-# cheap enough to stay on even when tracing is off.
+# and the fused trainer step (optimizer/fused.py + kvstore bucketing) report
+# their behavior here so the wins are observable: cache hits/misses,
+# raw-path bypasses, jit fallbacks, bulk flush sizes, fused-update group
+# sizes, and allreduce bucket counts.  Plain int adds — cheap enough to
+# stay on even when tracing is off.
 
 _counters = {
     "dispatch_cache_hit": 0,
@@ -54,6 +56,11 @@ _counters = {
     "bulk_flush": 0,
     "bulk_ops_flushed": 0,
     "bulk_fallback": 0,
+    "fused_step_call": 0,             # grouped optimizer dispatches
+    "fused_step_params": 0,           # params updated through fused groups
+    "fused_step_fallback_params": 0,  # params that took the per-tensor loop
+    "allreduce_bucket": 0,            # bucketed gradient pushpulls
+    "allreduce_bucket_params": 0,     # grads carried by those buckets
 }
 _counter_lock = _threading.Lock()
 

@@ -1,0 +1,446 @@
+"""Fused whole-group optimizer step + bucketed gradient allreduce (ISSUE 3).
+
+Covers the acceptance surface: fused-vs-per-tensor numerical equivalence
+across the supported optimizer zoo (SGD/NAG/Adam/AdamW, bf16 multi-
+precision, per-param lr/wd mults), fallback routing (lazy row-sparse,
+unsupported optimizers, NaiveEngine, env escape hatch), the grouped
+``multi_*`` kernels' clip sentinel, stale-grad tracking, rescale_grad
+clobber warning, save/load state monotonicity, kvstore gradient bucketing,
+and the profiler counter contract — plus a CI smoke of the
+``benchmark/opperf/trainer_step.py`` harness.
+
+Tolerance contract (docs/optimizer_fusion.md): fused and per-tensor paths
+run the SAME per-tensor kernels (inlined into one XLA program), but XLA may
+refuse/reassociate differently inside the group, so equivalence is asserted
+to 1e-6 relative (1e-2 for bf16 weights, whose storage rounding dominates).
+"""
+import os
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import autograd, engine, gluon, profiler
+from incubator_mxnet_tpu.gluon import Parameter
+from incubator_mxnet_tpu.ops import optimizer_ops as K
+
+nd = mx.nd
+
+
+@pytest.fixture(autouse=True)
+def fresh_counters():
+    profiler.reset_counters()
+    yield
+    profiler.reset_counters()
+
+
+def _c():
+    return profiler.counters()
+
+
+def _make_params(n, seed, dtype="float32", stype="default"):
+    rs = np.random.RandomState(seed)
+    params = []
+    for k in range(n):
+        p = Parameter(f"p{k}_weight", shape=(3, k % 3 + 2), dtype=dtype,
+                      stype=stype)
+        p.initialize()
+        p.set_data(nd.array(rs.randn(*p.shape).astype(np.float32)))
+        params.append(p)
+    return params
+
+
+def _run_steps(opt_name, opt_args, aggregate_num, n=6, dtype="float32",
+               steps=3, seed=3, lr_mults=None, wd_mults=None, grads=None):
+    """Run ``steps`` trainer steps with fixed grads; returns final weights
+    (as float64 numpy) and the trainer."""
+    params = _make_params(n, seed, dtype)
+    if lr_mults:
+        for p, m in zip(params, lr_mults):
+            p.lr_mult = m
+    if wd_mults:
+        for p, m in zip(params, wd_mults):
+            p.wd_mult = m
+    trainer = gluon.Trainer(params, opt_name, dict(opt_args), kvstore=None)
+    if aggregate_num is not None:
+        trainer._optimizer.aggregate_num = aggregate_num
+    rs = np.random.RandomState(seed + 1)
+    gvals = grads or [rs.randn(*p.shape).astype(np.float32) for p in params]
+    for _ in range(steps):
+        for p, g in zip(params, gvals):
+            p.grad()[:] = nd.array(g)
+        trainer.step(2)
+    return [p.data().asnumpy().astype(np.float64) for p in params], trainer
+
+
+def _assert_equiv(opt_name, opt_args, tol=1e-6, **kw):
+    ref, _ = _run_steps(opt_name, opt_args, 0, **kw)
+    out, _ = _run_steps(opt_name, opt_args, 256, **kw)
+    for a, b in zip(ref, out):
+        np.testing.assert_allclose(a, b, rtol=tol, atol=tol)
+
+
+# ---------------------------------------------------------------------------
+# fused vs per-tensor numerical equivalence
+# ---------------------------------------------------------------------------
+
+
+def test_equiv_sgd():
+    _assert_equiv("sgd", {"learning_rate": 0.1, "wd": 0.01})
+
+
+def test_equiv_sgd_momentum():
+    _assert_equiv("sgd", {"learning_rate": 0.1, "momentum": 0.9, "wd": 0.01})
+
+
+def test_equiv_nag():
+    _assert_equiv("nag", {"learning_rate": 0.1, "momentum": 0.9})
+
+
+@pytest.mark.parametrize("name", ["adam", "adamw"])
+def test_equiv_adam_family(name):
+    _assert_equiv(name, {"learning_rate": 0.01, "wd": 0.01})
+
+
+def test_equiv_clip_gradient():
+    _assert_equiv("sgd", {"learning_rate": 0.1, "clip_gradient": 0.05})
+
+
+@pytest.mark.parametrize("name,args", [
+    ("sgd", {"learning_rate": 0.1, "momentum": 0.9, "multi_precision": True}),
+    ("sgd", {"learning_rate": 0.1, "multi_precision": True}),
+    ("nag", {"learning_rate": 0.1, "momentum": 0.9, "multi_precision": True}),
+    ("adam", {"learning_rate": 0.01, "multi_precision": True}),
+    ("adamw", {"learning_rate": 0.01, "multi_precision": True}),
+])
+def test_equiv_bf16_multi_precision(name, args):
+    _assert_equiv(name, args, tol=1e-2, dtype="bfloat16")
+
+
+def test_equiv_lr_wd_mults():
+    _assert_equiv("sgd", {"learning_rate": 0.1, "momentum": 0.9, "wd": 0.1},
+                  lr_mults=[1.0, 0.5, 2.0, 0.0, 1.0, 1.0],
+                  wd_mults=[1.0, 0.0, 1.0, 1.0, 3.0, 0.5])
+
+
+def test_fused_is_default_and_counts_groups():
+    # aggregate_num=None: the trainer runs with the optimizer's DEFAULT
+    # aggregation — the fused path must engage without opt-in
+    _, trainer = _run_steps("sgd", {"learning_rate": 0.1, "momentum": 0.9},
+                            None, n=6, steps=2)
+    assert trainer._optimizer.aggregate_num > 1
+    c = _c()
+    assert c["fused_step_call"] == 2       # one group dispatch per step
+    assert c["fused_step_params"] == 12    # 6 params x 2 steps
+    assert c["fused_step_fallback_params"] == 0
+
+
+def test_aggregate_num_chunks_groups():
+    _run_steps("sgd", {"learning_rate": 0.1}, 4, n=10, steps=1)
+    # 10 same-dtype params with a cap of 4 -> 3 fused dispatches
+    assert _c()["fused_step_call"] == 3
+    assert _c()["fused_step_params"] == 10
+
+
+# ---------------------------------------------------------------------------
+# fallback routing
+# ---------------------------------------------------------------------------
+
+
+def test_env_escape_hatch(monkeypatch):
+    monkeypatch.setenv("MXNET_OPTIMIZER_AGGREGATION", "0")
+    _run_steps("sgd", {"learning_rate": 0.1}, None, steps=1)
+    assert _c()["fused_step_call"] == 0
+    monkeypatch.setenv("MXNET_OPTIMIZER_AGGREGATION", "7")
+    opt = mx.optimizer.create("sgd")
+    assert opt.aggregate_num == 7
+
+
+def test_unsupported_optimizer_falls_back():
+    ref, _ = _run_steps("rmsprop", {"learning_rate": 0.01}, 0, steps=2)
+    profiler.reset_counters()
+    out, _ = _run_steps("rmsprop", {"learning_rate": 0.01}, 256, steps=2)
+    assert _c()["fused_step_call"] == 0
+    assert _c()["fused_step_fallback_params"] > 0
+    for a, b in zip(ref, out):
+        np.testing.assert_allclose(a, b, rtol=1e-6)
+
+
+def test_naive_engine_bypasses_fusion():
+    prev = engine.set_engine_type("NaiveEngine")
+    try:
+        out, _ = _run_steps("sgd", {"learning_rate": 0.1, "momentum": 0.9},
+                            256, steps=2)
+        assert _c()["fused_step_call"] == 0
+    finally:
+        engine.set_engine_type(prev)
+    ref, _ = _run_steps("sgd", {"learning_rate": 0.1, "momentum": 0.9},
+                        0, steps=2)
+    for a, b in zip(ref, out):
+        np.testing.assert_allclose(a, b, rtol=1e-6)
+
+
+def test_lazy_row_sparse_falls_back_with_lazy_semantics():
+    """row_sparse params keep their lazy per-tensor kernels: rows with zero
+    grad must not decay/accumulate momentum, and the fused path must route
+    them around the group dispatch."""
+    def run(agg):
+        p = _make_params(1, 5, stype="row_sparse")[0]
+        dense = _make_params(1, 6)[0]
+        tr = gluon.Trainer([p, dense], "sgd",
+                           {"learning_rate": 0.1, "momentum": 0.9, "wd": 0.1},
+                           kvstore=None)
+        tr._optimizer.aggregate_num = agg
+        g = np.zeros(p.shape, np.float32)
+        g[1] = 1.0  # touch only row 1
+        for _ in range(2):
+            p.grad()[:] = nd.array(g)
+            dense.grad()[:] = nd.array(np.ones(dense.shape, np.float32))
+            tr.step(1)
+        return p.data().asnumpy(), dense.data().asnumpy()
+
+    w_ref, d_ref = run(0)
+    profiler.reset_counters()
+    w_fused, d_fused = run(256)
+    assert _c()["fused_step_fallback_params"] == 2  # row_sparse, both steps
+    assert _c()["fused_step_params"] == 2           # dense param fused
+    np.testing.assert_allclose(w_ref, w_fused, rtol=1e-6)
+    np.testing.assert_allclose(d_ref, d_fused, rtol=1e-6)
+    # lazy semantics: untouched row 0 never moved (no wd decay, no momentum)
+    p0 = _make_params(1, 5, stype="row_sparse")[0]
+    np.testing.assert_array_equal(w_fused[0], p0.data().asnumpy()[0])
+
+
+# ---------------------------------------------------------------------------
+# stale grads, rescale_grad clobber warning
+# ---------------------------------------------------------------------------
+
+
+def test_ignore_stale_grad_skips_unrefreshed_params():
+    # kvstore='device' on purpose: allreduce_grads rewrites every grad
+    # buffer (a version bump), and staleness must be judged BEFORE that
+    # transport — keying it off the post-allreduce version would make the
+    # check a silent no-op for every kvstore-backed trainer
+    params = _make_params(2, 9)
+    tr = gluon.Trainer(params, "sgd", {"learning_rate": 0.1}, kvstore="device")
+    for p in params:
+        p.grad()[:] = nd.array(np.ones(p.shape, np.float32))
+    tr.step(1, ignore_stale_grad=True)
+    w_after1 = [p.data().asnumpy().copy() for p in params]
+    # refresh ONLY param 0's grad; param 1 is stale on the next step
+    params[0].grad()[:] = nd.array(np.ones(params[0].shape, np.float32))
+    tr.step(1, ignore_stale_grad=True)
+    assert np.abs(params[0].data().asnumpy() - w_after1[0]).max() > 0
+    np.testing.assert_array_equal(params[1].data().asnumpy(), w_after1[1])
+    # without the flag the stale param is updated as before
+    tr.step(1, ignore_stale_grad=False)
+    assert np.abs(params[1].data().asnumpy() - w_after1[1]).max() > 0
+
+
+def test_missing_grad_buffer_raises_unless_ignored():
+    params = _make_params(2, 10)
+    tr = gluon.Trainer(params, "sgd", {"learning_rate": 0.1}, kvstore=None)
+    for p in params:
+        p.grad()[:] = nd.array(np.ones(p.shape, np.float32))
+    params[1]._data._grad = None
+    with pytest.raises(UserWarning):
+        tr.step(1)
+    tr.step(1, ignore_stale_grad=True)  # skips the missing-grad param
+
+
+def test_user_set_rescale_grad_warns_before_clobber():
+    params = _make_params(2, 11)
+    tr = gluon.Trainer(params, "sgd", {"learning_rate": 0.1}, kvstore=None)
+    for p in params:
+        p.grad()[:] = nd.array(np.ones(p.shape, np.float32))
+    tr.step(4)  # no warning: first step, rescale untouched
+    tr._optimizer.rescale_grad = 5.0
+    with pytest.warns(UserWarning, match="rescale_grad"):
+        tr.step(4)
+    assert tr._optimizer.rescale_grad == pytest.approx(0.25)
+    # a manual edit BEFORE the first step is clobbered too — and must warn
+    params2 = _make_params(2, 11)
+    tr2 = gluon.Trainer(params2, "sgd", {"learning_rate": 0.1}, kvstore=None)
+    for p in params2:
+        p.grad()[:] = nd.array(np.ones(p.shape, np.float32))
+    tr2._optimizer.rescale_grad = 7.0
+    with pytest.warns(UserWarning, match="rescale_grad"):
+        tr2.step(4)
+
+
+# ---------------------------------------------------------------------------
+# save/load states: Adam's t stays monotonic
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("aggregate_num", [0, 256])
+def test_save_load_states_keeps_adam_t_monotonic(tmp_path, aggregate_num):
+    f = str(tmp_path / "trainer.states")
+    g = [np.full((3, 2), 0.3, np.float32), np.full((3, 3), -0.2, np.float32)]
+
+    def fresh():
+        params = _make_params(2, 12)
+        tr = gluon.Trainer(params, "adam", {"learning_rate": 0.01},
+                           kvstore=None)
+        tr._optimizer.aggregate_num = aggregate_num
+        return params, tr
+
+    def steps(params, tr, k):
+        for _ in range(k):
+            for p, gv in zip(params, g):
+                p.grad()[:] = nd.array(gv)
+            tr.step(1)
+
+    params, tr = fresh()
+    steps(params, tr, 3)
+    w_mid = [p.data().asnumpy().copy() for p in params]
+    tr.save_states(f)
+    steps(params, tr, 2)
+    ref = [p.data().asnumpy() for p in params]
+
+    params2, tr2 = fresh()
+    for p, w in zip(params2, w_mid):
+        p.set_data(nd.array(w))
+    tr2.load_states(f)
+    # the roundtrip must restore the per-index counters, not reset t to 1
+    assert dict(tr2._optimizer._index_update_count) == {0: 3, 1: 3}
+    assert tr2._optimizer.begin_num_update == 3
+    steps(params2, tr2, 2)
+    assert dict(tr2._optimizer._index_update_count) == {0: 5, 1: 5}
+    for a, b in zip(ref, [p.data().asnumpy() for p in params2]):
+        np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-7)
+
+
+# ---------------------------------------------------------------------------
+# grouped multi_* kernels: clip sentinel + single-dispatch machinery
+# ---------------------------------------------------------------------------
+
+
+def test_multi_sgd_clip_sentinel_matches_per_tensor():
+    """clip_gradient=0.0 must CLIP (clamp to zero), not silently disable
+    clipping; < 0 is the only no-clip sentinel (reference convention)."""
+    import jax.numpy as jnp
+
+    w = [jnp.zeros((2,))]
+    g = [jnp.asarray([10.0, -10.0])]
+    clipped = K.multi_sgd_update(w, g, [1.0], [0.0], clip_gradient=0.1)
+    np.testing.assert_allclose(np.asarray(clipped[0]), [-0.1, 0.1], rtol=1e-6)
+    zeroed = K.multi_sgd_update(w, g, [1.0], [0.0], clip_gradient=0.0)
+    np.testing.assert_allclose(np.asarray(zeroed[0]), [0.0, 0.0])
+    unclipped = K.multi_sgd_update(w, g, [1.0], [0.0], clip_gradient=-1.0)
+    np.testing.assert_allclose(np.asarray(unclipped[0]), [-10.0, 10.0])
+
+
+def test_multi_and_preloaded_match_per_tensor_kernels():
+    import jax.numpy as jnp
+
+    rs = np.random.RandomState(0)
+    ws = [jnp.asarray(rs.randn(3, 2).astype(np.float32)) for _ in range(4)]
+    gs = [jnp.asarray(rs.randn(3, 2).astype(np.float32)) for _ in range(4)]
+    ms = [jnp.zeros((3, 2), jnp.float32) for _ in range(4)]
+    lrs, wds = [0.1, 0.2, 0.3, 0.4], [0.0, 0.01, 0.0, 0.02]
+    new_w, new_m = K.multi_sgd_mom_update(ws, gs, ms, lrs, wds, momentum=0.9,
+                                          clip_gradient=-1.0)
+    pre_w, pre_m = K.preloaded_multi_sgd_mom_update(
+        ws, gs, ms, jnp.asarray(lrs), jnp.asarray(wds), momentum=0.9,
+        clip_gradient=-1.0)
+    for i in range(4):
+        rw, rm = K.sgd_mom_update(ws[i], gs[i], ms[i], jnp.float32(lrs[i]),
+                                  jnp.float32(wds[i]), jnp.float32(1.0),
+                                  jnp.float32(-1.0), jnp.float32(0.9))
+        np.testing.assert_allclose(np.asarray(new_w[i]), np.asarray(rw),
+                                   rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(new_m[i]), np.asarray(rm),
+                                   rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(pre_w[i]), np.asarray(new_w[i]),
+                                   rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(pre_m[i]), np.asarray(new_m[i]),
+                                   rtol=1e-6)
+    # single-dispatch contract: the group ran through the shared jitted
+    # group machinery (one compiled body per adapter), not a python loop
+    assert any(step is K.sgd_mom_step for step, _ in K._GROUP_JIT)
+
+
+# ---------------------------------------------------------------------------
+# bucketed gradient allreduce
+# ---------------------------------------------------------------------------
+
+
+def test_bucketed_allreduce_preserves_grads(monkeypatch):
+    params = _make_params(5, 13)
+    tr = gluon.Trainer(params, "sgd", {"learning_rate": 0.1},
+                       kvstore="dist_sync")
+    gvals = [np.random.RandomState(i).randn(*p.shape).astype(np.float32)
+             for i, p in enumerate(params)]
+    for p, g in zip(params, gvals):
+        p.grad()[:] = nd.array(g)
+    tr.allreduce_grads()
+    # single worker: the reduced value IS the local grad, now routed through
+    # flatten -> pushpull -> unflatten
+    for p, g in zip(params, gvals):
+        np.testing.assert_allclose(p.grad().asnumpy(), g, rtol=1e-6)
+    assert _c()["allreduce_bucket"] == 1
+    assert _c()["allreduce_bucket_params"] == 5
+    # a tiny byte cap splits the same grads into multiple buckets
+    profiler.reset_counters()
+    monkeypatch.setenv("MXNET_KVSTORE_BUCKET_BYTES", "40")
+    for p, g in zip(params, gvals):
+        p.grad()[:] = nd.array(g)
+    tr.allreduce_grads()
+    for p, g in zip(params, gvals):
+        np.testing.assert_allclose(p.grad().asnumpy(), g, rtol=1e-6)
+    assert _c()["allreduce_bucket"] > 1
+    assert _c()["allreduce_bucket_params"] == 5
+
+
+def test_local_kvstore_does_not_bucket():
+    params = _make_params(3, 14)
+    tr = gluon.Trainer(params, "sgd", {"learning_rate": 0.1}, kvstore="device")
+    for p in params:
+        p.grad()[:] = nd.array(np.ones(p.shape, np.float32))
+    tr.allreduce_grads()
+    assert _c()["allreduce_bucket"] == 0
+
+
+def test_bucketing_disabled_for_server_side_optimizer():
+    from incubator_mxnet_tpu import kvstore as kv_mod
+
+    kv = kv_mod.create("dist_sync")
+    assert kv.supports_grad_bucketing()
+    kv.set_optimizer(mx.optimizer.create("sgd"))
+    assert not kv.supports_grad_bucketing()
+    # the async tier ACCUMULATES pushes per key server-side, so a reused
+    # bucket key would pull back a running sum — never bucket it
+    async_kv = object.__new__(kv_mod.KVStoreDistAsync)  # no server spawn
+    assert not async_kv.supports_grad_bucketing()
+
+
+# ---------------------------------------------------------------------------
+# observability + CI smoke of the microbenchmark
+# ---------------------------------------------------------------------------
+
+
+def test_fused_counters_surface_in_profiler_dumps():
+    _run_steps("sgd", {"learning_rate": 0.1}, 256, steps=1)
+    text = profiler.dumps()
+    assert "fused_step_call" in text
+    assert "allreduce_bucket" in text
+
+
+def test_trainer_step_benchmark_smoke():
+    """Tier-1-adjacent smoke of benchmark/opperf/trainer_step.py: tiny
+    sizes, proves the harness runs end-to-end on the CPU backend and emits
+    the JSON contract (the 2x acceptance number is measured by the full
+    run, not here)."""
+    import importlib.util
+
+    path = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                        "benchmark", "opperf", "trainer_step.py")
+    spec = importlib.util.spec_from_file_location("trainer_step_bench", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    line = mod.run(n_params=6, shape=(4, 2), iters=2, warmup=1, repeats=1)
+    assert line["bench"] == "trainer_step"
+    for mode in ("per_tensor", "fused"):
+        assert line["steps_per_sec"][mode] > 0
+    assert "speedup_fused" in line
