@@ -47,6 +47,7 @@ import os
 import threading
 import weakref
 from collections import OrderedDict
+from time import perf_counter as _perf
 
 import jax as _jax
 import numpy as _np
@@ -296,6 +297,7 @@ class _BulkQueue:
         self.ops = []
         self.consts = []
         self._lock = threading.RLock()
+        self._t0_accum = None  # first-enqueue time (bulk.accumulate span)
 
     # -- classification helpers --------------------------------------
     def _wire_value(self, v, jax, key_parts):
@@ -442,6 +444,8 @@ class _BulkQueue:
             op = _PendingOp(fn, tuple(wiring), static_kw, dyn_kw,
                             len(avals), op_key)
             i = len(self.ops)
+            if i == 0 and _profiler._active:
+                self._t0_accum = _perf()  # accumulate-phase span start
             # the queue holds only WEAK refs to its outputs: a deferred the
             # caller has dropped by flush time is provably unreadable, so
             # the flush program need not return it (XLA DCEs the buffer)
@@ -529,6 +533,8 @@ class _BulkQueue:
         with self._lock:
             if not self.ops:
                 return
+            t_flush = _perf() if profiler._active else None
+            t_accum, self._t0_accum = self._t0_accum, None
             ops, consts = self.ops, self.consts
             self.ops, self.consts = [], []
             # liveness snapshot: dereffed again at assignment, so a deferred
@@ -538,7 +544,8 @@ class _BulkQueue:
             graph_key = (tuple(op.key for op in ops), live)
             with _flush_lock:
                 jitted = _flush_jits.get(graph_key)
-                if jitted is None:
+                compiled_now = jitted is None
+                if compiled_now:
                     # spec built only on compile (and fallback below): the
                     # steady-state flush is just this dict hit + one pjit call
                     jitted = jax.jit(_program(_spec_of(ops), live))
@@ -548,7 +555,14 @@ class _BulkQueue:
                 else:
                     _flush_jits.move_to_end(graph_key)
             try:
+                t_ex = t_flush and _perf()
+                # jax.jit is lazy: a fresh graph traces+compiles inside its
+                # first call, so that call is the "trace" phase, not execute
                 results = jitted(consts)
+                if t_ex:
+                    profiler.record_span(
+                        "bulk.trace" if compiled_now else "bulk.execute",
+                        "bulk", t_ex)
             except Exception:
                 # jit artifact or genuine user error: re-run the graph
                 # eagerly; genuine errors surface with eager semantics
@@ -570,6 +584,17 @@ class _BulkQueue:
                     raise
             profiler.incr("bulk_flush")
             profiler.incr("bulk_ops_flushed", len(ops))
+            if t_flush is not None:
+                # the accumulate phase (first enqueue -> flush trigger)
+                # travels as an arg, NOT its own span: it can straddle
+                # unrelated spans on this thread (ambient bulking flushes
+                # from inside Trainer.step), and a partially-overlapping
+                # B/E interval would break chrome-trace duration nesting
+                args = {"ops": len(ops)}
+                if t_accum is not None:
+                    args["accum_ms"] = round((t_flush - t_accum) * 1e3, 3)
+                profiler.record_span("bulk.flush", "bulk", t_flush,
+                                     args=args)
             k = 0
             for op, lv in zip(ops, live):
                 for wr, alive in zip(op.outs, lv):

@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import warnings
 
+from .. import profiler as _profiler
 from .. import optimizer as opt_mod
 from ..optimizer import fused as _fused
 from .parameter import Parameter, ParameterDict
@@ -126,8 +127,18 @@ class Trainer:
         # every grad buffer (bumping its version), which is transport, not
         # a fresh backward
         stale = self._stale_indices() if ignore_stale_grad else frozenset()
-        self.allreduce_grads()
-        self._update(ignore_stale_grad, stale)
+        try:
+            with _profiler.span("trainer.allreduce", "trainer"):
+                self.allreduce_grads()
+            with _profiler.span("trainer.update", "trainer"):
+                self._update(ignore_stale_grad, stale)
+        finally:
+            # the step boundary every span since the previous boundary
+            # belongs to: closes step telemetry (buckets, slow-step check,
+            # memory watermark) and advances the step id; no-op when the
+            # profiler is off.  In a finally so a raised-and-recovered step
+            # can't bill its partial time to the NEXT step's telemetry.
+            _profiler.step_boundary()
 
     def allreduce_grads(self):
         """Aggregate gradients across devices/hosts via the kvstore facade
@@ -157,8 +168,13 @@ class Trainer:
         if not self._kv_initialized:
             self._init_kvstore()
         self._check_and_rescale_grad(self._scale / batch_size)
-        self._update(ignore_stale_grad,
-                     self._stale_indices() if ignore_stale_grad else frozenset())
+        try:
+            with _profiler.span("trainer.update", "trainer"):
+                self._update(ignore_stale_grad,
+                             self._stale_indices() if ignore_stale_grad
+                             else frozenset())
+        finally:
+            _profiler.step_boundary()
 
     def _stale_indices(self):
         """Params whose grad buffer was NOT rewritten since their last

@@ -10,9 +10,11 @@ from __future__ import annotations
 
 import threading
 import queue as _queue
+from time import perf_counter as _perf
 
 import numpy as _np
 
+from .. import profiler as _profiler
 from ..ndarray.ndarray import NDArray
 from .. import ndarray as nd
 
@@ -314,7 +316,11 @@ class PrefetchingIter(DataIter):
         while not self._stop.is_set():
             err = None
             try:
+                t0 = _perf() if _profiler._active else None
                 batch = self.data_iter.next()
+                if t0 is not None:
+                    _profiler.record_span("io.prefetch", "io", t0)
+                _profiler.incr("io_prefetch_batches")
             except StopIteration:
                 batch = None
             except BaseException as e:  # noqa: BLE001 — any failure must

@@ -35,9 +35,11 @@ collapses onto XLA collectives:
 from __future__ import annotations
 
 import os as _os
+from time import perf_counter as _perf
 
 import numpy as _np
 
+from .. import profiler as _profiler
 from ..ndarray.ndarray import NDArray, array, zeros
 
 __all__ = ["KVStore", "KVStoreLocal", "KVStoreDist", "KVStoreDistAsync",
@@ -109,7 +111,6 @@ def bucketed_pushpull(kv, items, cap_bytes=None):
     store hangs off them — are stable across steps."""
     import numpy as np
 
-    from .. import profiler as _profiler
     from ..engine import DeferredArray
 
     cap = bucket_bytes() if cap_bytes is None else cap_bytes
@@ -137,6 +138,7 @@ def bucketed_pushpull(kv, items, cap_bytes=None):
                 end += 1
             chunk = members[start:end]
             start = end
+            t0 = _perf() if _profiler._active else None
             grads = [g for _, g, _ in chunk]
             raws = [r for _, _, r in chunk]
             flat = NDArray(_flatten(raws), ctx=grads[0].context)
@@ -148,6 +150,12 @@ def bucketed_pushpull(kv, items, cap_bytes=None):
                 g._version += 1
             _profiler.incr("allreduce_bucket")
             _profiler.incr("allreduce_bucket_params", len(chunk))
+            if t0 is not None:
+                # the nested kvstore.pushpull span carries the wire time;
+                # this one adds flatten/scatter overhead + bucket shape
+                _profiler.record_span("kvstore.bucketed_pushpull", "comms",
+                                      t0, args={"params": len(chunk),
+                                                "bytes": nbytes})
 
 
 def create(name="local"):
@@ -203,6 +211,7 @@ class KVStore:
             for k, v in zip(key, value):
                 self.push(k, v, priority)
             return
+        t0 = _perf() if _profiler._active else None
         agg = self._aggregate(value)
         if self._compression is not None:
             # compress BEFORE the wire — the whole point of gradient
@@ -214,16 +223,21 @@ class KVStore:
             self._updater(key, agg, self._store[key])
         else:
             self._store[key] = agg
+        if t0 is not None:
+            _profiler.record_span("kvstore.push", "comms", t0)
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
         if isinstance(key, (list, tuple)):
             for k, o in zip(key, out):
                 self.pull(k, o, priority)
             return
+        t0 = _perf() if _profiler._active else None
         value = self._store[key]
         outs = out if isinstance(out, (list, tuple)) else [out]
         for o in outs:
             value.copyto(o)
+        if t0 is not None:
+            _profiler.record_span("kvstore.pull", "comms", t0)
 
     def pushpull(self, key, value, out=None, priority=0):
         """Fused push+pull (parity: the 1.7 ``pushpull`` fast path /
@@ -232,6 +246,7 @@ class KVStore:
             for i, k in enumerate(key):
                 self.pushpull(k, value[i], out[i] if out is not None else None, priority)
             return
+        t0 = _perf() if _profiler._active else None
         agg = self._aggregate(value)
         if self._compression is not None:
             agg = self._compressed_reduce(key, agg)
@@ -249,6 +264,8 @@ class KVStore:
             outs = out if isinstance(out, (list, tuple)) else [out]
             for o in outs:
                 result.copyto(o)
+        if t0 is not None:
+            _profiler.record_span("kvstore.pushpull", "comms", t0)
 
     def broadcast(self, key, value, out=None, priority=0):
         self.init(key, value)
@@ -521,6 +538,7 @@ class KVStoreDistAsync(KVStore):
             for k, v in zip(key, value):
                 self.push(k, v, priority)
             return
+        t0 = _perf() if _profiler._active else None
         agg = self._aggregate(value)
         if self._compression is not None:
             # the int8 CODES cross the TCP wire (the whole point of
@@ -529,19 +547,24 @@ class KVStoreDistAsync(KVStore):
             codes, threshold = self._quantize_2bit(key, agg)
             self._client.request("push_codes", key, _np.asarray(codes),
                                  threshold, self._rank)
-            return
-        self._client.request("push", key, _np.asarray(agg.asnumpy()),
-                             self._rank)
+        else:
+            self._client.request("push", key, _np.asarray(agg.asnumpy()),
+                                 self._rank)
+        if t0 is not None:
+            _profiler.record_span("kvstore.push", "comms", t0)
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
         if isinstance(key, (list, tuple)):
             for k, o in zip(key, out):
                 self.pull(k, o, priority)
             return
+        t0 = _perf() if _profiler._active else None
         value = self._client.request("pull", key)
         outs = out if isinstance(out, (list, tuple)) else [out]
         for o in outs:
             array(value, ctx=o.context).copyto(o)
+        if t0 is not None:
+            _profiler.record_span("kvstore.pull", "comms", t0)
 
     def pushpull(self, key, value, out=None, priority=0):
         self.push(key, value, priority)

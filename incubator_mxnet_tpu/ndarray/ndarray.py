@@ -22,10 +22,13 @@ import numpy as _np
 import jax
 import jax.numpy as jnp
 
+from time import perf_counter as _perf
+
 from ..base import _as_np_dtype
 from ..context import Context, current_context, cpu
 from .. import autograd
 from .. import engine as _engine
+from .. import profiler as _profiler
 from ..engine import DeferredArray as _Deferred
 from ..ops import registry as _registry
 from ..ops.registry import MISS as _MISS, get_op
@@ -656,7 +659,14 @@ def invoke(fn, arrays, kwargs, name="", ctx=None):
             if isinstance(out, tuple):
                 return [NDArray(o, ctx=ctx) for o in out]
             return NDArray(out, ctx=ctx)
-    out = fn(*raw, **kwargs)
+    if _profiler._active:
+        # cache miss / bypass / NaiveEngine: the raw python-traced call —
+        # the "miss cost" side of the dispatch-cache span set
+        _t0 = _perf()
+        out = fn(*raw, **kwargs)
+        _profiler.record_span("dispatch.raw", "dispatch", _t0)
+    else:
+        out = fn(*raw, **kwargs)
     if isinstance(out, tuple):
         return [NDArray(o, ctx=ctx) for o in out]
     return NDArray(out, ctx=ctx)

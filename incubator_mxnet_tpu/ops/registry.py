@@ -40,6 +40,7 @@ import inspect
 import os
 import threading
 from collections import OrderedDict
+from time import perf_counter as _perf
 
 import jax as _jax
 import numpy as _np
@@ -387,9 +388,11 @@ def _incr(name):  # rebound to profiler.incr on first use (import-cycle dodge)
 
 
 def _get_entry(fn, raw_args, kwargs):
-    """Core lookup: returns (entry, dyn_args, dyn_kw_vals) when a compiled
-    entry exists (counting a hit), or None (counting a miss/bypass) when the
-    call should take the raw path this time."""
+    """Core lookup: returns (entry, dyn_args, dyn_kw_vals, key, fresh)
+    when a compiled entry exists (counting a hit; ``fresh`` means this
+    call just created it, so its first execution pays trace+compile), or
+    None (counting a miss/bypass) when the call should take the raw path
+    this time."""
     try:
         key, spec, dyn, static_kw, dkn, dkv = _cache_key(fn, raw_args, kwargs)
     except _Ineligible:
@@ -404,11 +407,11 @@ def _get_entry(fn, raw_args, kwargs):
         except KeyError:
             pass  # concurrently evicted; the fetched entry is still valid
         _incr("dispatch_cache_hit")
-        return entry, dyn, dkv, key
+        return entry, dyn, dkv, key, False
     entry = _miss(fn, key, spec, static_kw, dkn)
     if entry is None:
         return None
-    return entry, dyn, dkv, key
+    return entry, dyn, dkv, key, True
 
 
 def _blacklist(fn, key):
@@ -458,18 +461,22 @@ def dispatch_eager(fn, raw_args, kwargs):
     # hit path is lock-free: C OrderedDict ops are GIL-atomic, and a lost
     # move_to_end race only perturbs LRU order, never correctness
     entry = _entries.get(key)
+    fresh = False
     if entry is None:
         entry = _miss(fn, key, spec, static_kw, dkn)
         if entry is None:
             return MISS
+        fresh = True  # first fwd call traces+compiles: the jit-trace span
     else:
         try:
             _entries.move_to_end(key)
         except KeyError:
             pass  # concurrently evicted; the fetched entry is still valid
         _incr("dispatch_cache_hit")
+    prof = _prof
+    t0 = _perf() if (prof is not None and prof._active) else None
     try:
-        return entry.fwd(tuple(dyn), tuple(dkv))
+        out = entry.fwd(tuple(dyn), tuple(dkv))
     except Exception:
         # Re-run raw: if *that* succeeds the failure was a jit artifact
         # (concretization on a dynamic value, etc.) — blacklist the key
@@ -479,7 +486,13 @@ def dispatch_eager(fn, raw_args, kwargs):
         with _lock:
             _blacklist(fn, key)
         _counters().incr("dispatch_cache_fallback")
+        if t0 is not None:
+            prof.record_span("dispatch.fallback", "dispatch", t0)
         return out
+    if t0 is not None:
+        prof.record_span("dispatch.jit_compile" if fresh
+                         else "dispatch.cache_hit", "dispatch", t0)
+    return out
 
 
 def _miss(fn, key, spec, static_kw, dkn):
@@ -549,7 +562,7 @@ def lookup_recorded(fn, raw_args, kwargs, needs):
     found = _get_entry(fn, raw_args, kwargs)
     if found is None:
         return None
-    entry, dyn, dkv, key = found
+    entry, dyn, dkv, key, fresh = found
     dyn = tuple(dyn)
     dkv = tuple(dkv)
     # positions of the grad-needing inputs within the dynamic-arg tuple:
@@ -564,6 +577,8 @@ def lookup_recorded(fn, raw_args, kwargs, needs):
             return None
     diff_pos = tuple(diff_pos)
 
+    prof = _prof
+    t0 = _perf() if (prof is not None and prof._active) else None
     try:
         out = entry.fwd(dyn, dkv)
     except Exception:
@@ -574,6 +589,9 @@ def lookup_recorded(fn, raw_args, kwargs, needs):
             _blacklist(fn, key)
         _counters().incr("dispatch_cache_fallback")
         return None
+    if t0 is not None:
+        prof.record_span("dispatch.jit_compile" if fresh
+                         else "dispatch.cache_hit", "dispatch", t0)
     outs = out if isinstance(out, tuple) else (out,)
 
     bwd = entry.bwd.get(diff_pos)
@@ -584,8 +602,13 @@ def lookup_recorded(fn, raw_args, kwargs, needs):
     def vjp_fn(cots, _bwd=bwd, _call=entry.call, _pos=diff_pos,
                _dyn=dyn, _dkv=dkv):
         cots = tuple(cots)
+        p = _prof
+        tb = _perf() if (p is not None and p._active) else None
         try:
-            return _bwd(_dyn, _dkv, cots)
+            grads = _bwd(_dyn, _dkv, cots)
+            if tb is not None:
+                p.record_span("dispatch.backward", "dispatch", tb)
+            return grads
         except Exception:
             # mirror the forward fallback: eager vjp keeps correctness if
             # the jitted backward trips on something the forward didn't

@@ -25,6 +25,7 @@ preserving their kernels' lazy semantics.
 from __future__ import annotations
 
 import os as _os
+from time import perf_counter as _perf
 
 from .. import engine as _engine
 from .. import profiler as _profiler
@@ -145,12 +146,20 @@ def fused_update(optimizer, items, states):
                 lrs = [optimizer._get_lr(i) for i, _, _, _ in chunk]
                 wds = [optimizer._get_wd(i) for i, _, _, _ in chunk]
                 ts = [optimizer._index_update_count[i] for i, _, _, _ in chunk]
+                # resolve pending bulk-deferred buffers BEFORE the span
+                # opens: a flush recorded inside it would double-bill the
+                # host bucket (bulk.flush and fused.group_apply are both
+                # telemetry roots)
+                ws = [_concrete(w) for _, w, _, _ in chunk]
+                gs = [_concrete(g) for _, _, g, _ in chunk]
+                t0 = _perf() if _profiler._active else None
                 new_w, new_s = K.group_apply(
-                    step,
-                    [_concrete(w) for _, w, _, _ in chunk],
-                    [_concrete(g) for _, _, g, _ in chunk],
+                    step, ws, gs,
                     [[s._data for s in flat] for _, _, _, flat in chunk],
                     lrs, wds, ts, scalars, donate=donate)
+                if t0 is not None:
+                    _profiler.record_span("fused.group_apply", "optimizer",
+                                          t0, args={"params": len(chunk)})
                 for m, (_, w, _, flat) in enumerate(chunk):
                     _swap(w, new_w[m])
                     for s_nd, s_new in zip(flat, new_s[m]):

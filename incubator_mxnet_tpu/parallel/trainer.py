@@ -18,10 +18,13 @@ use — same Block, same loss, same Optimizer subclass.
 """
 from __future__ import annotations
 
+from time import perf_counter as _perf
+
 import jax
 import jax.numpy as jnp
 import numpy as _np
 
+from .. import profiler as _profiler
 from .. import autograd
 from .. import optimizer as opt_mod
 from ..ndarray.ndarray import NDArray
@@ -231,17 +234,23 @@ class SPMDTrainer:
         lr = self.learning_rate()
         rescale = self._optimizer.rescale_grad / batch_size
         key = get_key()
-        new_params, new_states, loss = fn(
-            key,
-            jnp.float32(self._t),
-            jnp.float32(lr),
-            jnp.float32(rescale),
-            self._param_arrays,
-            self._opt_states,
-            *arrays,
-        )
-        self._param_arrays = new_params
-        self._opt_states = new_states
+        t0 = _perf() if _profiler._active else None
+        try:
+            new_params, new_states, loss = fn(
+                key,
+                jnp.float32(self._t),
+                jnp.float32(lr),
+                jnp.float32(rescale),
+                self._param_arrays,
+                self._opt_states,
+                *arrays,
+            )
+            self._param_arrays = new_params
+            self._opt_states = new_states
+            if t0 is not None:
+                _profiler.record_span("spmd.step", "trainer", t0)
+        finally:
+            _profiler.step_boundary()
         return NDArray(loss)
 
     # ------------------------------------------------------------------
@@ -278,17 +287,24 @@ class SPMDTrainer:
             lrs.append(self.learning_rate())
             keys.append(get_key())
         rescale = self._optimizer.rescale_grad / batch_size
-        new_params, new_states, loss = fn(
-            jnp.stack(keys),
-            jnp.asarray(ts, jnp.float32),
-            jnp.asarray(lrs, jnp.float32),
-            jnp.float32(rescale),
-            self._param_arrays,
-            self._opt_states,
-            *arrays,
-        )
-        self._param_arrays = new_params
-        self._opt_states = new_states
+        t0 = _perf() if _profiler._active else None
+        try:
+            new_params, new_states, loss = fn(
+                jnp.stack(keys),
+                jnp.asarray(ts, jnp.float32),
+                jnp.asarray(lrs, jnp.float32),
+                jnp.float32(rescale),
+                self._param_arrays,
+                self._opt_states,
+                *arrays,
+            )
+            self._param_arrays = new_params
+            self._opt_states = new_states
+            if t0 is not None:
+                _profiler.record_span("spmd.step_bulk", "trainer", t0,
+                                      args={"k": int(k)})
+        finally:
+            _profiler.step_boundary()  # one boundary per dispatch, not per k
         return NDArray(loss)
 
     def _build_bulk(self, example_arrays, k):

@@ -1,25 +1,60 @@
-"""``mx.profiler`` — profiling bridge.
+"""``mx.profiler`` — structured tracing / telemetry bridge.
 
 Parity target: [U:python/mxnet/profiler.py] over the C++ engine profiler
 ([U:src/profiler/profiler.cc]).  The reference instruments every engine op
-and dumps chrome://tracing JSON; on TPU the equivalent machinery is
-``jax.profiler`` (XLA/xprof traces viewable in TensorBoard/Perfetto, incl.
-per-HLO timing on device), so this module keeps the MXNet control surface
-(``set_config``/``start``/``stop``/``dumps``, scopes/markers) and routes it
-there.  ``MXNET_PROFILER_AUTOSTART=1`` is honored at import like the
-reference env var.
+and dumps chrome://tracing JSON; this module restores that contract on top
+of the jax_graft stack with three cooperating layers:
+
+1. **Span recorder** — a per-thread ring buffer of ``(name, category,
+   t0, duration, step, args)`` spans, armed by ``start()``.  Every hot
+   path that already reports counters (dispatch cache, engine bulk flush,
+   fused optimizer step, kvstore pushpull, io prefetch, trainer step
+   boundaries) records spans into it; ``dump()`` serializes the rings to a
+   chrome://tracing JSON at ``_config['filename']`` (paired B/E events,
+   viewable in Perfetto / ``chrome://tracing`` alongside the xprof
+   capture).  When the recorder is off the instrumentation sites pay one
+   module-attribute read (``_active``) and a branch — nothing else.
+
+2. **xprof bridge** — ``start()``/``stop()`` still drive
+   ``jax.profiler`` (XLA/xprof device traces, incl. per-HLO timing); a
+   broken xprof install warns ONCE and bumps the ``profiler_trace_error``
+   counter instead of failing silently.
+
+3. **Per-step telemetry** — ``step_boundary()`` (called by
+   ``gluon.Trainer.step``) closes a step: its wall time is split into
+   host-dispatch / comms / device buckets from the spans recorded inside
+   it, appended to a rolling window (``step_stats()``), checked by the
+   slow-step detector (``MXNET_PROFILER_SLOW_STEP_MS`` or an automatic
+   rolling-percentile mode — one breakdown log line per anomalous step),
+   and device-memory watermarks are sampled via ``Device.memory_stats()``.
+
+Counters are **strict** since ISSUE 5: ``incr`` on an undeclared name
+raises (a typo'd instrumentation site fails loudly instead of reporting
+zeros forever); extensions register theirs via ``declare_counter()``.
+
+``MXNET_PROFILER_AUTOSTART=1`` is honored at import like the reference
+env var.  See docs/observability.md for the full tour.
 """
 from __future__ import annotations
 
 import atexit
+import json
+import logging
 import os
 import threading as _threading
 import time
+import warnings as _warnings
+import weakref as _weakref
 
 import jax
 
 __all__ = ["set_config", "start", "stop", "dump", "dumps", "pause", "resume",
-           "scope", "Marker", "state", "counters", "reset_counters", "incr"]
+           "scope", "span", "Marker", "state", "counters", "reset_counters",
+           "incr", "declare_counter", "record_span", "step_boundary",
+           "current_step", "step_stats", "memory_watermark", "recorder_stats",
+           "recording_enabled"]
+
+_logger = logging.getLogger(__name__)
 
 _config = {
     "filename": "profile.json",   # reference default profile_output.json-ish
@@ -29,15 +64,32 @@ _config = {
     "profile_memory": True,
     "profile_api": True,
     "aggregate_stats": False,
+    # -- ISSUE 5 tracing/telemetry knobs --------------------------------
+    "ring_size": int(os.environ.get("MXNET_PROFILER_RING_SIZE", "65536")),
+    "slow_step_ms": None,          # explicit threshold; None = auto mode
+    "slow_step_auto": True,        # rolling-percentile detector when no
+    "slow_step_auto_mult": 4.0,    # explicit threshold is configured
+    "step_window": 256,            # rolling step-stats window length
+    "memory_sampling": True,       # Device.memory_stats() at step bounds
 }
-_state = {"running": False, "dir": None, "t0": None}
-_agg = {}  # name -> [count, total_s]; aggregated incrementally (bounded)
+_state = {"running": False, "dir": None, "t0": None, "xprof": False}
+_agg = {}  # name -> [count, total_s]; guarded by _counter_lock (scopes run
+           # concurrently on the engine's per-thread bulk queues)
+
+# perf_counter epoch all trace timestamps are relative to (chrome trace ts
+# is in us; an absolute perf_counter would overflow viewer precision)
+_EPOCH = time.perf_counter()
+_perf = time.perf_counter
 
 
 def _tally(name, dur):
-    cnt_tot = _agg.setdefault(name, [0, 0.0])
-    cnt_tot[0] += 1
-    cnt_tot[1] += dur
+    # under the counter lock: an unlocked read-modify-write on the shared
+    # dict drops tallies across concurrent scopes and lets dumps() observe
+    # a dict mutating mid-iteration
+    with _counter_lock:
+        cnt_tot = _agg.setdefault(name, [0, 0.0])
+        cnt_tot[0] += 1
+        cnt_tot[1] += dur
 
 
 # -- dispatch/engine event counters -----------------------------------------
@@ -47,6 +99,10 @@ def _tally(name, dur):
 # raw-path bypasses, jit fallbacks, bulk flush sizes, fused-update group
 # sizes, and allreduce bucket counts.  Plain int adds — cheap enough to
 # stay on even when tracing is off.
+#
+# The dict below is THE declared set: ``incr`` on any other name raises
+# (tools/lint_counters.py greps the tree against it), and extensions add
+# theirs via ``declare_counter()``.
 
 _counters = {
     "dispatch_cache_hit": 0,
@@ -61,21 +117,40 @@ _counters = {
     "fused_step_fallback_params": 0,  # params that took the per-tensor loop
     "allreduce_bucket": 0,            # bucketed gradient pushpulls
     "allreduce_bucket_params": 0,     # grads carried by those buckets
+    "profiler_trace_error": 0,        # jax.profiler start/stop failures
+    "slow_step_detected": 0,          # slow-step detector firings
+    "io_prefetch_batches": 0,         # batches produced by prefetch workers
 }
 _counter_lock = _threading.Lock()
+
+
+def declare_counter(name, initial=0):
+    """Register an extension counter so ``incr(name)`` is legal.  In-tree
+    counters live in the ``_counters`` literal above; out-of-tree
+    instrumentation (plugins, experiments) must declare before counting."""
+    with _counter_lock:
+        _counters.setdefault(name, initial)
 
 
 def incr(name, n=1):
     # locked: the engine supports concurrent per-thread bulk queues, and a
     # bare read-modify-write would drop increments across threads (tests
-    # pin exact counts); ~100ns next to a ~10us dispatch
+    # pin exact counts); ~100ns next to a ~10us dispatch.  STRICT: an
+    # undeclared name raises instead of silently creating a key that
+    # reports zeros forever (the old .get(name, 0) behavior).
     with _counter_lock:
-        _counters[name] = _counters.get(name, 0) + n
+        try:
+            _counters[name] += n
+        except KeyError:
+            raise KeyError(
+                f"undeclared profiler counter {name!r}; add it to "
+                f"profiler._counters or call declare_counter() first"
+            ) from None
 
 
 def counters():
     """Snapshot of the dispatch/bulking counters (parity-adjacent to the
-    reference's engine op counters; see docs/eager_dispatch.md)."""
+    reference's engine op counters; see docs/observability.md)."""
     with _counter_lock:
         return dict(_counters)
 
@@ -86,57 +161,562 @@ def reset_counters():
             _counters[k] = 0
 
 
+# ---------------------------------------------------------------------------
+# Span recorder (per-thread ring buffers)
+# ---------------------------------------------------------------------------
+
+# Fast gates read by the instrumentation sites (one module-attr read + a
+# branch on the disabled path — the <3% overhead budget of ISSUE 5):
+#   _recording  — spans go to the ring buffers (armed by start())
+#   _telemetry  — step buckets accumulate (slow-step knob without a trace)
+#   _active     — _recording or _telemetry; THE pre-check hot paths use
+_recording = False
+_telemetry = os.environ.get("MXNET_PROFILER_SLOW_STEP_MS") is not None
+_active = _recording or _telemetry
+
+_rings = []     # every live _Ring of the current recording generation
+_ring_gen = 0   # bumped by start(): stale TLS rings are abandoned
+_tls = _threading.local()
+
+# step-bucket attribution: only ROOT spans count (nested phases like
+# bulk.trace/bulk.execute or per-bucket kvstore.pushpull-inside-
+# bucketed_pushpull would double-bill their parent's time)
+_BUCKET_OF = {
+    "dispatch.cache_hit": "host",
+    "dispatch.jit_compile": "host",
+    "dispatch.fallback": "host",
+    "dispatch.raw": "host",
+    "dispatch.backward": "host",
+    "bulk.flush": "host",
+    "fused.group_apply": "host",
+    "kvstore.pushpull": "comms",
+    "kvstore.push": "comms",
+    "kvstore.pull": "comms",
+}
+
+
+_ring_uid = 0  # unique chrome-trace tid per ring: OS thread idents are
+               # recycled, and reusing one would merge distinct (dead)
+               # threads onto a single trace row
+
+
+class _Ring:
+    """Fixed-capacity per-thread span buffer.  Only the owner thread
+    writes; ``snapshot()`` from the dump thread rides the GIL (list slot
+    assignment is atomic — a racing write can at worst duplicate/omit the
+    newest span, never tear one)."""
+
+    __slots__ = ("buf", "cap", "pos", "count", "dropped", "tid", "tname",
+                 "gen", "owner")
+
+    def __init__(self, cap, gen):
+        global _ring_uid
+        self.cap = max(1, int(cap))
+        self.buf = [None] * self.cap
+        self.pos = 0
+        self.count = 0
+        self.dropped = 0
+        _ring_uid += 1          # caller holds _counter_lock (or import)
+        self.tid = _ring_uid
+        thread = _threading.current_thread()
+        self.tname = thread.name
+        # weakref, not ident: idents recycle the moment a joined thread's
+        # stack is reused, which would make its dead ring look alive
+        self.owner = _weakref.ref(thread)
+        self.gen = gen
+
+    def dead(self):
+        t = self.owner()
+        return t is None or not t.is_alive()
+
+    def add(self, ev):
+        p = self.pos
+        self.buf[p] = ev
+        self.pos = (p + 1) % self.cap
+        if self.count < self.cap:
+            self.count += 1
+        else:
+            self.dropped += 1
+
+    def snapshot(self):
+        """Spans in chronological (insertion) order."""
+        if self.count < self.cap:
+            return self.buf[:self.count]
+        p = self.pos
+        return self.buf[p:] + self.buf[:p]
+
+
+# retained-rings cap: dead threads' rings survive for dump() (a prefetch
+# worker that exited mid-session recorded real spans), but under thread
+# churn (a fresh worker per epoch) retention must not grow without bound
+_MAX_RINGS = 64
+_evicted = [0, 0]  # spans, dropped carried by evicted dead rings
+
+
+def _ring():
+    r = getattr(_tls, "ring", None)
+    if r is None or r.gen != _ring_gen:
+        with _counter_lock:
+            r = _Ring(_config["ring_size"], _ring_gen)
+            _tls.ring = r
+            _rings.append(r)
+            if len(_rings) > _MAX_RINGS:
+                for x in [x for x in _rings
+                          if x.dead() and x is not _step_ring][
+                        :len(_rings) - _MAX_RINGS]:
+                    # oldest dead rings evicted first; their spans leave
+                    # the trace but stay visible in the dropped tally
+                    _evicted[0] += x.count
+                    _evicted[1] += x.dropped
+                    _rings.remove(x)
+    return r
+
+
+_step_ring = None  # dedicated virtual timeline for the per-step spans: a
+                   # user scope may legitimately straddle a step boundary,
+                   # and a step span sharing the user thread's row would
+                   # then partially overlap it and break B/E nesting
+
+
+def _get_step_ring():
+    global _step_ring
+    with _counter_lock:
+        if _step_ring is None or _step_ring.gen != _ring_gen:
+            r = _Ring(_config["ring_size"], _ring_gen)
+            r.tname = "steps (telemetry)"
+            _rings.append(r)
+            _step_ring = r
+        return _step_ring
+
+
+def recording_enabled():
+    return _recording
+
+
+def recorder_stats():
+    """Occupancy of the span recorder: per-generation totals of recorded
+    and ring-evicted (dropped-oldest) spans."""
+    with _counter_lock:
+        rings = list(_rings)
+        ev_spans, ev_dropped = _evicted
+    return {
+        "recording": _recording,
+        "threads": len(rings),
+        "spans": sum(r.count for r in rings),
+        "dropped": sum(r.dropped for r in rings) + ev_spans + ev_dropped,
+        "ring_size": _config["ring_size"],
+    }
+
+
+def record_span(name, category, t0, t1=None, args=None, step=None):
+    """Record one completed span.  ``t0``/``t1`` are ``time.perf_counter()``
+    readings (``t1`` defaults to now); ``step`` defaults to the current
+    step id.  Cheap no-op when neither the recorder nor telemetry is armed
+    — but hot paths should pre-check ``profiler._active`` themselves so
+    the disabled path never pays the call."""
+    if not _active:
+        return
+    if t1 is None:
+        t1 = _perf()
+    if t0 < _armed_at:
+        # a span straddling the arming instant (e.g. a scope entered
+        # before start()) is clamped to the armed window: a B timestamp
+        # predating every other recorded span would partially overlap
+        # them and break chrome-trace duration nesting
+        t0 = _armed_at
+        if t1 < t0:
+            t1 = t0
+    bucket = _BUCKET_OF.get(name)
+    if bucket is not None and _threading.get_ident() == _step_thread:
+        # only the step-owning thread bills the step buckets: a background
+        # io-prefetch worker's dispatch spans overlap the step on the wall
+        # clock and would inflate host_ms past what the step critically
+        # paid (its spans still land in the trace below)
+        with _counter_lock:
+            _step_acc[bucket] = _step_acc.get(bucket, 0.0) + (t1 - t0)
+    if _recording:
+        # t1 stored raw (not as a duration): serialization derives begin
+        # and end timestamps through the SAME float pipeline, so spans
+        # sharing a boundary instant (adjacent step spans) stay exactly
+        # equal and B/E pairing cannot invert across the boundary
+        _ring().add((name, category, t0, t1,
+                     _step_id if step is None else step, args))
+
+
+class span:
+    """``with profiler.span('fwd', 'user'):`` — a recorded trace span.
+    Unlike :class:`scope` it does not touch ``jax.profiler`` (pure python,
+    hot-path safe) and appears in the chrome trace with its category."""
+
+    __slots__ = ("_name", "_cat", "_args", "_t0")
+
+    def __init__(self, name, category="user", args=None):
+        self._name = name
+        self._cat = category
+        self._args = args
+
+    def __enter__(self):
+        self._t0 = _perf() if _active else None
+        return self
+
+    def __exit__(self, *a):
+        if self._t0 is not None and _active:
+            record_span(self._name, self._cat, self._t0, args=self._args)
+        return False
+
+
+# ---------------------------------------------------------------------------
+# Per-step telemetry
+# ---------------------------------------------------------------------------
+
+_step_id = 1          # spans inherit this; Trainer.step boundaries advance it
+_step_t0 = None       # perf_counter at the current step's start (None =
+                      # recorder armed mid-step: first boundary only anchors)
+_step_thread = _threading.get_ident()   # thread whose spans bill the step
+                                        # buckets; re-pinned per boundary
+_armed_at = 0.0       # perf_counter of the last _arm(): spans straddling
+                      # it are clamped so the trace nests validly
+_step_acc = {"host": 0.0, "comms": 0.0}   # current step's bucket sums
+_step_window = []     # list of per-step stat dicts, capped at step_window
+_mem_watermark = {}   # device str -> peak bytes_in_use observed
+_devices_cache = None
+
+
+def current_step():
+    """The step id spans currently inherit (monotone; advanced by
+    ``step_boundary``)."""
+    return _step_id
+
+
+def step_stats():
+    """Rolling window of per-step telemetry dicts
+    (``step``/``wall_ms``/``host_ms``/``comms_ms``/``device_ms``)."""
+    with _counter_lock:
+        return [dict(s) for s in _step_window]
+
+
+def memory_watermark():
+    """Peak ``bytes_in_use`` observed per device at step boundaries (empty
+    when the backend exposes no ``memory_stats``, e.g. CPU)."""
+    with _counter_lock:
+        return dict(_mem_watermark)
+
+
+def _sample_memory():
+    global _devices_cache
+    try:
+        if _devices_cache is None:
+            _devices_cache = jax.local_devices()
+        for d in _devices_cache:
+            ms = getattr(d, "memory_stats", None)
+            stats = ms() if callable(ms) else None
+            if not stats:
+                continue
+            used = stats.get("peak_bytes_in_use",
+                             stats.get("bytes_in_use", 0))
+            key = str(d)
+            with _counter_lock:
+                if used > _mem_watermark.get(key, -1):
+                    _mem_watermark[key] = used
+    except Exception:
+        pass  # telemetry must never take training down
+
+
+def _slow_threshold_ms():
+    """Explicit slow-step threshold, or None for auto mode.  Config wins
+    over the env (set_config is the runtime control surface)."""
+    v = _config.get("slow_step_ms")
+    if v is None:
+        env = os.environ.get("MXNET_PROFILER_SLOW_STEP_MS")
+        if env:
+            try:
+                v = float(env)
+            except ValueError:
+                v = None
+    if v is not None and v <= 0:
+        # 0 = off, matching the repo's env-knob convention
+        # (MXNET_OPTIMIZER_AGGREGATION=0 etc.); auto mode stays off too
+        # because an explicit threshold was configured
+        return float("inf")
+    return v
+
+
+def _median(xs):
+    xs = sorted(xs)
+    n = len(xs)
+    return xs[n // 2] if n % 2 else 0.5 * (xs[n // 2 - 1] + xs[n // 2])
+
+
+def step_boundary():
+    """Close the current telemetry step (called by ``gluon.Trainer.step``
+    and ``SPMDTrainer.step``; safe to call directly from custom loops).
+
+    Records the whole-step span, splits its wall time into host-dispatch /
+    comms / device buckets from the spans seen since the previous
+    boundary, feeds the rolling window + slow-step detector, samples
+    device-memory watermarks, and advances the step id every subsequent
+    span inherits.  No-op while the profiler is inactive."""
+    global _step_id, _step_t0, _step_thread
+    if not _active:
+        return
+    now = _perf()
+    _step_thread = _threading.get_ident()  # whoever drives steps owns them
+    with _counter_lock:
+        sid = _step_id
+        t0 = _step_t0
+        _step_t0 = now
+        host = _step_acc.get("host", 0.0)
+        comms = _step_acc.get("comms", 0.0)
+        _step_acc["host"] = 0.0
+        _step_acc["comms"] = 0.0
+        _step_id = sid + 1
+    if t0 is None:
+        return  # armed mid-step: this boundary only anchors the next one
+    wall = now - t0
+    if _recording:
+        # straight onto the dedicated step timeline (adjacent step spans
+        # never overlap there; user-thread spans may straddle boundaries)
+        ring = _get_step_ring()
+        with _counter_lock:
+            ring.add(("step", "step", max(t0, _armed_at), now, sid,
+                      {"host_ms": round(host * 1e3, 3),
+                       "comms_ms": round(comms * 1e3, 3)}))
+    # host/comms are raw span sums (concurrent threads can legitimately
+    # exceed wall); only the derived device/other residue is clamped
+    wall_ms = wall * 1e3
+    host_ms = host * 1e3
+    comms_ms = comms * 1e3
+    device_ms = max(0.0, wall_ms - host_ms - comms_ms)
+    stats = {"step": sid, "wall_ms": wall_ms, "host_ms": host_ms,
+             "comms_ms": comms_ms, "device_ms": device_ms}
+
+    thr = _slow_threshold_ms()
+    slow, why = False, ""
+    with _counter_lock:
+        prior = [s["wall_ms"] for s in _step_window]
+        _step_window.append(stats)
+        limit = int(_config.get("step_window", 256))
+        while len(_step_window) > limit:
+            _step_window.pop(0)
+    if thr is not None:
+        if wall_ms > thr:
+            slow, why = True, f"threshold {thr:g} ms"
+    elif _config.get("slow_step_auto", True) and len(prior) >= 16:
+        med = _median(prior)
+        mult = float(_config.get("slow_step_auto_mult", 4.0))
+        if med > 0 and wall_ms > mult * med:
+            slow, why = True, f"auto: > {mult:g}x rolling median {med:.1f} ms"
+    if slow:
+        incr("slow_step_detected")
+        _logger.warning(
+            "slow step %d: %.1f ms (host-dispatch %.1f ms, comms %.1f ms, "
+            "device/other %.1f ms) [%s]",
+            sid, wall_ms, host_ms, comms_ms, device_ms, why)
+    if _config.get("memory_sampling", True):
+        _sample_memory()
+
+
+# ---------------------------------------------------------------------------
+# Control surface
+# ---------------------------------------------------------------------------
+
+
 def set_config(**kwargs):
     """Parity: ``mx.profiler.set_config`` — unknown keys are accepted and
-    ignored (the reference has many backend-specific flags)."""
+    ignored (the reference has many backend-specific flags).  Meaningful
+    keys here: ``filename``, ``ring_size``, ``slow_step_ms``,
+    ``slow_step_auto``, ``slow_step_auto_mult``, ``step_window``,
+    ``memory_sampling``.  ``ring_size`` takes effect at the NEXT
+    ``start()`` — live rings keep the capacity they were built with."""
+    global _telemetry, _active, _step_t0
     _config.update(kwargs)
+    if "slow_step_ms" in kwargs:
+        was_active = _active
+        _telemetry = (kwargs["slow_step_ms"] is not None
+                      or os.environ.get("MXNET_PROFILER_SLOW_STEP_MS")
+                      is not None)
+        _active = _recording or _telemetry
+        if _active and not was_active:
+            # re-anchor: the stale _step_t0 from before the disabled gap
+            # would bill the whole gap to the next step (stop() resets it
+            # for the same reason)
+            _step_t0 = None
 
 
 def state():
     return "running" if _state["running"] else "stopped"
 
 
-def start():
-    """Start an xprof trace.  Trace directory = dirname(filename) (the
-    chrome-trace single file of the reference maps onto xprof's directory
-    layout; load it with TensorBoard or xprof)."""
-    if _state["running"]:
-        return
+_trace_warned = False
+
+
+def _trace_error(what, exc):
+    """Satellite 3: a broken xprof install must be diagnosable — warn once
+    per process and always count, instead of a silent ``except: pass``."""
+    global _trace_warned
+    incr("profiler_trace_error")
+    if not _trace_warned:
+        _trace_warned = True
+        _warnings.warn(
+            f"jax.profiler.{what} failed ({type(exc).__name__}: {exc}); "
+            "device-side xprof tracing is unavailable for this run — the "
+            "python span recorder still captures host-side spans. "
+            "(warned once; see the profiler_trace_error counter)",
+            RuntimeWarning, stacklevel=3)
+
+
+def _arm(fresh):
+    """Shared start/resume body: start the xprof trace and arm the span
+    recorder.  ``fresh`` discards prior spans/telemetry (a new session);
+    resume keeps them (the reference's pause/resume accumulates)."""
+    global _recording, _active, _ring_gen, _step_t0, _step_thread, _armed_at
     logdir = os.path.dirname(os.path.abspath(_config["filename"])) or "."
     trace_dir = os.path.join(logdir, "mxtpu_profile")
     os.makedirs(trace_dir, exist_ok=True)
     try:
         jax.profiler.start_trace(trace_dir)
-    except Exception:
-        pass  # second start or unsupported backend: keep python markers only
+        _state["xprof"] = True
+    except Exception as e:  # unsupported backend / second trace: recorder
+        _state["xprof"] = False  # still arms, but the failure is visible
+        _trace_error("start_trace", e)
+    with _counter_lock:
+        # the bucket sums always restart with the step clock: a pause()
+        # mid-step leaves a partial step's sums behind, and billing them
+        # against a wall clock measured from resume() would corrupt the
+        # first post-resume step's split
+        _step_acc["host"] = 0.0
+        _step_acc["comms"] = 0.0
+        if fresh:
+            _ring_gen += 1    # abandon previous-generation rings
+            _rings.clear()
+            _evicted[0] = _evicted[1] = 0
+            # fresh telemetry per recording session: a stale rolling window
+            # would skew the slow-step percentile baseline
+            _step_window.clear()
+            _mem_watermark.clear()
+    _armed_at = _step_t0 = _perf()
+    _step_thread = _threading.get_ident()
+    _recording = True
+    _active = True
     _state.update(running=True, dir=trace_dir, t0=time.perf_counter())
 
 
+def start():
+    """Start a FRESH recording session: arm the span recorder (discarding
+    any previously recorded spans/telemetry) and start an xprof trace.
+    Trace directory = dirname(filename) (the chrome-trace single file of
+    the reference maps onto xprof's directory layout; load it with
+    TensorBoard or xprof)."""
+    if _state["running"]:
+        return
+    _arm(fresh=True)
+
+
+def resume():
+    """Re-arm after ``pause()`` WITHOUT discarding what was recorded
+    before it — pause/resume accumulates into one trace (reference
+    semantics); ``start()`` is the fresh-session entry."""
+    if _state["running"]:
+        return
+    _arm(fresh=False)
+
+
 def stop():
+    """Disarm the span recorder and stop the xprof trace.  Recorded spans
+    survive for ``dump()``."""
+    global _recording, _active, _step_t0
     if not _state["running"]:
         return
-    try:
-        jax.profiler.stop_trace()
-    except Exception:
-        pass
+    if _state["xprof"]:
+        try:
+            jax.profiler.stop_trace()
+        except Exception as e:
+            _trace_error("stop_trace", e)
+        _state["xprof"] = False
+    _recording = False
+    _active = _telemetry
+    # a later telemetry-only step_boundary must anchor fresh, not measure
+    # the wall-clock gap since this session's last boundary
+    _step_t0 = None
     _state["running"] = False
 
 
-pause = stop  # reference pause/resume ≈ stop/start at xprof granularity
-resume = start
+pause = stop  # stop keeps recorded spans, so pause/resume accumulates
+
+
+# ---------------------------------------------------------------------------
+# Chrome-trace serialization
+# ---------------------------------------------------------------------------
+
+
+def _trace_events():
+    """All recorded spans as chrome-trace B/E event dicts, ordered so B/E
+    pairs nest validly per thread (ties: E before B; outer B before inner
+    B; inner E before outer E)."""
+    pid = os.getpid()
+    with _counter_lock:
+        rings = list(_rings)
+    keyed = []
+    for r in rings:
+        for ev in r.snapshot():
+            if ev is None:
+                continue
+            name, cat, t0, t1, step, args = ev
+            ts = (t0 - _EPOCH) * 1e6
+            te = (t1 - _EPOCH) * 1e6
+            if te <= ts:
+                te = ts + 0.001  # zero-dur spans still pair B < E
+            dur_us = te - ts
+            a = {"step": step}
+            if args:
+                a.update(args)
+            keyed.append(((ts, 1, -dur_us),
+                          {"ph": "B", "name": name, "cat": cat, "ts": ts,
+                           "pid": pid, "tid": r.tid, "args": a}))
+            keyed.append(((te, 0, dur_us),
+                          {"ph": "E", "name": name, "cat": cat, "ts": te,
+                           "pid": pid, "tid": r.tid}))
+    keyed.sort(key=lambda kv: kv[0])
+    events = [{"ph": "M", "pid": pid, "tid": r.tid, "name": "thread_name",
+               "args": {"name": r.tname}} for r in rings]
+    events.extend(e for _, e in keyed)
+    return events
 
 
 def dump(finished=True, profile_process="worker"):
-    """Finish the trace (parity: ``mx.profiler.dump``)."""
-    stop()
+    """Serialize the recorded spans to chrome://tracing JSON at
+    ``_config['filename']`` (parity: ``mx.profiler.dump`` writing the
+    reference's chrome-trace file).  ``finished=False`` keeps the recorder
+    armed (periodic mid-run dumps); the default also ``stop()``s.
+    Returns the path written."""
+    path = _config["filename"]
+    parent = os.path.dirname(os.path.abspath(path))
+    os.makedirs(parent, exist_ok=True)  # telemetry-only sessions never ran
+    payload = {                         # _arm()'s makedirs
+        "traceEvents": _trace_events(),
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "counters": counters(),
+            "steps": step_stats(),
+            "memory_watermark_bytes": memory_watermark(),
+            "recorder": recorder_stats(),
+            "xprof_dir": _state["dir"],
+        },
+    }
+    with open(path, "w") as f:
+        json.dump(payload, f)
+    if finished:
+        stop()
+    return path
 
 
 def iter_xplane_ops(trace_dir):
     """Yield ``(full_hlo_text, duration_ps)`` for every event on a device
     plane's "XLA Ops" line in the newest ``.xplane.pb`` under ``trace_dir``
     (the "Async XLA Ops" line is skipped — its spans overlap compute).
-    Single shared xplane reader — tools/parse_xplane.py presents the same
-    stream differently.  Yields nothing when no trace/proto reader exists."""
+    Single shared xplane reader — tools/parse_xplane.py and
+    tools/trace_report.py present the same stream differently.  Yields
+    nothing when no trace/proto reader exists."""
     import glob
 
     try:
@@ -200,17 +780,38 @@ def _device_op_stats(trace_dir, topn=40):
 
 def dumps(reset=False):
     """Aggregate stats string (parity: ``mx.profiler.dumps``): python-side
-    marker table plus the per-device-op aggregate parsed from the captured
-    xprof trace (run between ``start()``/``stop()`` to populate it)."""
+    marker table, dispatch counters, step telemetry, plus the per-device-op
+    aggregate parsed from the captured xprof trace (run between
+    ``start()``/``stop()`` to populate it)."""
+    with _counter_lock:
+        agg_rows = sorted(((k, v[0], v[1]) for k, v in _agg.items()),
+                          key=lambda r: -r[2])
     lines = ["Profile Statistics (python markers):",
              f"{'Name':<40}{'Count':>8}{'Total(ms)':>12}{'Avg(ms)':>12}"]
-    for name, (cnt, tot) in sorted(_agg.items(), key=lambda kv: -kv[1][1]):
+    for name, cnt, tot in agg_rows:
         lines.append(f"{name:<40}{cnt:>8}{tot * 1e3:>12.3f}{tot / cnt * 1e3:>12.3f}")
-    if any(_counters.values()):
+    snap = counters()
+    if any(snap.values()):
         lines.append("")
         lines.append("Dispatch counters:")
-        for name, v in sorted(_counters.items()):
+        for name, v in sorted(snap.items()):
             lines.append(f"{name:<40}{v:>8}")
+    steps = step_stats()
+    if steps:
+        lines.append("")
+        lines.append("Step telemetry (rolling window):")
+        lines.append(f"{'Step':>6}{'Wall(ms)':>12}{'Host(ms)':>12}"
+                     f"{'Comms(ms)':>12}{'Device(ms)':>12}")
+        for s in steps[-20:]:
+            lines.append(f"{s['step']:>6}{s['wall_ms']:>12.3f}"
+                         f"{s['host_ms']:>12.3f}{s['comms_ms']:>12.3f}"
+                         f"{s['device_ms']:>12.3f}")
+    wm = memory_watermark()
+    if wm:
+        lines.append("")
+        lines.append("Device memory watermark (bytes_in_use peak):")
+        for dev, b in sorted(wm.items()):
+            lines.append(f"{dev:<40}{b:>16}")
     if _state["dir"]:
         dev = _device_op_stats(_state["dir"])
         if dev:
@@ -222,17 +823,21 @@ def dumps(reset=False):
         else:
             lines.append(f"(no device-op detail captured; trace dir: {_state['dir']})")
     if reset:
-        _agg.clear()
-        # the dump shows the dispatch/bulk counters too, so a reset must
-        # cover them — otherwise per-interval dumps mix fresh marker stats
-        # with cumulative cache/bulk numbers
+        with _counter_lock:
+            # a reset must cover EVERYTHING this dump shows — otherwise
+            # per-interval dumps mix fresh marker stats with cumulative
+            # counter/step-telemetry/watermark numbers
+            _agg.clear()
+            _step_window.clear()
+            _mem_watermark.clear()
         reset_counters()
     return "\n".join(lines)
 
 
 class scope:
     """``with profiler.scope('fwd'):`` — named region, visible in xprof as
-    a TraceAnnotation and tallied in ``dumps()``."""
+    a TraceAnnotation, tallied in ``dumps()``, and (when the recorder is
+    armed) present in the chrome trace under the ``user`` category."""
 
     def __init__(self, name="<unk>"):
         self._name = name
@@ -251,7 +856,10 @@ class scope:
     def __exit__(self, *a):
         if self._ctx is not None:
             self._ctx.__exit__(*a)
-        _tally(self._name, time.perf_counter() - self._t0)
+        t1 = time.perf_counter()
+        _tally(self._name, t1 - self._t0)
+        if _active:
+            record_span(self._name, "user", self._t0, t1)
         return False
 
 
@@ -263,6 +871,9 @@ class Marker:
 
     def mark(self, scope_name="process"):
         _tally(self._name, 0.0)
+        if _recording:
+            t = _perf()
+            record_span(self._name, "marker", t, t)
 
 
 if os.environ.get("MXNET_PROFILER_AUTOSTART", "0") == "1":

@@ -1,14 +1,62 @@
 """Profiler bridge + engine fence (parity: [U:tests/python/unittest/
-test_profiler.py] control-surface checks, plus the round-3 device-op
-aggregate table and multi-device waitall)."""
+test_profiler.py] control-surface checks, the round-3 device-op aggregate
+table and multi-device waitall, plus the ISSUE-5 tracing subsystem: span
+recorder / chrome-trace round trip, per-step telemetry, slow-step
+detector, strict counters, and the trace_report CLI)."""
+import json
+import logging
 import os
+import subprocess
+import sys
+import threading
+import time
+from collections import defaultdict
 
 import numpy as np
+import pytest
 
 import incubator_mxnet_tpu as mx
-from incubator_mxnet_tpu import profiler
+from incubator_mxnet_tpu import autograd, engine, profiler
+from incubator_mxnet_tpu.gluon import Trainer, nn
 
 import jax
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def clean_profiler(tmp_path):
+    """Arm-safe profiler state: fresh filename, stopped recorder, zeroed
+    counters before AND after (profiler state is module-global)."""
+    profiler.stop()
+    profiler.set_config(filename=str(tmp_path / "trace.json"),
+                        ring_size=65536, slow_step_ms=None)
+    profiler.reset_counters()
+    yield tmp_path
+    profiler.stop()
+    profiler.set_config(slow_step_ms=None, ring_size=65536,
+                        slow_step_auto=True, memory_sampling=True)
+    profiler.reset_counters()
+
+
+def _paired_spans(events):
+    """Pair B/E events per (pid, tid); returns the B events (with their
+    matching E verified) and asserts nothing is unpaired."""
+    stacks = defaultdict(list)
+    spans = []
+    for e in sorted((e for e in events if e.get("ph") in ("B", "E")),
+                    key=lambda e: e["ts"]):
+        k = (e["pid"], e["tid"])
+        if e["ph"] == "B":
+            stacks[k].append(e)
+        else:
+            assert stacks[k], f"E without open B at ts={e['ts']}"
+            b = stacks[k].pop()
+            assert e["ts"] >= b["ts"]
+            b["_end"] = e["ts"]
+            spans.append(b)
+    assert not any(stacks.values()), "B events left unclosed"
+    return spans
 
 
 class TestProfiler:
@@ -64,3 +112,392 @@ def test_waitall_covers_all_devices():
     for o in outs:
         # after waitall every per-device queue has drained; reads are instant
         assert np.isfinite(np.asarray(o)).all()
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 5: span recorder + chrome-trace round trip
+# ---------------------------------------------------------------------------
+
+
+class TestChromeTrace:
+    def test_train_trace_roundtrip(self, clean_profiler):
+        """The acceptance loop: start(); 3 train steps; dump() -> a
+        chrome://tracing-valid JSON with spans from the dispatch-cache,
+        bulk-flush, fused-step, and kvstore categories, each tagged with
+        the correct (monotone) step id."""
+        net = nn.Dense(8)
+        net.initialize()
+        trainer = Trainer(net.collect_params(), "sgd",
+                          {"learning_rate": 0.1, "momentum": 0.9},
+                          kvstore="device")
+        x = mx.nd.ones((4, 16))
+
+        profiler.start()
+        first_step = profiler.current_step()
+        for _ in range(3):
+            with autograd.record():
+                loss = (net(x) ** 2).sum()
+            loss.backward()
+            with engine.bulk(8):  # eager metric chain -> bulk spans
+                m = loss + 0.0
+                for _ in range(4):
+                    m = m * 1.0
+            m.asnumpy()
+            trainer.step(4)
+        path = profiler.dump()
+
+        with open(path) as f:
+            doc = json.load(f)
+        assert isinstance(doc["traceEvents"], list)
+        spans = _paired_spans(doc["traceEvents"])
+        cats = {s["cat"] for s in spans}
+        assert {"dispatch", "bulk", "optimizer", "comms", "step",
+                "trainer"} <= cats
+
+        # step ids: monotone per thread in timestamp order
+        per_tid = defaultdict(list)
+        for s in sorted(spans, key=lambda s: s["ts"]):
+            per_tid[s["tid"]].append(s["args"]["step"])
+        for ids in per_tid.values():
+            assert ids == sorted(ids)
+
+        # step ids: CORRECT — every span inside a step span's [B, E] range
+        # carries that step's id (asserted for the synchronous train-loop
+        # categories; the three steps are first_step..first_step+2)
+        step_spans = sorted((s for s in spans if s["cat"] == "step"),
+                            key=lambda s: s["ts"])
+        assert [s["args"]["step"] for s in step_spans] == [
+            first_step, first_step + 1, first_step + 2]
+        for s in spans:
+            if s["cat"] not in ("optimizer", "comms", "trainer"):
+                continue
+            owner = [st for st in step_spans
+                     if st["ts"] <= s["ts"] and s["_end"] <= st["_end"]]
+            assert owner, f"span {s['name']} outside every step"
+            assert s["args"]["step"] == owner[0]["args"]["step"]
+
+        # at least one span of each acceptance name family
+        names = {s["name"] for s in spans}
+        assert "fused.group_apply" in names
+        assert "bulk.flush" in names
+        assert "kvstore.pushpull" in names
+        assert names & {"dispatch.cache_hit", "dispatch.jit_compile"}
+
+        # telemetry rode along: 3 closed steps with bucket splits
+        steps = profiler.step_stats()[-3:]
+        assert [s["step"] for s in steps] == [first_step, first_step + 1,
+                                              first_step + 2]
+        for s in steps:
+            assert s["wall_ms"] >= s["host_ms"] >= 0
+            assert s["device_ms"] >= 0
+
+    def test_dump_finished_false_keeps_recording(self, clean_profiler):
+        profiler.start()
+        with profiler.span("before", "user"):
+            pass
+        path = profiler.dump(finished=False)
+        assert profiler.state() == "running"
+        assert profiler.recording_enabled()
+        with profiler.span("after", "user"):
+            pass
+        path = profiler.dump()  # default finishes
+        assert profiler.state() == "stopped"
+        assert not profiler.recording_enabled()
+        names = {s["name"] for s in
+                 _paired_spans(json.load(open(path))["traceEvents"])}
+        assert {"before", "after"} <= names
+
+    def test_multithreaded_span_counts(self, clean_profiler):
+        """Exact per-thread span counts under concurrency: the per-thread
+        rings may not drop or duplicate spans."""
+        n_threads, n_spans = 4, 250
+        profiler.start()
+        barrier = threading.Barrier(n_threads)
+
+        def work():
+            barrier.wait()
+            for i in range(n_spans):
+                t0 = time.perf_counter()
+                profiler.record_span(f"mt_{i % 7}", "user", t0)
+
+        threads = [threading.Thread(target=work) for _ in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        profiler.stop()
+        spans = _paired_spans(profiler._trace_events())
+        per_tid = defaultdict(int)
+        for s in spans:
+            if s["name"].startswith("mt_"):
+                per_tid[s["tid"]] += 1
+        assert len(per_tid) == n_threads
+        assert all(c == n_spans for c in per_tid.values())
+
+    def test_ring_buffer_bounds_memory(self, clean_profiler):
+        """Recording more spans than the ring capacity must not grow
+        memory: the oldest spans are evicted and counted as dropped."""
+        profiler.set_config(ring_size=64)
+        profiler.start()
+        for i in range(200):
+            t0 = time.perf_counter()
+            profiler.record_span(f"ring_{i}", "user", t0)
+        stats = profiler.recorder_stats()
+        profiler.stop()
+        assert stats["spans"] == 64
+        assert stats["dropped"] == 200 - 64
+        spans = _paired_spans(profiler._trace_events())
+        kept = sorted(int(s["name"].split("_")[1]) for s in spans
+                      if s["name"].startswith("ring_"))
+        assert kept == list(range(136, 200))  # oldest evicted, newest kept
+
+    def test_ring_registry_bounded_under_thread_churn(self, clean_profiler):
+        """Short-lived threads (a fresh prefetch worker per epoch) must not
+        grow the retained-rings list without bound: dead threads' rings are
+        evicted once the cap is exceeded."""
+        profiler.set_config(ring_size=8)
+        profiler.start()
+        for i in range(profiler._MAX_RINGS + 20):
+            t = threading.Thread(
+                target=lambda: profiler.record_span("churn", "user",
+                                                    time.perf_counter()))
+            t.start()
+            t.join()
+        n_rings = profiler.recorder_stats()["threads"]
+        profiler.stop()
+        # cap + the handful of genuinely-alive threads at eviction time
+        assert n_rings <= profiler._MAX_RINGS + 1
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 5: per-step telemetry + slow-step detector
+# ---------------------------------------------------------------------------
+
+
+class TestStepTelemetry:
+    def test_slow_step_detector_fires_exactly_once(self, clean_profiler,
+                                                   caplog):
+        profiler.set_config(slow_step_ms=50.0)
+        profiler.start()
+        with caplog.at_level(logging.WARNING,
+                             logger="incubator_mxnet_tpu.profiler"):
+            for _ in range(4):      # normal steps: well under 50 ms
+                profiler.step_boundary()
+            time.sleep(0.08)        # injected stall
+            profiler.step_boundary()
+            for _ in range(4):      # back to normal
+                profiler.step_boundary()
+        profiler.stop()
+        slow_lines = [r for r in caplog.records if "slow step" in r.message]
+        assert len(slow_lines) == 1
+        msg = slow_lines[0].getMessage()
+        assert "host-dispatch" in msg and "comms" in msg
+        assert profiler.counters()["slow_step_detected"] == 1
+
+    def test_slow_step_auto_percentile_mode(self, clean_profiler, caplog):
+        """No explicit threshold: a step > mult x the rolling median is
+        flagged once the window has enough history."""
+        profiler.set_config(slow_step_ms=None, slow_step_auto=True,
+                            slow_step_auto_mult=4.0)
+        profiler.start()
+        with caplog.at_level(logging.WARNING,
+                             logger="incubator_mxnet_tpu.profiler"):
+            for _ in range(20):
+                time.sleep(0.01)
+                profiler.step_boundary()
+            time.sleep(0.3)         # >> 4x the ~10 ms median
+            profiler.step_boundary()
+        profiler.stop()
+        auto = [r for r in caplog.records if "auto:" in r.message]
+        assert len(auto) == 1
+
+    def test_step_buckets_accumulate(self, clean_profiler):
+        profiler.start()
+        sid = profiler.current_step()
+        t0 = time.perf_counter()
+        profiler.record_span("kvstore.pushpull", "comms", t0, t0 + 0.010)
+        profiler.record_span("dispatch.cache_hit", "dispatch", t0, t0 + 0.005)
+        profiler.record_span("bulk.trace", "bulk", t0, t0 + 0.003)  # nested:
+        profiler.step_boundary()                    # excluded from buckets
+        profiler.stop()
+        s = [s for s in profiler.step_stats() if s["step"] == sid][-1]
+        assert s["comms_ms"] == pytest.approx(10.0, rel=0.3)
+        assert s["host_ms"] == pytest.approx(5.0, rel=0.3)
+
+    def test_memory_watermark_surface(self, clean_profiler):
+        # CPU devices may expose no memory_stats: the sampler must stay
+        # silent/empty, never raise
+        profiler.start()
+        profiler.step_boundary()
+        profiler.step_boundary()
+        profiler.stop()
+        wm = profiler.memory_watermark()
+        assert isinstance(wm, dict)
+        assert all(isinstance(v, int) and v >= 0 for v in wm.values())
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 5 satellites: strict counters, locked _tally, trace-error surfacing
+# ---------------------------------------------------------------------------
+
+
+class TestCounters:
+    def test_incr_unknown_name_raises(self):
+        typo = "dispatch_cache_hti"  # built dynamically elsewhere this
+        with pytest.raises(KeyError):  # would silently report zeros forever
+            profiler.incr(typo)
+
+    def test_declare_counter_extension_path(self):
+        profiler.declare_counter("test_custom_counter")
+        profiler.incr("test_custom_counter", 3)
+        assert profiler.counters()["test_custom_counter"] == 3
+        profiler.reset_counters()
+        assert profiler.counters()["test_custom_counter"] == 0
+
+    def test_incr_exact_under_concurrency(self):
+        profiler.reset_counters()
+        n_threads, n_incr = 8, 500
+
+        def work():
+            for _ in range(n_incr):
+                profiler.incr("dispatch_cache_hit")
+
+        threads = [threading.Thread(target=work) for _ in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert profiler.counters()["dispatch_cache_hit"] == n_threads * n_incr
+        profiler.reset_counters()
+
+    def test_tally_exact_under_concurrency(self):
+        """Satellite 1: concurrent scopes must not drop _agg tallies (the
+        old unlocked read-modify-write did) and dumps() must iterate a
+        stable snapshot."""
+        name = "tally_race_probe"
+        with profiler._counter_lock:
+            profiler._agg.pop(name, None)
+        n_threads, n_tallies = 8, 400
+        stop = threading.Event()
+
+        def dump_loop():  # concurrent reader: would blow up on a mutating
+            while not stop.is_set():  # dict pre-fix
+                profiler.dumps()
+
+        reader = threading.Thread(target=dump_loop)
+        reader.start()
+
+        def work():
+            for _ in range(n_tallies):
+                profiler._tally(name, 0.001)
+
+        threads = [threading.Thread(target=work) for _ in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        stop.set()
+        reader.join()
+        cnt, tot = profiler._agg[name]
+        assert cnt == n_threads * n_tallies
+        assert tot == pytest.approx(cnt * 0.001)
+        with profiler._counter_lock:
+            profiler._agg.pop(name, None)
+
+    def test_trace_error_warns_once_and_counts(self, clean_profiler,
+                                               monkeypatch):
+        """Satellite 3: a broken xprof install is diagnosable — RuntimeWarning
+        (once) + profiler_trace_error counter, and the span recorder still
+        arms."""
+        def boom(*a, **k):
+            raise RuntimeError("no xprof here")
+
+        monkeypatch.setattr(jax.profiler, "start_trace", boom)
+        monkeypatch.setattr(profiler, "_trace_warned", False)
+        with pytest.warns(RuntimeWarning, match="profiler_trace_error"):
+            profiler.start()
+        assert profiler.recording_enabled()  # python spans still captured
+        assert profiler.counters()["profiler_trace_error"] == 1
+        profiler.stop()  # must not call stop_trace (xprof never started)
+        assert profiler.counters()["profiler_trace_error"] == 1
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 5: disabled-recorder overhead + trace_report CLI
+# ---------------------------------------------------------------------------
+
+
+def test_disabled_recorder_overhead_smoke():
+    """The eager-dispatch chain runs with the recorder OFF: no spans may be
+    recorded and the benchmark harness must be unperturbed (the <3% number
+    is measured by the full paired-median run, not asserted here)."""
+    import importlib.util
+
+    profiler.stop()
+    assert not profiler.recording_enabled()
+    before = profiler.recorder_stats()["spans"]
+    path = os.path.join(_REPO, "benchmark", "opperf", "eager_dispatch.py")
+    spec = importlib.util.spec_from_file_location("eager_dispatch_bench", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    line = mod.run(n_ops=6, iters=2, shape=(4, 4), warmup=1)
+    for mode in ("uncached", "cached_jit", "bulked"):
+        assert line["ops_per_sec"][mode]["elemwise"] > 0
+    assert profiler.recorder_stats()["spans"] == before
+
+
+class TestTraceReport:
+    def _synthetic_trace(self, path):
+        evs = []
+        t = 1000.0
+        for step in (1, 2, 3):
+            evs.append({"ph": "B", "name": "step", "cat": "step", "ts": t,
+                        "pid": 1, "tid": 7, "args": {"step": step}})
+            evs.append({"ph": "B", "name": "fused.group_apply",
+                        "cat": "optimizer", "ts": t + 10, "pid": 1,
+                        "tid": 7, "args": {"step": step}})
+            evs.append({"ph": "E", "name": "fused.group_apply",
+                        "cat": "optimizer", "ts": t + 60, "pid": 1, "tid": 7})
+            evs.append({"ph": "E", "name": "step", "cat": "step",
+                        "ts": t + 100, "pid": 1, "tid": 7})
+            t += 200
+        doc = {"traceEvents": evs, "displayTimeUnit": "ms",
+               "otherData": {"steps": [
+                   {"step": s, "wall_ms": 0.1, "host_ms": 0.05,
+                    "comms_ms": 0.0, "device_ms": 0.05} for s in (1, 2, 3)]}}
+        with open(path, "w") as f:
+            json.dump(doc, f)
+        return path
+
+    def test_report_on_synthetic_trace(self, tmp_path):
+        trace = self._synthetic_trace(str(tmp_path / "synth.json"))
+        out = subprocess.run(
+            [sys.executable, os.path.join(_REPO, "tools", "trace_report.py"),
+             trace, "--top", "5"],
+            capture_output=True, text=True, timeout=120)
+        assert out.returncode == 0, out.stderr
+        assert "Per-category totals" in out.stdout
+        assert "optimizer" in out.stdout
+        assert "Step-time histogram" in out.stdout
+        assert "fused.group_apply" in out.stdout
+
+    def test_report_rejects_invalid_trace(self, tmp_path):
+        bad = tmp_path / "bad.json"
+        bad.write_text("{not json")
+        out = subprocess.run(
+            [sys.executable, os.path.join(_REPO, "tools", "trace_report.py"),
+             str(bad)],
+            capture_output=True, text=True, timeout=120)
+        assert out.returncode == 2
+
+    def test_report_on_real_dump(self, clean_profiler, tmp_path):
+        profiler.start()
+        with profiler.span("real_work", "user"):
+            (mx.nd.ones((8, 8)) * 3).asnumpy()
+        profiler.step_boundary()
+        path = profiler.dump()
+        out = subprocess.run(
+            [sys.executable, os.path.join(_REPO, "tools", "trace_report.py"),
+             path], capture_output=True, text=True, timeout=120)
+        assert out.returncode == 0, out.stderr
+        assert "real_work" in out.stdout

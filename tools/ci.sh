@@ -8,7 +8,11 @@
 #   3. dist      — multi-process kvstore/launcher tier (incl. dist_async)
 #   4. examples  — example-script smoke tier
 #   5. bench     — bench.py smoke on whatever backend is present (CPU-safe)
-#   6. tpu       — (opt-in: CI_TPU=1) on-chip correctness tier, needs a chip
+#   6. profiler  — tracing-subsystem smoke: tiny train loop with the span
+#                  recorder on, chrome-trace file must parse, trace_report
+#                  must exit 0, and every profiler.incr(...) literal in the
+#                  tree must name a declared counter (lint_counters.py)
+#   7. tpu       — (opt-in: CI_TPU=1) on-chip correctness tier, needs a chip
 #
 # The unit tier is split in two so each invocation fits a ~10 min shell on
 # a 1-core box (the full suite exceeds one 600 s window there); `unit` is
@@ -49,7 +53,7 @@ TIERS=()
 for t in "$@"; do
     if [ "$t" = unit ]; then TIERS+=(unit1 unit2); else TIERS+=("$t"); fi
 done
-[ ${#TIERS[@]} -eq 0 ] && TIERS=(unit1 unit2 zoo dist examples bench)
+[ ${#TIERS[@]} -eq 0 ] && TIERS=(unit1 unit2 zoo dist examples bench profiler)
 [ "${CI_TPU:-0}" = "1" ] && TIERS+=(tpu)
 
 declare -A RESULT
@@ -110,6 +114,19 @@ for tier in "${TIERS[@]}"; do
             # CPU smoke: tiny batch, 1-2 steps — proves the headline path runs
             run_tier bench "${CPU_ENV[@]}" \
                 env MXNET_TPU_BENCH_BATCH=8 python bench.py
+            ;;
+        profiler)
+            # tracing smoke: recorder-on train loop -> valid chrome trace,
+            # trace_report runs clean, counter-name lint passes
+            # per-run trace path: concurrent ci.sh runs on one box must
+            # not race on a shared file
+            run_tier profiler "${CPU_ENV[@]}" bash -c '
+                set -e
+                trace="/tmp/ci_profiler_trace_$$.json"
+                trap "rm -f \"$trace\"" EXIT
+                python tools/profiler_smoke.py --out "$trace"
+                python tools/trace_report.py "$trace" --top 10 >/dev/null
+                python tools/lint_counters.py'
             ;;
         tpu)
             # on-chip tier: runs under the ambient axon env (NOT cpu-cleaned)

@@ -1,0 +1,74 @@
+#!/usr/bin/env python
+"""Counter-name lint (tools/ci.sh ``profiler`` tier).
+
+``profiler.incr`` is strict at runtime — an undeclared name raises — but a
+counter site on a cold path can hide a typo until production.  This lint
+greps every ``*.py`` in the tree for ``incr`` / ``_incr`` call sites with
+a string-literal name and checks each against the declared set: the
+``_counters`` dict literal in ``incubator_mxnet_tpu/profiler.py`` (parsed
+with ``ast`` — no jax import needed) plus any ``declare_counter("...")``
+literals found in the tree.
+
+Exit 0 = every literal declared; 1 = violations (listed on stderr).
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCAN_DIRS = ("incubator_mxnet_tpu", "tools", "benchmark", "tests", "example")
+INCR_RE = re.compile(r"\b_?incr\(\s*[\"']([A-Za-z0-9_]+)[\"']")
+DECLARE_RE = re.compile(r"\bdeclare_counter\(\s*[\"']([A-Za-z0-9_]+)[\"']")
+
+
+def declared_counters():
+    """Keys of the ``_counters = {...}`` literal in profiler.py."""
+    path = os.path.join(ROOT, "incubator_mxnet_tpu", "profiler.py")
+    with open(path) as f:
+        tree = ast.parse(f.read(), path)
+    for node in tree.body:
+        if (isinstance(node, ast.Assign)
+                and any(isinstance(t, ast.Name) and t.id == "_counters"
+                        for t in node.targets)
+                and isinstance(node.value, ast.Dict)):
+            return {ast.literal_eval(k) for k in node.value.keys}
+    raise SystemExit("lint_counters: no _counters dict literal in profiler.py")
+
+
+def iter_py_files():
+    for d in SCAN_DIRS:
+        base = os.path.join(ROOT, d)
+        for dirpath, dirnames, filenames in os.walk(base):
+            dirnames[:] = [x for x in dirnames
+                           if x not in (".git", "__pycache__")]
+            for fn in filenames:
+                if fn.endswith(".py"):
+                    yield os.path.join(dirpath, fn)
+
+
+def main():
+    declared = declared_counters()
+    files = {p: open(p, errors="replace").read() for p in iter_py_files()}
+    for text in files.values():  # pass 1: extensions opt in via declare
+        declared |= set(DECLARE_RE.findall(text))
+    violations = []
+    for path, text in files.items():  # pass 2: check every incr literal
+        for i, line in enumerate(text.splitlines(), 1):
+            for name in INCR_RE.findall(line):
+                if name not in declared:
+                    violations.append((os.path.relpath(path, ROOT), i, name))
+    if violations:
+        for path, line, name in violations:
+            print(f"{path}:{line}: undeclared profiler counter {name!r}",
+                  file=sys.stderr)
+        return 1
+    print(f"lint_counters OK: {len(declared)} declared counters, "
+          "all incr() literals match")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

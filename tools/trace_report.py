@@ -1,0 +1,177 @@
+#!/usr/bin/env python
+"""Offline report over a chrome://tracing JSON written by ``profiler.dump()``.
+
+Prints per-category span totals, the top-N longest spans, and a step-time
+histogram — the quick-look attribution pass (host dispatch vs comms vs
+device) MLPerf-style scaling work starts from, without opening Perfetto.
+Optionally merges the device-side HLO-op table parsed from an xprof
+capture directory (``--xplane``; the same ``iter_xplane_ops`` reader the
+profiler's ``dumps()`` uses, so op attribution cannot drift between them).
+
+Usage::
+
+    python tools/trace_report.py profile.json [--top 15] [--bins 10]
+                                 [--xplane DIR/mxtpu_profile]
+
+Exit codes: 0 on success, 2 on an unreadable/invalid trace file.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from collections import defaultdict
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def load_spans(path):
+    """Parse the trace into completed spans ``(name, cat, ts_us, dur_us,
+    step)``.  Accepts both the object form ({"traceEvents": [...]}) and the
+    bare-array form of the chrome trace spec; pairs B/E events per thread
+    with a stack and takes X (complete) events as-is."""
+    with open(path) as f:
+        doc = json.load(f)
+    events = doc["traceEvents"] if isinstance(doc, dict) else doc
+    if not isinstance(events, list):
+        raise ValueError("traceEvents is not a list")
+    spans = []
+    stacks = defaultdict(list)  # (pid, tid) -> [B events]
+    for e in sorted((e for e in events if isinstance(e, dict)),
+                    key=lambda e: e.get("ts", 0)):
+        ph = e.get("ph")
+        tkey = (e.get("pid"), e.get("tid"))
+        if ph == "B":
+            stacks[tkey].append(e)
+        elif ph == "E":
+            if not stacks[tkey]:
+                raise ValueError(f"unpaired E event at ts={e.get('ts')}")
+            b = stacks[tkey].pop()
+            spans.append((b.get("name", "<unk>"), b.get("cat", ""),
+                          b["ts"], e["ts"] - b["ts"],
+                          (b.get("args") or {}).get("step")))
+        elif ph == "X":
+            spans.append((e.get("name", "<unk>"), e.get("cat", ""),
+                          e.get("ts", 0), e.get("dur", 0),
+                          (e.get("args") or {}).get("step")))
+    dangling = sum(len(s) for s in stacks.values())
+    if dangling:
+        raise ValueError(f"{dangling} B event(s) never closed")
+    other = doc.get("otherData", {}) if isinstance(doc, dict) else {}
+    return spans, other
+
+
+def histogram(values, bins):
+    """ASCII histogram rows [(lo, hi, count, bar)] over ``values``."""
+    if not values:
+        return []
+    lo, hi = min(values), max(values)
+    if hi <= lo:
+        hi = lo + 1e-9
+    width = (hi - lo) / bins
+    counts = [0] * bins
+    for v in values:
+        i = min(int((v - lo) / width), bins - 1)
+        counts[i] += 1
+    peak = max(counts)
+    return [(lo + i * width, lo + (i + 1) * width, c,
+             "#" * max(1, round(40 * c / peak)) if c else "")
+            for i, c in enumerate(counts)]
+
+
+def report(path, spans, other, top=15, bins=10, xplane=None,
+           out=sys.stdout):
+    w = out.write
+
+    w(f"trace: {path} — {len(spans)} spans\n\n")
+
+    by_cat = defaultdict(lambda: [0, 0.0])
+    by_name = defaultdict(lambda: [0, 0.0])
+    for name, cat, _, dur, _ in spans:
+        by_cat[cat][0] += 1
+        by_cat[cat][1] += dur
+        by_name[(cat, name)][0] += 1
+        by_name[(cat, name)][1] += dur
+
+    w("Per-category totals (spans overlap across categories by design —\n"
+      "a trainer.update span contains its fused/dispatch children):\n")
+    w(f"{'category':<14}{'count':>8}{'total(ms)':>12}{'avg(us)':>10}\n")
+    for cat, (cnt, tot) in sorted(by_cat.items(), key=lambda kv: -kv[1][1]):
+        w(f"{cat:<14}{cnt:>8}{tot / 1e3:>12.3f}{tot / cnt:>10.1f}\n")
+
+    w("\nPer-span-name totals:\n")
+    w(f"{'name':<28}{'category':<12}{'count':>8}{'total(ms)':>12}\n")
+    for (cat, name), (cnt, tot) in sorted(by_name.items(),
+                                          key=lambda kv: -kv[1][1]):
+        w(f"{name:<28}{cat:<12}{cnt:>8}{tot / 1e3:>12.3f}\n")
+
+    w(f"\nTop {top} spans by duration:\n")
+    w(f"{'name':<28}{'category':<12}{'step':>6}{'dur(ms)':>12}\n")
+    for name, cat, _, dur, step in sorted(spans, key=lambda s: -s[3])[:top]:
+        w(f"{name:<28}{cat:<12}{step if step is not None else '-':>6}"
+          f"{dur / 1e3:>12.3f}\n")
+
+    step_walls = [dur / 1e3 for name, cat, _, dur, _ in spans
+                  if cat == "step"]
+    if step_walls:
+        w(f"\nStep-time histogram ({len(step_walls)} steps, ms):\n")
+        for lo, hi, cnt, bar in histogram(step_walls, bins):
+            w(f"  [{lo:>10.2f}, {hi:>10.2f}) {cnt:>5}  {bar}\n")
+
+    steps = other.get("steps") or []
+    if steps:
+        tot_host = sum(s.get("host_ms", 0) for s in steps)
+        tot_comms = sum(s.get("comms_ms", 0) for s in steps)
+        tot_dev = sum(s.get("device_ms", 0) for s in steps)
+        w("\nStep bucket attribution (telemetry window): "
+          f"host-dispatch {tot_host:.1f} ms, comms {tot_comms:.1f} ms, "
+          f"device/other {tot_dev:.1f} ms\n")
+    wm = other.get("memory_watermark_bytes") or {}
+    for dev, b in sorted(wm.items()):
+        w(f"memory watermark {dev}: {b} bytes\n")
+
+    if xplane:
+        from incubator_mxnet_tpu import profiler as _p
+
+        agg = defaultdict(lambda: [0, 0])
+        for hlo, ps in _p.iter_xplane_ops(xplane):
+            inst, _ = _p.collapse_hlo_name(hlo)
+            agg[inst][0] += 1
+            agg[inst][1] += ps
+        if agg:
+            w(f"\nDevice HLO ops ({xplane}):\n")
+            w(f"{'HLO op':<44}{'count':>8}{'total(ms)':>12}\n")
+            for inst, (cnt, ps) in sorted(agg.items(),
+                                          key=lambda kv: -kv[1][1])[:top]:
+                w(f"{inst[:44]:<44}{cnt:>8}{ps / 1e9:>12.3f}\n")
+        else:
+            w(f"\n(no device plane found under {xplane})\n")
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("trace", help="chrome-trace JSON from profiler.dump()")
+    p.add_argument("--top", type=int, default=15)
+    p.add_argument("--bins", type=int, default=10)
+    p.add_argument("--xplane", default=None,
+                   help="xprof trace dir to merge the device HLO table from")
+    args = p.parse_args(argv)
+    try:
+        # only trace LOADING maps to exit 2 — a BrokenPipeError from the
+        # report writes (| head) must not masquerade as an invalid trace
+        spans, other = load_spans(args.trace)
+    except (OSError, ValueError, KeyError, json.JSONDecodeError) as e:
+        print(f"trace_report: invalid trace {args.trace!r}: {e}",
+              file=sys.stderr)
+        return 2
+    try:
+        report(args.trace, spans, other, top=args.top, bins=args.bins,
+               xplane=args.xplane)
+    except BrokenPipeError:
+        pass  # downstream consumer closed the pipe: not an error
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
