@@ -29,7 +29,22 @@ import os
 import signal
 import threading
 
-__all__ = ["CheckpointManager", "save_sharded", "restore_sharded"]
+__all__ = ["CheckpointManager", "save_sharded", "restore_sharded",
+           "atomic_write_bytes"]
+
+
+def atomic_write_bytes(path, data):
+    """Write ``data`` to ``path`` through a same-directory tmp file +
+    ``os.replace`` (+fsync): a reader never observes a torn file and a
+    kill mid-write leaves the previous complete version in place.  The
+    CheckpointManager write discipline, shared with the async-PS snapshot
+    (``kvstore/async_ps.py``) and the trainers' ``save_states``."""
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
 
 
 # ---------------------------------------------------------------------------
@@ -281,13 +296,11 @@ class CheckpointManager:
                 tmp = sth + ".tmp"
                 trainer_for_states.save_states(tmp)
                 os.replace(tmp, sth)
-            tmp = mth + ".tmp"
-            with open(tmp, "w") as f:
-                json.dump({"step": step,
-                           "params": os.path.basename(pth) if params is not None else None,
-                           "states": os.path.basename(sth) if trainer_for_states is not None else None},
-                          f)
-            os.replace(tmp, mth)
+            atomic_write_bytes(mth, json.dumps(
+                {"step": step,
+                 "params": os.path.basename(pth) if params is not None else None,
+                 "states": os.path.basename(sth) if trainer_for_states is not None else None},
+            ).encode())
             self._gc(step)
 
     def _gc(self, newest_step):

@@ -216,21 +216,45 @@ class Trainer:
                     and p._data._grad is not None:
                 self._grad_versions[i] = p.grad_version
 
+    def close(self):
+        """Release distributed resources.  Against an elastic dist store
+        (``dist_async``) this deregisters the rank — peers' barrier and
+        SSP accounting shrink immediately instead of waiting out the
+        lease-eviction window.  Idempotent; a no-op for local stores."""
+        # before the first step _init_kvstore hasn't run: a store OBJECT
+        # the caller passed in still lives in _kvstore_type and must be
+        # closed all the same (string types were never instantiated)
+        kv = self._kvstore
+        if kv is None and not isinstance(self._kvstore_type, str):
+            kv = self._kvstore_type
+        if kv is not None and hasattr(kv, "close"):
+            kv.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
     def save_states(self, fname):
         """Parity: ``Trainer.save_states`` (optimizer state snapshot).
         Persists the per-index update counts too — Adam's bias-correction
-        counter ``t`` must stay monotonic across a save/load roundtrip."""
+        counter ``t`` must stay monotonic across a save/load roundtrip.
+        Atomic (tmp + ``os.replace``): a preemption mid-write never tears
+        the snapshot."""
         import pickle
+
+        from ..checkpoint import atomic_write_bytes
 
         flat = {}
         for i, st in self._states.items():
             flat[i] = _states_to_numpy(st)
-        with open(fname, "wb") as f:
-            pickle.dump({
-                "states": flat,
-                "num_update": self._optimizer.num_update,
-                "update_counts": dict(self._optimizer._index_update_count),
-            }, f)
+        atomic_write_bytes(fname, pickle.dumps({
+            "states": flat,
+            "num_update": self._optimizer.num_update,
+            "update_counts": dict(self._optimizer._index_update_count),
+        }))
 
     def load_states(self, fname):
         import pickle

@@ -2,4 +2,18 @@ from .kvstore import (KVStore, KVStoreLocal, KVStoreDist, KVStoreDistAsync,
                       bucket_bytes, bucketed_pushpull, create)
 
 __all__ = ["KVStore", "KVStoreLocal", "KVStoreDist", "KVStoreDistAsync",
-           "bucket_bytes", "bucketed_pushpull", "create"]
+           "bucket_bytes", "bucketed_pushpull", "create",
+           "PSError", "PSKeyError", "PSProtocolError", "PSTimeoutError"]
+
+_ASYNC_PS_NAMES = ("PSError", "PSKeyError", "PSProtocolError",
+                   "PSTimeoutError", "ParameterServer", "AsyncClient")
+
+
+def __getattr__(name):
+    # lazy: async_ps pulls in utils/faultinject; don't pay (or risk a
+    # partial-package import of) that at kvstore-package import time
+    if name in _ASYNC_PS_NAMES:
+        from . import async_ps
+
+        return getattr(async_ps, name)
+    raise AttributeError(name)

@@ -1,42 +1,128 @@
-"""True ``dist_async`` — a parameter-server tier with asynchronous,
-barrier-free push/pull (parity: [U:src/kvstore/kvstore_dist.cc] async mode
-+ [U:src/kvstore/kvstore_dist_server.h] server-side updates).
+"""True ``dist_async`` — a fault-tolerant, elastic parameter-server tier
+with asynchronous, barrier-free push/pull (parity:
+[U:src/kvstore/kvstore_dist.cc] async mode +
+[U:src/kvstore/kvstore_dist_server.h] server-side updates).
 
 Architecture: unlike ``dist_sync`` (SPMD peers over XLA collectives — a
 collective IS a barrier, so async semantics cannot ride that path), this
 backend runs an actual server: a threaded TCP parameter server hosted
-inside worker 0's process, the analog of the reference's ps-lite server
-co-located with the scheduler.  Workers push gradients and pull weights
-independently; the server applies each push the moment it arrives (the
-optimizer runs SERVER-side, as the reference's async mode does), so fast
-workers never wait for stragglers — bounded only by the optional
-``MXNET_KVSTORE_MAX_STALENESS`` window.
+inside worker 0's process (or standalone: ``python -m
+incubator_mxnet_tpu.kvstore.async_ps``), the analog of the reference's
+ps-lite server co-located with the scheduler.  Workers push gradients and
+pull weights independently; the server applies each push the moment it
+arrives (the optimizer runs SERVER-side, as the reference's async mode
+does), so fast workers never wait for stragglers — bounded only by the
+optional ``MXNET_KVSTORE_MAX_STALENESS`` window.
+
+Fault tolerance (stragglers and preemptions are the common case at pod
+scale, not the exception):
+
+* **Liveness + elastic membership** — workers ``register`` and heartbeat
+  on a background thread; the server grants leases
+  (``MXNET_KVSTORE_LEASE_S``) and a reaper evicts expired workers from SSP
+  accounting and the barrier count, so a dead straggler unblocks its peers
+  within one eviction window and ``join``/``leave`` needs no cluster
+  restart (``num_workers`` is dynamic; each change bumps a membership
+  epoch).
+* **Idempotent retry** — every client request carries ``(client_id, seq)``;
+  the server keeps a per-client dedup window (replaying a completed
+  request returns its cached reply, replaying an in-flight one waits for
+  the original), and ``AsyncClient.request`` adds per-attempt timeouts,
+  exponential-backoff reconnect, and replay — a dropped connection never
+  double-applies a push or hangs a trainer (at-most-once pushes).
+* **Snapshot/restore** — with ``MXNET_KVSTORE_PS_SNAPSHOT`` set the server
+  periodically (and on SIGTERM) snapshots the store, push counts, dedup
+  window, and pickled updater via the atomic tmp+``os.replace`` discipline
+  shared with ``checkpoint.py``; a restarted server resumes from the last
+  complete snapshot while clients reconnect transparently.
+* **Fault injection** — the wire helpers thread named fault points through
+  ``utils/faultinject.py`` (drop before/after send, duplicate delivery,
+  delay, dropped replies), so the chaos tier drives the REAL recovery
+  paths deterministically.
+
+Retries, reconnects, evictions, snapshots, and heartbeat misses bump
+declared profiler counters (``ps_*``; see docs/observability.md), so the
+failure handling is observable, not silent.
 
 Wire protocol: length-prefixed pickles of small tuples; tensors cross as
-raw numpy bytes.  This is a control-plane path (the reference's ZMQ tier);
-the SPMD data plane stays on XLA collectives.
+raw numpy bytes.  Requests ride a ``("req", client_id, seq, msg)`` envelope
+answered by ``("rep", seq, reply)`` so replays and duplicate deliveries
+can be correlated; bare tuples remain accepted for protocol tests.  This
+is a control-plane path (the reference's ZMQ tier); the SPMD data plane
+stays on XLA collectives.
 
 Staleness bound: with ``MXNET_KVSTORE_MAX_STALENESS=k``, a worker whose
-push count leads the slowest worker by >= k blocks until the straggler
-catches up (SSP, Ho et al. 2013); unset = unbounded (the reference's
-``dist_async`` contract).
+push count leads the slowest LIVE active worker by >= k blocks until the
+straggler catches up (SSP, Ho et al. 2013) or is evicted; unset =
+unbounded (the reference's ``dist_async`` contract).  The wait itself is
+bounded by ``MXNET_KVSTORE_SSP_TIMEOUT`` (default 300 s): on expiry the
+push fails loudly, naming the lagging rank, instead of re-waiting forever.
 """
 from __future__ import annotations
 
 import atexit
 import os
 import pickle
+import signal
 import socket
 import socketserver
 import struct
 import threading
 import time
+import uuid
+from collections import OrderedDict
 
 import numpy as np
 
-__all__ = ["ParameterServer", "AsyncClient", "serve_if_rank0", "server_port"]
+from .. import profiler as _profiler
+from ..utils import faultinject as _fi
+
+__all__ = ["ParameterServer", "AsyncClient", "HeartbeatThread",
+           "serve_if_rank0", "server_port",
+           "PSError", "PSKeyError", "PSProtocolError", "PSTimeoutError"]
 
 _LEN = struct.Struct("!I")
+
+
+# ---------------------------------------------------------------------------
+# Client-visible exception hierarchy: every server-side ("err", kind, text)
+# reply maps onto one of these.  PSKeyError doubles as KeyError so the
+# missing-key contract stays a KeyError for callers; protocol and server
+# faults no longer masquerade as missing keys.
+# ---------------------------------------------------------------------------
+
+class PSError(RuntimeError):
+    """Base: the parameter server reported or caused a failure."""
+
+
+class PSKeyError(PSError, KeyError):
+    """A genuinely missing key on the server."""
+
+    def __str__(self):  # KeyError would repr() the message
+        return RuntimeError.__str__(self)
+
+
+class PSProtocolError(PSError):
+    """Malformed/unknown message or wrong argument types on the wire."""
+
+
+class PSTimeoutError(PSError):
+    """A bounded wait expired: SSP staleness wait, request deadline, or
+    in-flight-duplicate wait."""
+
+
+_EXC_BY_KIND = {"key": PSKeyError, "protocol": PSProtocolError,
+                "timeout": PSTimeoutError, "server": PSError}
+
+
+def _raise_err(reply):
+    if len(reply) >= 3:
+        raise _EXC_BY_KIND.get(reply[1], PSError)(reply[2])
+    raise PSError(reply[1])  # pre-envelope 2-tuple form
+
+
+class _SSPTimeout(Exception):
+    """Server-internal: the SSP wait deadline expired (maps to 'timeout')."""
 
 
 def _send_msg(sock, obj):
@@ -63,10 +149,19 @@ def _recv_msg(sock):
 
 def server_port():
     """The async-PS listen port: the DMLC coordinator port shifted out of
-    the jax.distributed coordinator's way (override: MXNET_ASYNC_PS_PORT)."""
+    the jax.distributed coordinator's way (override: MXNET_ASYNC_PS_PORT —
+    tools/launch_local.py exports a per-run ephemeral port there so
+    concurrent runs on one host never collide)."""
     if "MXNET_ASYNC_PS_PORT" in os.environ:
         return int(os.environ["MXNET_ASYNC_PS_PORT"])
     return int(os.environ.get("DMLC_PS_ROOT_PORT", "9000")) + 1000
+
+
+def _env_float(name, default):
+    try:
+        return float(os.environ[name])
+    except (KeyError, ValueError):
+        return default
 
 
 class _Handler(socketserver.BaseRequestHandler):
@@ -76,13 +171,25 @@ class _Handler(socketserver.BaseRequestHandler):
             while True:
                 msg = _recv_msg(self.request)
                 try:
-                    reply = ps.dispatch(msg)
-                except Exception as e:  # keep the connection; report the cause
-                    reply = ("err", f"{type(e).__name__}: {e}")
+                    if msg[0] == "req":
+                        _, cid, seq, inner = msg
+                        reply = ("rep", seq,
+                                 ps.dispatch_dedup(cid, seq, inner))
+                    else:
+                        inner = msg
+                        reply = ps.safe_dispatch(msg)
+                except (TypeError, ValueError, IndexError, KeyError) as e:
+                    # a frame that is not even envelope-shaped still gets a
+                    # typed protocol error, not a dead connection
+                    inner = ("?",)
+                    reply = ("err", "protocol",
+                             f"malformed message: {type(e).__name__}: {e}")
+                if _fi.active() and _fi.fire("server.drop_reply"):
+                    return  # connection dies instead of replying
                 _send_msg(self.request, reply)
-                if msg[0] == "shutdown":
+                if inner[0] == "shutdown":
                     return
-        except (ConnectionError, OSError):
+        except (ConnectionError, OSError, pickle.UnpicklingError, EOFError):
             return
 
 
@@ -90,22 +197,89 @@ class _TCPServer(socketserver.ThreadingTCPServer):
     allow_reuse_address = True
     daemon_threads = True
 
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._conns = set()
+        self._conns_lock = threading.Lock()
+
+    def get_request(self):
+        sock, addr = super().get_request()
+        with self._conns_lock:
+            self._conns.add(sock)
+        return sock, addr
+
+    def shutdown_request(self, request):
+        with self._conns_lock:
+            self._conns.discard(request)
+        super().shutdown_request(request)
+
+    def close_all_connections(self):
+        """Sever every live client connection — a ``stop()`` must look
+        like a crash to clients (handler threads would otherwise keep
+        serving the dead server's in-memory state indefinitely)."""
+        with self._conns_lock:
+            conns, self._conns = list(self._conns), set()
+        for s in conns:
+            try:
+                s.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                s.close()
+            except OSError:
+                pass
+
+
+class _DedupEntry:
+    __slots__ = ("done", "event", "reply")
+
+    def __init__(self):
+        self.done = False
+        self.event = threading.Event()
+        self.reply = None
+
+
+# messages exempt from the dedup window: pure reads (safe to re-execute)
+# and heartbeats (idempotent by definition, highest frequency)
+_NO_DEDUP = frozenset(("pull", "counts", "members", "heartbeat"))
+
 
 class ParameterServer:
-    """The server tier: key -> numpy weight, applied-on-arrival updates."""
+    """The server tier: key -> numpy weight, applied-on-arrival updates,
+    lease-based liveness, per-client request dedup, snapshot/restore."""
 
-    def __init__(self, num_workers, port=None, staleness=None):
-        self.num_workers = int(num_workers)
+    def __init__(self, num_workers, port=None, staleness=None, lease_s=None,
+                 ssp_timeout=None, snapshot_path=None, snapshot_every_s=None):
+        self._expected = int(num_workers)
         self.staleness = staleness if staleness is not None else (
             int(os.environ["MXNET_KVSTORE_MAX_STALENESS"])
             if "MXNET_KVSTORE_MAX_STALENESS" in os.environ else None)
+        self._lease_s = (lease_s if lease_s is not None
+                         else _env_float("MXNET_KVSTORE_LEASE_S", 10.0))
+        self._ssp_timeout = (ssp_timeout if ssp_timeout is not None
+                             else _env_float("MXNET_KVSTORE_SSP_TIMEOUT", 300.0))
+        self._snapshot_path = (snapshot_path if snapshot_path is not None
+                               else os.environ.get("MXNET_KVSTORE_PS_SNAPSHOT"))
+        self._snapshot_every = (snapshot_every_s if snapshot_every_s is not None
+                                else _env_float("MXNET_KVSTORE_PS_SNAPSHOT_S", 30.0))
+        self._dedup_window = int(os.environ.get("MXNET_KVSTORE_DEDUP_WINDOW", "64"))
         self._store = {}
         self._updater = None
-        self._push_counts = [0] * self.num_workers
+        self._push_counts = [0] * self._expected
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
+        self._leases = {}   # rank -> monotonic lease expiry (registered only)
+        self._left = set()  # deregistered or evicted ranks
+        self._epoch = 0     # membership epoch: bumped on join/leave/evict
+        self._dedup = {}    # client_id -> OrderedDict(seq -> _DedupEntry)
+        self._dedup_seen = {}   # client_id -> monotonic last-use time
+        self._dedup_ttl = _env_float("MXNET_KVSTORE_DEDUP_TTL", 900.0)
+        self._snap_lock = threading.Lock()  # serializes snapshot writers
         self._barrier_count = 0
         self._barrier_gen = 0
+        self._stop_event = threading.Event()
+        if self._snapshot_path and os.path.exists(self._snapshot_path):
+            self._load_snapshot(self._snapshot_path)
         # bind all interfaces: clients connect to DMLC_PS_ROOT_URI, which a
         # real tracker sets to the host's routable address, not loopback
         self._tcp = _TCPServer(("", port if port is not None else server_port()),
@@ -114,12 +288,154 @@ class ParameterServer:
         self._thread = threading.Thread(target=self._tcp.serve_forever,
                                         name="mxtpu-async-ps", daemon=True)
         self._thread.start()
+        self._reaper = threading.Thread(target=self._reap_loop,
+                                        name="mxtpu-ps-reaper", daemon=True)
+        self._reaper.start()
+        self._prev_sigterm = None
+        if self._snapshot_path and \
+                threading.current_thread() is threading.main_thread():
+            # persist on preemption, chaining any previously-installed
+            # handler (the CheckpointManager discipline)
+            self._prev_sigterm = signal.signal(signal.SIGTERM, self._on_sigterm)
 
     @property
     def address(self):
         return self._tcp.server_address
 
+    @property
+    def num_workers(self):
+        """LIVE worker count — dynamic under join/leave/eviction."""
+        with self._lock:
+            return max(1, len(self._live_ranks()))
+
+    @property
+    def membership_epoch(self):
+        with self._lock:
+            return self._epoch
+
+    # -- membership (callers hold self._lock) -----------------------------
+    def _live_ranks(self):
+        now = time.monotonic()
+        live = set()
+        for r in set(range(self._expected)) | set(self._leases):
+            if r in self._left:
+                continue
+            exp = self._leases.get(r)
+            if exp is not None and exp <= now:
+                continue  # expired; the reaper will move it to _left
+            live.add(r)
+        return live
+
+    def _touch(self, rank):
+        """Any message from ``rank`` is a liveness proof: refresh its lease
+        and re-admit it if it was evicted (join-without-restart).  A rank
+        coming back from the evicted set re-enters WITH a lease — having
+        once fallen out of the live set it must keep proving liveness;
+        only never-evicted legacy clients stay leaseless."""
+        if rank in self._left:
+            self._left.discard(rank)
+            self._leases[rank] = time.monotonic() + self._lease_s
+            self._epoch += 1
+            self._cond.notify_all()
+        elif rank in self._leases:
+            self._leases[rank] = time.monotonic() + self._lease_s
+
+    def _ensure_rank(self, rank):
+        if rank >= len(self._push_counts):
+            self._push_counts.extend([0] * (rank + 1 - len(self._push_counts)))
+
+    def _maybe_release_barrier(self):
+        target = max(1, len(self._live_ranks()))
+        if self._barrier_count >= target:
+            self._barrier_count = 0
+            self._barrier_gen += 1
+            self._cond.notify_all()
+
+    # -- reaper: lease expiry + periodic snapshot --------------------------
+    def _reap_loop(self):
+        interval = max(0.05, min(self._lease_s / 4.0, 5.0))
+        last_snap = time.monotonic()
+        while not self._stop_event.wait(interval):
+            now = time.monotonic()
+            with self._cond:
+                expired = [r for r, exp in self._leases.items()
+                           if exp <= now and r not in self._left]
+                for r in expired:
+                    self._left.add(r)
+                    del self._leases[r]
+                    self._epoch += 1
+                    _profiler.incr("ps_eviction")
+                    print(f"[async_ps] evicting worker {r}: lease expired "
+                          f"({self._lease_s:.1f}s without a heartbeat)",
+                          flush=True)
+                if expired:
+                    # a dead straggler must unblock SSP pushers and shrink
+                    # the barrier target NOW, not at the next message
+                    self._maybe_release_barrier()
+                    self._cond.notify_all()
+                # GC dedup windows of departed clients: every restart mints
+                # a fresh client_id, so under churn the windows would grow
+                # (and bloat every snapshot) without bound.  A window idle
+                # longer than any client retries (>> request deadline) can
+                # no longer receive a replay.
+                stale = [cid for cid, t in self._dedup_seen.items()
+                         if now - t > self._dedup_ttl]
+                for cid in stale:
+                    del self._dedup_seen[cid]
+                    self._dedup.pop(cid, None)
+            if self._snapshot_path and self._snapshot_every > 0 \
+                    and now - last_snap >= self._snapshot_every:
+                self.snapshot()
+                last_snap = now
+
     # -- message dispatch (runs on handler threads) ----------------------
+    def dispatch_dedup(self, cid, seq, msg):
+        """At-most-once wrapper: a replayed completed request returns its
+        cached reply; a replayed in-flight request waits for the original.
+        Reads bypass the window (safe to re-execute)."""
+        if msg[0] in _NO_DEDUP:
+            return self.safe_dispatch(msg)
+        with self._lock:
+            self._dedup_seen[cid] = time.monotonic()
+            win = self._dedup.setdefault(cid, OrderedDict())
+            ent = win.get(seq)
+            if ent is None:
+                ent = win[seq] = _DedupEntry()
+                mine = True
+                # trim oldest COMPLETED entries beyond the window
+                while len(win) > self._dedup_window:
+                    k = next(iter(win))
+                    if not win[k].done:
+                        break
+                    del win[k]
+            else:
+                mine = False
+        if not mine:
+            _profiler.incr("ps_dedup_hit")
+            while not ent.event.wait(timeout=5.0):
+                if self._stop_event.is_set():
+                    return ("err", "server", "server stopping")
+            return ent.reply
+        reply = self.safe_dispatch(msg)
+        with self._lock:
+            ent.reply = reply
+            ent.done = True
+            ent.event.set()
+        return reply
+
+    def safe_dispatch(self, msg):
+        """dispatch() with exceptions mapped to typed ``err`` replies."""
+        try:
+            return self.dispatch(msg)
+        except _SSPTimeout as e:
+            return ("err", "timeout", str(e))
+        except KeyError as e:
+            return ("err", "key", str(e.args[0]) if e.args else str(e))
+        except (TypeError, ValueError, IndexError, struct.error) as e:
+            return ("err", "protocol", f"{type(e).__name__}: {e}")
+        except Exception as e:  # keep the connection; report the cause
+            return ("err", "server", f"{type(e).__name__}: {e}")
+
     def dispatch(self, msg):
         kind = msg[0]
         if kind == "init":
@@ -130,20 +446,10 @@ class ParameterServer:
         if kind == "push":
             _, key, arr, rank = msg
             with self._cond:
+                self._ensure_rank(rank)
+                self._touch(rank)
                 if self.staleness is not None:
-                    # SSP: block while this worker leads the slowest ACTIVE
-                    # worker by >= the bound.  "Active" = has pushed at
-                    # least once: a pull-only evaluator rank must not
-                    # deadlock the pushers (divergence from strict SSP,
-                    # which cannot distinguish 'slow' from 'never').
-                    bound = max(1, self.staleness)
-                    while True:
-                        active = [c for i, c in enumerate(self._push_counts)
-                                  if c > 0 and i != rank]
-                        if not active or (self._push_counts[rank]
-                                          - min(active) < bound):
-                            break
-                        self._cond.wait(timeout=60)
+                    self._ssp_wait(rank)
                 if self._updater is not None:
                     self._apply_update(key, np.asarray(arr))
                 elif key in self._store:
@@ -164,7 +470,7 @@ class ParameterServer:
             _, key = msg
             with self._lock:
                 if key not in self._store:
-                    return ("err", f"unknown key {key!r}")
+                    return ("err", "key", f"unknown key {key!r}")
                 return ("val", np.array(self._store[key], copy=True))
         if kind == "set_optimizer":
             _, blob = msg
@@ -172,26 +478,96 @@ class ParameterServer:
             with self._lock:
                 self._updater = get_updater(pickle.loads(blob))
             return ("ok",)
+        if kind == "register":
+            _, rank = msg
+            with self._cond:
+                self._ensure_rank(rank)
+                if rank in self._left or rank not in self._leases:
+                    self._epoch += 1
+                self._left.discard(rank)
+                self._leases[rank] = time.monotonic() + self._lease_s
+                self._maybe_release_barrier()
+                self._cond.notify_all()
+            return ("val", self._lease_s)
+        if kind == "heartbeat":
+            _, rank = msg
+            with self._cond:
+                self._ensure_rank(rank)
+                if rank in self._left:
+                    _profiler.incr("ps_heartbeat_miss")  # late: missed window
+                if rank in self._left or rank not in self._leases:
+                    self._epoch += 1  # (re)joining the live set
+                self._left.discard(rank)
+                self._leases[rank] = time.monotonic() + self._lease_s
+                self._cond.notify_all()
+            return ("ok",)
+        if kind == "deregister":
+            _, rank = msg
+            with self._cond:
+                self._leases.pop(rank, None)
+                self._left.add(rank)
+                self._epoch += 1
+                # a clean leave shrinks the barrier target immediately
+                self._maybe_release_barrier()
+                self._cond.notify_all()
+            return ("ok",)
+        if kind == "members":
+            with self._lock:
+                return ("val", {"epoch": self._epoch,
+                                "ranks": sorted(self._live_ranks())})
         if kind == "barrier":
-            # counting barrier, generation-tagged for reuse
+            # counting barrier over LIVE workers, generation-tagged for
+            # reuse; an eviction mid-barrier shrinks the target so the
+            # survivors release instead of waiting on a corpse
             with self._cond:
                 gen = self._barrier_gen
                 self._barrier_count += 1
-                if self._barrier_count == self.num_workers:
-                    self._barrier_count = 0
-                    self._barrier_gen += 1
-                    self._cond.notify_all()
-                else:
-                    while self._barrier_gen == gen:
-                        self._cond.wait(timeout=120)
+                self._maybe_release_barrier()
+                while self._barrier_gen == gen:
+                    self._cond.wait(timeout=1.0)
+                    self._maybe_release_barrier()
             return ("ok",)
         if kind == "counts":
             with self._lock:
                 return ("val", list(self._push_counts))
+        if kind == "snapshot":
+            if not self._snapshot_path:
+                return ("err", "server",
+                        "no snapshot path configured (MXNET_KVSTORE_PS_SNAPSHOT)")
+            self.snapshot()
+            return ("ok",)
         if kind == "shutdown":
             threading.Thread(target=self.stop, daemon=True).start()
             return ("ok",)
-        return ("err", f"unknown message {kind!r}")
+        return ("err", "protocol", f"unknown message {kind!r}")
+
+    def _ssp_wait(self, rank):
+        """SSP: block while this worker leads the slowest LIVE active
+        worker by >= the bound.  "Active" = has pushed at least once: a
+        pull-only evaluator rank must not deadlock the pushers (divergence
+        from strict SSP, which cannot distinguish 'slow' from 'never').
+        Eviction of the straggler unblocks the wait; the wait itself is
+        bounded by ``MXNET_KVSTORE_SSP_TIMEOUT``.  Caller holds _cond."""
+        bound = max(1, self.staleness)
+        deadline = (time.monotonic() + self._ssp_timeout
+                    if self._ssp_timeout and self._ssp_timeout > 0 else None)
+        while True:
+            live = self._live_ranks()
+            active = [(i, c) for i, c in enumerate(self._push_counts)
+                      if c > 0 and i != rank and i in live]
+            if not active or (self._push_counts[rank]
+                              - min(c for _, c in active) < bound):
+                return
+            if deadline is not None and time.monotonic() >= deadline:
+                lag_rank, lag_count = min(active, key=lambda rc: rc[1])
+                raise _SSPTimeout(
+                    f"SSP wait exceeded {self._ssp_timeout:.0f}s "
+                    f"(MXNET_KVSTORE_SSP_TIMEOUT): rank {rank} at "
+                    f"{self._push_counts[rank]} pushes is blocked on lagging "
+                    f"rank {lag_rank} at {lag_count} (staleness bound "
+                    f"{bound}); the straggler is alive but not progressing")
+            # 1s granularity: notice evictions and the deadline promptly
+            self._cond.wait(timeout=1.0)
 
     def _apply_update(self, key, grad):
         """Server-side optimizer step (the reference's async contract:
@@ -202,44 +578,260 @@ class ParameterServer:
         self._updater(key, NDArray(grad), w)
         self._store[key] = np.asarray(w.asnumpy())
 
-    def stop(self):
+    # -- snapshot / restore ------------------------------------------------
+    def snapshot(self, path=None):
+        """Atomically persist store + push counts + dedup window + updater
+        (tmp + os.replace, the checkpoint.py discipline): a kill mid-write
+        never corrupts the last complete snapshot.  The dedup window rides
+        along so a push acked just before the snapshot is never re-applied
+        by a post-restart replay."""
+        path = path or self._snapshot_path
+        if not path:
+            return None
+        t0 = time.perf_counter() if _profiler._active else None
+        with self._lock:
+            # copies isolate the state; the EXPENSIVE outer pickle runs
+            # outside the lock so a periodic snapshot never stalls pushes
+            # (the updater blob serializes the one mutable piece in-lock)
+            state = {
+                "format": 1,
+                "store": {k: np.array(v, copy=True)
+                          for k, v in self._store.items()},
+                "push_counts": list(self._push_counts),
+                "expected": self._expected,
+                "updater": (pickle.dumps(self._updater)
+                            if self._updater is not None else None),
+                "dedup": {cid: [(seq, ent.reply) for seq, ent in win.items()
+                                if ent.done]
+                          for cid, win in self._dedup.items()},
+            }
+        blob = pickle.dumps(state, protocol=pickle.HIGHEST_PROTOCOL)
+        from ..checkpoint import atomic_write_bytes
+
+        with self._snap_lock:
+            # concurrent writers (reaper tick + SIGTERM + explicit message)
+            # share one tmp path; unserialized, a slower writer could keep
+            # appending to an already-published file
+            atomic_write_bytes(path, blob)
+        _profiler.incr("ps_snapshot")
+        if t0 is not None:
+            _profiler.record_span("kvstore.ps_snapshot", "comms", t0,
+                                  args={"bytes": len(blob)})
+        return path
+
+    def _load_snapshot(self, path):
+        with open(path, "rb") as f:
+            state = pickle.loads(f.read())
+        self._store = dict(state["store"])
+        self._push_counts = list(state["push_counts"])
+        self._expected = max(self._expected, int(state.get("expected", 0)))
+        if state.get("updater") is not None:
+            self._updater = pickle.loads(state["updater"])
+        for cid, entries in state.get("dedup", {}).items():
+            win = self._dedup.setdefault(cid, OrderedDict())
+            for seq, reply in entries:
+                ent = _DedupEntry()
+                ent.reply = reply
+                ent.done = True
+                ent.event.set()
+                win[seq] = ent
+        # probation leases: every restored rank must prove liveness within
+        # one window or be evicted — without this, a worker that died with
+        # the old server would be grandfathered back in as a leaseless
+        # "legacy" member and block SSP peers forever
+        now = time.monotonic()
+        for r in range(len(self._push_counts)):
+            self._leases[r] = now + self._lease_s
+
+    def _on_sigterm(self, signum, frame):
+        self.snapshot()
+        if callable(self._prev_sigterm):
+            self._prev_sigterm(signum, frame)
+        else:
+            signal.signal(signal.SIGTERM, signal.SIG_DFL)
+            os.kill(os.getpid(), signal.SIGTERM)
+
+    def stop(self, final_snapshot=True):
+        """Graceful stop: final snapshot (when configured), then close the
+        listener.  ``stop(final_snapshot=False)`` is the crash-test hook —
+        sockets die abruptly and NO state is persisted beyond the last
+        periodic snapshot, exactly like a kill."""
+        self._stop_event.set()
+        if final_snapshot and self._snapshot_path:
+            try:
+                self.snapshot()
+            except OSError:
+                pass
+        with self._cond:
+            self._cond.notify_all()
         self._tcp.shutdown()
         self._tcp.server_close()
+        self._tcp.close_all_connections()
 
 
 class AsyncClient:
-    """Worker-side connection to the parameter server."""
+    """Worker-side connection to the parameter server: per-request
+    ``(client_id, seq)`` ids, per-attempt timeouts, exponential-backoff
+    reconnect, and replay — at-most-once against the server's dedup
+    window.  Request/reply envelopes are seq-correlated so duplicate or
+    stale replies on a reused socket are discarded, never mismatched."""
 
-    def __init__(self, host, port, connect_timeout=60.0):
-        deadline = time.monotonic() + connect_timeout
+    def __init__(self, host, port, connect_timeout=60.0, client_id=None,
+                 attempt_timeout=None, deadline_s=None, abort_event=None):
+        self._host, self._port = host, port
+        self._attempt_timeout = (attempt_timeout if attempt_timeout is not None
+                                 else _env_float("MXNET_KVSTORE_REQUEST_TIMEOUT",
+                                                 30.0))
+        self._deadline_s = (deadline_s if deadline_s is not None
+                            else _env_float("MXNET_KVSTORE_REQUEST_DEADLINE",
+                                            600.0))
+        self._abort = abort_event  # set() kills the retry loop immediately
+        self.client_id = client_id or \
+            f"{socket.gethostname()}:{os.getpid()}:{uuid.uuid4().hex[:8]}"
+        self._seq = 0
+        self._sock = None
+        self._lock = threading.Lock()
+        self._connect(time.monotonic() + connect_timeout, first=True)
+        atexit.register(self.close)
+
+    # -- connection management -------------------------------------------
+    def _connect(self, deadline, first=False):
         last = None
         while True:
             try:
-                self._sock = socket.create_connection((host, port), timeout=300)
-                self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-                break
-            except OSError as e:  # server not up yet
+                s = socket.create_connection((self._host, self._port),
+                                             timeout=self._attempt_timeout)
+                s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                self._sock = s
+                if not first:
+                    _profiler.incr("ps_reconnect")
+                return
+            except OSError as e:  # server not up yet / restarting
                 last = e
                 if time.monotonic() > deadline:
                     raise ConnectionError(
-                        f"async PS at {host}:{port} unreachable: {last}") from e
+                        f"async PS at {self._host}:{self._port} unreachable: "
+                        f"{last}") from e
                 time.sleep(0.1)
-        self._lock = threading.Lock()
-        atexit.register(self.close)
 
+    def _drop_sock(self):
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+    # -- request path ------------------------------------------------------
     def request(self, *msg):
         with self._lock:
-            _send_msg(self._sock, msg)
-            reply = _recv_msg(self._sock)
+            seq = self._seq
+            self._seq += 1
+            reply = self._roundtrip(("req", self.client_id, seq, msg), seq)
         if reply[0] == "err":
-            raise KeyError(reply[1])
+            _raise_err(reply)
         return reply[1] if len(reply) > 1 else None
 
+    def _roundtrip(self, envelope, seq):
+        deadline = time.monotonic() + self._deadline_s
+        backoff = 0.05
+        while True:
+            try:
+                if self._sock is None:
+                    t0 = time.perf_counter() if _profiler._active else None
+                    self._connect(deadline)
+                    if t0 is not None:
+                        _profiler.record_span("kvstore.ps_reconnect", "comms",
+                                              t0)
+                if _fi.active():
+                    if _fi.fire("client.delay"):
+                        time.sleep(_fi.param("client.delay", "s", 0.02))
+                    if _fi.fire("client.drop_before_send"):
+                        self._drop_sock()
+                        raise _fi.FaultInjected("drop before send")
+                self._sock.settimeout(self._attempt_timeout)
+                _send_msg(self._sock, envelope)
+                if _fi.active():
+                    if _fi.fire("client.dup_send"):
+                        _send_msg(self._sock, envelope)  # duplicate delivery
+                    if _fi.fire("client.drop_after_send"):
+                        self._drop_sock()
+                        raise _fi.FaultInjected("drop after send")
+                return self._recv_matching(seq)
+            except (ConnectionError, OSError) as e:
+                self._drop_sock()
+                if self._abort is not None and self._abort.is_set():
+                    # owner is shutting down: a retried heartbeat landing
+                    # AFTER a deregister would re-admit the departed rank
+                    raise ConnectionError("client aborted (shutdown)") from e
+                now = time.monotonic()
+                if now >= deadline:
+                    raise PSTimeoutError(
+                        f"PS request {envelope[3][0]!r} (seq {seq}) gave up "
+                        f"after {self._deadline_s:.0f}s "
+                        f"(MXNET_KVSTORE_REQUEST_DEADLINE): {e}") from e
+                _profiler.incr("ps_retry")
+                time.sleep(min(backoff, max(0.0, deadline - now)))
+                backoff = min(backoff * 2, 2.0)
+
+    def _recv_matching(self, seq):
+        """Read replies until the one correlated with ``seq``; stale
+        replies (a duplicate delivery's second answer, or the answer to a
+        timed-out earlier attempt) are discarded, never mismatched."""
+        while True:
+            reply = _recv_msg(self._sock)
+            if reply[0] != "rep":
+                return reply  # pre-envelope server
+            if reply[1] == seq:
+                return reply[2]
+            if reply[1] > seq:
+                raise ConnectionError(
+                    f"reply stream ahead of request (got seq {reply[1]}, "
+                    f"want {seq})")
+            # reply[1] < seq: stale duplicate — skip
+
     def close(self):
-        try:
-            self._sock.close()
-        except OSError:
-            pass
+        with self._lock:
+            self._drop_sock()
+
+
+class HeartbeatThread(threading.Thread):
+    """Background lease renewal on a DEDICATED connection: the main
+    request socket can legitimately block for minutes inside an SSP-bound
+    push, and a heartbeat queued behind it would let the lease lapse —
+    the server would evict a live worker."""
+
+    def __init__(self, host, port, rank, interval):
+        super().__init__(name=f"mxtpu-ps-heartbeat-{rank}", daemon=True)
+        self._host, self._port = host, port
+        self._rank = rank
+        self._interval = max(0.05, interval)
+        self._stop_event = threading.Event()
+        self._client = None
+
+    def run(self):
+        while not self._stop_event.wait(self._interval):
+            try:
+                if self._client is None:
+                    self._client = AsyncClient(
+                        self._host, self._port,
+                        connect_timeout=self._interval,
+                        attempt_timeout=max(self._interval, 1.0),
+                        deadline_s=max(self._interval, 1.0),
+                        abort_event=self._stop_event)
+                self._client.request("heartbeat", self._rank)
+            except Exception:
+                if not self._stop_event.is_set():
+                    _profiler.incr("ps_heartbeat_miss")
+                if self._client is not None:
+                    self._client.close()
+                    self._client = None
+
+    def stop(self):
+        self._stop_event.set()
+        if self._client is not None:
+            self._client.close()
+            self._client = None
 
 
 _SERVER = None
@@ -250,11 +842,48 @@ def serve_if_rank0(rank, num_workers):
     """Start the PS inside worker 0's process (the reference co-locates
     server+scheduler the same way in single-host mode); returns the server
     handle or None.  Singleton per process: every KVStore instance in the
-    process shares one server, as ps-lite shares one van."""
+    process shares one server, as ps-lite shares one van.  With
+    ``MXNET_ASYNC_PS_EXTERNAL=1`` no in-process server starts — the
+    cluster runs a standalone one (``python -m
+    incubator_mxnet_tpu.kvstore.async_ps``) that can be killed and
+    restarted independently of any worker."""
     global _SERVER
+    if os.environ.get("MXNET_ASYNC_PS_EXTERNAL", "0") == "1":
+        return None
     if int(rank) != 0:
         return None
     with _SERVER_LOCK:
         if _SERVER is None:
             _SERVER = ParameterServer(num_workers)
         return _SERVER
+
+
+def _main(argv=None):
+    """Standalone server mode — the restartable-PS deployment the chaos
+    tier kills: ``python -m incubator_mxnet_tpu.kvstore.async_ps
+    --num-workers 2 --port 9999 --snapshot /path/ps.snap``."""
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--num-workers", type=int, default=1)
+    ap.add_argument("--port", type=int, default=None)
+    ap.add_argument("--staleness", type=int, default=None)
+    ap.add_argument("--lease-s", type=float, default=None)
+    ap.add_argument("--snapshot", default=None,
+                    help="snapshot path (atomic; restored on restart)")
+    ap.add_argument("--snapshot-every-s", type=float, default=None)
+    args = ap.parse_args(argv)
+    ps = ParameterServer(args.num_workers, port=args.port,
+                         staleness=args.staleness, lease_s=args.lease_s,
+                         snapshot_path=args.snapshot,
+                         snapshot_every_s=args.snapshot_every_s)
+    print(f"PS_READY {ps.address[1]}", flush=True)
+    try:
+        while ps._thread.is_alive():
+            time.sleep(0.2)
+    except KeyboardInterrupt:
+        ps.stop()
+
+
+if __name__ == "__main__":
+    _main()

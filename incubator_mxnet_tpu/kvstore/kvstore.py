@@ -21,7 +21,10 @@ collapses onto XLA collectives:
   parameter server inside worker 0's process (``async_ps.py``), applying
   each worker's push the moment it arrives with the optimizer running
   server-side — the reference's ps-lite async contract, stragglers and
-  all.  Optional SSP bound via MXNET_KVSTORE_MAX_STALENESS.
+  all.  Optional SSP bound via MXNET_KVSTORE_MAX_STALENESS.  Elastic and
+  fault-tolerant (this PR): heartbeat leases with eviction, idempotent
+  retry over a per-client dedup window, server snapshot/restore, and a
+  deterministic fault-injection harness (docs/fault_tolerance.md).
 * gradient compression — per-worker gradients are quantized to 2-bit
   {-t, 0, +t} codes with an error-feedback residual *before* the wire
   (matching [U:src/kvstore/gradient_compression.cc]'s worker-side
@@ -114,6 +117,11 @@ def bucketed_pushpull(kv, items, cap_bytes=None):
     from ..engine import DeferredArray
 
     cap = bucket_bytes() if cap_bytes is None else cap_bytes
+    # membership epoch namespaces the bucket keys: any store-side state a
+    # backend hangs off a bucket key (e.g. a compression residual) must NOT
+    # survive a change in the contributing worker set — stale error
+    # feedback from a departed worker would be re-injected forever
+    epoch = kv.membership_epoch() if hasattr(kv, "membership_epoch") else 0
     by_group = {}
     for key, g in items:
         raw = g._data
@@ -142,7 +150,8 @@ def bucketed_pushpull(kv, items, cap_bytes=None):
             grads = [g for _, g, _ in chunk]
             raws = [r for _, _, r in chunk]
             flat = NDArray(_flatten(raws), ctx=grads[0].context)
-            kv.pushpull(f"__grad_bucket__:{dt}:{bucket_id}", flat, out=flat)
+            kv.pushpull(f"__grad_bucket__:{epoch}:{dt}:{bucket_id}", flat,
+                        out=flat)
             bucket_id += 1
             pieces = _unflatten(flat._data, [r.shape for r in raws])
             for g, piece in zip(grads, pieces):
@@ -283,6 +292,21 @@ class KVStore:
         semantics must keep one key per parameter.  Local stores skip
         bucketing too: in-process pushpull is already free of wire cost."""
         return False
+
+    def membership_epoch(self):
+        """Monotonic epoch of the contributing worker set.  Static stores
+        (local / SPMD dist, where membership is fixed at bootstrap) stay at
+        0; the elastic async tier bumps it on join/leave/eviction so
+        membership-derived state (bucket keys, compression residuals) is
+        re-derived instead of carried across a membership change.
+
+        Contract for a future store that is BOTH elastic and bucketing
+        (none exists today — the async tier never buckets): the epoch fed
+        into bucket keys must be step-synchronized across workers (e.g.
+        agreed at a barrier), not read through a per-worker TTL cache —
+        peers formatting the same step's buckets with different epochs
+        would silently split the reduction."""
+        return 0
 
     # -- helpers ---------------------------------------------------------
     def _aggregate(self, value):
@@ -497,7 +521,13 @@ class KVStoreDistAsync(KVStore):
     server in worker 0 (see ``async_ps.py``).  Pure control-plane sockets —
     no jax.distributed, no collectives, hence no implicit barriers: a
     straggler cannot block its peers (parity:
-    [U:src/kvstore/kvstore_dist.cc] async mode)."""
+    [U:src/kvstore/kvstore_dist.cc] async mode).
+
+    Elastic + fault-tolerant (docs/fault_tolerance.md): the store registers
+    its rank on construction and renews the lease from a background
+    heartbeat thread; requests retry with reconnect+replay against the
+    server's dedup window; ``close()`` (or ``Trainer.close()``) leaves the
+    membership immediately instead of waiting out the lease."""
 
     def __init__(self, name):
         super().__init__(name)
@@ -508,6 +538,14 @@ class KVStoreDistAsync(KVStore):
         host = _os.environ.get("DMLC_PS_ROOT_URI", "127.0.0.1")
         self._server = async_ps.serve_if_rank0(self._rank, self._num_workers)
         self._client = async_ps.AsyncClient(host, async_ps.server_port())
+        lease_s = float(self._client.request("register", self._rank))
+        self._heartbeat = async_ps.HeartbeatThread(
+            host, async_ps.server_port(), self._rank,
+            interval=max(0.05, lease_s / 3.0))
+        self._heartbeat.start()
+        self._members_cache = None   # (expires_at, {"epoch","ranks"})
+        self._members_ttl = max(0.2, lease_s / 4.0)
+        self._closed = False
 
     def supports_grad_bucketing(self):
         # never: the async server ACCUMULATES pushes to an existing key
@@ -522,7 +560,44 @@ class KVStoreDistAsync(KVStore):
 
     @property
     def num_workers(self):
+        # the CONFIGURED cluster size (scaling denominators and launch
+        # assertions key off this); live membership is num_live_workers()
         return self._num_workers
+
+    # -- elastic membership ----------------------------------------------
+    def _members(self):
+        from time import monotonic as _mono
+
+        if self._members_cache is not None and \
+                self._members_cache[0] > _mono():
+            return self._members_cache[1]
+        val = self._client.request("members")
+        self._members_cache = (_mono() + self._members_ttl, val)
+        return val
+
+    def live_workers(self):
+        """Ranks currently holding (or grandfathered into) a live lease."""
+        return list(self._members()["ranks"])
+
+    def num_live_workers(self):
+        return len(self.live_workers())
+
+    def membership_epoch(self):
+        return int(self._members()["epoch"])
+
+    def close(self):
+        """Leave the cluster cleanly: deregister (peers' barrier/SSP
+        accounting shrinks NOW, no eviction window), stop heartbeating,
+        drop the connection.  Idempotent."""
+        if self._closed:
+            return
+        self._closed = True
+        self._heartbeat.stop()
+        try:
+            self._client.request("deregister", self._rank)
+        except Exception:
+            pass  # server already gone: nothing to leave
+        self._client.close()
 
     def init(self, key, value):
         if isinstance(key, (list, tuple)):

@@ -141,6 +141,16 @@ def init_distributed(coordinator_address=None, num_processes=None, process_id=No
         process_id = int(wid) if wid else None
     if coordinator_address is None:
         return  # single-process
+    # The CPU backend ships no cross-process collectives by default
+    # ("Multiprocess computations aren't implemented on the CPU backend");
+    # multi-process CPU runs (the dist test tier, local launch) need the
+    # gloo implementation selected BEFORE the backend initializes.
+    if "cpu" in os.environ.get("JAX_PLATFORMS", "").lower():
+        impl = os.environ.get("JAX_CPU_COLLECTIVES_IMPLEMENTATION", "gloo")
+        try:
+            jax.config.update("jax_cpu_collectives_implementation", impl)
+        except Exception:
+            pass  # older jax: flag absent — keep the previous behavior
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
         num_processes=num_processes,

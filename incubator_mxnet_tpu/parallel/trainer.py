@@ -453,9 +453,12 @@ class SPMDTrainer:
     def save_states(self, fname):
         import pickle
 
+        from ..checkpoint import atomic_write_bytes
+
         flat = jax.tree_util.tree_map(_np.asarray, self._opt_states)
-        with open(fname, "wb") as f:
-            pickle.dump({"states": flat, "num_update": self._t}, f)
+        # atomic (tmp + os.replace): preemption mid-write never tears it
+        atomic_write_bytes(fname, pickle.dumps(
+            {"states": flat, "num_update": self._t}))
 
     def load_states(self, fname):
         import pickle

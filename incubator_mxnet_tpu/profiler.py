@@ -120,6 +120,13 @@ _counters = {
     "profiler_trace_error": 0,        # jax.profiler start/stop failures
     "slow_step_detected": 0,          # slow-step detector firings
     "io_prefetch_batches": 0,         # batches produced by prefetch workers
+    "ps_retry": 0,                    # async-PS client request retries
+    "ps_reconnect": 0,                # async-PS client reconnects
+    "ps_dedup_hit": 0,                # duplicate requests the PS suppressed
+    "ps_eviction": 0,                 # workers evicted on lease expiry
+    "ps_heartbeat_miss": 0,           # heartbeats that failed or arrived late
+    "ps_snapshot": 0,                 # PS state snapshots written
+    "fault_injected": 0,              # faultinject.py points that fired
 }
 _counter_lock = _threading.Lock()
 

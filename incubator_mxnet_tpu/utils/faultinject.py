@@ -1,0 +1,146 @@
+"""Deterministic fault injection for the parameter-server wire (chaos tier).
+
+The async PS (``kvstore/async_ps.py``) threads named *fault points* through
+its client/server wire helpers; this module decides — deterministically —
+whether a given point fires at a given hit.  Faults simulate the real
+failure modes of a flaky link by driving the REAL recovery paths (the
+injected "drop" actually closes the socket, so the code under test is the
+production reconnect/replay logic, not a mock).
+
+Configuration (env or :func:`configure`):
+
+* ``MXNET_FAULT_SPEC`` — comma-separated entries ``point:k=v[:k=v...]``::
+
+      client.drop_after_send:n=2,client.dup_send:every=5,client.delay:p=0.1:s=0.05
+
+  Per-point triggers (exactly one):
+
+  - ``n=K``     fire on the first K hits of the point (exact, per process)
+  - ``every=K`` fire on every K-th hit (hits K, 2K, ...)
+  - ``p=F``     fire with probability F per hit, from a per-point RNG
+                seeded by ``MXNET_FAULT_SEED`` (same seed → same schedule)
+
+  Optional params: ``s=SEC`` (sleep length for delay points, default 0.02).
+
+* ``MXNET_FAULT_SEED`` — integer seed for the ``p=`` RNGs (default 0).
+
+Known points (see docs/fault_tolerance.md):
+
+====================== ====================================================
+``client.drop_before_send``  close the socket before the request is sent
+``client.drop_after_send``   send, then close before reading the reply
+                             (forces a replay — exercises server dedup)
+``client.dup_send``          send the request envelope twice (duplicate
+                             delivery — server must apply once)
+``client.delay``             sleep ``s`` seconds before sending
+``server.drop_reply``        server closes the connection instead of
+                             replying (client retries on a fresh socket)
+====================== ====================================================
+
+Every fired fault bumps the ``fault_injected`` profiler counter, so a chaos
+run's injected-fault count is part of its evidence.
+"""
+from __future__ import annotations
+
+import os
+import random
+import threading
+import zlib
+
+__all__ = ["FaultInjected", "configure", "active", "fire", "param", "stats"]
+
+
+class FaultInjected(ConnectionError):
+    """Raised (or used as the cause) when an injected fault drops a
+    connection — a ``ConnectionError`` subclass so the production
+    reconnect paths handle it identically to a real peer failure."""
+
+
+_lock = threading.Lock()
+_spec = {}   # point -> {"n"/"every"/"p": float, "s": float}
+_hits = {}   # point -> hit count
+_fired = {}  # point -> fired count
+_rng = {}    # point -> seeded random.Random (p= mode)
+_seed = 0
+
+
+def _parse(spec):
+    out = {}
+    for entry in filter(None, (e.strip() for e in spec.split(","))):
+        parts = entry.split(":")
+        point, cfg = parts[0], {}
+        for kv in parts[1:]:
+            k, _, v = kv.partition("=")
+            cfg[k] = float(v)
+        if not any(k in cfg for k in ("n", "every", "p")):
+            raise ValueError(
+                f"fault spec entry {entry!r} needs one of n=/every=/p=")
+        out[point] = cfg
+    return out
+
+
+def configure(spec=None, seed=None):
+    """(Re)load the fault schedule.  ``spec=None`` re-reads the env vars;
+    ``spec=""`` disables injection.  Resets all hit counts."""
+    global _spec, _seed
+    if spec is None:
+        spec = os.environ.get("MXNET_FAULT_SPEC", "")
+    if seed is None:
+        seed = int(os.environ.get("MXNET_FAULT_SEED", "0"))
+    with _lock:
+        _spec = _parse(spec)
+        _seed = seed
+        _hits.clear()
+        _fired.clear()
+        _rng.clear()
+
+
+def active():
+    """Whether any fault point is configured (the wire helpers pre-check
+    this so the fault-free path costs one module-attr read)."""
+    return bool(_spec)
+
+
+def fire(point):
+    """Count a hit of ``point``; return True when the fault should fire."""
+    cfg = _spec.get(point)
+    if cfg is None:
+        return False
+    with _lock:
+        _hits[point] = hit = _hits.get(point, 0) + 1
+        if "n" in cfg:
+            hot = hit <= cfg["n"]
+        elif "every" in cfg:
+            hot = hit % int(cfg["every"]) == 0
+        else:
+            rng = _rng.get(point)
+            if rng is None:
+                # per-point stream: independent of other points, stable
+                # across runs for a given (seed, point) pair
+                rng = _rng[point] = random.Random(
+                    _seed ^ zlib.crc32(point.encode()))
+            hot = rng.random() < cfg["p"]
+        if hot:
+            _fired[point] = _fired.get(point, 0) + 1
+    if hot:
+        from .. import profiler as _profiler
+
+        _profiler.incr("fault_injected")
+    return hot
+
+
+def param(point, key, default):
+    """A numeric parameter of a configured point (e.g. delay seconds)."""
+    cfg = _spec.get(point)
+    if cfg is None:
+        return default
+    return cfg.get(key, default)
+
+
+def stats():
+    """{point: (hits, fired)} — chaos-test evidence."""
+    with _lock:
+        return {p: (_hits.get(p, 0), _fired.get(p, 0)) for p in _spec}
+
+
+configure()

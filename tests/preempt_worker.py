@@ -5,6 +5,12 @@ Modes:
   phase1         — train with per-step checkpoints + slow-down sleeps,
                    printing "TRAINING" once underway; SIGTERM triggers the
                    manager's synchronous save and kills the process
+  phase1_killwrite — like phase1 but with checkpoint file writes SLOWED
+                   (a sleep inside the save, after the tmp file is written
+                   and before os.replace) and a "SAVING <step>" marker per
+                   save, so the test can land a SIGKILL mid-write and
+                   assert atomicity: restore() must load the last COMPLETE
+                   checkpoint, never a torn one
   resume         — restore the newest checkpoint, train the remaining
                    steps, print "FINAL <loss>"
 
@@ -66,6 +72,25 @@ def main():
             if i == 2:
                 print("TRAINING", flush=True)
             time.sleep(0.12)  # widen the window so SIGTERM lands mid-fit
+        print("FINISHED", flush=True)
+        return
+
+    if mode == "phase1_killwrite":
+        from incubator_mxnet_tpu.ndarray import utils as nd_utils
+
+        orig_save = nd_utils.save
+
+        def slow_save(fname, data, format=None):
+            orig_save(fname, data, format=format)
+            time.sleep(0.4)  # kill window: tmp written, os.replace pending
+
+        nd_utils.save = slow_save
+        mgr = CheckpointManager(prefix, net=net, trainer=trainer,
+                                save_on_sigterm=False, async_write=False)
+        for i in range(1, TOTAL + 1):
+            step(net, trainer, x, y)
+            print("SAVING", i, flush=True)
+            mgr.save(i, blocking=True)
         print("FINISHED", flush=True)
         return
 

@@ -143,6 +143,47 @@ def test_sigterm_mid_fit_resumes_same_curve(tmp_path):
     np.testing.assert_allclose(final_resumed, final_gold, rtol=1e-4, atol=1e-5)
 
 
+def test_sigkill_mid_checkpoint_write_keeps_last_complete(tmp_path):
+    """SIGKILL (no handler, no cleanup) landing MID-WRITE of a checkpoint:
+    the tmp + os.replace discipline must leave the last COMPLETE
+    checkpoint loadable — restore() never sees a torn file."""
+    script = os.path.join(ROOT, "tests", "preempt_worker.py")
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    prefix = str(tmp_path / "kw")
+
+    p = subprocess.Popen(
+        [sys.executable, script, prefix, "phase1_killwrite"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+    killed_during = None
+    try:
+        for line in p.stdout:
+            if line.startswith("SAVING"):
+                killed_during = int(line.split()[1])
+                if killed_during >= 3:
+                    break
+        assert killed_during is not None, "worker never reached a save"
+        time.sleep(0.15)  # inside the slowed write: tmp exists, no replace
+        p.kill()          # SIGKILL: no signal handler can run
+        p.wait(timeout=60)
+    finally:
+        if p.poll() is None:
+            p.kill()
+
+    resumed = subprocess.run(
+        [sys.executable, script, prefix, "resume"],
+        env=env, capture_output=True, text=True, timeout=240)
+    assert resumed.returncode == 0, resumed.stderr[-2000:]
+    start = int(resumed.stdout.split("RESUMED_FROM")[1].split()[0])
+    # the restored step is a COMPLETE checkpoint at or just below the one
+    # being written when the kill landed — never ahead of it
+    assert 1 <= start <= killed_during, (start, killed_during)
+    final = float(resumed.stdout.strip().splitlines()[-1].split()[-1])
+    assert np.isfinite(final)
+
+
 class TestShardedCheckpoint:
     """Sharded save/restore: every process writes only its addressable
     shards (no global gather) — SURVEY §5's sharded-async plan, exercised

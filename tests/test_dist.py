@@ -4,6 +4,14 @@ Launches tests/dist_worker.py at process_count=2 through
 tools/launch_local.py — the [U:tools/launch.py] --launcher local analog —
 so KVStoreDist/_allreduce/compression actually execute over
 jax.distributed, which single-process tests cannot cover.
+
+Two environmental failure modes bit this tier historically, both fixed:
+the CPU backend ships no cross-process collectives by default
+("Multiprocess computations aren't implemented on the CPU backend") —
+``parallel.mesh.init_distributed`` now selects the gloo implementation
+before backend init — and the async PS listened on coordinator_port+1000,
+which collided with unrelated listeners; ``launch_local.py`` now exports
+a per-run ephemeral ``MXNET_ASYNC_PS_PORT`` instead.
 """
 import os
 import subprocess

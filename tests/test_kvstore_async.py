@@ -1,14 +1,20 @@
 """In-process unit tier for the dist_async parameter server (async_ps.py):
-protocol, applied-on-arrival semantics, and the SSP staleness bound — the
-single-process complement to tests/test_dist.py's 8-worker subprocess tier.
+protocol, applied-on-arrival semantics, the SSP staleness bound, and the
+fault-tolerance machinery (leases/eviction, dedup, snapshot/restore,
+typed errors) — the single-process complement to tests/test_dist.py's
+8-worker subprocess tier and tests/test_chaos.py's fault-injection tier.
 """
+import pickle
+import socket
 import threading
 import time
 
 import numpy as np
 import pytest
 
-from incubator_mxnet_tpu.kvstore.async_ps import AsyncClient, ParameterServer
+from incubator_mxnet_tpu.kvstore.async_ps import (
+    AsyncClient, HeartbeatThread, ParameterServer,
+    PSError, PSKeyError, PSProtocolError, PSTimeoutError)
 
 
 @pytest.fixture()
@@ -123,6 +129,270 @@ def test_push_codes_wire_compression(server):
     np.testing.assert_allclose(c.request("pull", "k"),
                                [0.5, -0.5, 0.0, 0.5])
     assert c.request("counts") == [1, 0]
+
+
+def test_error_hierarchy(server):
+    """Every server-side err reply maps onto the typed hierarchy; only a
+    genuinely missing key is a KeyError."""
+    c = _client(server)
+    with pytest.raises(PSKeyError) as ei:
+        c.request("pull", "missing")
+    assert isinstance(ei.value, KeyError) and isinstance(ei.value, PSError)
+    with pytest.raises(PSProtocolError) as ei:
+        c.request("no_such_message")
+    assert not isinstance(ei.value, KeyError)
+    assert "no_such_message" in str(ei.value)
+    with pytest.raises(PSProtocolError):
+        c.request("push", "k")  # malformed: missing fields -> type error
+
+
+def test_register_members_dynamic_num_workers():
+    """register/deregister grow and shrink live membership without a
+    cluster restart; each change bumps the membership epoch."""
+    ps = ParameterServer(num_workers=2, port=0)
+    try:
+        c = _client(ps)
+        m0 = c.request("members")
+        assert m0["ranks"] == [0, 1] and ps.num_workers == 2
+        assert float(c.request("register", 5)) > 0  # join: lease granted
+        m1 = c.request("members")
+        assert m1["ranks"] == [0, 1, 5] and ps.num_workers == 3
+        assert m1["epoch"] > m0["epoch"]
+        c.request("deregister", 5)                  # clean leave
+        m2 = c.request("members")
+        assert m2["ranks"] == [0, 1] and m2["epoch"] > m1["epoch"]
+    finally:
+        ps.stop()
+
+
+def test_lease_eviction_unblocks_ssp_pusher():
+    """A registered worker that stops heartbeating is evicted after its
+    lease, and a pusher blocked on it by the SSP bound unblocks within the
+    eviction window instead of waiting forever."""
+    ps = ParameterServer(num_workers=2, port=0, staleness=1, lease_s=0.4)
+    try:
+        fast, dead = _client(ps), _client(ps)
+        fast.request("register", 0)
+        dead.request("register", 1)
+        fast.request("init", "k", np.zeros(1, np.float32))
+        fast.request("push", "k", np.ones(1, np.float32), 0)
+        dead.request("push", "k", np.ones(1, np.float32), 1)
+        fast.request("push", "k", np.ones(1, np.float32), 0)  # lead 2-1=1
+        # rank 1 now goes silent: no heartbeat renews its lease.  rank 0's
+        # next push leads it by the bound and must block — then unblock
+        # once the reaper evicts rank 1.
+        hb = HeartbeatThread(*ps.address, rank=0, interval=0.1)
+        hb.start()
+        t0 = time.monotonic()
+        fast.request("push", "k", np.ones(1, np.float32), 0)
+        waited = time.monotonic() - t0
+        hb.stop()
+        assert waited < 4 * 0.4 + 2.0, f"eviction took {waited:.1f}s"
+        members = fast.request("members")
+        assert members["ranks"] == [0], members
+        # the SSP wait may unblock on LAZY lease expiry a tick before the
+        # reaper formally evicts and counts — poll briefly for the counter
+        from incubator_mxnet_tpu import profiler
+
+        deadline = time.monotonic() + 3.0
+        while time.monotonic() < deadline \
+                and profiler.counters()["ps_eviction"] < 1:
+            time.sleep(0.05)
+        assert profiler.counters()["ps_eviction"] >= 1
+    finally:
+        ps.stop()
+
+
+def test_heartbeat_thread_keeps_lease_alive():
+    ps = ParameterServer(num_workers=1, port=0, lease_s=0.4)
+    try:
+        c = _client(ps)
+        c.request("register", 3)
+        hb = HeartbeatThread(*ps.address, rank=3, interval=0.1)
+        hb.start()
+        time.sleep(1.2)  # three lease windows
+        assert 3 in c.request("members")["ranks"]
+        hb.stop()
+        time.sleep(1.0)  # now the lease lapses
+        assert 3 not in c.request("members")["ranks"]
+    finally:
+        ps.stop()
+
+
+def test_dedup_window_suppresses_duplicate_push(server):
+    """The same (client_id, seq) envelope delivered twice applies once and
+    returns the cached reply (at-most-once pushes)."""
+    from incubator_mxnet_tpu import profiler
+
+    c = _client(server)
+    c.request("init", "k", np.zeros(2, np.float32))
+    env = ("req", "dup-client", 0, ("push", "k", np.ones(2, np.float32), 0))
+    raw = socket.create_connection(server.address)
+    try:
+        before = profiler.counters()["ps_dedup_hit"]
+        from incubator_mxnet_tpu.kvstore.async_ps import _recv_msg, _send_msg
+
+        _send_msg(raw, env)
+        r1 = _recv_msg(raw)
+        _send_msg(raw, env)   # duplicate delivery of the SAME request
+        r2 = _recv_msg(raw)
+        assert r1 == r2 == ("rep", 0, ("ok",))
+        assert c.request("counts")[0] == 1  # applied exactly once
+        assert profiler.counters()["ps_dedup_hit"] == before + 1
+    finally:
+        raw.close()
+
+
+def test_ssp_timeout_names_lagging_rank():
+    """Bounded SSP wait: a pusher stuck behind a live-but-stalled peer
+    fails loudly after MXNET_KVSTORE_SSP_TIMEOUT, naming the laggard."""
+    ps = ParameterServer(num_workers=2, port=0, staleness=1, ssp_timeout=1.5)
+    try:
+        fast, slow = _client(ps), _client(ps)
+        fast.request("init", "k", np.zeros(1, np.float32))
+        fast.request("push", "k", np.ones(1, np.float32), 0)
+        slow.request("push", "k", np.ones(1, np.float32), 1)
+        fast.request("push", "k", np.ones(1, np.float32), 0)  # lead 2-1=1
+        # rank 1 is alive (legacy member, no lease to expire) but stalled:
+        # the bound engages and only the timeout can end the wait
+        with pytest.raises(PSTimeoutError, match="lagging rank 1"):
+            fast.request("push", "k", np.ones(1, np.float32), 0)
+        assert ps._push_counts == [2, 1]  # the timed-out push did NOT apply
+    finally:
+        ps.stop()
+
+
+def test_snapshot_restore_roundtrip(tmp_path):
+    """A restarted server resumes from the last complete snapshot: store,
+    push counts, server-side optimizer, and dedup window all survive."""
+    import incubator_mxnet_tpu.optimizer as opt_mod
+
+    snap = str(tmp_path / "ps.snap")
+    ps = ParameterServer(num_workers=2, port=0, snapshot_path=snap,
+                         snapshot_every_s=0)  # explicit snapshots only
+    c = _client(ps)
+    c.request("init", "w", np.ones(3, np.float32))
+    c.request("set_optimizer",
+              pickle.dumps(opt_mod.create("sgd", learning_rate=0.5)))
+    c.request("push", "w", np.ones(3, np.float32), 0)   # w -> 0.5
+    c.request("snapshot")
+    ps.stop(final_snapshot=False)  # crash: nothing after the snapshot lands
+
+    ps2 = ParameterServer(num_workers=2, port=0, snapshot_path=snap,
+                          snapshot_every_s=0)
+    try:
+        c2 = _client(ps2)
+        np.testing.assert_allclose(c2.request("pull", "w"), np.full(3, 0.5))
+        assert c2.request("counts") == [1, 0]
+        c2.request("push", "w", np.ones(3, np.float32), 0)  # updater survived
+        np.testing.assert_allclose(c2.request("pull", "w"), np.zeros(3),
+                                   atol=1e-7)
+    finally:
+        ps2.stop()
+
+
+def test_barrier_releases_on_clean_leave():
+    """A deregister mid-barrier shrinks the target so survivors release
+    instead of waiting on a departed worker."""
+    ps = ParameterServer(num_workers=2, port=0)
+    try:
+        a, b = _client(ps), _client(ps)
+        a.request("register", 0)
+        b.request("register", 1)
+        done = threading.Event()
+
+        def waiter():
+            a.request("barrier")
+            done.set()
+
+        th = threading.Thread(target=waiter, daemon=True)
+        th.start()
+        time.sleep(0.3)
+        assert not done.is_set()  # barrier holds at 1/2
+        b.request("deregister", 1)
+        assert done.wait(timeout=5), "barrier did not release on leave"
+        th.join(timeout=5)
+    finally:
+        ps.stop()
+
+
+def test_client_reconnects_across_server_restart(tmp_path):
+    """AsyncClient.request survives a server restart transparently:
+    the in-flight request retries with backoff until the reborn server
+    (same port, restored snapshot) answers."""
+    snap = str(tmp_path / "ps.snap")
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    ps = ParameterServer(num_workers=1, port=port, snapshot_path=snap,
+                         snapshot_every_s=0)
+    c = AsyncClient("127.0.0.1", port, attempt_timeout=1.0, deadline_s=30.0)
+    c.request("init", "k", np.arange(4, dtype=np.float32))
+    c.request("snapshot")
+    ps.stop(final_snapshot=False)
+
+    got = {}
+
+    def puller():
+        got["v"] = c.request("pull", "k")
+
+    th = threading.Thread(target=puller, daemon=True)
+    th.start()
+    time.sleep(0.8)  # the request is now failing against a dead port
+    ps2 = ParameterServer(num_workers=1, port=port, snapshot_path=snap,
+                          snapshot_every_s=0)
+    try:
+        th.join(timeout=20)
+        assert not th.is_alive(), "request did not recover after restart"
+        np.testing.assert_allclose(got["v"], np.arange(4))
+        from incubator_mxnet_tpu import profiler
+
+        assert profiler.counters()["ps_retry"] >= 1
+    finally:
+        ps2.stop()
+
+
+def test_store_close_leaves_membership(monkeypatch):
+    """KVStoreDistAsync registers on construction and close() leaves the
+    membership immediately (elastic leave, no eviction window)."""
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu.kvstore import async_ps
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    monkeypatch.setenv("MXNET_ASYNC_PS_PORT", str(port))
+    monkeypatch.setenv("DMLC_WORKER_ID", "0")
+    monkeypatch.setenv("DMLC_NUM_WORKER", "1")
+    monkeypatch.setattr(async_ps, "_SERVER", None)
+
+    kv = mx.kv.create("dist_async")
+    try:
+        assert kv.rank in kv.live_workers()
+        assert kv.num_live_workers() >= 1
+        epoch0 = kv.membership_epoch()
+        server = kv._server
+        # Trainer integration: close() rides the trainer teardown (and the
+        # context-manager form), deregistering the rank immediately
+        from incubator_mxnet_tpu import autograd, gluon
+
+        net = gluon.nn.Dense(1)
+        net.initialize()
+        net(mx.nd.ones((1, 2)))
+        with gluon.Trainer(net.collect_params(), "sgd",
+                           {"learning_rate": 0.1}, kvstore=kv) as trainer:
+            with autograd.record():
+                loss = (net(mx.nd.ones((1, 2))) ** 2).mean()
+            loss.backward()
+            trainer.step(1)
+        assert kv._closed  # the context manager closed the store
+        with server._lock:
+            assert kv.rank in server._left  # left NOW, not at lease expiry
+            assert server._epoch > epoch0
+    finally:
+        kv._server.stop()
 
 
 def test_async_store_compression_end_to_end(monkeypatch):

@@ -12,7 +12,11 @@
 #                  recorder on, chrome-trace file must parse, trace_report
 #                  must exit 0, and every profiler.incr(...) literal in the
 #                  tree must name a declared counter (lint_counters.py)
-#   7. tpu       — (opt-in: CI_TPU=1) on-chip correctness tier, needs a chip
+#   7. chaos     — fault-injection tier (fixed seed): wire drops/dups/kills
+#                  against the async PS with exactly-once accounting, the
+#                  2-worker chaos training acceptance run, and the
+#                  standalone-server SIGKILL+resume subprocess test
+#   8. tpu       — (opt-in: CI_TPU=1) on-chip correctness tier, needs a chip
 #
 # The unit tier is split in two so each invocation fits a ~10 min shell on
 # a 1-core box (the full suite exceeds one 600 s window there); `unit` is
@@ -53,7 +57,7 @@ TIERS=()
 for t in "$@"; do
     if [ "$t" = unit ]; then TIERS+=(unit1 unit2); else TIERS+=("$t"); fi
 done
-[ ${#TIERS[@]} -eq 0 ] && TIERS=(unit1 unit2 zoo dist examples bench profiler)
+[ ${#TIERS[@]} -eq 0 ] && TIERS=(unit1 unit2 zoo dist examples bench profiler chaos)
 [ "${CI_TPU:-0}" = "1" ] && TIERS+=(tpu)
 
 declare -A RESULT
@@ -127,6 +131,12 @@ for tier in "${TIERS[@]}"; do
                 python tools/profiler_smoke.py --out "$trace"
                 python tools/trace_report.py "$trace" --top 10 >/dev/null
                 python tools/lint_counters.py'
+            ;;
+        chaos)
+            # deterministic fault injection: the seed pins the p= fault
+            # schedules so a chaos failure reproduces exactly
+            run_tier chaos "${CPU_ENV[@]}" env MXNET_FAULT_SEED=0 \
+                python -m pytest tests/test_chaos.py -q ${CI_PYTEST_ARGS:-}
             ;;
         tpu)
             # on-chip tier: runs under the ambient axon env (NOT cpu-cleaned)

@@ -46,6 +46,10 @@ def main(argv=None):
         ap.error("no worker command given")
 
     holder, port = reserve_port()
+    # separate ephemeral port for the async parameter server: the old
+    # convention (coordinator port + 1000) collides with whatever else
+    # landed on that port — the flake behind the async dist-test failures
+    ps_holder, ps_port = reserve_port()
     procs = []
     for rank in range(args.num_workers):
         env = dict(os.environ)
@@ -57,6 +61,7 @@ def main(argv=None):
             DMLC_NUM_SERVER=str(args.num_servers),
             DMLC_WORKER_ID=str(rank),
         )
+        env.setdefault("MXNET_ASYNC_PS_PORT", str(ps_port))
         env.setdefault("JAX_PLATFORMS", "cpu")
         if env["JAX_PLATFORMS"] == "cpu":
             # CPU workers must not register/claim a tunneled accelerator
@@ -68,6 +73,7 @@ def main(argv=None):
         procs.append(subprocess.Popen(args.command, env=env))
 
     holder.close()  # workers spawned; the coordinator (worker 0) binds next
+    ps_holder.close()
 
     # poll instead of sequential waits: when one worker dies, its SPMD
     # peers block forever inside collectives — kill them immediately
