@@ -86,6 +86,7 @@ def run(n_ops=64, iters=30, shape=(8, 8), warmup=5, repeats=5):
 
     modes = ("uncached", "cached_jit", "bulked")
     results = {m: {} for m in modes}
+    medians = {m: {} for m in modes}
     rounds = max(1, iters * repeats)
     prev = registry.set_dispatch_cache(enabled=True, warmup=0)
     try:
@@ -129,7 +130,9 @@ def run(n_ops=64, iters=30, shape=(8, 8), warmup=5, repeats=5):
                 if gc_was_on:
                     gc.enable()
             for m in modes:
-                results[m][name] = n_ops / _median(times[m])
+                med = _median(times[m])
+                results[m][name] = n_ops / med
+                medians[m][name] = med
     finally:
         registry.set_dispatch_cache(enabled=prev[0], max_entries=prev[1],
                                     warmup=prev[2])
@@ -140,8 +143,12 @@ def run(n_ops=64, iters=30, shape=(8, 8), warmup=5, repeats=5):
         "backend": os.environ.get("JAX_PLATFORMS", "default"),
         "n_ops": n_ops,
         "iters": iters,
+        "warmup": warmup,
+        "repeats": repeats,
+        "rounds": rounds,          # paired timing rounds behind each median
         "shape": list(shape),
         "ops_per_sec": results,
+        "median_s": medians,       # raw per-mode median round, seconds
         "speedup_cached": round(
             results["cached_jit"]["elemwise"] / results["uncached"]["elemwise"], 2),
         "speedup_bulked": round(
@@ -161,11 +168,20 @@ def main(argv=None):
     p.add_argument("--repeats", type=int, default=5,
                    help="multiplier on --iters for the number of paired "
                         "timing rounds (median round wins)")
+    p.add_argument("--json", dest="json_path", default=None, metavar="PATH",
+                   help="also write the result object to PATH — the "
+                        "machine-readable record (medians, round counts, "
+                        "config) bench trajectory harvesting reads instead "
+                        "of hand-copied numbers")
     args = p.parse_args(argv)
     line = run(n_ops=args.n_ops, iters=args.iters,
                shape=(args.side, args.side), warmup=args.warmup,
                repeats=args.repeats)
     print(json.dumps(line))
+    if args.json_path:
+        with open(args.json_path, "w") as f:
+            json.dump(line, f, indent=2)
+            f.write("\n")
     return line
 
 

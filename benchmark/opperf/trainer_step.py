@@ -101,7 +101,8 @@ def run(n_params=200, shape=(16, 4), iters=10, warmup=3, repeats=3,
         if gc_was_on:
             gc.enable()
 
-    steps_per_sec = {m: 1.0 / _median(ts) for m, ts in times.items()}
+    medians = {m: _median(ts) for m, ts in times.items()}
+    steps_per_sec = {m: 1.0 / v for m, v in medians.items()}
     return {
         "bench": "trainer_step",
         "backend": os.environ.get("JAX_PLATFORMS", "default"),
@@ -109,7 +110,11 @@ def run(n_params=200, shape=(16, 4), iters=10, warmup=3, repeats=3,
         "shape": list(shape),
         "optimizer": optimizer,
         "iters": iters,
+        "warmup": warmup,
+        "repeats": repeats,
+        "rounds": rounds,          # paired timing rounds behind each median
         "steps_per_sec": {m: round(v, 2) for m, v in steps_per_sec.items()},
+        "median_s": medians,       # raw per-mode median round, seconds
         "speedup_fused": round(
             steps_per_sec["fused"] / steps_per_sec["per_tensor"], 2),
     }
@@ -127,11 +132,20 @@ def main(argv=None):
                    help="multiplier on --iters for the number of paired "
                         "timing rounds (median round wins)")
     p.add_argument("--optimizer", default="sgd")
+    p.add_argument("--json", dest="json_path", default=None, metavar="PATH",
+                   help="also write the result object to PATH — the "
+                        "machine-readable record (medians, round counts, "
+                        "config) bench trajectory harvesting reads instead "
+                        "of hand-copied numbers")
     args = p.parse_args(argv)
     line = run(n_params=args.n_params, iters=args.iters,
                shape=(args.side, 4), warmup=args.warmup,
                repeats=args.repeats, optimizer=args.optimizer)
     print(json.dumps(line))
+    if args.json_path:
+        with open(args.json_path, "w") as f:
+            json.dump(line, f, indent=2)
+            f.write("\n")
     return line
 
 

@@ -87,6 +87,11 @@ class Trainer:
             for i, p in enumerate(self._params):
                 if p._data is not None:
                     self._kvstore.init(i, p.data())
+            if self._kvstore.num_workers > 1:
+                # pin the rank for trace/metrics metadata (the async store
+                # already did; the SPMD dist store knows it only after
+                # jax.distributed bootstraps, which init() just forced)
+                _profiler.set_process_info(rank=self._kvstore.rank)
         self._kv_initialized = True
 
     @property

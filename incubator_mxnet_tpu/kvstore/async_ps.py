@@ -42,7 +42,11 @@ scale, not the exception):
 
 Retries, reconnects, evictions, snapshots, and heartbeat misses bump
 declared profiler counters (``ps_*``; see docs/observability.md), so the
-failure handling is observable, not silent.
+failure handling is observable, not silent.  The heartbeat wire doubles
+as the cluster-observability plane (ISSUE 7): each beat ships the
+worker's metrics snapshot up (straggler attribution, the rank-0 /metrics
+scrape surface) and carries the server's wall clock back as a
+midpoint-of-RTT clock-offset sample for multi-rank trace alignment.
 
 Wire protocol: length-prefixed pickles of small tuples; tensors cross as
 raw numpy bytes.  Requests ride a ``("req", client_id, seq, msg)`` envelope
@@ -157,11 +161,9 @@ def server_port():
     return int(os.environ.get("DMLC_PS_ROOT_PORT", "9000")) + 1000
 
 
-def _env_float(name, default):
-    try:
-        return float(os.environ[name])
-    except (KeyError, ValueError):
-        return default
+# one env-parsing rule for every float knob in the stack (a malformed
+# value degrades to the default everywhere, never raises)
+_env_float = _profiler._env_float
 
 
 class _Handler(socketserver.BaseRequestHandler):
@@ -241,7 +243,8 @@ class _DedupEntry:
 
 # messages exempt from the dedup window: pure reads (safe to re-execute)
 # and heartbeats (idempotent by definition, highest frequency)
-_NO_DEDUP = frozenset(("pull", "counts", "members", "heartbeat"))
+_NO_DEDUP = frozenset(("pull", "counts", "members", "heartbeat", "clock",
+                       "metrics"))
 
 
 class ParameterServer:
@@ -273,6 +276,9 @@ class ParameterServer:
         self._epoch = 0     # membership epoch: bumped on join/leave/evict
         self._dedup = {}    # client_id -> OrderedDict(seq -> _DedupEntry)
         self._dedup_seen = {}   # client_id -> monotonic last-use time
+        self._metrics = {}  # rank -> latest metrics snapshot (heartbeat
+                            # piggyback; feeds straggler attribution and
+                            # the cluster scrape surface)
         self._dedup_ttl = _env_float("MXNET_KVSTORE_DEDUP_TTL", 900.0)
         self._snap_lock = threading.Lock()  # serializes snapshot writers
         self._barrier_count = 0
@@ -364,6 +370,10 @@ class ParameterServer:
                     self._left.add(r)
                     del self._leases[r]
                     self._epoch += 1
+                    # a dead rank's frozen telemetry must leave the scrape
+                    # surface and the straggler comparison with it
+                    self._metrics.pop(r, None)
+                    _profiler.forget_peer_metrics(r)
                     _profiler.incr("ps_eviction")
                     print(f"[async_ps] evicting worker {r}: lease expired "
                           f"({self._lease_s:.1f}s without a heartbeat)",
@@ -490,7 +500,12 @@ class ParameterServer:
                 self._cond.notify_all()
             return ("val", self._lease_s)
         if kind == "heartbeat":
-            _, rank = msg
+            # ("heartbeat", rank[, metrics_snapshot]) — the snapshot rides
+            # the liveness wire for free (ISSUE 7); the reply carries the
+            # server's wall clock so the same round trip doubles as a
+            # midpoint-of-RTT clock-offset sample
+            rank = msg[1]
+            snap = msg[2] if len(msg) > 2 else None
             with self._cond:
                 self._ensure_rank(rank)
                 if rank in self._left:
@@ -499,17 +514,37 @@ class ParameterServer:
                     self._epoch += 1  # (re)joining the live set
                 self._left.discard(rank)
                 self._leases[rank] = time.monotonic() + self._lease_s
+                if isinstance(snap, dict):
+                    self._metrics[rank] = snap
                 self._cond.notify_all()
-            return ("ok",)
+            if isinstance(snap, dict):
+                # the PS lives in rank 0's process (in-process mode), so
+                # publishing here puts every peer on rank 0's /metrics
+                # scrape surface; in standalone mode the PS's own endpoint
+                # serves the cluster
+                _profiler.publish_peer_metrics(snap)
+            return ("val", time.time())
+        if kind == "clock":
+            # reference wall clock for one-shot offset sampling at client
+            # bootstrap (profiler.sample_clock_offset)
+            return ("val", time.time())
+        if kind == "metrics":
+            with self._lock:
+                return ("val", {r: dict(s) for r, s in self._metrics.items()})
         if kind == "deregister":
             _, rank = msg
             with self._cond:
                 self._leases.pop(rank, None)
                 self._left.add(rank)
                 self._epoch += 1
+                # the departed rank's telemetry leaves with it: keeping a
+                # frozen snapshot would let a ghost rank win every future
+                # straggler comparison
+                self._metrics.pop(rank, None)
                 # a clean leave shrinks the barrier target immediately
                 self._maybe_release_barrier()
                 self._cond.notify_all()
+            _profiler.forget_peer_metrics(rank)
             return ("ok",)
         if kind == "members":
             with self._lock:
@@ -565,9 +600,26 @@ class ParameterServer:
                     f"(MXNET_KVSTORE_SSP_TIMEOUT): rank {rank} at "
                     f"{self._push_counts[rank]} pushes is blocked on lagging "
                     f"rank {lag_rank} at {lag_count} (staleness bound "
-                    f"{bound}); the straggler is alive but not progressing")
+                    f"{bound}); the straggler is alive but not progressing"
+                    + self._lag_telemetry(lag_rank))
             # 1s granularity: notice evictions and the deadline promptly
             self._cond.wait(timeout=1.0)
+
+    def _lag_telemetry(self, lag_rank):
+        """The lagging rank's heartbeat-shipped telemetry, rendered for an
+        SSP-timeout report — a ``lagging rank N`` error should say WHERE
+        that rank's time goes, not just name it.  Caller holds _cond (the
+        same lock guards _metrics)."""
+        snap = self._metrics.get(lag_rank)
+        ls = snap.get("last_step") if isinstance(snap, dict) else None
+        if not ls:
+            return " (no telemetry heartbeat from the straggler yet)"
+        return (f"; rank {lag_rank} telemetry (host "
+                f"{snap.get('host', '?')}): step {ls.get('step')} wall "
+                f"{ls.get('wall_ms', 0):.1f} ms (host-dispatch "
+                f"{ls.get('host_ms', 0):.1f} ms, comms "
+                f"{ls.get('comms_ms', 0):.1f} ms, device/other "
+                f"{ls.get('device_ms', 0):.1f} ms)")
 
     def _apply_update(self, key, grad):
         """Server-side optimizer step (the reference's async contract:
@@ -819,7 +871,22 @@ class HeartbeatThread(threading.Thread):
                         attempt_timeout=max(self._interval, 1.0),
                         deadline_s=max(self._interval, 1.0),
                         abort_event=self._stop_event)
-                self._client.request("heartbeat", self._rank)
+                # piggyback (ISSUE 7): the beat ships this rank's metrics
+                # snapshot up (straggler attribution + cluster scrape) and
+                # the reply's server wall clock comes back down as a
+                # midpoint-of-RTT clock-offset sample — cluster
+                # observability for zero extra round trips
+                try:
+                    snap = _profiler.metrics_snapshot()
+                except Exception:
+                    snap = None
+                t0 = time.time()
+                server_now = self._client.request("heartbeat", self._rank,
+                                                  snap)
+                t1 = time.time()
+                if isinstance(server_now, float):
+                    _profiler.update_clock_offset(
+                        (t0 + t1) / 2.0 - server_now, t1 - t0)
             except Exception:
                 if not self._stop_event.is_set():
                     _profiler.incr("ps_heartbeat_miss")

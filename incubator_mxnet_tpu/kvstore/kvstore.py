@@ -539,6 +539,16 @@ class KVStoreDistAsync(KVStore):
         self._server = async_ps.serve_if_rank0(self._rank, self._num_workers)
         self._client = async_ps.AsyncClient(host, async_ps.server_port())
         lease_s = float(self._client.request("register", self._rank))
+        # multi-rank trace alignment (ISSUE 7): pin this process's rank in
+        # the profiler and take a one-shot midpoint-of-RTT clock-offset
+        # sample against the server's wall clock (the heartbeat thread
+        # keeps refreshing it for the life of the store)
+        _profiler.set_process_info(rank=self._rank)
+        try:
+            _profiler.sample_clock_offset(
+                lambda: self._client.request("clock"), samples=5)
+        except Exception:
+            pass  # pre-ISSUE-7 server: no clock on the wire
         self._heartbeat = async_ps.HeartbeatThread(
             host, async_ps.server_port(), self._rank,
             interval=max(0.05, lease_s / 3.0))
@@ -659,6 +669,11 @@ class KVStoreDistAsync(KVStore):
     def push_counts(self):
         """Per-worker applied-push counts (observability / SSP tests)."""
         return self._client.request("counts")
+
+    def cluster_metrics(self):
+        """The server's per-rank metrics snapshots (heartbeat piggyback):
+        ``{rank: snapshot}`` — what rank 0's /metrics scrape aggregates."""
+        return self._client.request("metrics")
 
     def barrier(self):
         self._client.request("barrier")

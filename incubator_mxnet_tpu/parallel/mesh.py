@@ -39,6 +39,7 @@ __all__ = [
     "local_mesh",
     "init_distributed",
     "mesh_scope",
+    "sync_profiler_clock",
 ]
 
 # Outermost → innermost.  jax.devices() enumerates in topology order on TPU
@@ -156,3 +157,64 @@ def init_distributed(coordinator_address=None, num_processes=None, process_id=No
         num_processes=num_processes,
         process_id=process_id,
     )
+    if os.environ.get("MXNET_PROFILER_CLOCK_SYNC", "1") != "0":
+        # one bootstrap-time collective right after the cluster-wide
+        # rendezvous above: every process that reaches initialize() also
+        # reaches this, so the broadcast cannot orphan a rank
+        sync_profiler_clock()
+
+
+# epoch the cross-host clock exchange is encoded against: unix seconds do
+# not fit float32 (eps ~2 min at 1.7e9) and the test/CPU tiers run with
+# x64 disabled, so the wire carries (int32 seconds since this base,
+# int32 microseconds) instead of one float
+_CLOCK_BASE_UNIX = 1_600_000_000
+
+
+def sync_profiler_clock(samples=3):
+    """One-shot clock-offset estimate for the SPMD ``dist_sync`` tier
+    (the async tier samples against the PS heartbeat wire instead):
+    broadcast process 0's wall clock over the mesh collectives and
+    attribute it to the local send/receive midpoint, min-RTT sample wins
+    (``profiler.update_clock_offset``).  Collective: EVERY process must
+    call this the same number of times.  Never raises — observability
+    must not take bootstrap down."""
+    from .. import profiler
+
+    try:
+        if jax.process_count() <= 1:
+            return None
+        from jax.experimental import multihost_utils
+
+        import time as _time
+
+        profiler.set_process_info(rank=jax.process_index())
+
+        def one_round():
+            t0 = _time.time()
+            now = _time.time()
+            payload = _np.array(
+                [int(now) - _CLOCK_BASE_UNIX, int((now % 1.0) * 1e6)],
+                dtype=_np.int32)
+            out = _np.asarray(multihost_utils.broadcast_one_to_all(payload))
+            t1 = _time.time()
+            ref = _CLOCK_BASE_UNIX + int(out[0]) + int(out[1]) / 1e6
+            return ((t0 + t1) / 2.0 - ref, t1 - t0)
+
+        # warmup round, DISCARDED: a barrier collective is not a request —
+        # the broadcast value is process 0's clock at ITS entry, so a rank
+        # arriving late sees a tiny t0..t1 window around an arbitrarily
+        # stale reference (min-RTT would prefer exactly that sample).  The
+        # warmup absorbs compile time and releases every rank from the
+        # same instant; the sampled rounds that follow are entered nearly
+        # simultaneously, so their midpoint error really is ~rtt-bounded.
+        one_round()
+        best = None
+        for _ in range(max(1, int(samples))):
+            off, rtt = one_round()
+            if best is None or rtt < best[1]:
+                best = (off, rtt)
+        profiler.update_clock_offset(*best)
+        return best
+    except Exception:
+        return None

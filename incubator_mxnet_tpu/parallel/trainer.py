@@ -162,6 +162,11 @@ class SPMDTrainer:
         self._step_cache = {}
         from ..base import register_jit_cache_owner
         register_jit_cache_owner(self)
+        if jax.process_count() > 1:
+            # pin the rank for trace/metrics metadata: a multi-process SPMD
+            # run may never touch a kvstore (collectives come from XLA), so
+            # the trainer is the bootstrap point for this tier
+            _profiler.set_process_info(rank=jax.process_index())
 
     def _invalidate_jit_cache(self):
         self._step_cache.clear()

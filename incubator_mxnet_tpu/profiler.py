@@ -28,6 +28,22 @@ of the jax_graft stack with three cooperating layers:
    rolling-percentile mode — one breakdown log line per anomalous step),
    and device-memory watermarks are sampled via ``Device.memory_stats()``.
 
+Since ISSUE 7 the profiler is **cluster-aware**:
+
+* every trace carries process metadata (rank/host/pid) plus a wall-clock
+  anchor and a midpoint-of-RTT **clock-offset estimate**
+  (``update_clock_offset``; sampled against the async-PS wall clock or a
+  one-shot ``parallel.mesh`` broadcast), so ``tools/trace_merge.py`` can
+  fuse per-rank dumps into ONE offset-corrected Perfetto timeline;
+* a **metrics registry** (``metrics_snapshot()``) periodically writes
+  per-rank JSONL (``MXNET_METRICS_JSONL``) and serves Prometheus text
+  from a stdlib-http endpoint (``MXNET_METRICS_PORT``, 0 = off); peers'
+  snapshots arrive via ``publish_peer_metrics`` (the async-PS heartbeat
+  wire feeds it), so one scrape of rank 0 sees the whole cluster;
+* the slow-step detector compares per-rank step wall-times from those
+  snapshots and names the slowest rank with its host/comms/device split
+  (**straggler attribution** — ``straggler_report()``).
+
 Counters are **strict** since ISSUE 5: ``incr`` on an undeclared name
 raises (a typo'd instrumentation site fails loudly instead of reporting
 zeros forever); extensions register theirs via ``declare_counter()``.
@@ -38,9 +54,11 @@ env var.  See docs/observability.md for the full tour.
 from __future__ import annotations
 
 import atexit
+import gzip as _gzip
 import json
 import logging
 import os
+import socket as _socket
 import threading as _threading
 import time
 import warnings as _warnings
@@ -52,7 +70,12 @@ __all__ = ["set_config", "start", "stop", "dump", "dumps", "pause", "resume",
            "scope", "span", "Marker", "state", "counters", "reset_counters",
            "incr", "declare_counter", "record_span", "step_boundary",
            "current_step", "step_stats", "memory_watermark", "recorder_stats",
-           "recording_enabled"]
+           "recording_enabled", "process_info", "set_process_info",
+           "update_clock_offset", "sample_clock_offset", "metrics_snapshot",
+           "publish_peer_metrics", "peer_metrics", "forget_peer_metrics",
+           "render_prometheus",
+           "start_metrics", "stop_metrics", "metrics_server_port",
+           "straggler_report"]
 
 _logger = logging.getLogger(__name__)
 
@@ -79,7 +102,21 @@ _agg = {}  # name -> [count, total_s]; guarded by _counter_lock (scopes run
 # perf_counter epoch all trace timestamps are relative to (chrome trace ts
 # is in us; an absolute perf_counter would overflow viewer precision)
 _EPOCH = time.perf_counter()
+# wall-clock instant of _EPOCH (ts=0 of every trace this process dumps):
+# the anchor tools/trace_merge.py aligns per-rank timelines with.  Sampled
+# as the mean of two wall readings bracketing the perf reading so the
+# pairing error is bounded by half the triple-read, not a full read.
+_wt0 = time.time()
+_EPOCH_UNIX = (_wt0 + time.time()) / 2.0 - (time.perf_counter() - _EPOCH)
+del _wt0
 _perf = time.perf_counter
+
+
+def _env_float(name, default):
+    try:
+        return float(os.environ[name])
+    except (KeyError, ValueError):
+        return default
 
 
 def _tally(name, dur):
@@ -127,6 +164,9 @@ _counters = {
     "ps_heartbeat_miss": 0,           # heartbeats that failed or arrived late
     "ps_snapshot": 0,                 # PS state snapshots written
     "fault_injected": 0,              # faultinject.py points that fired
+    "metrics_snapshot": 0,            # metrics_snapshot() captures taken
+    "metrics_scrape": 0,              # HTTP GETs served by the endpoint
+    "straggler_detected": 0,          # cross-rank straggler attributions
 }
 _counter_lock = _threading.Lock()
 
@@ -166,6 +206,78 @@ def reset_counters():
     with _counter_lock:
         for k in _counters:
             _counters[k] = 0
+
+
+# ---------------------------------------------------------------------------
+# Process identity + clock alignment (ISSUE 7 multi-rank aggregation)
+# ---------------------------------------------------------------------------
+
+# Per-process metadata stamped into every dump()/metrics snapshot so a
+# cluster's N traces can be told apart and re-aligned.  ``clock_offset_s``
+# is THIS process's wall clock minus the cluster reference clock (rank 0 /
+# the PS): corrected_unix = local_unix - clock_offset_s.  Offsets come
+# from midpoint-of-RTT sampling (NTP's core trick): read local wall time
+# around a fetch of the reference's wall time and attribute the reply to
+# the midpoint; the min-RTT sample wins because its midpoint error is
+# bounded by rtt/2.
+_proc = {
+    "rank": int(os.environ.get("DMLC_WORKER_ID", "0") or 0),
+    "host": _socket.gethostname(),
+    "pid": os.getpid(),
+    "clock_offset_s": 0.0,
+    "clock_rtt_s": None,   # RTT of the winning sample; None = never sampled
+    "epoch_unix": _EPOCH_UNIX,
+}
+
+
+def process_info():
+    """Copy of this process's identity/clock metadata (rank, host, pid,
+    clock_offset_s, clock_rtt_s, epoch_unix)."""
+    with _counter_lock:
+        return dict(_proc)
+
+
+def set_process_info(rank=None, host=None):
+    """Pin this process's rank/host for traces and metrics (the dist
+    kvstore tiers call this at bootstrap; DMLC_WORKER_ID is the default)."""
+    with _counter_lock:
+        if rank is not None:
+            _proc["rank"] = int(rank)
+        if host is not None:
+            _proc["host"] = str(host)
+
+
+def update_clock_offset(offset_s, rtt_s):
+    """Record one clock-offset sample (local wall minus reference wall,
+    attributed to the RTT midpoint).  The min-RTT sample of the process
+    lifetime wins — its midpoint error bound (rtt/2) is the tightest."""
+    with _counter_lock:
+        best = _proc["clock_rtt_s"]
+        if best is None or rtt_s < best:
+            _proc["clock_offset_s"] = float(offset_s)
+            _proc["clock_rtt_s"] = float(rtt_s)
+
+
+def sample_clock_offset(fetch_ref_time, samples=5):
+    """Estimate this process's wall-clock offset against a reference by
+    midpoint-of-RTT sampling: ``fetch_ref_time()`` must return the
+    reference's ``time.time()`` (e.g. a ``("clock",)`` request to the
+    async PS).  Records the winning sample via ``update_clock_offset``
+    and returns ``(offset_s, rtt_s)``."""
+    best = None
+    for _ in range(max(1, int(samples))):
+        t0 = time.time()
+        ref = fetch_ref_time()
+        t1 = time.time()
+        if ref is None:
+            continue  # pre-ISSUE-7 peer: no wall time on the wire
+        rtt = t1 - t0
+        off = (t0 + t1) / 2.0 - float(ref)
+        if best is None or rtt < best[1]:
+            best = (off, rtt)
+    if best is not None:
+        update_clock_offset(*best)
+    return best
 
 
 # ---------------------------------------------------------------------------
@@ -519,8 +631,353 @@ def step_boundary():
             "slow step %d: %.1f ms (host-dispatch %.1f ms, comms %.1f ms, "
             "device/other %.1f ms) [%s]",
             sid, wall_ms, host_ms, comms_ms, device_ms, why)
+        # cross-rank attribution: when peers' metrics snapshots are in the
+        # registry (heartbeat piggyback / scrape aggregation), name the
+        # slowest rank — EXACTLY one line per anomalous step, guarded by
+        # this branch firing once per boundary
+        rep = straggler_report()
+        if rep is not None:
+            incr("straggler_detected")
+            _logger.warning(
+                "slow step %d straggler: rank %d (%s) — step %s wall "
+                "%.1f ms (host-dispatch %.1f ms, comms %.1f ms, "
+                "device/other %.1f ms)",
+                sid, rep["rank"], rep["host"], rep["step"], rep["wall_ms"],
+                rep["host_ms"], rep["comms_ms"], rep["device_ms"])
     if _config.get("memory_sampling", True):
         _sample_memory()
+
+
+# ---------------------------------------------------------------------------
+# Live metrics export (ISSUE 7): registry, JSONL log, Prometheus endpoint
+# ---------------------------------------------------------------------------
+
+_metrics_seq = 0       # monotone per-process snapshot sequence number
+_peer_metrics = {}     # rank -> latest snapshot published by that rank
+
+
+def metrics_snapshot():
+    """One self-describing metrics capture: process identity, counters,
+    the step-telemetry window summary + last closed step's bucket split,
+    and memory watermarks.  This dict IS the JSONL schema (one object per
+    line; ``schema`` versions it) and the unit the cluster aggregates —
+    heartbeats ship it to the PS, ``publish_peer_metrics`` registers it,
+    the Prometheus endpoint renders it."""
+    global _metrics_seq
+    incr("metrics_snapshot")
+    with _counter_lock:
+        _metrics_seq += 1
+        seq = _metrics_seq
+    steps = step_stats()
+    walls = [s["wall_ms"] for s in steps]
+    return {
+        "schema": 1,
+        "rank": _proc["rank"],
+        "host": _proc["host"],
+        "pid": _proc["pid"],
+        "seq": seq,
+        "time_unix": time.time(),
+        "clock_offset_s": _proc["clock_offset_s"],
+        "counters": counters(),
+        "last_step": dict(steps[-1]) if steps else None,
+        "window": {
+            "n": len(steps),
+            "wall_ms_median": _median(walls) if walls else None,
+            "wall_ms_max": max(walls) if walls else None,
+        },
+        "memory_watermark_bytes": memory_watermark(),
+    }
+
+
+def publish_peer_metrics(snap):
+    """Register a peer rank's metrics snapshot (called by the async PS on
+    heartbeat receipt — the PS lives in rank 0's process, so rank 0's
+    scrape surface sees the cluster).  Stale out-of-order snapshots from
+    the SAME process are dropped; a restarted peer (new pid) always
+    replaces its predecessor."""
+    if not isinstance(snap, dict) or "rank" not in snap:
+        return
+    rank = int(snap["rank"])
+    with _counter_lock:
+        old = _peer_metrics.get(rank)
+        if (old is None or old.get("pid") != snap.get("pid")
+                or snap.get("seq", 0) >= old.get("seq", 0)):
+            _peer_metrics[rank] = dict(snap)
+
+
+def peer_metrics():
+    """Snapshot of the peer-metrics registry: ``{rank: snapshot}``."""
+    with _counter_lock:
+        return {r: dict(s) for r, s in _peer_metrics.items()}
+
+
+def forget_peer_metrics(rank):
+    """Drop a departed rank's snapshot (the async PS calls this on
+    deregister/eviction so a dead rank's frozen numbers leave the scrape
+    surface and the straggler comparison instead of haunting them)."""
+    with _counter_lock:
+        _peer_metrics.pop(int(rank), None)
+
+
+def _cluster_snapshots():
+    """Local snapshot first, then peers by rank.  On a rank clash the
+    local snapshot wins (rank 0 heartbeats against its own co-located PS,
+    so its snapshot legitimately appears on both sides) — UNLESS the
+    clash is a different process with real step telemetry while the local
+    one is idle: that is the standalone-PS case (the PS process defaults
+    to rank 0 while worker 0 heartbeats), where the training process's
+    numbers are the ones a scrape is after."""
+    local = metrics_snapshot()
+    rows = [local]
+    for rank, snap in sorted(peer_metrics().items()):
+        if rank != local["rank"]:
+            rows.append(snap)
+        elif (snap.get("pid") != local.get("pid")
+                and local.get("last_step") is None
+                and snap.get("last_step") is not None):
+            rows[0] = snap
+    return rows
+
+
+def _prom_escape(v):
+    return str(v).replace("\\", "\\\\").replace('"', '\\"').replace("\n", " ")
+
+
+def render_prometheus():
+    """All known snapshots (local + peers) as Prometheus text (exposition
+    format 0.0.4): counters, per-rank step buckets, rolling-window
+    summary, memory watermarks, clock offsets."""
+    out = [
+        "# HELP mxnet_profiler_counter_total profiler event counters "
+        "(see docs/observability.md counter reference)",
+        "# TYPE mxnet_profiler_counter_total counter",
+    ]
+    gauges = []  # (name, help) emitted after the counter block
+    g_lines = {}
+
+    def gauge(name, help_, labels, value):
+        if value is None:
+            return
+        if name not in g_lines:
+            gauges.append((name, help_))
+            g_lines[name] = []
+        lab = ",".join(f'{k}="{_prom_escape(v)}"' for k, v in labels)
+        g_lines[name].append(f"{name}{{{lab}}} {value}")
+
+    for snap in _cluster_snapshots():
+        base = (("rank", snap.get("rank")), ("host", snap.get("host", "?")))
+        for cname, v in sorted((snap.get("counters") or {}).items()):
+            lab = ",".join(f'{k}="{_prom_escape(v2)}"' for k, v2 in
+                           (("counter", cname),) + base)
+            out.append(f"mxnet_profiler_counter_total{{{lab}}} {v}")
+        ls = snap.get("last_step") or {}
+        gauge("mxnet_step_last_id", "id of the last closed step",
+              base, ls.get("step"))
+        for bucket in ("wall_ms", "host_ms", "comms_ms", "device_ms"):
+            gauge(f"mxnet_step_last_{bucket}",
+                  f"last closed step {bucket.replace('_', ' ')} split",
+                  base, ls.get(bucket))
+        win = snap.get("window") or {}
+        gauge("mxnet_step_window_n", "steps in the rolling telemetry window",
+              base, win.get("n"))
+        gauge("mxnet_step_wall_ms_median", "rolling-window median step wall",
+              base, win.get("wall_ms_median"))
+        gauge("mxnet_step_wall_ms_max", "rolling-window max step wall",
+              base, win.get("wall_ms_max"))
+        gauge("mxnet_clock_offset_seconds",
+              "estimated wall-clock offset vs the cluster reference",
+              base, snap.get("clock_offset_s"))
+        gauge("mxnet_metrics_snapshot_seq", "snapshot sequence number",
+              base, snap.get("seq"))
+        for dev, b in sorted((snap.get("memory_watermark_bytes")
+                              or {}).items()):
+            gauge("mxnet_memory_watermark_bytes",
+                  "peak device bytes_in_use observed at step boundaries",
+                  base + (("device", dev),), b)
+    for name, help_ in gauges:
+        out.append(f"# HELP {name} {help_}")
+        out.append(f"# TYPE {name} gauge")
+        out.extend(g_lines[name])
+    return "\n".join(out) + "\n"
+
+
+class _MetricsExporter(_threading.Thread):
+    """Periodic per-rank JSONL metrics log (append-only; one
+    ``metrics_snapshot()`` object per line)."""
+
+    def __init__(self, path, interval_s):
+        super().__init__(name="mxtpu-metrics-exporter", daemon=True)
+        self.path = path
+        self.interval_s = max(0.05, float(interval_s))
+        self.stop_event = _threading.Event()
+
+    def run(self):
+        while not self.stop_event.wait(self.interval_s):
+            try:
+                snap = metrics_snapshot()
+                with open(self.path, "a") as f:
+                    f.write(json.dumps(snap) + "\n")
+            except Exception:
+                pass  # telemetry must never take training down
+
+    def stop(self):
+        self.stop_event.set()
+
+
+_metrics_http = None      # (ThreadingHTTPServer, serving thread)
+_metrics_exporter = None  # _MetricsExporter
+_metrics_lock = _threading.Lock()
+
+
+def _make_metrics_handler():
+    from http.server import BaseHTTPRequestHandler
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_GET(self):
+            incr("metrics_scrape")
+            path = self.path.split("?", 1)[0]
+            if path in ("/", "/metrics"):
+                body = render_prometheus().encode()
+                ctype = "text/plain; version=0.0.4; charset=utf-8"
+            elif path == "/metrics.json":
+                body = json.dumps({"local": metrics_snapshot(),
+                                   "peers": {str(r): s for r, s in
+                                             peer_metrics().items()}}).encode()
+                ctype = "application/json"
+            else:
+                self.send_error(404)
+                return
+            self.send_response(200)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):  # scrapes must not spam stderr
+            pass
+
+    return Handler
+
+
+def start_metrics(port=None, jsonl=None, interval_s=None):
+    """Start the live metrics surface: a Prometheus ``/metrics`` endpoint
+    (+ ``/metrics.json``) and/or a periodic per-rank JSONL log.
+
+    ``port=None`` reads ``MXNET_METRICS_PORT`` (0/unset = no endpoint,
+    the repo's env-knob convention); an explicit ``port=0`` binds an
+    OS-assigned ephemeral port (tests; read it back via
+    ``metrics_server_port()``).  ``jsonl=None`` reads
+    ``MXNET_METRICS_JSONL`` (unset = no log); the interval comes from
+    ``MXNET_METRICS_INTERVAL_S`` (default 10 s).  A port already taken
+    (two local ranks sharing one env) warns once and serves nothing —
+    the surviving binder is the scrape target.  Idempotent per surface."""
+    global _metrics_http, _metrics_exporter
+    env_port = port is None
+    if env_port:
+        try:
+            port = int(os.environ.get("MXNET_METRICS_PORT", "0") or 0)
+        except ValueError:
+            port = 0
+    if jsonl is None:
+        jsonl = os.environ.get("MXNET_METRICS_JSONL") or None
+    if interval_s is None:
+        # guarded like the port parse: a typo'd knob degrades to the
+        # default instead of raising at import (this runs env-driven at
+        # module import — telemetry must never take training down)
+        interval_s = _env_float("MXNET_METRICS_INTERVAL_S", 10.0)
+    with _metrics_lock:
+        if _metrics_http is None and (port > 0 or (port == 0 and not env_port)):
+            from http.server import ThreadingHTTPServer
+
+            try:
+                srv = ThreadingHTTPServer(("", port), _make_metrics_handler())
+                srv.daemon_threads = True
+                th = _threading.Thread(target=srv.serve_forever,
+                                       name="mxtpu-metrics-http", daemon=True)
+                th.start()
+                _metrics_http = (srv, th)
+            except OSError as e:
+                _warnings.warn(
+                    f"metrics endpoint: cannot bind port {port} ({e}); "
+                    "serving no metrics from this process (another local "
+                    "rank probably owns the port)", RuntimeWarning,
+                    stacklevel=2)
+        if jsonl and _metrics_exporter is None:
+            _metrics_exporter = _MetricsExporter(jsonl, interval_s)
+            _metrics_exporter.start()
+    return metrics_server_port()
+
+
+def metrics_server_port():
+    """Actual bound port of the live endpoint, or None when off."""
+    with _metrics_lock:
+        return _metrics_http[0].server_address[1] if _metrics_http else None
+
+
+def stop_metrics():
+    """Tear the metrics surface down (endpoint + JSONL exporter)."""
+    global _metrics_http, _metrics_exporter
+    with _metrics_lock:
+        if _metrics_http is not None:
+            srv, th = _metrics_http
+            _metrics_http = None
+            srv.shutdown()
+            srv.server_close()
+        if _metrics_exporter is not None:
+            _metrics_exporter.stop()
+            _metrics_exporter = None
+
+
+# ---------------------------------------------------------------------------
+# Cross-rank straggler attribution (ISSUE 7)
+# ---------------------------------------------------------------------------
+
+
+def straggler_report():
+    """Compare the freshest per-rank step wall-times (local telemetry +
+    peer snapshots) and return the slowest rank's breakdown::
+
+        {"rank", "host", "step", "wall_ms", "host_ms", "comms_ms",
+         "device_ms", "ranks_compared"}
+
+    Returns None without at least two ranks' worth of step data (nothing
+    to attribute ACROSS).  Peers' numbers are their last CLOSED step —
+    ranks run asynchronously, so the compared step ids may differ; each
+    row names its own.  Peer fields are read defensively (this runs
+    inside ``step_boundary`` on the training hot path, and the heartbeat
+    wire accepts any dict-shaped snapshot, including an older build's);
+    snapshots older than ``MXNET_METRICS_PEER_TTL_S`` are ignored so a
+    departed rank's frozen numbers cannot be blamed forever."""
+    rows = []
+    steps = step_stats()
+    if steps:
+        with _counter_lock:
+            me = dict(rank=_proc["rank"], host=_proc["host"])
+        rows.append({**me, **steps[-1]})
+    now_ref = time.time() - _proc["clock_offset_s"]
+    ttl = _env_float("MXNET_METRICS_PEER_TTL_S", 120.0)
+    for rank, snap in sorted(peer_metrics().items()):
+        if rows and rank == rows[0]["rank"]:
+            continue
+        ls = snap.get("last_step")
+        if not isinstance(ls, dict) or "wall_ms" not in ls:
+            continue
+        t = snap.get("time_unix")
+        if ttl > 0 and isinstance(t, (int, float)):
+            # both sides corrected onto the reference clock before aging
+            age = now_ref - (t - (snap.get("clock_offset_s") or 0.0))
+            if age > ttl:
+                continue
+        rows.append({"rank": rank, "host": snap.get("host", "?"), **ls})
+    if len(rows) < 2:
+        return None
+    worst = max(rows, key=lambda r: r.get("wall_ms", 0.0))
+    return {"rank": worst["rank"], "host": worst["host"],
+            "step": worst.get("step"), "wall_ms": worst.get("wall_ms", 0.0),
+            "host_ms": worst.get("host_ms", 0.0),
+            "comms_ms": worst.get("comms_ms", 0.0),
+            "device_ms": worst.get("device_ms", 0.0),
+            "ranks_compared": len(rows)}
 
 
 # ---------------------------------------------------------------------------
@@ -684,8 +1141,10 @@ def _trace_events():
                           {"ph": "E", "name": name, "cat": cat, "ts": te,
                            "pid": pid, "tid": r.tid}))
     keyed.sort(key=lambda kv: kv[0])
-    events = [{"ph": "M", "pid": pid, "tid": r.tid, "name": "thread_name",
-               "args": {"name": r.tname}} for r in rings]
+    events = [{"ph": "M", "pid": pid, "name": "process_name",
+               "args": {"name": f"rank {_proc['rank']} ({_proc['host']})"}}]
+    events.extend({"ph": "M", "pid": pid, "tid": r.tid, "name": "thread_name",
+                   "args": {"name": r.tname}} for r in rings)
     events.extend(e for _, e in keyed)
     return events
 
@@ -695,14 +1154,24 @@ def dump(finished=True, profile_process="worker"):
     ``_config['filename']`` (parity: ``mx.profiler.dump`` writing the
     reference's chrome-trace file).  ``finished=False`` keeps the recorder
     armed (periodic mid-run dumps); the default also ``stop()``s.
-    Returns the path written."""
+    With ``MXNET_PROFILER_TRACE_GZ=1`` the file is gzip-compressed (a
+    ``.gz`` suffix is appended unless already present — pod-scale traces
+    shrink ~10x and ``tools/trace_report.py``/``trace_merge.py`` read
+    them directly).  Returns the path written."""
     path = _config["filename"]
+    gz = os.environ.get("MXNET_PROFILER_TRACE_GZ", "0") == "1"
+    if gz and not path.endswith(".gz"):
+        path += ".gz"
     parent = os.path.dirname(os.path.abspath(path))
     os.makedirs(parent, exist_ok=True)  # telemetry-only sessions never ran
     payload = {                         # _arm()'s makedirs
         "traceEvents": _trace_events(),
         "displayTimeUnit": "ms",
         "otherData": {
+            # process identity + wall-clock anchor + offset estimate: what
+            # tools/trace_merge.py needs to fuse per-rank dumps into one
+            # offset-corrected timeline
+            "process": process_info(),
             "counters": counters(),
             "steps": step_stats(),
             "memory_watermark_bytes": memory_watermark(),
@@ -710,7 +1179,8 @@ def dump(finished=True, profile_process="worker"):
             "xprof_dir": _state["dir"],
         },
     }
-    with open(path, "w") as f:
+    opener = (lambda p: _gzip.open(p, "wt")) if gz else (lambda p: open(p, "w"))
+    with opener(path) as f:
         json.dump(payload, f)
     if finished:
         stop()
@@ -886,3 +1356,7 @@ class Marker:
 if os.environ.get("MXNET_PROFILER_AUTOSTART", "0") == "1":
     start()
     atexit.register(dump)
+
+if (os.environ.get("MXNET_METRICS_PORT", "0") not in ("", "0")
+        or os.environ.get("MXNET_METRICS_JSONL")):
+    start_metrics()  # env-driven surfaces come up with the process

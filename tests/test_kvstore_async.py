@@ -430,3 +430,125 @@ def test_async_store_compression_end_to_end(monkeypatch):
         np.testing.assert_allclose(out.asnumpy(), [1.0, -1.0, 0.0, 0.0])
     finally:
         kv._server.stop()
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 7: clock sampling + metrics piggyback on the heartbeat wire
+# ---------------------------------------------------------------------------
+
+
+def _fake_snap(rank, host="hX", seq=3, step=9):
+    return {"schema": 1, "rank": rank, "host": host, "pid": 7000 + rank,
+            "seq": seq, "time_unix": time.time(), "counters": {},
+            "last_step": {"step": step, "wall_ms": 800.0, "host_ms": 10.0,
+                          "comms_ms": 700.0, "device_ms": 90.0},
+            "window": {"n": 1, "wall_ms_median": 800.0,
+                       "wall_ms_max": 800.0},
+            "memory_watermark_bytes": {}}
+
+
+def _clear_peer(rank):
+    from incubator_mxnet_tpu import profiler
+
+    with profiler._counter_lock:
+        profiler._peer_metrics.pop(rank, None)
+
+
+def test_clock_message_and_offset_sampling(server):
+    """The ("clock",) read returns the server's wall time, and the
+    profiler's midpoint-of-RTT sampler derives a near-zero offset from a
+    same-host server (|offset| is bounded by the observed RTT)."""
+    from incubator_mxnet_tpu import profiler
+
+    c = _client(server)
+    now = c.request("clock")
+    assert isinstance(now, float) and abs(now - time.time()) < 5.0
+    best = profiler.sample_clock_offset(lambda: c.request("clock"),
+                                        samples=3)
+    assert best is not None
+    off, rtt = best
+    assert rtt > 0 and abs(off) <= rtt + 0.05
+
+
+def test_heartbeat_piggybacks_metrics_and_returns_server_clock(server):
+    """("heartbeat", rank, snapshot): the snapshot lands in the server's
+    per-rank metrics table AND the co-located profiler peer registry, and
+    the reply is the server's wall clock (the free offset sample).  A
+    bare 2-tuple heartbeat still works."""
+    from incubator_mxnet_tpu import profiler
+
+    c = _client(server)
+    try:
+        server_now = c.request("heartbeat", 1, _fake_snap(1))
+        assert isinstance(server_now, float)
+        assert abs(server_now - time.time()) < 5.0
+        stored = c.request("metrics")
+        assert stored[1]["last_step"]["step"] == 9
+        assert profiler.peer_metrics()[1]["host"] == "hX"
+        assert isinstance(c.request("heartbeat", 0), float)  # legacy shape
+    finally:
+        _clear_peer(1)
+
+
+def test_heartbeat_thread_ships_snapshots_and_samples_clock(server):
+    """The background HeartbeatThread does the piggyback unprompted: the
+    server accumulates this worker's snapshots and the local clock-offset
+    estimate gets (re)sampled from the beat replies."""
+    from incubator_mxnet_tpu import profiler
+    from incubator_mxnet_tpu.kvstore.async_ps import HeartbeatThread
+
+    hb = HeartbeatThread(*server.address, rank=1, interval=0.05)
+    hb.start()
+    try:
+        deadline = time.monotonic() + 10.0
+        stored = {}
+        c = _client(server)
+        while time.monotonic() < deadline:
+            stored = c.request("metrics")
+            if 1 in stored:
+                break
+            time.sleep(0.05)
+        assert 1 in stored, "heartbeat never delivered a snapshot"
+        assert "counters" in stored[1] and "seq" in stored[1]
+        assert profiler.process_info()["clock_rtt_s"] is not None
+    finally:
+        hb.stop()
+        _clear_peer(stored.get(1, {}).get("rank", -1))
+
+
+def test_ssp_timeout_carries_straggler_telemetry():
+    """The bounded-SSP-wait error names the lagging rank WITH its
+    heartbeat-shipped host/comms/device split (and degrades to a plain
+    rank id when the straggler never heartbeat a snapshot)."""
+    ps = ParameterServer(num_workers=2, port=0, staleness=1, ssp_timeout=1.5)
+    try:
+        c = _client(ps)
+        c.request("init", "k", np.zeros(1, np.float32))
+        c.request("push", "k", np.ones(1, np.float32), 1)
+        c.request("heartbeat", 1, _fake_snap(1))
+        c.request("push", "k", np.ones(1, np.float32), 0)
+        c.request("push", "k", np.ones(1, np.float32), 0)
+        with pytest.raises(PSTimeoutError) as ei:
+            c.request("push", "k", np.ones(1, np.float32), 0)
+        msg = str(ei.value)
+        assert "lagging rank 1" in msg
+        assert "host hX" in msg and "host-dispatch 10.0 ms" in msg
+        assert "comms 700.0 ms" in msg and "device/other 90.0 ms" in msg
+    finally:
+        ps.stop()
+        _clear_peer(1)
+
+
+def test_ssp_timeout_without_telemetry_degrades_gracefully():
+    ps = ParameterServer(num_workers=2, port=0, staleness=1, ssp_timeout=1.0)
+    try:
+        c = _client(ps)
+        c.request("init", "k", np.zeros(1, np.float32))
+        c.request("push", "k", np.ones(1, np.float32), 1)
+        c.request("push", "k", np.ones(1, np.float32), 0)
+        c.request("push", "k", np.ones(1, np.float32), 0)
+        with pytest.raises(PSTimeoutError,
+                           match="no telemetry heartbeat"):
+            c.request("push", "k", np.ones(1, np.float32), 0)
+    finally:
+        ps.stop()

@@ -27,16 +27,24 @@ _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 @pytest.fixture
 def clean_profiler(tmp_path):
     """Arm-safe profiler state: fresh filename, stopped recorder, zeroed
-    counters before AND after (profiler state is module-global)."""
+    counters and an empty peer-metrics registry before AND after
+    (profiler state is module-global; a leftover peer snapshot would make
+    every slow step also log a straggler line)."""
     profiler.stop()
+    profiler.stop_metrics()
     profiler.set_config(filename=str(tmp_path / "trace.json"),
                         ring_size=65536, slow_step_ms=None)
     profiler.reset_counters()
+    with profiler._counter_lock:
+        profiler._peer_metrics.clear()
     yield tmp_path
     profiler.stop()
+    profiler.stop_metrics()
     profiler.set_config(slow_step_ms=None, ring_size=65536,
                         slow_step_auto=True, memory_sampling=True)
     profiler.reset_counters()
+    with profiler._counter_lock:
+        profiler._peer_metrics.clear()
 
 
 def _paired_spans(events):
@@ -501,3 +509,413 @@ class TestTraceReport:
              path], capture_output=True, text=True, timeout=120)
         assert out.returncode == 0, out.stderr
         assert "real_work" in out.stdout
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 7: pause/resume vs the telemetry window + metrics snapshots
+# ---------------------------------------------------------------------------
+
+sys.path.insert(0, os.path.join(_REPO, "tools"))
+import trace_merge  # noqa: E402
+
+
+def _peer_snap(rank, host="peer-host", seq=1, wall=900.0, comms=700.0,
+               step=77):
+    return {"schema": 1, "rank": rank, "host": host, "pid": 10000 + rank,
+            "seq": seq, "time_unix": time.time(),
+            "counters": {"bulk_flush": 1},
+            "last_step": {"step": step, "wall_ms": wall, "host_ms": 50.0,
+                          "comms_ms": comms,
+                          "device_ms": wall - 50.0 - comms},
+            "window": {"n": 1, "wall_ms_median": wall, "wall_ms_max": wall},
+            "memory_watermark_bytes": {}}
+
+
+class TestPauseResumeWindow:
+    def test_pause_gap_not_billed_to_window(self, clean_profiler):
+        """A pause()d interval must not pollute step_stats(): the first
+        post-resume boundary anchors at resume time, so the gap never
+        appears as a giant step wall."""
+        profiler.start()
+        profiler.step_boundary()                    # ~0 ms step
+        time.sleep(0.01)
+        profiler.step_boundary()                    # ~10 ms step
+        profiler.pause()
+        time.sleep(0.25)                            # the paused gap
+        profiler.resume()
+        time.sleep(0.01)
+        profiler.step_boundary()                    # measured from resume
+        profiler.stop()
+        steps = profiler.step_stats()
+        assert len(steps) == 3                      # window survived pause
+        assert all(s["wall_ms"] < 200.0 for s in steps), steps
+
+    def test_dump_unfinished_keeps_window_accumulating(self, clean_profiler):
+        profiler.start()
+        time.sleep(0.002)
+        profiler.step_boundary()
+        profiler.dump(finished=False)
+        assert profiler.recording_enabled()
+        time.sleep(0.002)
+        profiler.step_boundary()
+        profiler.dump()
+        assert len(profiler.step_stats()) == 2
+
+    def test_metrics_snapshot_monotone_across_session_events(
+            self, clean_profiler):
+        """Snapshot monotonicity: seq/time/counters/window size never go
+        backwards across boundaries, mid-run dumps, and pause/resume."""
+        profiler.start()
+        profiler.step_boundary()
+        s1 = profiler.metrics_snapshot()
+        time.sleep(0.005)
+        profiler.step_boundary()
+        profiler.dump(finished=False)
+        s2 = profiler.metrics_snapshot()
+        profiler.pause()
+        profiler.resume()
+        s3 = profiler.metrics_snapshot()
+        profiler.stop()
+        for a, b in ((s1, s2), (s2, s3)):
+            assert b["seq"] > a["seq"]
+            assert b["time_unix"] >= a["time_unix"]
+            assert b["window"]["n"] >= a["window"]["n"]
+            for k, v in a["counters"].items():
+                assert b["counters"][k] >= v, k
+        assert s2["window"]["n"] == 2
+        assert s2["last_step"]["wall_ms"] >= 4.0
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 7: live metrics export (registry, Prometheus endpoint, JSONL)
+# ---------------------------------------------------------------------------
+
+
+class TestMetricsExport:
+    def test_render_prometheus_includes_local_and_peers(self, clean_profiler):
+        profiler.start()
+        time.sleep(0.003)
+        profiler.step_boundary()
+        time.sleep(0.003)
+        profiler.step_boundary()
+        profiler.publish_peer_metrics(_peer_snap(9))
+        txt = profiler.render_prometheus()
+        profiler.stop()
+        me = profiler.process_info()["rank"]
+        assert f'mxnet_profiler_counter_total{{counter="bulk_flush",rank="9"' \
+            in txt
+        assert f'rank="{me}"' in txt
+        assert 'mxnet_step_last_wall_ms{' in txt
+        assert 'mxnet_step_last_comms_ms{rank="9"' in txt
+        assert "# TYPE mxnet_profiler_counter_total counter" in txt
+        assert "# TYPE mxnet_step_last_wall_ms gauge" in txt
+
+    def test_peer_registry_replaces_by_seq_and_pid(self, clean_profiler):
+        profiler.publish_peer_metrics(_peer_snap(4, seq=5, wall=100.0))
+        profiler.publish_peer_metrics(_peer_snap(4, seq=3, wall=999.0))
+        assert profiler.peer_metrics()[4]["last_step"]["wall_ms"] == 100.0
+        restarted = _peer_snap(4, seq=1, wall=50.0)
+        restarted["pid"] = 4242                     # a restarted peer wins
+        profiler.publish_peer_metrics(restarted)
+        assert profiler.peer_metrics()[4]["last_step"]["wall_ms"] == 50.0
+
+    def test_http_endpoint_serves_cluster(self, clean_profiler):
+        import urllib.request
+
+        profiler.start()
+        time.sleep(0.003)
+        profiler.step_boundary()
+        time.sleep(0.003)
+        profiler.step_boundary()
+        profiler.publish_peer_metrics(_peer_snap(9))
+        port = profiler.start_metrics(port=0)       # explicit 0 = ephemeral
+        try:
+            assert port and port == profiler.metrics_server_port()
+            body = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=10).read().decode()
+            assert 'mxnet_profiler_counter_total' in body
+            assert 'rank="9"' in body               # the peer is on the scrape
+            assert 'mxnet_step_last_wall_ms' in body
+            doc = json.loads(urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics.json", timeout=10).read())
+            assert "9" in doc["peers"]
+            assert doc["local"]["rank"] == profiler.process_info()["rank"]
+            assert profiler.counters()["metrics_scrape"] >= 2
+        finally:
+            profiler.stop_metrics()
+        assert profiler.metrics_server_port() is None
+
+    def test_jsonl_exporter_writes_monotone_snapshots(self, clean_profiler,
+                                                     tmp_path):
+        path = tmp_path / "metrics.jsonl"
+        profiler.start()
+        profiler.step_boundary()
+        profiler.start_metrics(port=None, jsonl=str(path), interval_s=0.05)
+        time.sleep(0.35)
+        profiler.stop_metrics()
+        profiler.stop()
+        lines = [json.loads(l) for l in path.read_text().splitlines()]
+        assert len(lines) >= 2
+        assert [l["seq"] for l in lines] == sorted(l["seq"] for l in lines)
+        assert all(l["schema"] == 1 for l in lines)
+        assert all(l["rank"] == lines[0]["rank"] for l in lines)
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 7: cross-rank straggler attribution
+# ---------------------------------------------------------------------------
+
+
+class TestStragglerAttribution:
+    def test_straggler_named_exactly_once_per_anomalous_step(
+            self, clean_profiler, caplog):
+        profiler.set_config(slow_step_ms=30.0)
+        profiler.start()
+        profiler.publish_peer_metrics(_peer_snap(5, host="worker-h5",
+                                                 wall=900.0, comms=700.0))
+        with caplog.at_level(logging.WARNING,
+                             logger="incubator_mxnet_tpu.profiler"):
+            profiler.step_boundary()            # fast step
+            time.sleep(0.05)
+            profiler.step_boundary()            # THE anomalous step
+            profiler.step_boundary()            # fast again
+        profiler.stop()
+        lines = [r for r in caplog.records if "straggler" in r.message]
+        assert len(lines) == 1
+        msg = lines[0].getMessage()
+        assert "rank 5" in msg and "worker-h5" in msg
+        assert "host-dispatch" in msg and "comms" in msg \
+            and "device/other" in msg
+        assert "700.3" not in msg               # numbers come from the snap
+        assert "900.0 ms" in msg and "700.0 ms" in msg
+        assert profiler.counters()["straggler_detected"] == 1
+
+    def test_no_straggler_line_without_peer_data(self, clean_profiler,
+                                                 caplog):
+        profiler.set_config(slow_step_ms=30.0)
+        profiler.start()
+        with caplog.at_level(logging.WARNING,
+                             logger="incubator_mxnet_tpu.profiler"):
+            profiler.step_boundary()
+            time.sleep(0.05)
+            profiler.step_boundary()
+        profiler.stop()
+        assert [r for r in caplog.records if "slow step" in r.message]
+        assert not [r for r in caplog.records if "straggler" in r.message]
+        assert profiler.counters()["straggler_detected"] == 0
+
+    def test_straggler_report_compares_local_and_peers(self, clean_profiler):
+        profiler.start()
+        time.sleep(0.003)
+        profiler.step_boundary()
+        time.sleep(0.003)
+        profiler.step_boundary()
+        assert profiler.straggler_report() is None  # one rank: nothing to
+        profiler.publish_peer_metrics(_peer_snap(2, wall=5000.0))  # compare
+        rep = profiler.straggler_report()
+        profiler.stop()
+        assert rep["rank"] == 2 and rep["wall_ms"] == 5000.0
+        assert rep["ranks_compared"] == 2
+        assert rep["step"] == 77
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 7: multi-rank trace merge + gz round trip
+# ---------------------------------------------------------------------------
+
+
+class TestTraceMerge:
+    def _rank_doc(self, rank, epoch_unix, clock_offset_s, host="hostX"):
+        evs = [{"ph": "M", "pid": 1234, "name": "process_name",
+                "args": {"name": "local"}}]
+        t = 100.0
+        for step in (1, 2):
+            evs += [{"ph": "B", "name": "step", "cat": "step", "ts": t,
+                     "pid": 1234, "tid": 7, "args": {"step": step}},
+                    {"ph": "E", "name": "step", "cat": "step", "ts": t + 50,
+                     "pid": 1234, "tid": 7}]
+            t += 60
+        return {"traceEvents": evs, "displayTimeUnit": "ms",
+                "otherData": {"process": {
+                    "rank": rank, "host": host, "pid": 1234,
+                    "epoch_unix": epoch_unix,
+                    "clock_offset_s": clock_offset_s, "clock_rtt_s": 0.001},
+                    "counters": {}, "steps": []}}
+
+    def test_merge_offset_corrects_and_labels_ranks(self, tmp_path):
+        # rank 1's wall clock runs 1 s AHEAD (offset +1.0) and its process
+        # started 3 s after rank 0: corrected shift = 3 - 1 = 2 s
+        p0, p1 = str(tmp_path / "r0.json"), str(tmp_path / "r1.json")
+        json.dump(self._rank_doc(0, 1000.0, 0.0, "hostA"), open(p0, "w"))
+        json.dump(self._rank_doc(1, 1003.0, 1.0, "hostB"), open(p1, "w"))
+        merged = trace_merge.merge_traces([p0, p1])
+        names = {e["pid"]: e["args"]["name"] for e in merged["traceEvents"]
+                 if e.get("ph") == "M" and e.get("name") == "process_name"}
+        assert names == {0: "rank 0 (hostA)", 1: "rank 1 (hostB)"}
+        ts = {pid: [e["ts"] for e in merged["traceEvents"]
+                    if e.get("ph") == "B" and e["pid"] == pid]
+              for pid in (0, 1)}
+        assert ts[0] == [100.0, 160.0]
+        assert ts[1] == [100.0 + 2e6, 160.0 + 2e6]
+        summary = trace_merge.check_merged(merged, expect_ranks=2)
+        assert summary["steps_per_rank"] == {0: 2, 1: 2}
+        assert merged["otherData"]["ranks"]["1"]["shift_us"] == 2e6
+
+    def test_merge_rejects_duplicate_ranks(self, tmp_path):
+        p0, p1 = str(tmp_path / "a.json"), str(tmp_path / "b.json")
+        json.dump(self._rank_doc(0, 1000.0, 0.0), open(p0, "w"))
+        json.dump(self._rank_doc(0, 1001.0, 0.0), open(p1, "w"))
+        with pytest.raises(ValueError, match="duplicate rank"):
+            trace_merge.merge_traces([p0, p1])
+
+    def test_check_catches_non_monotone_steps(self, tmp_path):
+        doc = self._rank_doc(0, 1000.0, 0.0)
+        for e in doc["traceEvents"]:
+            if e.get("args", {}).get("step") == 2:
+                e["args"]["step"] = 1               # duplicate id
+        p = str(tmp_path / "bad.json")
+        json.dump(doc, open(p, "w"))
+        merged = trace_merge.merge_traces([p])
+        with pytest.raises(ValueError, match="monotone"):
+            trace_merge.check_merged(merged)
+
+    def test_real_dump_gz_roundtrip_through_report(self, clean_profiler,
+                                                   tmp_path, monkeypatch):
+        """dump() honors MXNET_PROFILER_TRACE_GZ=1 and the gz file flows
+        through trace_report unchanged."""
+        monkeypatch.setenv("MXNET_PROFILER_TRACE_GZ", "1")
+        profiler.start()
+        with profiler.span("gz_work", "user"):
+            time.sleep(0.002)
+        profiler.step_boundary()
+        path = profiler.dump()
+        assert path.endswith(".json.gz") and os.path.exists(path)
+        out = subprocess.run(
+            [sys.executable, os.path.join(_REPO, "tools", "trace_report.py"),
+             path], capture_output=True, text=True, timeout=120)
+        assert out.returncode == 0, out.stderr
+        assert "gz_work" in out.stdout
+
+    def test_report_merge_mode_and_empty_diagnosis(self, tmp_path):
+        p0, p1 = str(tmp_path / "r0.json"), str(tmp_path / "r1.json")
+        json.dump(self._rank_doc(0, 1000.0, 0.0), open(p0, "w"))
+        json.dump(self._rank_doc(1, 1000.5, 0.0), open(p1, "w"))
+        merged = str(tmp_path / "merged.json")
+        out = subprocess.run(
+            [sys.executable, os.path.join(_REPO, "tools", "trace_report.py"),
+             p0, p1, "--merge", merged],
+            capture_output=True, text=True, timeout=120)
+        assert out.returncode == 0, out.stderr
+        assert "Per-rank attribution" in out.stdout
+        assert "hostX" in out.stdout
+        assert os.path.exists(merged)
+        empty = tmp_path / "empty.json"
+        empty.write_text("")
+        out = subprocess.run(
+            [sys.executable, os.path.join(_REPO, "tools", "trace_report.py"),
+             str(empty)], capture_output=True, text=True, timeout=120)
+        assert out.returncode == 2
+        assert "empty trace file" in out.stderr
+        assert "Traceback" not in out.stderr
+
+    def test_dump_carries_process_metadata(self, clean_profiler):
+        profiler.start()
+        profiler.step_boundary()
+        path = profiler.dump()
+        proc = json.load(open(path))["otherData"]["process"]
+        assert proc["rank"] == profiler.process_info()["rank"]
+        assert proc["host"] and proc["pid"] == os.getpid()
+        assert proc["epoch_unix"] > 0
+
+
+@pytest.mark.slow
+def test_dist_trace_smoke_two_workers():
+    """The CI acceptance path end to end: 2 dist_async workers -> per-rank
+    traces -> offset-corrected merge with one process row per rank; rank
+    0's /metrics scrape aggregates both ranks; straggler attribution fires
+    exactly once (tools/dist_trace_smoke.py, also run by ci.sh profiler)."""
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    out = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "tools", "dist_trace_smoke.py")],
+        env=env, capture_output=True, text=True, timeout=280)
+    sys.stdout.write(out.stdout[-2000:])
+    sys.stderr.write(out.stderr[-2000:])
+    assert out.returncode == 0
+    assert "dist trace smoke OK" in out.stdout
+
+
+class TestStragglerRegistryHygiene:
+    def test_schema_light_peer_snapshot_cannot_break_step_boundary(
+            self, clean_profiler, caplog):
+        """A peer on an older build may heartbeat a snapshot whose
+        last_step lacks bucket fields; the straggler comparison must
+        degrade, never raise out of the training hot path."""
+        profiler.set_config(slow_step_ms=30.0)
+        profiler.start()
+        profiler.publish_peer_metrics(
+            {"rank": 8, "pid": 1, "seq": 1, "time_unix": time.time(),
+             "last_step": {"step": 3, "wall_ms": 5000.0}})   # no buckets
+        with caplog.at_level(logging.WARNING,
+                             logger="incubator_mxnet_tpu.profiler"):
+            profiler.step_boundary()
+            time.sleep(0.05)
+            profiler.step_boundary()                 # must not raise
+        profiler.stop()
+        lines = [r for r in caplog.records if "straggler" in r.message]
+        assert len(lines) == 1 and "rank 8" in lines[0].getMessage()
+        # and a last_step that is not even a dict is skipped outright
+        profiler.publish_peer_metrics(
+            {"rank": 9, "pid": 1, "seq": 1, "last_step": "garbage"})
+        rep = profiler.straggler_report()
+        assert rep is None or rep["rank"] != 9
+
+    def test_stale_peer_snapshot_aged_out_of_comparison(self,
+                                                       clean_profiler):
+        profiler.start()
+        time.sleep(0.003)
+        profiler.step_boundary()
+        time.sleep(0.003)
+        profiler.step_boundary()
+        old = _peer_snap(6, wall=9000.0)
+        old["time_unix"] = time.time() - 3600.0      # an hour-dead rank
+        profiler.publish_peer_metrics(old)
+        assert profiler.straggler_report() is None   # nothing fresh to
+        profiler.publish_peer_metrics(_peer_snap(7, wall=8000.0))  # compare
+        rep = profiler.straggler_report()
+        profiler.stop()
+        assert rep["rank"] == 7                      # ghost never wins
+
+    def test_forget_peer_metrics_on_deregister_and_eviction(self):
+        """The PS purges a departed rank's telemetry from its table AND
+        the co-located peer registry — clean leave and lease eviction."""
+        from incubator_mxnet_tpu.kvstore.async_ps import (AsyncClient,
+                                                          ParameterServer)
+
+        ps = ParameterServer(num_workers=2, port=0, lease_s=0.4)
+        try:
+            c = AsyncClient(*ps.address)
+            snap = {"rank": 1, "pid": 1, "seq": 1, "time_unix": time.time(),
+                    "last_step": {"step": 1, "wall_ms": 1.0, "host_ms": 0.0,
+                                  "comms_ms": 0.0, "device_ms": 1.0}}
+            c.request("register", 1)
+            c.request("heartbeat", 1, snap)
+            assert 1 in c.request("metrics")
+            assert 1 in profiler.peer_metrics()
+            c.request("deregister", 1)
+            assert 1 not in c.request("metrics")
+            assert 1 not in profiler.peer_metrics()
+            # eviction path: register + one beat, then let the lease lapse
+            c.request("register", 2)
+            c.request("heartbeat", 2, dict(snap, rank=2))
+            assert 2 in c.request("metrics")
+            deadline = time.monotonic() + 10.0
+            while 2 in c.request("metrics"):
+                assert time.monotonic() < deadline, "reaper never purged"
+                time.sleep(0.1)
+            assert 2 not in profiler.peer_metrics()
+        finally:
+            ps.stop()
+            with profiler._counter_lock:
+                profiler._peer_metrics.clear()

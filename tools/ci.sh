@@ -10,8 +10,13 @@
 #   5. bench     — bench.py smoke on whatever backend is present (CPU-safe)
 #   6. profiler  — tracing-subsystem smoke: tiny train loop with the span
 #                  recorder on, chrome-trace file must parse, trace_report
-#                  must exit 0, and every profiler.incr(...) literal in the
-#                  tree must name a declared counter (lint_counters.py)
+#                  must exit 0, every profiler.incr(...) literal in the
+#                  tree must name a declared counter AND the
+#                  docs/observability.md counter table must match it
+#                  (lint_counters.py), plus the 2-process cluster smoke
+#                  (dist_trace_smoke.py): per-rank traces merge into one
+#                  offset-corrected timeline and rank 0's /metrics scrape
+#                  aggregates every rank
 #   7. chaos     — fault-injection tier (fixed seed): wire drops/dups/kills
 #                  against the async PS with exactly-once accounting, the
 #                  2-worker chaos training acceptance run, and the
@@ -121,7 +126,11 @@ for tier in "${TIERS[@]}"; do
             ;;
         profiler)
             # tracing smoke: recorder-on train loop -> valid chrome trace,
-            # trace_report runs clean, counter-name lint passes
+            # trace_report runs clean, counter-name lint passes (incl. the
+            # docs/observability.md counter-table diff), and the 2-process
+            # cluster smoke: per-rank traces -> offset-corrected merge with
+            # one process row per rank, rank-0 /metrics scrape sees both
+            # ranks, straggler attribution fires exactly once
             # per-run trace path: concurrent ci.sh runs on one box must
             # not race on a shared file
             run_tier profiler "${CPU_ENV[@]}" bash -c '
@@ -130,7 +139,8 @@ for tier in "${TIERS[@]}"; do
                 trap "rm -f \"$trace\"" EXIT
                 python tools/profiler_smoke.py --out "$trace"
                 python tools/trace_report.py "$trace" --top 10 >/dev/null
-                python tools/lint_counters.py'
+                python tools/lint_counters.py
+                python tools/dist_trace_smoke.py'
             ;;
         chaos)
             # deterministic fault injection: the seed pins the p= fault

@@ -1,0 +1,167 @@
+#!/usr/bin/env python
+"""2-process cluster-observability smoke (tools/ci.sh ``profiler`` tier).
+
+Drives the whole ISSUE-7 loop end to end on one host:
+
+* launches 2 dist_async workers through ``tools/launch_local.py``; each
+  runs a tiny recorder-on push/pull loop with step boundaries and dumps a
+  per-rank chrome trace carrying process metadata + a clock-offset
+  estimate (sampled over the PS wire);
+* rank 0 serves live metrics on an ephemeral port and asserts its OWN
+  ``GET /metrics`` scrape contains counters and step buckets from BOTH
+  ranks (rank 1's snapshots arrive via heartbeat piggyback), then forces
+  one anomalous step and asserts the straggler attribution line fired
+  exactly once;
+* the driver merges the two traces (``tools/trace_merge.py``) and checks
+  one process row per rank with offset-corrected monotone step spans, and
+  exercises ``trace_report.py --merge`` on the same pair.
+
+Exit 0 = healthy.  Usage: ``python tools/dist_trace_smoke.py`` (the
+``--worker`` mode is internal).
+"""
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+TOOLS = os.path.dirname(os.path.abspath(__file__))
+ROOT = os.path.dirname(TOOLS)
+sys.path.insert(0, ROOT)
+sys.path.insert(0, TOOLS)
+
+
+# ---------------------------------------------------------------------------
+# worker
+# ---------------------------------------------------------------------------
+
+def worker():
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import kvstore as kv_mod, profiler
+
+    rank = int(os.environ["DMLC_WORKER_ID"])
+    outdir = os.environ["MXNET_TRACE_SMOKE_DIR"]
+    profiler.set_config(filename=os.path.join(outdir, f"trace_rank{rank}.json"))
+    profiler.start()
+    port = profiler.start_metrics(port=0) if rank == 0 else None
+
+    kv = kv_mod.create("dist_async")
+    kv.init("w", mx.nd.zeros((4,)))
+    out = mx.nd.zeros((4,))
+    for _ in range(6):
+        with profiler.span("smoke_fwd", "user"):
+            time.sleep(0.002 + 0.004 * rank)  # rank 1 is genuinely slower
+        kv.pushpull("w", mx.nd.ones((4,)), out=out)
+        profiler.step_boundary()
+
+    if rank == 0:
+        import urllib.request
+
+        # the peer's step telemetry rides its heartbeat (lease/3 cadence);
+        # poll the LIVE endpoint until the cluster view is complete
+        deadline = time.monotonic() + 20.0
+        need = ('mxnet_profiler_counter_total', 'rank="1"',
+                'mxnet_step_last_wall_ms')
+        body = ""
+        while time.monotonic() < deadline:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/metrics", timeout=5) as r:
+                body = r.read().decode()
+            if all(n in body for n in need):
+                break
+            time.sleep(0.25)
+        missing = [n for n in need if n not in body]
+        assert not missing, f"rank-0 scrape never aggregated: {missing}"
+        assert 'mxnet_step_last_comms_ms{rank="1"' in body, \
+            "peer step buckets missing from the scrape"
+
+        # straggler attribution: one anomalous step -> exactly one line
+        records = []
+        h = logging.Handler()
+        h.emit = lambda rec: records.append(rec)
+        logging.getLogger("incubator_mxnet_tpu.profiler").addHandler(h)
+        profiler.set_config(slow_step_ms=100000.0)
+        profiler.step_boundary()          # absorb the scrape/poll gap
+        profiler.set_config(slow_step_ms=10.0)
+        time.sleep(0.05)
+        profiler.step_boundary()          # THE anomalous step
+        profiler.set_config(slow_step_ms=None)
+        straggler = [r for r in records if "straggler" in r.getMessage()]
+        assert len(straggler) == 1, \
+            f"want exactly 1 straggler line, got {len(straggler)}"
+        msg = straggler[0].getMessage()
+        assert "host-dispatch" in msg and "comms" in msg, msg
+
+    kv.barrier()   # both ranks' telemetry settled before anyone leaves
+    kv.close()
+    path = profiler.dump()
+    assert os.path.exists(path)
+    info = profiler.process_info()
+    assert info["rank"] == rank
+    if rank != 0:   # rank 0 talks to its co-located PS: offset may be ~0
+        assert info["clock_rtt_s"] is not None, "clock never sampled"
+    print(f"trace smoke worker OK (rank {rank})", flush=True)
+
+
+# ---------------------------------------------------------------------------
+# driver
+# ---------------------------------------------------------------------------
+
+def driver():
+    import trace_merge
+
+    tmp = tempfile.mkdtemp(prefix="dist_trace_smoke_")
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)          # workers boot their own backend
+    env["JAX_PLATFORMS"] = "cpu"
+    env["MXNET_TRACE_SMOKE_DIR"] = tmp
+    env["MXNET_KVSTORE_LEASE_S"] = "2.0"   # heartbeat ~0.66 s: snapshots
+    proc = subprocess.run(                 # reach the PS fast
+        [sys.executable, os.path.join(TOOLS, "launch_local.py"), "-n", "2",
+         sys.executable, os.path.abspath(__file__), "--worker"],
+        env=env, capture_output=True, text=True, timeout=240)
+    sys.stdout.write(proc.stdout[-4000:])
+    sys.stderr.write(proc.stderr[-4000:])
+    assert proc.returncode == 0, f"workers failed (rc={proc.returncode})"
+    assert proc.stdout.count("trace smoke worker OK") == 2
+
+    traces = [os.path.join(tmp, f"trace_rank{r}.json") for r in (0, 1)]
+    merged = os.path.join(tmp, "merged.json")
+    rc = trace_merge.main(traces + ["-o", merged, "--check",
+                                    "--expect-ranks", "2"])
+    assert rc == 0, "trace_merge --check failed"
+
+    # both ranks really sampled a clock anchor into their dumps
+    doc = trace_merge.load_trace(merged)
+    for rank in ("0", "1"):
+        proc_meta = doc["otherData"]["ranks"][rank]["process"]
+        assert proc_meta.get("epoch_unix") is not None
+
+    # the trace_report --merge front door on the same pair
+    rep = subprocess.run(
+        [sys.executable, os.path.join(TOOLS, "trace_report.py")] + traces
+        + ["--merge", os.path.join(tmp, "merged_report.json"), "--top", "5"],
+        capture_output=True, text=True, timeout=120)
+    assert rep.returncode == 0, rep.stderr
+    assert "Per-rank attribution" in rep.stdout
+    print("dist trace smoke OK")
+    return 0
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--worker", action="store_true",
+                   help="internal: run as a launched worker")
+    args = p.parse_args(argv)
+    if args.worker:
+        worker()
+        return 0
+    return driver()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -9,7 +9,13 @@ a string-literal name and checks each against the declared set: the
 with ``ast`` — no jax import needed) plus any ``declare_counter("...")``
 literals found in the tree.
 
-Exit 0 = every literal declared; 1 = violations (listed on stderr).
+It ALSO (ISSUE 7) diffs the declared set against the canonical counter
+table in ``docs/observability.md`` ("Counter reference" section): a
+counter added without a doc row — or documented after removal — fails the
+profiler CI tier, so code and doc cannot drift.
+
+Exit 0 = every literal declared AND the doc table in sync; 1 = violations
+(listed on stderr).
 """
 from __future__ import annotations
 
@@ -36,6 +42,26 @@ def declared_counters():
                 and isinstance(node.value, ast.Dict)):
             return {ast.literal_eval(k) for k in node.value.keys}
     raise SystemExit("lint_counters: no _counters dict literal in profiler.py")
+
+
+DOC_PATH = os.path.join(ROOT, "docs", "observability.md")
+DOC_ROW_RE = re.compile(r"^\|\s*`([A-Za-z0-9_]+)`")
+
+
+def doc_counters():
+    """Counter names from the ``## Counter reference`` table in
+    docs/observability.md (first backticked cell of each row)."""
+    names = set()
+    in_section = False
+    with open(DOC_PATH) as f:
+        for line in f:
+            if line.startswith("## "):
+                in_section = line.strip().lower() == "## counter reference"
+            elif in_section:
+                m = DOC_ROW_RE.match(line)
+                if m:
+                    names.add(m.group(1))
+    return names
 
 
 def iter_py_files():
@@ -65,8 +91,26 @@ def main():
             print(f"{path}:{line}: undeclared profiler counter {name!r}",
                   file=sys.stderr)
         return 1
-    print(f"lint_counters OK: {len(declared)} declared counters, "
-          "all incr() literals match")
+    # pass 3: the docs/observability.md counter table must mirror the
+    # IN-TREE declared set exactly (declare_counter() extensions are
+    # runtime opt-ins — tests register throwaways — and stay out of it)
+    intree = declared_counters()
+    documented = doc_counters()
+    drift = 0
+    for name in sorted(intree - documented):
+        print(f"docs/observability.md: counter {name!r} declared in "
+              "profiler._counters but missing from the Counter reference "
+              "table", file=sys.stderr)
+        drift += 1
+    for name in sorted(documented - intree):
+        print(f"docs/observability.md: counter {name!r} documented but not "
+              "declared in profiler._counters (stale row?)", file=sys.stderr)
+        drift += 1
+    if drift:
+        return 1
+    print(f"lint_counters OK: {len(declared)} declared counters, all "
+          f"incr() literals match, doc table in sync "
+          f"({len(documented)} rows)")
     return 0
 
 

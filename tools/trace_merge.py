@@ -1,0 +1,223 @@
+#!/usr/bin/env python
+"""Fuse per-rank chrome-trace JSONs from ``profiler.dump()`` into ONE
+Perfetto-viewable timeline (ISSUE 7 multi-rank trace aggregation).
+
+Each rank of a dist_sync / dist_async run dumps its own trace with
+``otherData.process`` metadata: rank, host, pid, the wall-clock instant of
+its ts=0 (``epoch_unix``), and a midpoint-of-RTT clock-offset estimate
+against the cluster reference (``clock_offset_s``; sampled over the PS
+heartbeat wire or a one-shot mesh broadcast).  The merge:
+
+* remaps every event's ``pid`` to the rank (one process row per rank,
+  labeled ``rank N (host)`` and sorted by rank),
+* shifts every timestamp onto the common corrected timeline
+  (``corrected_unix = epoch_unix - clock_offset_s``, earliest rank = 0),
+* carries each rank's counters/step-telemetry/process metadata under
+  ``otherData.ranks``.
+
+``--check`` validates the result the CI smoke relies on: one process row
+per rank, B/E pairs that nest, and offset-corrected per-rank step spans
+with monotone step ids.  Inputs and ``-o`` output may be ``.json.gz``.
+
+Usage::
+
+    python tools/trace_merge.py rank0.json rank1.json.gz -o merged.json \
+                                [--check] [--expect-ranks 2]
+
+Exit codes: 0 ok, 2 unreadable/invalid input or a failed --check.
+"""
+from __future__ import annotations
+
+import argparse
+import gzip
+import json
+import sys
+from collections import defaultdict
+
+
+def open_trace(path, mode="rt"):
+    """Open a trace for reading, transparently gunzipping (by suffix or
+    magic — a ``.json`` that is secretly gzip still loads)."""
+    if "r" in mode:
+        with open(path, "rb") as f:
+            magic = f.read(2)
+        if path.endswith(".gz") or magic == b"\x1f\x8b":
+            return gzip.open(path, mode)
+        return open(path, mode.replace("t", ""))
+    if path.endswith(".gz"):
+        return gzip.open(path, mode)
+    return open(path, mode.replace("t", ""))
+
+
+def load_trace(path):
+    """Load one trace document; bare-array traces are wrapped into the
+    object form with empty metadata."""
+    with open_trace(path) as f:
+        doc = json.load(f)
+    if isinstance(doc, list):
+        doc = {"traceEvents": doc, "otherData": {}}
+    if not isinstance(doc.get("traceEvents"), list):
+        raise ValueError("traceEvents is not a list")
+    return doc
+
+
+def merge_traces(paths):
+    """Merge per-rank trace files into one document (see module doc)."""
+    docs = [(p, load_trace(p)) for p in paths]
+    ranks = {}
+    for i, (path, doc) in enumerate(docs):
+        proc = (doc.get("otherData") or {}).get("process") or {}
+        rank = int(proc.get("rank", i))
+        if rank in ranks:
+            raise ValueError(
+                f"duplicate rank {rank} ({ranks[rank]['source']} and "
+                f"{path}): per-rank traces must carry distinct "
+                "otherData.process.rank metadata")
+        base = None
+        if proc.get("epoch_unix") is not None:
+            base = float(proc["epoch_unix"]) - float(
+                proc.get("clock_offset_s") or 0.0)
+        ranks[rank] = {"source": path, "doc": doc, "process": proc,
+                       "base_unix": base}
+    bases = [r["base_unix"] for r in ranks.values()
+             if r["base_unix"] is not None]
+    t0_unix = min(bases) if bases else None
+
+    events = []
+    other_ranks = {}
+    for rank in sorted(ranks):
+        entry = ranks[rank]
+        doc, proc = entry["doc"], entry["process"]
+        shift_us = ((entry["base_unix"] - t0_unix) * 1e6
+                    if entry["base_unix"] is not None else 0.0)
+        host = proc.get("host", "?")
+        events.append({"ph": "M", "pid": rank, "name": "process_name",
+                       "args": {"name": f"rank {rank} ({host})"}})
+        events.append({"ph": "M", "pid": rank, "name": "process_sort_index",
+                       "args": {"sort_index": rank}})
+        for ev in doc["traceEvents"]:
+            if not isinstance(ev, dict):
+                continue
+            if ev.get("ph") == "M" and ev.get("name") == "process_name":
+                continue  # re-emitted above with the rank label
+            ev = dict(ev)
+            ev["pid"] = rank
+            if isinstance(ev.get("ts"), (int, float)):
+                ev["ts"] = ev["ts"] + shift_us
+            events.append(ev)
+        od = doc.get("otherData") or {}
+        other_ranks[str(rank)] = {
+            "source": entry["source"],
+            "process": proc,
+            "shift_us": shift_us,
+            "counters": od.get("counters"),
+            "steps": od.get("steps"),
+            "memory_watermark_bytes": od.get("memory_watermark_bytes"),
+        }
+    # stable ts sort keeps each file's intra-instant B/E ordering (pairing
+    # is per (pid, tid), so cross-rank interleaving at equal ts is inert)
+    events.sort(key=lambda e: (0, e["ts"]) if isinstance(
+        e.get("ts"), (int, float)) else (-1, 0.0))
+    return {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "otherData": {"merged": True, "t0_unix": t0_unix,
+                      "ranks": other_ranks},
+    }
+
+
+def check_merged(doc, expect_ranks=None):
+    """Validate a merged trace: one labeled process row per rank, B/E
+    pairs that nest per (pid, tid), and per-rank step spans whose ids are
+    strictly monotone on the corrected timeline.  Raises ValueError;
+    returns a summary dict."""
+    events = doc["traceEvents"]
+    names = {}
+    for e in events:
+        if e.get("ph") == "M" and e.get("name") == "process_name":
+            names.setdefault(e["pid"], e["args"]["name"])
+    span_pids = sorted({e["pid"] for e in events
+                        if e.get("ph") in ("B", "E", "X")})
+    if expect_ranks is not None:
+        want = sorted(range(expect_ranks))
+        if span_pids != want:
+            raise ValueError(
+                f"expected one process row per rank {want}, got {span_pids}")
+    missing = [p for p in span_pids if p not in names]
+    if missing:
+        raise ValueError(f"process rows without a rank label: {missing}")
+
+    stacks = defaultdict(list)
+    step_ids = defaultdict(list)
+    step_bounds = defaultdict(list)
+    n_spans = 0
+    for e in sorted((e for e in events if e.get("ph") in ("B", "E")),
+                    key=lambda e: e["ts"]):
+        k = (e["pid"], e["tid"])
+        if e["ph"] == "B":
+            stacks[k].append(e)
+        else:
+            if not stacks[k]:
+                raise ValueError(f"unpaired E event at ts={e['ts']}")
+            b = stacks[k].pop()
+            n_spans += 1
+            if b.get("cat") == "step":
+                step_ids[e["pid"]].append((b["args"] or {}).get("step"))
+                step_bounds[e["pid"]].append((b["ts"], e["ts"]))
+    dangling = sum(len(s) for s in stacks.values())
+    if dangling:
+        raise ValueError(f"{dangling} B event(s) never closed")
+    for pid, ids in step_ids.items():
+        if any(i is None for i in ids):
+            raise ValueError(f"rank {pid}: step span without a step id")
+        if ids != sorted(ids) or len(set(ids)) != len(ids):
+            raise ValueError(
+                f"rank {pid}: step ids not strictly monotone on the "
+                f"corrected timeline: {ids}")
+        bounds = step_bounds[pid]
+        for (b0, e0), (b1, _) in zip(bounds, bounds[1:]):
+            if b1 < e0:
+                raise ValueError(
+                    f"rank {pid}: overlapping step spans after offset "
+                    f"correction ({e0} > {b1})")
+    return {"ranks": span_pids,
+            "labels": {p: names.get(p) for p in span_pids},
+            "spans": n_spans,
+            "steps_per_rank": {p: len(v) for p, v in step_ids.items()}}
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("traces", nargs="+",
+                   help="per-rank chrome-trace JSON(.gz) from profiler.dump()")
+    p.add_argument("-o", "--out", default="merged_trace.json",
+                   help="merged output path (.gz compresses)")
+    p.add_argument("--check", action="store_true",
+                   help="validate the merged trace (rows/pairing/step "
+                        "monotonicity) and fail loudly when broken")
+    p.add_argument("--expect-ranks", type=int, default=None,
+                   help="with --check: require exactly ranks 0..N-1")
+    args = p.parse_args(argv)
+    try:
+        merged = merge_traces(args.traces)
+    except (OSError, ValueError, KeyError, json.JSONDecodeError) as e:
+        print(f"trace_merge: invalid input: {e}", file=sys.stderr)
+        return 2
+    if args.check:
+        try:
+            summary = check_merged(merged, expect_ranks=args.expect_ranks)
+        except ValueError as e:
+            print(f"trace_merge: merged trace failed validation: {e}",
+                  file=sys.stderr)
+            return 2
+        print(f"trace_merge check OK: ranks {summary['ranks']}, "
+              f"{summary['spans']} spans, steps/rank "
+              f"{summary['steps_per_rank']}")
+    with open_trace(args.out, "wt") as f:
+        json.dump(merged, f)
+    print(f"merged {len(args.traces)} trace(s) -> {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

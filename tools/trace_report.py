@@ -12,8 +12,14 @@ Usage::
 
     python tools/trace_report.py profile.json [--top 15] [--bins 10]
                                  [--xplane DIR/mxtpu_profile]
+    python tools/trace_report.py rank0.json rank1.json.gz --merge merged.json
 
-Exit codes: 0 on success, 2 on an unreadable/invalid trace file.
+With several traces, ``--merge PATH`` first fuses them through
+``tools/trace_merge.py`` (rank-labeled process rows, offset-corrected
+timestamps) and reports on the merged timeline.  ``.json.gz`` inputs are
+read transparently.
+
+Exit codes: 0 on success, 2 on an unreadable/invalid/empty trace file.
 """
 from __future__ import annotations
 
@@ -24,14 +30,20 @@ import sys
 from collections import defaultdict
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import trace_merge  # noqa: E402 — gz-aware loader + the --merge engine
 
 
 def load_spans(path):
     """Parse the trace into completed spans ``(name, cat, ts_us, dur_us,
     step)``.  Accepts both the object form ({"traceEvents": [...]}) and the
-    bare-array form of the chrome trace spec; pairs B/E events per thread
-    with a stack and takes X (complete) events as-is."""
-    with open(path) as f:
+    bare-array form of the chrome trace spec (gzipped or not); pairs B/E
+    events per thread with a stack and takes X (complete) events as-is."""
+    if os.path.getsize(path) == 0:
+        raise ValueError("empty trace file (0 bytes) — did profiler.dump() "
+                         "run, or was the run killed mid-write?")
+    with trace_merge.open_trace(path) as f:
         doc = json.load(f)
     events = doc["traceEvents"] if isinstance(doc, dict) else doc
     if not isinstance(events, list):
@@ -131,6 +143,22 @@ def report(path, spans, other, top=15, bins=10, xplane=None,
     for dev, b in sorted(wm.items()):
         w(f"memory watermark {dev}: {b} bytes\n")
 
+    if other.get("merged"):
+        w("\nPer-rank attribution (merged trace):\n")
+        w(f"{'rank':>5} {'host':<18}{'steps':>6}{'wall(ms)':>11}"
+          f"{'host(ms)':>10}{'comms(ms)':>11}{'device(ms)':>11}"
+          f"{'clk-off(ms)':>12}\n")
+        for rank, info in sorted(other.get("ranks", {}).items(),
+                                 key=lambda kv: int(kv[0])):
+            steps = info.get("steps") or []
+            proc = info.get("process") or {}
+            w(f"{rank:>5} {proc.get('host', '?'):<18}{len(steps):>6}"
+              f"{sum(s.get('wall_ms', 0) for s in steps):>11.1f}"
+              f"{sum(s.get('host_ms', 0) for s in steps):>10.1f}"
+              f"{sum(s.get('comms_ms', 0) for s in steps):>11.1f}"
+              f"{sum(s.get('device_ms', 0) for s in steps):>11.1f}"
+              f"{(proc.get('clock_offset_s') or 0) * 1e3:>12.3f}\n")
+
     if xplane:
         from incubator_mxnet_tpu import profiler as _p
 
@@ -151,22 +179,35 @@ def report(path, spans, other, top=15, bins=10, xplane=None,
 
 def main(argv=None):
     p = argparse.ArgumentParser(description=__doc__)
-    p.add_argument("trace", help="chrome-trace JSON from profiler.dump()")
+    p.add_argument("trace", nargs="+",
+                   help="chrome-trace JSON(.gz) from profiler.dump(); "
+                        "several per-rank traces need --merge")
     p.add_argument("--top", type=int, default=15)
     p.add_argument("--bins", type=int, default=10)
     p.add_argument("--xplane", default=None,
                    help="xprof trace dir to merge the device HLO table from")
+    p.add_argument("--merge", metavar="OUT", default=None,
+                   help="fuse the per-rank input traces (trace_merge.py) "
+                        "into OUT and report on the merged timeline")
     args = p.parse_args(argv)
+    path = args.trace[0]
     try:
         # only trace LOADING maps to exit 2 — a BrokenPipeError from the
         # report writes (| head) must not masquerade as an invalid trace
-        spans, other = load_spans(args.trace)
+        if len(args.trace) > 1 or args.merge:
+            if not args.merge:
+                p.error("several traces given: add --merge OUT to fuse them")
+            merged = trace_merge.merge_traces(args.trace)
+            with trace_merge.open_trace(args.merge, "wt") as f:
+                json.dump(merged, f)
+            path = args.merge
+        spans, other = load_spans(path)
     except (OSError, ValueError, KeyError, json.JSONDecodeError) as e:
-        print(f"trace_report: invalid trace {args.trace!r}: {e}",
+        print(f"trace_report: invalid trace {path!r}: {e}",
               file=sys.stderr)
         return 2
     try:
-        report(args.trace, spans, other, top=args.top, bins=args.bins,
+        report(path, spans, other, top=args.top, bins=args.bins,
                xplane=args.xplane)
     except BrokenPipeError:
         pass  # downstream consumer closed the pipe: not an error
