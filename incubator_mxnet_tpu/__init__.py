@@ -66,6 +66,7 @@ from . import checkpoint
 from . import library
 from . import config
 from . import predictor
+from . import serving
 from . import monitor
 from .monitor import Monitor
 from . import name

@@ -5,14 +5,70 @@ Forward / GetOutput) — the embedding-oriented predict surface that loads a
 ``-symbol.json`` + ``.params`` checkpoint and runs forward-only.  Here the
 bound program is one ``jax.jit``-compiled XLA executable (donated inputs,
 no autograd machinery), the deployment analog of ``Block.export``.
+
+Since ISSUE 8 the predictor is the binding substrate of the serving tier
+(``incubator_mxnet_tpu/serving``): parameters are placed on device ONCE
+and **shared by object across every shape bind** — ``reshape(new_shapes)``
+swaps the active input-shape signature, reusing both the parameter arrays
+and any executor (+ its jit cache) previously bound for that signature.
+A shape-bucketed server therefore holds one copy of the weights no matter
+how many (batch, length) buckets it serves, and switching buckets costs a
+dict lookup, not a device copy or a recompile.
+
+A ``Predictor`` is NOT thread-safe (``reshape``/``set_input``/``forward``
+mutate the active executor): concurrent callers must serialize, which is
+exactly what ``serving.InferenceServer``'s single scheduler thread does.
 """
 from __future__ import annotations
 
-import json as _json
-
 import numpy as _np
 
-__all__ = ["Predictor"]
+__all__ = ["Predictor", "load_checkpoint"]
+
+
+def _split_param_key(name):
+    """Split a checkpoint key into (kind, bare_name).
+
+    Only the literal ``arg:`` / ``aux:`` prefixes of the reference
+    checkpoint format are stripped; any other colon is part of the
+    parameter's own name (the old ``split(":", 1)`` mangled e.g. a scoped
+    ``encoder:weight`` into ``weight``).  ``kind`` is ``"arg"``, ``"aux"``
+    or ``None`` (unprefixed — classified against the symbol's
+    argument/aux lists by the caller), so prefixed and unprefixed
+    checkpoints load identically."""
+    if name.startswith("arg:"):
+        return "arg", name[4:]
+    if name.startswith("aux:"):
+        return "aux", name[4:]
+    return None, name
+
+
+def load_checkpoint(symbol_file, param_file):
+    """Load a (symbol, params) checkpoint into ``(symbol, arg_params,
+    aux_params)`` NDArray dicts with the ``arg:``/``aux:`` prefixes
+    resolved (unprefixed keys are classified against the symbol's
+    argument/aux lists — prefixed and bare checkpoints load identically).
+    The :class:`Predictor` constructor and the serving tier's AMP path
+    (``amp.convert_model`` wants the split dicts) share this loader."""
+    from . import symbol as sym_mod
+    from .ndarray import utils as nd_utils
+    from .ndarray.ndarray import NDArray, array
+
+    sym = sym_mod.load(symbol_file) if isinstance(symbol_file, str) \
+        else symbol_file
+    loaded = nd_utils.load(param_file) if isinstance(param_file, str) \
+        else param_file
+    arg_names = set(sym.list_arguments())
+    aux_names = set(sym.list_auxiliary_states())
+    args, auxs = {}, {}
+    for k, v in loaded.items():
+        kind, name = _split_param_key(k)
+        if kind is None:
+            kind = "aux" if name in aux_names and name not in arg_names \
+                else "arg"
+        nd = v if isinstance(v, NDArray) else array(_np.asarray(v))
+        (auxs if kind == "aux" else args)[name] = nd
+    return sym, args, auxs
 
 
 class Predictor:
@@ -21,48 +77,125 @@ class Predictor:
     Parameters
     ----------
     symbol_file : path to ``*-symbol.json`` (or a Symbol instance)
-    param_file : path to ``.params``/``.npz`` (or a dict of NDArrays)
-    input_shapes : dict name -> shape
+    param_file : path to ``.params``/``.npz`` (or a dict of NDArrays,
+        keys optionally ``arg:``/``aux:``-prefixed)
+    input_shapes : dict name -> shape of the initially bound signature
     """
 
     def __init__(self, symbol_file, param_file, input_shapes, dev_type="cpu",
                  dev_id=0):
         from . import context as ctx_mod
-        from . import symbol as sym_mod
-        from .ndarray import utils as nd_utils
 
-        if isinstance(symbol_file, str):
-            self._sym = sym_mod.load(symbol_file)
-        else:
-            self._sym = symbol_file
-        if isinstance(param_file, str):
-            loaded = nd_utils.load(param_file)
-        else:
-            loaded = param_file
-        self._params = {}
-        for k, v in loaded.items():
-            name = k.split(":", 1)[1] if ":" in k else k
-            self._params[name] = v
-        self._input_shapes = dict(input_shapes)
+        self._sym, self._arg_store, self._aux_store = load_checkpoint(
+            symbol_file, param_file)
         self._ctx = ctx_mod.Context(dev_type, dev_id)
-        self._inputs = {k: None for k in input_shapes}
+        self._input_shapes = {k: tuple(v) for k, v in input_shapes.items()}
+        self._exe_cache = {}   # shape signature -> Executor (jit caches ride)
         self._outputs = None
-        self._exe = self._bind()
+        self._exe = self._executor_for(self._input_shapes)
 
-    def _bind(self):
-        exe = self._sym.simple_bind(**self._input_shapes)
-        for name, arr in self._params.items():
-            if name in exe.arg_dict:
-                exe.arg_dict[name][:] = arr
-            elif name in exe.aux_dict:
-                exe.aux_dict[name][:] = arr
+    @staticmethod
+    def _sig(shapes):
+        return tuple(sorted((k, tuple(v)) for k, v in shapes.items()))
+
+    def _executor_for(self, shapes):
+        """Executor bound for ``shapes``, from the cache when this
+        signature was seen before.  A fresh bind allocates ONLY the input
+        placeholders — parameters/aux states are the shared store arrays,
+        so the device copy made at construction is the only one ever."""
+        import jax.numpy as jnp
+
+        from .base import _as_np_dtype
+        from .executor import Executor
+        from .ndarray.ndarray import NDArray
+
+        sig = self._sig(shapes)
+        exe = self._exe_cache.get(sig)
+        if exe is not None:
+            return exe
+        arg_shapes, _, aux_shapes = self._sym.infer_shape(**shapes)
+        arg_dtypes, _, aux_dtypes = self._sym.infer_type(
+            **{k: tuple(v) for k, v in shapes.items()})
+
+        args = {}
+        for name, shp, dt in zip(self._sym.list_arguments(), arg_shapes,
+                                 arg_dtypes):
+            if name in shapes:
+                dtype = _as_np_dtype(dt or "float32")
+                args[name] = NDArray(jnp.zeros(tuple(shapes[name]), dtype))
+                continue
+            nd = self._arg_store.get(name)
+            if nd is None:
+                # parameter absent from the checkpoint: bind zeros, but
+                # STORE them so later binds share the same array
+                if shp is None:
+                    raise ValueError(
+                        f"predictor: cannot infer shape of unbound "
+                        f"parameter {name!r}")
+                dtype = _as_np_dtype(dt or "float32")
+                nd = self._arg_store[name] = NDArray(jnp.zeros(shp, dtype))
+            elif shp is not None and tuple(nd.shape) != tuple(shp):
+                raise ValueError(
+                    f"predictor: parameter {name!r} has shape "
+                    f"{tuple(nd.shape)} but the graph needs {tuple(shp)} "
+                    f"for inputs {dict(shapes)} — shape-dependent "
+                    f"parameters cannot be shared across binds")
+            args[name] = nd
+        auxs = {}
+        for name, shp, dt in zip(self._sym.list_auxiliary_states(),
+                                 aux_shapes, aux_dtypes):
+            nd = self._aux_store.get(name)
+            if nd is None:
+                dtype = _as_np_dtype(dt or "float32")
+                nd = self._aux_store[name] = NDArray(
+                    jnp.zeros(shp if shp is not None else (1,), dtype))
+            auxs[name] = nd
+        exe = Executor(self._sym, self._ctx, args=args, grad_req="null",
+                       aux_states=auxs)
+        self._exe_cache[sig] = exe
         return exe
+
+    def reshape(self, new_shapes):
+        """Rebind for a new input-shape signature, sharing the parameter
+        arrays (no device copy).  A signature seen before reuses its
+        executor — and therefore its warm jit cache — outright.  Returns
+        ``self`` (the c_predict ``MXPredReshape`` contract: the handle
+        stays valid, only the bound shapes change)."""
+        new_shapes = {k: tuple(v) for k, v in new_shapes.items()}
+        unknown = set(new_shapes) - set(self._input_shapes)
+        if unknown:
+            raise KeyError(f"unknown input(s) {sorted(unknown)!r}; "
+                           f"inputs are {sorted(self._input_shapes)}")
+        shapes = dict(self._input_shapes)
+        shapes.update(new_shapes)
+        self._exe = self._executor_for(shapes)
+        self._input_shapes = shapes
+        self._outputs = None
+        return self
+
+    def is_warm(self, shapes=None):
+        """True when the given (default: current) signature has a bound
+        executor whose forward program is already compiled — i.e. a
+        ``forward`` at this signature will not trigger a jit trace.  The
+        serving tier's bucket hit/miss accounting reads this."""
+        shapes = dict(self._input_shapes) if shapes is None else \
+            {k: tuple(v) for k, v in shapes.items()}
+        exe = self._exe_cache.get(self._sig(shapes))
+        return exe is not None and len(exe._fwd_cache) > 0
+
+    def compile_stats(self):
+        """{"executors": bound signatures, "fwd_entries": compiled forward
+        programs across them} — the serving harness diffs this around a
+        traffic run to prove zero post-warmup recompiles."""
+        return {
+            "executors": len(self._exe_cache),
+            "fwd_entries": sum(len(e._fwd_cache)
+                               for e in self._exe_cache.values()),
+        }
 
     # -- c_predict-style surface ----------------------------------------
     def set_input(self, name, value):
         """``MXPredSetInput``."""
-        from .ndarray.ndarray import array
-
         if name not in self._input_shapes:
             raise KeyError(f"unknown input {name!r}")
         self._exe.arg_dict[name][:] = _np.asarray(

@@ -73,6 +73,7 @@ __all__ = ["set_config", "start", "stop", "dump", "dumps", "pause", "resume",
            "recording_enabled", "process_info", "set_process_info",
            "update_clock_offset", "sample_clock_offset", "metrics_snapshot",
            "publish_peer_metrics", "peer_metrics", "forget_peer_metrics",
+           "register_metrics_provider", "unregister_metrics_provider",
            "render_prometheus",
            "start_metrics", "stop_metrics", "metrics_server_port",
            "straggler_report"]
@@ -167,6 +168,13 @@ _counters = {
     "metrics_snapshot": 0,            # metrics_snapshot() captures taken
     "metrics_scrape": 0,              # HTTP GETs served by the endpoint
     "straggler_detected": 0,          # cross-rank straggler attributions
+    "serving_request": 0,             # requests accepted by InferenceServer
+    "serving_batch": 0,               # dynamic batches dispatched
+    "serving_batch_requests": 0,      # requests carried by those batches
+    "serving_bucket_hit": 0,          # batches landing on a warm bucket
+    "serving_bucket_miss": 0,         # batches that had to bind/compile
+    "serving_slo_violation": 0,       # requests completing past their SLO
+    "serving_queue_depth_peak": 0,    # high-watermark of the request queue
 }
 _counter_lock = _threading.Lock()
 
@@ -654,6 +662,41 @@ def step_boundary():
 
 _metrics_seq = 0       # monotone per-process snapshot sequence number
 _peer_metrics = {}     # rank -> latest snapshot published by that rank
+_metrics_providers = {}  # key -> fn() -> flat {field: number} dict
+
+
+def register_metrics_provider(key, fn):
+    """Attach a subsystem gauge source to ``metrics_snapshot()``: ``fn``
+    must return a flat ``{field: number}`` dict, captured under
+    ``snapshot["providers"][key]`` and rendered by the Prometheus endpoint
+    as ``mxnet_<key>_<field>`` gauges.  The serving tier registers its
+    queue depth / latency percentiles here so every export surface
+    (JSONL, /metrics, heartbeat piggyback) carries serving health for
+    free.  Re-registering a key replaces the previous provider."""
+    with _counter_lock:
+        _metrics_providers[str(key)] = fn
+
+
+def unregister_metrics_provider(key):
+    """Detach a provider (``InferenceServer.close`` calls this so a dead
+    server's frozen gauges leave the scrape surface)."""
+    with _counter_lock:
+        _metrics_providers.pop(str(key), None)
+
+
+def _provider_metrics():
+    with _counter_lock:
+        providers = dict(_metrics_providers)
+    out = {}
+    for key, fn in providers.items():
+        try:
+            d = fn()
+        except Exception:
+            continue  # telemetry must never take serving down
+        if isinstance(d, dict):
+            out[key] = {str(k): v for k, v in d.items()
+                        if isinstance(v, (int, float)) or v is None}
+    return out
 
 
 def metrics_snapshot():
@@ -686,6 +729,7 @@ def metrics_snapshot():
             "wall_ms_max": max(walls) if walls else None,
         },
         "memory_watermark_bytes": memory_watermark(),
+        "providers": _provider_metrics(),
     }
 
 
@@ -794,6 +838,12 @@ def render_prometheus():
             gauge("mxnet_memory_watermark_bytes",
                   "peak device bytes_in_use observed at step boundaries",
                   base + (("device", dev),), b)
+        for pkey, fields in sorted((snap.get("providers") or {}).items()):
+            for field, v in sorted((fields or {}).items()):
+                gauge(f"mxnet_{pkey}_{field}",
+                      f"{pkey} subsystem gauge (registered metrics "
+                      "provider; see docs/serving.md)",
+                      base, v)
     for name, help_ in gauges:
         out.append(f"# HELP {name} {help_}")
         out.append(f"# TYPE {name} gauge")

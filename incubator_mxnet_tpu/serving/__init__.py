@@ -1,0 +1,23 @@
+"""``mx.serving`` — the inference serving tier (ISSUE 8).
+
+Continuous batching under a latency SLO on top of ``mx.predictor``:
+
+* :class:`InferenceServer` — thread-safe request queue + scheduler loop
+  forming dynamic batches (``max_batch_size`` / ``max_queue_ms``, early
+  dispatch when the oldest request would miss its deadline);
+* :class:`ShapeBucketer` — pad variable-length traffic up to a small
+  closed set of bucket shapes so every batch hits a warm compiled
+  ``Predictor`` entry (zero recompiles after warmup);
+* an AMP tier (``amp_dtype="bfloat16"``) routing the bound model through
+  ``amp.convert_model``;
+* full observability: ``serving.*`` spans, ``serving_*`` counters, and a
+  metrics provider feeding queue depth / p50-p99 latency into
+  ``profiler.metrics_snapshot()`` (and so the Prometheus endpoint).
+
+See docs/serving.md for the tour and benchmark/opperf/serving.py for the
+throughput-at-SLO harness.
+"""
+from .bucketing import ShapeBucketer
+from .server import InferenceServer, PendingResult
+
+__all__ = ["InferenceServer", "PendingResult", "ShapeBucketer"]

@@ -1,0 +1,72 @@
+"""Shape bucketing for the serving tier.
+
+Variable-length traffic is the enemy of a compiled inference path: every
+distinct input shape is its own XLA program, so naive serving recompiles
+on each new sequence length.  The fix (the Gemma-on-Cloud-TPU serving
+setup in PAPERS.md) is a **small closed set of bucket shapes**: requests
+are padded UP to the nearest bucket, so after one warmup pass every batch
+the scheduler forms lands on a warm, already-compiled
+``Predictor``/dispatch-cache entry.  Powers of two by default (amortized
+padding waste <= 2x, bucket count logarithmic in the max length),
+overridable with an explicit ladder when the traffic distribution is
+known.
+"""
+from __future__ import annotations
+
+import bisect
+
+__all__ = ["ShapeBucketer"]
+
+
+class ShapeBucketer:
+    """Map a length onto a fixed ascending ladder of bucket sizes.
+
+    Parameters
+    ----------
+    buckets : explicit ascending ladder (iterable of positive ints), or
+        None to derive powers of two.
+    max_length : largest length the ladder must cover (required when
+        ``buckets`` is None; with explicit buckets the ladder's top IS
+        the cover).
+    min_bucket : smallest derived bucket (default 8 — tinier buckets
+        multiply compiled programs for negligible padding savings).
+    """
+
+    def __init__(self, buckets=None, max_length=None, min_bucket=8):
+        if buckets is not None:
+            ladder = sorted({int(b) for b in buckets})
+            if not ladder or ladder[0] <= 0:
+                raise ValueError(f"buckets must be positive ints: {buckets!r}")
+        else:
+            if max_length is None or int(max_length) <= 0:
+                raise ValueError(
+                    "ShapeBucketer needs max_length to derive buckets")
+            max_length = int(max_length)
+            b = max(1, int(min_bucket))
+            ladder = []
+            while b < max_length:
+                ladder.append(b)
+                b *= 2
+            ladder.append(max_length)
+        self._buckets = tuple(ladder)
+
+    @property
+    def buckets(self):
+        return self._buckets
+
+    def bucket_for(self, length):
+        """Smallest bucket >= ``length``.  Raises ValueError past the top
+        of the ladder (the server surfaces this to the submitter — a
+        too-long request must fail loudly, not recompile)."""
+        length = int(length)
+        if length < 0:
+            raise ValueError(f"negative length {length}")
+        i = bisect.bisect_left(self._buckets, length)
+        if i == len(self._buckets):
+            raise ValueError(
+                f"length {length} exceeds the largest bucket "
+                f"{self._buckets[-1]} (buckets: {list(self._buckets)})")
+        return self._buckets[i]
+
+    def __repr__(self):
+        return f"ShapeBucketer(buckets={list(self._buckets)})"

@@ -3,7 +3,8 @@ import numpy as np
 
 import incubator_mxnet_tpu as mx
 import incubator_mxnet_tpu.symbol as S
-from incubator_mxnet_tpu.predictor import Predictor
+from incubator_mxnet_tpu.predictor import (Predictor, _split_param_key,
+                                           load_checkpoint)
 
 
 class TestPredictor:
@@ -44,6 +45,117 @@ class TestPredictor:
         pred.set_input("data", np.ones((2, 3), np.float32))
         pred.forward()
         assert pred.get_output(0).shape == (2, 4)
+
+
+class TestParamKeySplit:
+    """Satellite (ISSUE 8): only the literal ``arg:``/``aux:`` prefixes
+    are stripped — other colons belong to the parameter's name, and
+    prefixed / unprefixed checkpoints load identically."""
+
+    def test_split_rules(self):
+        assert _split_param_key("arg:weight") == ("arg", "weight")
+        assert _split_param_key("aux:moving_mean") == ("aux", "moving_mean")
+        assert _split_param_key("weight") == (None, "weight")
+        # a colon that is NOT an arg:/aux: prefix stays in the name
+        # (the old split(":", 1) mangled this into "weight")
+        assert _split_param_key("encoder:weight") == (None, "encoder:weight")
+        assert _split_param_key("arg:scope:weight") == ("arg", "scope:weight")
+
+    def _bn_model(self):
+        S.symbol._reset_naming()
+        data = S.var("data")
+        fc = S.FullyConnected(data, num_hidden=4, name="fc1")
+        sym = S.BatchNorm(fc, name="bn1")
+        rng = np.random.RandomState(0)
+        shapes, _, aux_shapes = sym.infer_shape(data=(2, 3))
+        args, auxs = {}, {}
+        for name, shp in zip(sym.list_arguments(), shapes):
+            if name != "data":
+                args[name] = rng.randn(*shp).astype(np.float32)
+        for name, shp in zip(sym.list_auxiliary_states(), aux_shapes):
+            auxs[name] = rng.rand(*shp).astype(np.float32)
+        return sym, args, auxs
+
+    def test_prefixed_and_bare_load_identically(self):
+        sym, args, auxs = self._bn_model()
+        x = np.random.RandomState(1).rand(2, 3).astype(np.float32)
+
+        prefixed = {f"arg:{k}": mx.nd.array(v) for k, v in args.items()}
+        prefixed.update({f"aux:{k}": mx.nd.array(v) for k, v in auxs.items()})
+        bare = {k: mx.nd.array(v) for k, v in args.items()}
+        bare.update({k: mx.nd.array(v) for k, v in auxs.items()})
+
+        out_p = Predictor(sym, prefixed, {"data": (2, 3)}).predict(data=x)
+        out_b = Predictor(sym, bare, {"data": (2, 3)}).predict(data=x)
+        np.testing.assert_array_equal(out_p, out_b)
+
+    def test_load_checkpoint_classifies_aux(self):
+        sym, args, auxs = self._bn_model()
+        bare = {k: mx.nd.array(v) for k, v in args.items()}
+        bare.update({k: mx.nd.array(v) for k, v in auxs.items()})
+        _, arg_d, aux_d = load_checkpoint(sym, bare)
+        assert set(aux_d) == set(auxs)
+        assert set(arg_d) == set(args)
+
+
+class TestSharedParamRebind:
+    """Satellite (ISSUE 8): rebinding for a new input shape shares the
+    parameter arrays — one device copy total — and ``reshape`` reuses a
+    previously bound executor outright."""
+
+    def _pred(self, tmp_path_or_none=None):
+        S.symbol._reset_naming()
+        data = S.var("data")
+        fc = S.FullyConnected(data, num_hidden=4, name="fc1")
+        sym = S.Activation(fc, act_type="tanh", name="t1")
+        rng = np.random.RandomState(0)
+        params = {"arg:fc1_weight": mx.nd.array(
+                      rng.randn(4, 3).astype(np.float32)),
+                  "arg:fc1_bias": mx.nd.array(
+                      rng.randn(4).astype(np.float32))}
+        return sym, params, Predictor(sym, params, {"data": (2, 3)})
+
+    def test_reshape_shares_param_objects(self):
+        _, _, pred = self._pred()
+        exe1 = pred._exe
+        w1 = exe1.arg_dict["fc1_weight"]
+        pred.reshape({"data": (8, 3)})
+        exe2 = pred._exe
+        assert exe2 is not exe1
+        # the SAME NDArray objects back both executors: no re-copy
+        assert exe2.arg_dict["fc1_weight"] is w1
+        assert exe2.arg_dict["fc1_bias"] is exe1.arg_dict["fc1_bias"]
+
+    def test_reshape_reuses_cached_executor(self):
+        _, _, pred = self._pred()
+        exe1 = pred._exe
+        pred.forward()
+        assert pred.is_warm()
+        pred.reshape({"data": (8, 3)})
+        pred.reshape({"data": (2, 3)})
+        assert pred._exe is exe1           # signature seen before: cache hit
+        assert pred.is_warm()              # jit cache rode along
+        assert pred.compile_stats()["executors"] == 2
+
+    def test_reshape_results_correct(self):
+        sym, params, pred = self._pred()
+        rng = np.random.RandomState(2)
+        x = rng.rand(8, 3).astype(np.float32)
+        out = pred.reshape({"data": (8, 3)}).predict(data=x)
+
+        exe = sym.simple_bind(data=(8, 3))
+        exe.arg_dict["data"][:] = x
+        for k, v in params.items():
+            exe.arg_dict[k.split(":", 1)[1]][:] = v.asnumpy()
+        ref = exe.forward(is_train=False)[0].asnumpy()
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+    def test_reshape_unknown_input_raises(self):
+        _, _, pred = self._pred()
+        import pytest
+
+        with pytest.raises(KeyError):
+            pred.reshape({"nope": (2, 3)})
 
 
 class TestConfig:

@@ -1,0 +1,412 @@
+"""Serving tier (ISSUE 8): continuous batching under a latency SLO.
+
+Covers the acceptance checklist: batch formation respects
+``max_batch_size`` + deadline dispatch, bucket padding round-trips exact
+results vs unbatched ``Predictor.forward``, the bf16 AMP tier stays
+within tolerance, the SLO-violation counter fires exactly once per late
+request, concurrent submitters get their own results back, and
+``close()`` drains the queue.
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+import incubator_mxnet_tpu.symbol as S
+from incubator_mxnet_tpu import profiler
+from incubator_mxnet_tpu.predictor import Predictor
+from incubator_mxnet_tpu.serving import InferenceServer, ShapeBucketer
+
+FEAT = 4
+HID = 6
+
+
+def _model(seed=0):
+    """Padding-safe per-position model: FC(flatten=False) + tanh over
+    (batch, length, FEAT) — parameter shapes are length-independent."""
+    S.symbol._reset_naming()
+    data = S.var("data")
+    fc = S.FullyConnected(data, num_hidden=HID, flatten=False, name="fc1")
+    sym = S.Activation(fc, act_type="tanh", name="t1")
+    rng = np.random.RandomState(seed)
+    params = {
+        "arg:fc1_weight": mx.nd.array(rng.randn(HID, FEAT).astype(np.float32)),
+        "arg:fc1_bias": mx.nd.array(rng.randn(HID).astype(np.float32)),
+    }
+    return sym, params
+
+
+def _server(sym, params, **kw):
+    kw.setdefault("max_batch_size", 4)
+    kw.setdefault("max_queue_ms", 50.0)
+    kw.setdefault("max_length", 16)
+    kw.setdefault("name", "serving_test")
+    return InferenceServer(sym, params, {"data": (None, FEAT)}, **kw)
+
+
+class TestShapeBucketer:
+    def test_power_of_two_ladder(self):
+        b = ShapeBucketer(max_length=100, min_bucket=8)
+        assert b.buckets == (8, 16, 32, 64, 100)
+
+    def test_explicit_buckets(self):
+        b = ShapeBucketer(buckets=[64, 16, 32])
+        assert b.buckets == (16, 32, 64)
+
+    def test_bucket_for_boundaries(self):
+        b = ShapeBucketer(buckets=[8, 16])
+        assert b.bucket_for(1) == 8
+        assert b.bucket_for(8) == 8
+        assert b.bucket_for(9) == 16
+        assert b.bucket_for(16) == 16
+
+    def test_too_long_raises(self):
+        b = ShapeBucketer(buckets=[8])
+        with pytest.raises(ValueError):
+            b.bucket_for(9)
+
+    def test_needs_max_length(self):
+        with pytest.raises(ValueError):
+            ShapeBucketer()
+
+
+class TestBatchFormation:
+    def test_full_batches_respect_max_batch_size(self):
+        sym, params = _model()
+        srv = _server(sym, params, max_batch_size=4, max_queue_ms=5000.0)
+        try:
+            rng = np.random.RandomState(1)
+            pendings = [srv.submit({"data": rng.rand(8, FEAT)
+                                    .astype(np.float32)})
+                        for _ in range(8)]
+            outs = [p.result(timeout=30.0) for p in pendings]
+            assert all(o.shape == (8, HID) for o in outs)
+            st = srv.stats()
+            assert st["batches"] == 2            # two full batches of 4
+            assert st["batch_requests"] == 8
+            # full batches dispatched immediately — nobody waited out the
+            # 5 s queueing deadline
+            assert max(p.latency_ms for p in pendings) < 2000.0
+        finally:
+            srv.close()
+
+    def test_deadline_dispatches_partial_batch(self):
+        sym, params = _model()
+        srv = _server(sym, params, max_batch_size=8, max_queue_ms=100.0)
+        try:
+            t0 = time.perf_counter()
+            out = srv.infer({"data": np.ones((3, FEAT), np.float32)},
+                            timeout=30.0)
+            elapsed = time.perf_counter() - t0
+            assert out.shape == (3, HID)
+            # a lone request cannot fill the batch: it must go out on its
+            # deadline, not hang until more traffic shows up
+            assert 0.05 <= elapsed < 10.0
+            assert srv.stats()["batches"] == 1
+        finally:
+            srv.close()
+
+    def test_mixed_lengths_split_into_bucket_groups(self):
+        sym, params = _model()
+        srv = _server(sym, params, max_batch_size=4, max_queue_ms=100.0,
+                      length_buckets=[8, 16])
+        try:
+            rng = np.random.RandomState(2)
+            pendings = [srv.submit({"data": rng.rand(L, FEAT)
+                                    .astype(np.float32)})
+                        for L in (3, 12, 5, 16)]
+            for p in pendings:
+                p.result(timeout=30.0)
+            st = srv.stats()
+            # one batch per length bucket: (3,5)->8 and (12,16)->16
+            assert st["batches"] == 2
+            assert st["batch_requests"] == 4
+        finally:
+            srv.close()
+
+    def test_past_deadline_head_beats_full_batches(self):
+        """A sustained flood of full batches in one length bucket must not
+        starve a past-deadline request in another bucket: the deadline
+        check outranks the full-batch preference."""
+        sym, params = _model()
+        srv = _server(sym, params, max_batch_size=2, max_queue_ms=50.0,
+                      length_buckets=[8, 16])
+        try:
+            rng = np.random.RandomState(8)
+            minority = srv.submit({"data": rng.rand(12, FEAT)
+                                   .astype(np.float32)})
+            stop = threading.Event()
+
+            def flood():  # keeps bucket-8 full batches always available
+                while not stop.is_set():
+                    ps = [srv.submit({"data": rng.rand(4, FEAT)
+                                      .astype(np.float32)})
+                          for _ in range(4)]
+                    for p in ps:
+                        p.result(timeout=30.0)
+
+            th = threading.Thread(target=flood, daemon=True)
+            th.start()
+            try:
+                out = minority.result(timeout=10.0)
+            finally:
+                stop.set()
+                th.join(30.0)
+            assert out.shape == (12, HID)
+        finally:
+            srv.close()
+
+    def test_submit_validates_inputs(self):
+        sym, params = _model()
+        srv = _server(sym, params, max_length=16)
+        try:
+            with pytest.raises(ValueError):   # too long for the top bucket
+                srv.submit({"data": np.ones((17, FEAT), np.float32)})
+            with pytest.raises(ValueError):   # wrong fixed dim
+                srv.submit({"data": np.ones((4, FEAT + 1), np.float32)})
+            with pytest.raises(ValueError):   # wrong input name
+                srv.submit({"nope": np.ones((4, FEAT), np.float32)})
+        finally:
+            srv.close()
+
+
+class TestExactness:
+    def _reference(self, sym, params, sample, bucket):
+        pred = Predictor(sym, params, {"data": (1, bucket, FEAT)})
+        buf = np.zeros((1, bucket, FEAT), np.float32)
+        buf[0, :sample.shape[0]] = sample
+        return pred.predict(data=buf)[0, :sample.shape[0]]
+
+    def test_padding_roundtrip_exact(self):
+        sym, params = _model()
+        srv = _server(sym, params, max_queue_ms=5.0, length_buckets=[8, 16])
+        try:
+            rng = np.random.RandomState(3)
+            for L in (2, 8, 11, 16):
+                x = rng.rand(L, FEAT).astype(np.float32)
+                out = srv.infer({"data": x}, timeout=30.0)
+                ref = self._reference(sym, params, x,
+                                      srv._len_bucketer.bucket_for(L))
+                assert out.shape == ref.shape
+                np.testing.assert_array_equal(out, ref)
+        finally:
+            srv.close()
+
+    def test_batched_rows_match_unbatched(self):
+        sym, params = _model()
+        srv = _server(sym, params, max_batch_size=4, max_queue_ms=2000.0)
+        try:
+            rng = np.random.RandomState(4)
+            xs = [rng.rand(5, FEAT).astype(np.float32) for _ in range(4)]
+            pendings = [srv.submit({"data": x}) for x in xs]
+            for x, p in zip(xs, pendings):
+                ref = self._reference(sym, params, x, 8)
+                np.testing.assert_allclose(p.result(timeout=30.0), ref,
+                                           rtol=0, atol=1e-6)
+        finally:
+            srv.close()
+
+    def test_bf16_tier_within_tolerance(self):
+        sym, params = _model()
+        srv32 = _server(sym, params, max_queue_ms=5.0, name="srv_fp32")
+        srv16 = _server(sym, params, max_queue_ms=5.0, name="srv_bf16",
+                        amp_dtype="bfloat16")
+        try:
+            rng = np.random.RandomState(5)
+            x = rng.rand(7, FEAT).astype(np.float32)
+            o32 = srv32.infer({"data": x}, timeout=30.0)
+            o16 = srv16.infer({"data": x}, timeout=30.0)
+            assert str(o16.dtype) == "bfloat16"
+            np.testing.assert_allclose(o32, o16.astype(np.float32),
+                                       rtol=0, atol=0.05)
+        finally:
+            srv32.close()
+            srv16.close()
+
+
+class TestObservability:
+    def test_slo_violation_exactly_once_per_late_request(self):
+        sym, params = _model()
+        # an SLO nothing can meet: every request is late exactly once
+        srv = _server(sym, params, max_queue_ms=5.0, slo_ms=1e-6)
+        try:
+            before = profiler.counters()["serving_slo_violation"]
+            n = 6
+            pendings = [srv.submit({"data": np.ones((4, FEAT), np.float32)})
+                        for _ in range(n)]
+            for p in pendings:
+                p.result(timeout=30.0)
+            after = profiler.counters()["serving_slo_violation"]
+            assert after - before == n
+            assert srv.stats()["slo_violations"] == n
+        finally:
+            srv.close()
+
+    def test_no_violation_under_generous_slo(self):
+        sym, params = _model()
+        srv = _server(sym, params, max_queue_ms=5.0, slo_ms=60_000.0)
+        try:
+            before = profiler.counters()["serving_slo_violation"]
+            srv.infer({"data": np.ones((4, FEAT), np.float32)}, timeout=30.0)
+            assert profiler.counters()["serving_slo_violation"] == before
+        finally:
+            srv.close()
+
+    def test_bucket_hits_after_warmup(self):
+        sym, params = _model()
+        srv = _server(sym, params, max_queue_ms=5.0)
+        try:
+            for _ in range(3):
+                srv.infer({"data": np.ones((4, FEAT), np.float32)},
+                          timeout=30.0)
+            st = srv.stats()
+            assert st["bucket_misses"] == 0
+            assert st["bucket_miss_after_warmup"] == 0
+            assert st["bucket_hits"] == 3
+        finally:
+            srv.close()
+
+    def test_metrics_provider_in_snapshot_and_prometheus(self):
+        sym, params = _model()
+        srv = _server(sym, params, max_queue_ms=5.0, name="srv_metrics")
+        try:
+            srv.infer({"data": np.ones((2, FEAT), np.float32)}, timeout=30.0)
+            snap = profiler.metrics_snapshot()
+            prov = snap["providers"]["srv_metrics"]
+            assert prov["completed"] >= 1
+            assert prov["latency_ms_p99"] is not None
+            text = profiler.render_prometheus()
+            assert "mxnet_srv_metrics_latency_ms_p99" in text
+            assert "mxnet_srv_metrics_queue_depth" in text
+        finally:
+            srv.close()
+        # a closed server leaves the scrape surface
+        assert "srv_metrics" not in profiler.metrics_snapshot()["providers"]
+
+    def test_spans_recorded(self, tmp_path):
+        sym, params = _model()
+        srv = _server(sym, params, max_queue_ms=5.0)
+        try:
+            profiler.set_config(filename=str(tmp_path / "trace.json"))
+            profiler.start()
+            srv.infer({"data": np.ones((2, FEAT), np.float32)}, timeout=30.0)
+            profiler.stop()
+            import json
+
+            path = profiler.dump()
+            with open(path) as f:
+                trace = json.load(f)
+            names = {e.get("name") for e in trace["traceEvents"]}
+            for want in ("serving.enqueue", "serving.batch_form",
+                         "serving.dispatch", "serving.complete"):
+                assert want in names, f"missing span {want}"
+        finally:
+            srv.close()
+
+
+class TestConcurrency:
+    def test_concurrent_submitters_get_their_own_results(self):
+        sym, params = _model()
+        srv = _server(sym, params, max_batch_size=4, max_queue_ms=20.0,
+                      length_buckets=[8, 16])
+        try:
+            lengths = {0: 3, 1: 8, 2: 11, 3: 16, 4: 5, 5: 13}
+            expected = {}
+            ref = TestExactness()
+            for tid, L in lengths.items():
+                x = np.full((L, FEAT), tid + 1, np.float32) / 10.0
+                expected[tid] = ref._reference(
+                    sym, params, x, srv._len_bucketer.bucket_for(L))
+            errors = []
+
+            def worker(tid):
+                L = lengths[tid]
+                x = np.full((L, FEAT), tid + 1, np.float32) / 10.0
+                for _ in range(4):
+                    out = srv.infer({"data": x}, timeout=30.0)
+                    if out.shape != expected[tid].shape or \
+                            not np.allclose(out, expected[tid], atol=1e-6):
+                        errors.append(tid)
+
+            threads = [threading.Thread(target=worker, args=(t,))
+                       for t in lengths]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(60.0)
+            assert not errors, f"cross-request leakage for threads {errors}"
+            assert srv.stats()["completed"] == 4 * len(lengths)
+        finally:
+            srv.close()
+
+
+class TestLifecycle:
+    def test_close_drains_queue(self):
+        sym, params = _model()
+        # deadline far away and batch never fills: only close() can flush
+        srv = _server(sym, params, max_batch_size=8, max_queue_ms=60_000.0)
+        rng = np.random.RandomState(6)
+        pendings = [srv.submit({"data": rng.rand(4, FEAT)
+                                .astype(np.float32)})
+                    for _ in range(5)]
+        srv.close()
+        for p in pendings:
+            assert p.done()
+            assert p.result(timeout=1.0).shape == (4, HID)
+        assert srv.stats()["completed"] == 5
+
+    def test_close_without_drain_fails_pending(self):
+        sym, params = _model()
+        srv = _server(sym, params, max_batch_size=8, max_queue_ms=60_000.0)
+        p = srv.submit({"data": np.ones((4, FEAT), np.float32)})
+        srv.close(drain=False)
+        # either the scheduler grabbed it before close, or it was failed;
+        # both are terminal — never a hang
+        try:
+            p.result(timeout=5.0)
+        except RuntimeError as e:
+            assert "closed" in str(e)
+
+    def test_submit_after_close_raises(self):
+        sym, params = _model()
+        srv = _server(sym, params)
+        srv.close()
+        with pytest.raises(RuntimeError):
+            srv.submit({"data": np.ones((4, FEAT), np.float32)})
+
+    def test_context_manager(self):
+        sym, params = _model()
+        with _server(sym, params, max_queue_ms=5.0) as srv:
+            out = srv.infer({"data": np.ones((2, FEAT), np.float32)},
+                            timeout=30.0)
+            assert out.shape == (2, HID)
+
+
+class TestFixedShapeInputs:
+    def test_no_variable_axis(self):
+        sym, params = _model()
+        srv = InferenceServer(sym, params, {"data": (3, FEAT)},
+                              max_batch_size=2, max_queue_ms=20.0,
+                              name="srv_fixed")
+        try:
+            x = np.random.RandomState(7).rand(3, FEAT).astype(np.float32)
+            out = srv.infer({"data": x}, timeout=30.0)
+            pred = Predictor(sym, params, {"data": (1, 3, FEAT)})
+            np.testing.assert_allclose(out, pred.predict(data=x[None])[0],
+                                       rtol=0, atol=1e-6)
+        finally:
+            srv.close()
+
+
+class TestBenchSmoke:
+    @pytest.mark.slow
+    def test_harness_smoke(self):
+        import benchmark.opperf.serving as bench
+
+        line = bench.run(n_requests=40, layers=1, feat=8, max_length=32,
+                         max_batch=4, slo_ms=100.0, smoke=True)
+        assert line["served"] is not None
+        assert not line["recompiles_after_warmup"]["served"]
+        assert line["recompiles_after_warmup"]["bucket_miss_after_warmup"] == 0

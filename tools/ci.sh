@@ -21,7 +21,11 @@
 #                  against the async PS with exactly-once accounting, the
 #                  2-worker chaos training acceptance run, and the
 #                  standalone-server SIGKILL+resume subprocess test
-#   8. tpu       — (opt-in: CI_TPU=1) on-chip correctness tier, needs a chip
+#   8. serving   — inference serving tier: the open-loop throughput-at-SLO
+#                  harness in --smoke mode (exits non-zero if any batch
+#                  recompiled after warmup — the bucket-miss regression
+#                  guard) plus the non-slow serving tests
+#   9. tpu       — (opt-in: CI_TPU=1) on-chip correctness tier, needs a chip
 #
 # The unit tier is split in two so each invocation fits a ~10 min shell on
 # a 1-core box (the full suite exceeds one 600 s window there); `unit` is
@@ -31,7 +35,7 @@
 # is ALSO written to ci_logs/last_summary.txt, so a round's evidence
 # survives a dead terminal.
 #
-# Usage:  tools/ci.sh [tier ...]   # default: unit1 unit2 zoo dist examples bench
+# Usage:  tools/ci.sh [tier ...]   # default: all but the opt-in tpu tier
 # Env:    CI_TPU=1 adds the tpu tier; CI_PYTEST_ARGS extra pytest flags.
 set -u -o pipefail
 
@@ -62,7 +66,7 @@ TIERS=()
 for t in "$@"; do
     if [ "$t" = unit ]; then TIERS+=(unit1 unit2); else TIERS+=("$t"); fi
 done
-[ ${#TIERS[@]} -eq 0 ] && TIERS=(unit1 unit2 zoo dist examples bench profiler chaos)
+[ ${#TIERS[@]} -eq 0 ] && TIERS=(unit1 unit2 zoo dist examples bench profiler chaos serving)
 [ "${CI_TPU:-0}" = "1" ] && TIERS+=(tpu)
 
 declare -A RESULT
@@ -147,6 +151,15 @@ for tier in "${TIERS[@]}"; do
             # schedules so a chaos failure reproduces exactly
             run_tier chaos "${CPU_ENV[@]}" env MXNET_FAULT_SEED=0 \
                 python -m pytest tests/test_chaos.py -q ${CI_PYTEST_ARGS:-}
+            ;;
+        serving)
+            # serving tier: the smoke harness IS the bucket-miss regression
+            # guard (non-zero exit if any batch bound/compiled after
+            # warmup), then the fast serving tests
+            run_tier serving "${CPU_ENV[@]}" bash -c '
+                set -e
+                python benchmark/opperf/serving.py --smoke >/dev/null
+                python -m pytest tests/test_serving.py -q -m "not slow" '"${CI_PYTEST_ARGS:-}"
             ;;
         tpu)
             # on-chip tier: runs under the ambient axon env (NOT cpu-cleaned)
