@@ -9,6 +9,7 @@ from .io import (
     CSVIter,
 )
 from .record_iter import ImageRecordIter
+from .pipeline import DataPipeline
 
 __all__ = [
     "DataDesc",
@@ -19,4 +20,5 @@ __all__ = [
     "PrefetchingIter",
     "CSVIter",
     "ImageRecordIter",
+    "DataPipeline",
 ]

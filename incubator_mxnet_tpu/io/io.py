@@ -138,28 +138,70 @@ def _init_data(data, allow_empty, default_name):
 
 class NDArrayIter(DataIter):
     """In-memory iterator (parity: ``mx.io.NDArrayIter``), incl.
-    ``last_batch_handle`` = 'pad' | 'discard' | 'roll_over' and shuffle."""
+    ``last_batch_handle`` = 'pad' | 'discard' | 'roll_over' and shuffle.
+
+    ``num_parts``/``part_index`` (the upstream record-iterator sharding
+    kwargs, shared with :class:`ImageRecordIter`) restrict the iterator to
+    one host's shard: the FULL index space is permuted with a seed every
+    host agrees on (``seed``; the RNG stream advances per epoch, so the
+    permutation is epoch-aware yet identical across hosts) and each part
+    takes a disjoint contiguous slice of it.  Uneven totals are an error
+    unless ``allow_pad=True``, which wraps the tail so every part sees
+    the same number of samples (SPMD hosts must agree on batch counts).
+    This is the single sharding surface ``io.DataPipeline`` plumbs
+    through."""
 
     def __init__(self, data, label=None, batch_size=1, shuffle=False,
                  last_batch_handle="pad", data_name="data",
-                 label_name="softmax_label"):
+                 label_name="softmax_label", num_parts=1, part_index=0,
+                 allow_pad=False, seed=0):
         super().__init__(batch_size)
         self.data = _init_data(data, allow_empty=False, default_name=data_name)
         self.label = _init_data(label, allow_empty=True, default_name=label_name)
-        self.num_data = self.data[0][1].shape[0]
+        self.num_parts = int(num_parts)
+        self.part_index = int(part_index)
+        if self.num_parts < 1 or not 0 <= self.part_index < self.num_parts:
+            raise ValueError(
+                f"part_index {part_index} out of range for num_parts "
+                f"{num_parts}")
+        total = self.data[0][1].shape[0]
+        self._total = total
+        if self.num_parts > 1:
+            if total % self.num_parts != 0 and not allow_pad:
+                raise ValueError(
+                    f"{total} samples do not divide evenly over "
+                    f"{self.num_parts} parts ({total % self.num_parts} "
+                    "left over); pass allow_pad=True to wrap the tail so "
+                    "every host sees the same number of samples")
+            self._part_n = -(-total // self.num_parts)  # ceil
+        else:
+            self._part_n = total
+        self.num_data = self._part_n
         self.shuffle = shuffle
         self.last_batch_handle = last_batch_handle
         self.cursor = -batch_size
         self._carry = _np.array([], dtype=_np.int64)  # roll_over leftovers
         self._consumed = 0  # index into _order just past the last returned batch
-        self._order = _np.arange(self.num_data)
         if last_batch_handle == "discard":
             self.num_batches = self.num_data // batch_size
         else:
             self.num_batches = (self.num_data + batch_size - 1) // batch_size
-        self._rng = _np.random.RandomState(0)
-        if shuffle:
-            self._rng.shuffle(self._order)
+        self._rng = _np.random.RandomState(seed)
+        self._order = self._epoch_order()
+
+    def _epoch_order(self):
+        """One epoch's index order for THIS part: permute the full index
+        space (advancing the shared RNG stream exactly once per epoch on
+        every host), then slice this part's window, wrapping modulo the
+        total when ``allow_pad`` made the parts oversized."""
+        base = _np.arange(self._total)
+        if self.shuffle:
+            self._rng.shuffle(base)
+        if self.num_parts == 1:
+            return base
+        pos = _np.arange(self.part_index * self._part_n,
+                         (self.part_index + 1) * self._part_n) % self._total
+        return base[pos]
 
     @property
     def provide_data(self):
@@ -186,9 +228,7 @@ class NDArrayIter(DataIter):
                 self._carry = _np.array([], dtype=_np.int64)
         self.cursor = -self.batch_size
         self._consumed = 0
-        base = _np.arange(self.num_data)
-        if self.shuffle:
-            self._rng.shuffle(base)
+        base = self._epoch_order()
         self._order = _np.concatenate([self._carry, base]) if len(self._carry) else base
 
     def iter_next(self):
@@ -289,17 +329,27 @@ class ResizeIter(DataIter):
 class PrefetchingIter(DataIter):
     """Double-buffer prefetch on a worker thread (parity:
     ``mx.io.PrefetchingIter`` / the C++ ThreadedIter — [U:src/io/
-    iter_prefetcher.h]).  Overlaps host batch prep with device compute."""
+    iter_prefetcher.h]).  Overlaps host batch prep with device compute.
 
-    def __init__(self, iters, rename_data=None, rename_label=None, depth=2):
+    Lifecycle: :meth:`close` (also the context-manager exit and
+    ``__del__``) stops and joins the worker — an iterator abandoned
+    mid-epoch no longer leaks its daemon thread and queued batches.
+    ``depth`` defaults from ``MXNET_IO_PREFETCH_DEPTH`` (2).  For a
+    device-resident mesh-sharded infeed use :class:`~incubator_mxnet_tpu.
+    io.pipeline.DataPipeline` instead (docs/input_pipeline.md)."""
+
+    def __init__(self, iters, rename_data=None, rename_label=None, depth=None):
         if not isinstance(iters, (list, tuple)):
             iters = [iters]
         super().__init__(iters[0].batch_size)
         if len(iters) != 1:
             raise NotImplementedError("composite prefetch not supported; pass one iter")
+        if depth is None:
+            depth = _profiler._env_int("MXNET_IO_PREFETCH_DEPTH", 2)
         self.data_iter = iters[0]
-        self._queue = _queue.Queue(maxsize=depth)
-        self._stop = threading.Event()
+        self._depth = max(1, depth)
+        self._queue = None
+        self._stop = None
         self._thread = None
         self.current_batch = None
         self._start()
@@ -312,8 +362,12 @@ class PrefetchingIter(DataIter):
     def provide_label(self):
         return self.data_iter.provide_label
 
-    def _worker(self):
-        while not self._stop.is_set():
+    def _worker(self, q, stop):
+        # q/stop are THIS generation's, captured at thread start: a worker
+        # that outlives a timed-out close() (stuck in data_iter.next())
+        # keeps talking to its orphaned queue and set stop flag, never to
+        # a restarted iterator's
+        while not stop.is_set():
             err = None
             try:
                 t0 = _perf() if _profiler._active else None
@@ -330,9 +384,9 @@ class PrefetchingIter(DataIter):
             # bounded put that notices reset(): never blocks forever with a
             # stale pre-reset batch (that race duplicated epoch tails)
             item = (batch, err)
-            while not self._stop.is_set():
+            while not stop.is_set():
                 try:
-                    self._queue.put(item, timeout=0.05)
+                    q.put(item, timeout=0.05)
                     break
                 except _queue.Full:
                     continue
@@ -340,28 +394,65 @@ class PrefetchingIter(DataIter):
                 return
 
     def _start(self):
-        self._thread = threading.Thread(target=self._worker, daemon=True)
+        self._queue = _queue.Queue(maxsize=self._depth)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._worker, args=(self._queue, self._stop), daemon=True)
         self._thread.start()
 
-    def reset(self):
+    def close(self, timeout=10.0):
+        """Stop the worker and drain its queue (no pre-close batch
+        survives).  Idempotent; safe after partial consumption — the
+        worker may be blocked on a full queue and is drained out.
+
+        BOUNDED: this also runs from ``__del__`` (possibly on the GC's
+        thread), so a worker stuck inside ``data_iter.next()`` — which
+        has no cancellation point — must not hang the caller forever.
+        Past ``timeout`` the daemon worker is abandoned with its stop
+        flag set and its (orphaned, per-generation) queue; it exits on
+        its own the moment the blocked ``next()`` returns."""
+        if self._thread is None:
+            return
         self._stop.set()
-        # drain until the worker exits so no pre-reset batch survives
-        while self._thread.is_alive():
+        # drain until the worker exits so no stale batch survives
+        deadline = _perf() + timeout
+        while self._thread.is_alive() and _perf() < deadline:
             try:
                 self._queue.get(timeout=0.05)
             except _queue.Empty:
                 pass
-        self._thread.join()
+        self._thread.join(timeout=max(0.0, deadline - _perf()))
+        self._thread = None
         try:
             while True:
                 self._queue.get_nowait()
         except _queue.Empty:
             pass
-        self._stop.clear()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        self.close()
+        return False
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    def reset(self):
+        self.close()
         self.data_iter.reset()
-        self._start()
+        self._start()  # fresh queue + stop event per generation
 
     def iter_next(self):
+        if self._thread is None:
+            # closed: the worker is joined and its queue drained — a
+            # blocking get() here would hang forever, never error
+            raise RuntimeError(
+                "PrefetchingIter is closed; call reset() to restart")
         batch, err = self._queue.get()
         if err is not None:
             raise err

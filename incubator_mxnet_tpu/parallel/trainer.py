@@ -212,10 +212,19 @@ class SPMDTrainer:
             sharding = NamedSharding(self._mesh, spec)
             if isinstance(a, jax.Array) and a.sharding == sharding:
                 out.append(a)  # idempotent: already staged on the mesh
-            elif jax.process_count() > 1:
+                continue       # (the io.DataPipeline fast path: batches
+                               # arrive device-resident, zero host work)
+            t0 = _perf() if _profiler._active else None
+            if jax.process_count() > 1:
                 out.append(jax.make_array_from_process_local_data(sharding, a))
             else:
                 out.append(jax.device_put(a, sharding))
+            if t0 is not None:
+                # bills the step's host bucket: a per-step transfer on the
+                # consumer thread is exactly the host-input wall the async
+                # infeed removes — its absence is asserted in tests
+                _profiler.record_span("spmd.shard_batch", "trainer", t0,
+                                      args={"bytes": int(a.nbytes)})
         return tuple(out)
 
     # ------------------------------------------------------------------

@@ -120,6 +120,15 @@ def _env_float(name, default):
         return default
 
 
+def _env_int(name, default):
+    # one parse rule for env knobs across the repo (serving, io): a typo'd
+    # value degrades to the default instead of raising
+    try:
+        return int(os.environ[name])
+    except (KeyError, ValueError):
+        return default
+
+
 def _tally(name, dur):
     # under the counter lock: an unlocked read-modify-write on the shared
     # dict drops tallies across concurrent scopes and lets dumps() observe
@@ -158,6 +167,10 @@ _counters = {
     "profiler_trace_error": 0,        # jax.profiler start/stop failures
     "slow_step_detected": 0,          # slow-step detector firings
     "io_prefetch_batches": 0,         # batches produced by prefetch workers
+    "io_pipeline_batches": 0,         # device-resident batches DataPipeline delivered
+    "io_pipeline_stalls": 0,          # consumer arrivals that found the buffer empty
+    "io_pipeline_depth_change": 0,    # autotuner depth raises + lowers
+    "io_pipeline_bytes": 0,           # host->device bytes the transfer thread moved
     "ps_retry": 0,                    # async-PS client request retries
     "ps_reconnect": 0,                # async-PS client reconnects
     "ps_dedup_hit": 0,                # duplicate requests the PS suppressed
@@ -316,6 +329,11 @@ _BUCKET_OF = {
     "dispatch.backward": "host",
     "bulk.flush": "host",
     "fused.group_apply": "host",
+    "io.wait": "host",           # consumer stalled on the infeed buffer —
+                                 # host time the step critically paid
+    "spmd.shard_batch": "host",  # per-step host->device transfer on the
+                                 # consumer thread (what DataPipeline
+                                 # exists to remove from the step)
     "kvstore.pushpull": "comms",
     "kvstore.push": "comms",
     "kvstore.pull": "comms",

@@ -30,7 +30,6 @@ contract.  Outputs are un-padded back per request (``unpad_output_axis``).
 """
 from __future__ import annotations
 
-import os
 import threading
 import time
 
@@ -48,13 +47,7 @@ _perf = time.perf_counter
 # one parse rule for env knobs across the repo: a typo'd value degrades
 # to the default instead of raising (profiler.py owns the float variant)
 _env_float = profiler._env_float
-
-
-def _env_int(name, default):
-    try:
-        return int(os.environ[name])
-    except (KeyError, ValueError):
-        return default
+_env_int = profiler._env_int
 
 
 class PendingResult:

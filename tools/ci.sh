@@ -25,7 +25,11 @@
 #                  harness in --smoke mode (exits non-zero if any batch
 #                  recompiled after warmup — the bucket-miss regression
 #                  guard) plus the non-slow serving tests
-#   9. tpu       — (opt-in: CI_TPU=1) on-chip correctness tier, needs a chip
+#   9. io        — input-pipeline tier: the synthetic host-bound harness in
+#                  --smoke mode (exits non-zero if the async infeed's
+#                  consumer stalled after warmup — the host-starvation
+#                  regression guard) plus the fast pipeline tests
+#  10. tpu       — (opt-in: CI_TPU=1) on-chip correctness tier, needs a chip
 #
 # The unit tier is split in two so each invocation fits a ~10 min shell on
 # a 1-core box (the full suite exceeds one 600 s window there); `unit` is
@@ -66,7 +70,7 @@ TIERS=()
 for t in "$@"; do
     if [ "$t" = unit ]; then TIERS+=(unit1 unit2); else TIERS+=("$t"); fi
 done
-[ ${#TIERS[@]} -eq 0 ] && TIERS=(unit1 unit2 zoo dist examples bench profiler chaos serving)
+[ ${#TIERS[@]} -eq 0 ] && TIERS=(unit1 unit2 zoo dist examples bench profiler chaos serving io)
 [ "${CI_TPU:-0}" = "1" ] && TIERS+=(tpu)
 
 declare -A RESULT
@@ -160,6 +164,16 @@ for tier in "${TIERS[@]}"; do
                 set -e
                 python benchmark/opperf/serving.py --smoke >/dev/null
                 python -m pytest tests/test_serving.py -q -m "not slow" '"${CI_PYTEST_ARGS:-}"
+            ;;
+        io)
+            # input-pipeline tier: the smoke harness IS the
+            # host-starvation regression guard (non-zero exit if the
+            # infeed's consumer stalled after warmup at the autotuned
+            # depth), then the fast pipeline tests
+            run_tier io "${CPU_ENV[@]}" bash -c '
+                set -e
+                python benchmark/opperf/input_pipeline.py --smoke >/dev/null
+                python -m pytest tests/test_io_pipeline.py -q -m "not slow" '"${CI_PYTEST_ARGS:-}"
             ;;
         tpu)
             # on-chip tier: runs under the ambient axon env (NOT cpu-cleaned)
