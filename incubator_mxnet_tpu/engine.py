@@ -554,11 +554,15 @@ class _BulkQueue:
                         _flush_jits.popitem(last=False)
                 else:
                     _flush_jits.move_to_end(graph_key)
+            compile_ms = None
+            t_c = _perf() if compiled_now else None
             try:
                 t_ex = t_flush and _perf()
                 # jax.jit is lazy: a fresh graph traces+compiles inside its
                 # first call, so that call is the "trace" phase, not execute
                 results = jitted(consts)
+                if compiled_now:
+                    compile_ms = (_perf() - t_c) * 1e3
                 if t_ex:
                     profiler.record_span(
                         "bulk.trace" if compiled_now else "bulk.execute",
@@ -603,6 +607,35 @@ class _BulkQueue:
                         if d is not None:
                             d._concrete = results[k]
                         k += 1
+            if compile_ms is not None:
+                # AFTER result wiring: a guard in raise mode must not
+                # leave the flushed deferreds unresolved.  Micro-graphs
+                # have no named arguments, so the signature is coarse —
+                # op mix + a graph digest (drift shows as a new graph).
+                # The digest covers only process-stable parts (op names,
+                # wiring, statics, liveness — NOT fn reprs or Python
+                # hash(), both of which differ across ranks/runs), so
+                # merged multi-rank compile reports see ONE graph id.
+                import zlib
+
+                mix = {}
+                stable = []
+                for op, lv in zip(ops, live):
+                    n = getattr(op.fn, "__name__", "?")
+                    mix[n] = mix.get(n, 0) + 1
+                    stable.append((n, op.wiring,
+                                   tuple(sorted(op.static_kw.items())),
+                                   tuple(op.dyn_kw), lv,
+                                   tuple((tuple(a.shape), str(a.dtype))
+                                         for a in (op.avals or ()))))
+                digest = zlib.crc32(repr(stable).encode())
+                profiler.record_compile("engine.bulk", {
+                    "__program__": "bulk",
+                    "ops": {"k": "static", "value": str(len(ops))},
+                    "graph": {"k": "static", "value": f"{digest:08x}"},
+                    "op_mix": {"k": "static", "value": ",".join(
+                        f"{n}x{c}" for n, c in sorted(mix.items()))[:120]},
+                }, compile_ms)
 
 
 def active_queue():

@@ -18,10 +18,13 @@ from __future__ import annotations
 
 import numpy as _np
 
+from time import perf_counter as _perf
+
 import jax
 import jax.numpy as jnp
 
 from . import autograd
+from . import profiler as _profiler
 from .base import _as_np_dtype
 from .context import current_context
 from .ndarray.ndarray import NDArray
@@ -76,6 +79,10 @@ class Executor:
         self._fwd_cache = {}
         self._bwd_cache = {}
         self._last_batch_sig = None
+        # compile-registry site label; the Predictor relabels its executors
+        # "predictor.forward" and the serving tier overrides both with a
+        # profiler.compile_site scope ("serving.warmup"/"serving.dispatch")
+        self._compile_site = "executor.forward"
         from .base import register_jit_cache_owner
         register_jit_cache_owner(self)
 
@@ -171,6 +178,14 @@ class Executor:
     def _signature(self, arrays):
         return tuple(sorted((k, v.shape, str(v.dtype)) for k, v in arrays.items()))
 
+    def _compile_signature(self, arrays, program):
+        """Compile-registry signature: every bound array by NAME, so a
+        recompile attributes the exact drifted input or parameter."""
+        sig = {"__program__": program}
+        for k in sorted(arrays):
+            sig[k] = _profiler.sig_array(arrays[k])
+        return sig
+
     # ------------------------------------------------------------------
     def forward(self, is_train=False, **kwargs):
         for k, v in kwargs.items():
@@ -184,7 +199,8 @@ class Executor:
         arrays = self._collect_inputs()
         sig = (self._signature(arrays), bool(is_train))
         fn = self._fwd_cache.get(sig)
-        if fn is None:
+        fresh = fn is None
+        if fresh:
             training = bool(is_train)
 
             def pure(var_arrays, key):
@@ -201,7 +217,20 @@ class Executor:
         # stochastic draws (dropout masks) as this forward — the reference
         # backprops through the cached forward, never a re-sampled one.
         self._last_key = get_key()
+        lowered = None
+        if fresh and _profiler.compile_cost_enabled():
+            try:  # AOT lowering purely for XLA cost accounting (opt-in)
+                lowered = fn.lower(arrays, self._last_key)
+            except Exception:
+                lowered = None
+        tc = _perf() if fresh else None
         outs, aux_updates = fn(arrays, self._last_key)
+        if tc is not None:
+            _profiler.record_compile(
+                self._compile_site,
+                self._compile_signature(
+                    arrays, "fwd_train" if is_train else "fwd"),
+                (_perf() - tc) * 1e3, lowered=lowered)
         self.outputs = [NDArray(o, ctx=self._ctx) for o in outs]
         for name, new in aux_updates.items():
             self._aux_dict[name]._data = new
@@ -217,7 +246,8 @@ class Executor:
             return
         sig = self._signature(arrays)
         fn = self._bwd_cache.get(sig)
-        if fn is None:
+        fresh = fn is None
+        if fresh:
 
             def pure_grads(var_arrays, key, cotangents):
                 push_traced_key(key)
@@ -251,7 +281,13 @@ class Executor:
         key = getattr(self, "_last_key", None)
         if key is None:  # backward without a prior forward
             key = get_key()
+        tc = _perf() if fresh else None
         grads = fn(arrays, key, out_grads)
+        if tc is not None:
+            _profiler.record_compile(
+                "executor.backward",
+                self._compile_signature(arrays, "bwd"),
+                (_perf() - tc) * 1e3)
         for name, g in grads.items():
             req = self._grad_req[name]
             tgt = self._grad_dict.get(name)

@@ -29,8 +29,11 @@ import threading
 import jax
 import numpy as _np
 
+from time import perf_counter as _perf
+
 from .. import autograd
 from .. import ndarray as nd_mod
+from .. import profiler as _profiler
 from ..context import current_context
 from ..engine import DeferredArray as _Deferred
 from ..ndarray.ndarray import NDArray
@@ -502,7 +505,8 @@ class HybridBlock(Block):
             training,
         )
         entry = self._cached_graph.get(key_sig)
-        if entry is None:
+        fresh = entry is None
+        if fresh:
             entry = self._build_cache(args, params, training)
             self._cached_graph[key_sig] = entry
         jit_fn, n_out, aux_params = entry
@@ -520,6 +524,7 @@ class HybridBlock(Block):
         def fn(*arrs, _jit=jit_fn, _key=key):
             return _jit(_key, *arrs)
 
+        tc = _perf() if fresh else None
         node = None
         if autograd.is_recording():
             raws = [a._data for a in all_inputs]
@@ -528,6 +533,15 @@ class HybridBlock(Block):
                 outs = fn(*raws)
         else:
             outs = fn(*(a._data for a in all_inputs))
+        if tc is not None:
+            sig = {"__program__":
+                   f"{self.name}:{'train' if training else 'eval'}"}
+            for i, (shape, dt) in enumerate(key_sig[0]):
+                sig[f"in{i}"] = {"k": "array", "shape": tuple(shape),
+                                 "dtype": dt}
+            sig["params"] = _profiler.sig_static(len(params))
+            _profiler.record_compile("block.cached_op", sig,
+                                     (_perf() - tc) * 1e3)
         outs = list(outs)
         aux_new = outs[n_out:]
         outs = outs[:n_out]

@@ -74,7 +74,8 @@ def _unflatten(flat, shapes):
 
     key = (tuple(shapes), str(flat.dtype))
     fn = _UNFLATTEN_CACHE.get(key)
-    if fn is None:
+    fresh = fn is None
+    if fresh:
         spans, off = [], 0
         for s in shapes:
             n = 1
@@ -87,7 +88,15 @@ def _unflatten(flat, shapes):
             return [buf[o:o + n].reshape(s) for o, n, s in spans]
 
         fn = _UNFLATTEN_CACHE[key] = jax.jit(split)
-    return fn(flat)
+    tc = _perf() if fresh else None
+    out = fn(flat)
+    if tc is not None:
+        _profiler.record_compile("kvstore.unflatten", {
+            "__program__": "unflatten",
+            "flat": _profiler.sig_array(flat),
+            "layout": _profiler.sig_static(list(shapes)),
+        }, (_perf() - tc) * 1e3)
+    return out
 
 
 _FLATTEN_JIT = None
@@ -95,7 +104,9 @@ _FLATTEN_JIT = None
 
 def _flatten(raws):
     # one persistent jitted gather: jit's own aval cache keys the per-bucket
-    # signatures (a fresh jit wrapper per call would recompile every step)
+    # signatures (a fresh jit wrapper per call would recompile every step);
+    # the profiler.jit_cache_size delta around the call is the exact O(1)
+    # did-this-compile probe feeding the compile registry
     global _FLATTEN_JIT
     if _FLATTEN_JIT is None:
         import jax
@@ -103,7 +114,15 @@ def _flatten(raws):
 
         _FLATTEN_JIT = jax.jit(
             lambda xs: jnp.concatenate([x.reshape(-1) for x in xs]))
-    return _FLATTEN_JIT(list(raws))
+    n0 = _profiler.jit_cache_size(_FLATTEN_JIT)
+    tc = _perf()
+    out = _FLATTEN_JIT(list(raws))
+    if n0 >= 0 and _profiler.jit_cache_size(_FLATTEN_JIT) > n0:
+        sig = {"__program__": "flatten"}
+        for i, r in enumerate(raws):
+            sig[f"x{i}"] = _profiler.sig_array(r)
+        _profiler.record_compile("kvstore.flatten", sig, (_perf() - tc) * 1e3)
+    return out
 
 
 def bucketed_pushpull(kv, items, cap_bytes=None):

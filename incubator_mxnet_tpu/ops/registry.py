@@ -387,6 +387,45 @@ def _incr(name):  # rebound to profiler.incr on first use (import-cycle dodge)
     _counters().incr(name)
 
 
+def _compile_tok(tok):
+    """Cache-key token -> compile-registry signature token (the key
+    already carries exactly what the compiled entry specializes on)."""
+    kind = tok[0]
+    if kind == "a" or kind == "ka":
+        off = 1 if kind == "a" else 2
+        t = {"k": "array", "shape": tuple(tok[off]),
+             "dtype": str(tok[off + 1])}
+        spec = getattr(tok[off + 3], "spec", None)
+        if spec is not None:
+            t["sharding"] = str(spec)
+        return t
+    if kind == "n":
+        return {"k": "array", "shape": tuple(tok[1]), "dtype": str(tok[2])}
+    off = 2 if kind == "ks" else 1
+    return {"k": "static", "value": repr(tok[off] if len(tok) == off + 1
+                                         else tok[off:])[:120]}
+
+
+def _compile_sig(fn, akey, kkey):
+    """Compile-registry signature for a level-1 cache entry: per-position
+    array/static tokens namespaced by the op (__program__), so a new op's
+    first compile is never misattributed as another op's recompile.  A
+    pytree argument ("t" token, e.g. add_n's array list) expands into one
+    entry per leaf — ``arg0[2]`` — so a drift inside the list attributes
+    at the leaf with its real kind (shape/dtype), not as an opaque
+    static-value change."""
+    sig = {"__program__": getattr(fn, "__name__", str(fn))}
+    for i, tok in enumerate(akey):
+        if tok[0] == "t":
+            for j, sub in enumerate(tok[2]):
+                sig[f"arg{i}[{j}]"] = _compile_tok(sub)
+        else:
+            sig[f"arg{i}"] = _compile_tok(tok)
+    for tok in kkey:
+        sig[str(tok[1])] = _compile_tok(tok)
+    return sig
+
+
 def _get_entry(fn, raw_args, kwargs):
     """Core lookup: returns (entry, dyn_args, dyn_kw_vals, key, fresh)
     when a compiled entry exists (counting a hit; ``fresh`` means this
@@ -475,6 +514,7 @@ def dispatch_eager(fn, raw_args, kwargs):
         _incr("dispatch_cache_hit")
     prof = _prof
     t0 = _perf() if (prof is not None and prof._active) else None
+    tc = _perf() if fresh else None
     try:
         out = entry.fwd(tuple(dyn), tuple(dkv))
     except Exception:
@@ -492,6 +532,11 @@ def dispatch_eager(fn, raw_args, kwargs):
     if t0 is not None:
         prof.record_span("dispatch.jit_compile" if fresh
                          else "dispatch.cache_hit", "dispatch", t0)
+    if fresh:
+        # compile registry AFTER the fallback try-block: a guard in raise
+        # mode must surface, not blacklist the entry as a jit failure
+        _counters().record_compile("ops.dispatch", _compile_sig(fn, akey, kkey),
+                                   (_perf() - tc) * 1e3)
     return out
 
 
@@ -579,6 +624,7 @@ def lookup_recorded(fn, raw_args, kwargs, needs):
 
     prof = _prof
     t0 = _perf() if (prof is not None and prof._active) else None
+    tc = _perf() if fresh else None
     try:
         out = entry.fwd(dyn, dkv)
     except Exception:
@@ -592,6 +638,10 @@ def lookup_recorded(fn, raw_args, kwargs, needs):
     if t0 is not None:
         prof.record_span("dispatch.jit_compile" if fresh
                          else "dispatch.cache_hit", "dispatch", t0)
+    if fresh:
+        _counters().record_compile("ops.dispatch",
+                                   _compile_sig(fn, key[1], key[2]),
+                                   (_perf() - tc) * 1e3)
     outs = out if isinstance(out, tuple) else (out,)
 
     bwd = entry.bwd.get(diff_pos)

@@ -30,7 +30,7 @@ from time import perf_counter as _perf
 from .. import engine as _engine
 from .. import profiler as _profiler
 from ..ops import optimizer_ops as K
-from .optimizer import SGD, NAG, Adam, AdamW, _swap
+from .optimizer import LAMB, NAG, RMSProp, SGD, Adam, AdamW, _swap
 
 __all__ = ["fused_update", "supports", "donation_enabled"]
 
@@ -82,22 +82,44 @@ def _select(opt, index, weight, state):
             return K.mp_adam_step, (mean, var, w32)
         mean, var = state
         return (K.adamw_step if t is AdamW else K.adam_step), (mean, var)
+    if t is RMSProp:
+        if mp:
+            return None  # base-class mp wrapper: per-tensor path
+        if opt.centered:
+            n, g_avg, delta = state
+            return K.rmspropalex_step, (n, g_avg, delta)
+        return K.rmsprop_step, (state,)
+    if t is LAMB:
+        if mp:
+            return None
+        mean, var = state
+        return K.lamb_step, (mean, var)
     return None
 
 
 def supports(opt):
     """Whether this optimizer instance has fused group kernels at all."""
-    return type(opt) in (SGD, NAG, Adam, AdamW)
+    return type(opt) in (SGD, NAG, Adam, AdamW, RMSProp, LAMB)
 
 
 def _scalars(opt):
     S = {"rescale": opt.rescale_grad, "clip": opt.clip_gradient}
-    if type(opt) in (SGD, NAG):
+    t = type(opt)
+    if t in (SGD, NAG):
         S["momentum"] = opt.momentum
+    elif t is RMSProp:
+        S["rho"], S["epsilon"] = opt.rho, opt.epsilon
+        if opt.centered:
+            S["momentum"] = opt.momentum
+    elif t is LAMB:
+        S["beta1"], S["beta2"] = opt.beta1, opt.beta2
+        S["epsilon"] = opt.epsilon
+        S["lower_bound"], S["upper_bound"] = opt.lower_bound, opt.upper_bound
+        S["bias_correction"] = 1.0 if opt.bias_correction else 0.0
     else:
         S["beta1"], S["beta2"] = opt.beta1, opt.beta2
         S["epsilon"] = opt.epsilon
-        if type(opt) is AdamW:
+        if t is AdamW:
             S["eta"] = opt.eta
     return S
 
@@ -153,10 +175,21 @@ def fused_update(optimizer, items, states):
                 ws = [_concrete(w) for _, w, _, _ in chunk]
                 gs = [_concrete(g) for _, _, g, _ in chunk]
                 t0 = _perf() if _profiler._active else None
-                new_w, new_s = K.group_apply(
-                    step, ws, gs,
-                    [[s._data for s in flat] for _, _, _, flat in chunk],
-                    lrs, wds, ts, scalars, donate=donate)
+                guard_err = None
+                try:
+                    new_w, new_s = K.group_apply(
+                        step, ws, gs,
+                        [[s._data for s in flat] for _, _, _, flat in chunk],
+                        lrs, wds, ts, scalars, donate=donate)
+                except _profiler.CompileGuardError as e:
+                    # the compile guard fired AFTER the dispatch: the old
+                    # buffers are already donated, so wire the new ones in
+                    # below and re-raise once the group is consistent
+                    res = getattr(e, "group_result", None)
+                    if res is None:
+                        raise
+                    new_w, new_s = res
+                    guard_err = e
                 if t0 is not None:
                     _profiler.record_span("fused.group_apply", "optimizer",
                                           t0, args={"params": len(chunk)})
@@ -166,6 +199,8 @@ def fused_update(optimizer, items, states):
                         _swap(s_nd, s_new)
                 _profiler.incr("fused_step_call")
                 _profiler.incr("fused_step_params", len(chunk))
+                if guard_err is not None:
+                    raise guard_err  # every buffer re-wired: safe to surface
     if rest:
         _profiler.incr("fused_step_fallback_params", len(rest))
     return rest

@@ -160,6 +160,8 @@ class SPMDTrainer:
 
         self._t = self._optimizer.begin_num_update
         self._step_cache = {}
+        self._guard_armed = False   # steady-state compile guard armed after
+                                    # the first compiled step completes
         from ..base import register_jit_cache_owner
         register_jit_cache_owner(self)
         if jax.process_count() > 1:
@@ -227,6 +229,23 @@ class SPMDTrainer:
                                       args={"bytes": int(a.nbytes)})
         return tuple(out)
 
+    def _compile_sig(self, arrays, program):
+        """Compile-registry signature for a step build: named batch inputs
+        (the recompile-attribution targets) + the parameter count."""
+        sig = {"__program__": program, "label": _profiler.sig_array(arrays[-1]),
+               "params": _profiler.sig_static(len(self._params))}
+        for i, a in enumerate(arrays[:-1]):
+            sig[f"input{i}"] = _profiler.sig_array(a)
+        return sig
+
+    def _post_step(self):
+        # the guard arms AFTER the first compiled step: everything later
+        # is steady state — recompiles from here on are counted (and
+        # escalated per MXNET_COMPILE_GUARD)
+        if not self._guard_armed:
+            self._guard_armed = True
+            _profiler.arm_compile_guard("spmd.trainer")
+
     # ------------------------------------------------------------------
     def step(self, data, label, batch_size=None):
         """Run one fused train step; returns the scalar loss (NDArray).
@@ -240,7 +259,8 @@ class SPMDTrainer:
             batch_size = arrays[0].shape[0]
         sig = tuple((a.shape, str(a.dtype)) for a in arrays)
         fn = self._step_cache.get(sig)
-        if fn is None:
+        fresh = fn is None
+        if fresh:
             fn = self._build_step(arrays)
             self._step_cache[sig] = fn
         self._t += 1
@@ -248,23 +268,30 @@ class SPMDTrainer:
         lr = self.learning_rate()
         rescale = self._optimizer.rescale_grad / batch_size
         key = get_key()
+        call_args = (key, jnp.float32(self._t), jnp.float32(lr),
+                     jnp.float32(rescale), self._param_arrays,
+                     self._opt_states, *arrays)
+        lowered = None
+        if fresh and _profiler.compile_cost_enabled():
+            try:  # AOT lowering for XLA cost accounting (opt-in: the
+                lowered = fn.lower(*call_args)  # real call compiles again)
+            except Exception:
+                lowered = None
+        tc = _perf() if fresh else None
         t0 = _perf() if _profiler._active else None
         try:
-            new_params, new_states, loss = fn(
-                key,
-                jnp.float32(self._t),
-                jnp.float32(lr),
-                jnp.float32(rescale),
-                self._param_arrays,
-                self._opt_states,
-                *arrays,
-            )
+            new_params, new_states, loss = fn(*call_args)
             self._param_arrays = new_params
             self._opt_states = new_states
+            if tc is not None:
+                _profiler.record_compile(
+                    "spmd.step", self._compile_sig(arrays, "step"),
+                    (_perf() - tc) * 1e3, lowered=lowered)
             if t0 is not None:
                 _profiler.record_span("spmd.step", "trainer", t0)
         finally:
             _profiler.step_boundary()
+        self._post_step()
         return NDArray(loss)
 
     # ------------------------------------------------------------------
@@ -290,7 +317,8 @@ class SPMDTrainer:
             batch_size = arrays[0].shape[0]
         sig = (tuple((a.shape, str(a.dtype)) for a in arrays), int(k))
         fn = self._step_cache.get(sig)
-        if fn is None:
+        fresh = fn is None
+        if fresh:
             fn = self._build_bulk(arrays, int(k))
             self._step_cache[sig] = fn
         ts, lrs, keys = [], [], []
@@ -301,24 +329,31 @@ class SPMDTrainer:
             lrs.append(self.learning_rate())
             keys.append(get_key())
         rescale = self._optimizer.rescale_grad / batch_size
+        call_args = (jnp.stack(keys), jnp.asarray(ts, jnp.float32),
+                     jnp.asarray(lrs, jnp.float32), jnp.float32(rescale),
+                     self._param_arrays, self._opt_states, *arrays)
+        lowered = None
+        if fresh and _profiler.compile_cost_enabled():
+            try:
+                lowered = fn.lower(*call_args)
+            except Exception:
+                lowered = None
+        tc = _perf() if fresh else None
         t0 = _perf() if _profiler._active else None
         try:
-            new_params, new_states, loss = fn(
-                jnp.stack(keys),
-                jnp.asarray(ts, jnp.float32),
-                jnp.asarray(lrs, jnp.float32),
-                jnp.float32(rescale),
-                self._param_arrays,
-                self._opt_states,
-                *arrays,
-            )
+            new_params, new_states, loss = fn(*call_args)
             self._param_arrays = new_params
             self._opt_states = new_states
+            if tc is not None:
+                _profiler.record_compile(
+                    "spmd.step", self._compile_sig(arrays, f"step_bulk[{k}]"),
+                    (_perf() - tc) * 1e3, lowered=lowered)
             if t0 is not None:
                 _profiler.record_span("spmd.step_bulk", "trainer", t0,
                                       args={"k": int(k)})
         finally:
             _profiler.step_boundary()  # one boundary per dispatch, not per k
+        self._post_step()
         return NDArray(loss)
 
     def _build_bulk(self, example_arrays, k):

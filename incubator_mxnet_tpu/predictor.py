@@ -152,6 +152,10 @@ class Predictor:
             auxs[name] = nd
         exe = Executor(self._sym, self._ctx, args=args, grad_req="null",
                        aux_states=auxs)
+        # compile-registry attribution: a compile triggered by a predictor
+        # bind reports as the predictor's, not a bare executor's (the
+        # serving tier further overrides via profiler.compile_site)
+        exe._compile_site = "predictor.forward"
         self._exe_cache[sig] = exe
         return exe
 
@@ -211,6 +215,20 @@ class Predictor:
         if self._outputs is None:
             raise RuntimeError("call forward() first")
         return self._outputs[index].asnumpy()
+
+    def num_outputs(self):
+        """``MXPredGetOutputShape``-adjacent: how many outputs the bound
+        graph produces (the serving tier sizes its per-output unpadding
+        spec from this)."""
+        return len(self._sym._outputs)
+
+    def get_outputs(self):
+        """Numpy copies of ALL outputs of the last ``forward()`` (the
+        multi-output serving path; ``get_output`` stays the single-output
+        c_predict surface)."""
+        if self._outputs is None:
+            raise RuntimeError("call forward() first")
+        return [o.asnumpy() for o in self._outputs]
 
     def predict(self, **inputs):
         """Convenience: set all inputs, forward, return output 0."""

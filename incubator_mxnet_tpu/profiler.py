@@ -44,6 +44,13 @@ Since ISSUE 7 the profiler is **cluster-aware**:
   snapshots and names the slowest rank with its host/comms/device split
   (**straggler attribution** — ``straggler_report()``).
 
+Since ISSUE 10 the profiler also owns **compilation observability**: a
+process-wide compile registry every jit site reports into
+(``record_compile``), per-recompile attribution naming the exact drifted
+argument, XLA cost accounting, and a steady-state compile guard
+(``MXNET_COMPILE_GUARD``) — see the Compilation observability section
+below and ``tools/compile_report.py``.
+
 Counters are **strict** since ISSUE 5: ``incr`` on an undeclared name
 raises (a typo'd instrumentation site fails loudly instead of reporting
 zeros forever); extensions register theirs via ``declare_counter()``.
@@ -58,6 +65,7 @@ import gzip as _gzip
 import json
 import logging
 import os
+from collections import OrderedDict as _OrderedDict
 import socket as _socket
 import threading as _threading
 import time
@@ -76,7 +84,13 @@ __all__ = ["set_config", "start", "stop", "dump", "dumps", "pause", "resume",
            "register_metrics_provider", "unregister_metrics_provider",
            "render_prometheus",
            "start_metrics", "stop_metrics", "metrics_server_port",
-           "straggler_report"]
+           "straggler_report",
+           # -- compilation observability (ISSUE 10) --
+           "record_compile", "compile_site", "compile_registry",
+           "compile_stats", "reset_compiles", "sig_array", "sig_static",
+           "diff_signatures", "compile_cost_enabled", "jit_cache_size",
+           "arm_compile_guard", "disarm_compile_guard", "compile_guard_state",
+           "compile_guard_paused", "CompileGuardError"]
 
 _logger = logging.getLogger(__name__)
 
@@ -188,6 +202,9 @@ _counters = {
     "serving_bucket_miss": 0,         # batches that had to bind/compile
     "serving_slo_violation": 0,       # requests completing past their SLO
     "serving_queue_depth_peak": 0,    # high-watermark of the request queue
+    "compile_total": 0,               # jit compilations across every site
+    "compile_ms_total": 0,            # wall ms those compilations cost
+    "recompile_steady_state": 0,      # compiles after the guard armed
 }
 _counter_lock = _threading.Lock()
 
@@ -602,6 +619,7 @@ def step_boundary():
     device-memory watermarks, and advances the step id every subsequent
     span inherits.  No-op while the profiler is inactive."""
     global _step_id, _step_t0, _step_thread
+    _guard_tick()  # compile-guard warmup countdown is tracing-independent
     if not _active:
         return
     now = _perf()
@@ -1049,6 +1067,518 @@ def straggler_report():
 
 
 # ---------------------------------------------------------------------------
+# Compilation observability (ISSUE 10): global compile registry, recompile
+# attribution, XLA cost accounting, steady-state compile guard
+# ---------------------------------------------------------------------------
+
+# "Compile the program, not the ops" only pays off while programs actually
+# stop compiling.  Every jit site in the repo (dispatch cache, engine bulk
+# flush, SPMD step, executor/predictor binds, serving warmup, kvstore
+# flatten/unflatten, fused optimizer group_apply, hybridized CachedOp)
+# reports each compilation here through ONE helper — record_compile() —
+# with the full input signature, so the registry can answer "what compiled,
+# why, and what did it cost":
+#
+# * a compile at a site that already holds a signature for the same
+#   program is a RECOMPILE: the new signature is diffed against the
+#   nearest cached one and the exact offending argument is named (shape
+#   drift / dtype flip / new static value / sharding change) in a
+#   ``compile.recompile`` span + one structured log line;
+# * where the site can hand over a ``jax.stages.Lowered``, XLA's
+#   ``cost_analysis()`` (FLOPs / bytes accessed) and ``memory_analysis()``
+#   (executable footprint) ride along (``MXNET_COMPILE_COST=1`` lets
+#   lazily-jitted sites lower once more just for the accounting);
+# * a **steady-state guard** turns "no recompiles after warmup" from a
+#   benchmark convention into an enforced property: once armed (by
+#   ``serving.InferenceServer.start()`` post-warmup, by ``SPMDTrainer``
+#   after its first step, or automatically after
+#   ``MXNET_COMPILE_WARMUP_STEPS`` step boundaries), every further compile
+#   bumps ``recompile_steady_state``; with ``MXNET_COMPILE_GUARD=warn`` it
+#   also logs ONE warning, with ``=raise`` it raises CompileGuardError.
+#
+# tools/compile_report.py summarizes a dump by site; a ``compile``
+# metrics provider feeds per-site stats into metrics_snapshot() ->
+# JSONL / Prometheus.  See docs/observability.md#compilation-observability.
+
+
+class CompileGuardError(RuntimeError):
+    """A jit compilation happened while the steady-state compile guard was
+    armed and ``MXNET_COMPILE_GUARD=raise`` (a recompilation storm caught
+    at its first stall instead of pages of slow-step logs)."""
+
+
+_compile_lock = _threading.Lock()
+_compile_records = []      # bounded FIFO of per-compile record dicts
+_compile_sites = {}        # site -> {"count","ms","recompiles","sigs"}
+_MAX_COMPILE_RECORDS = _env_int("MXNET_COMPILE_LOG_SIZE", 4096)
+_MAX_SITE_SIGS = 128       # per-site LRU of cached signatures to diff against
+_site_tls = _threading.local()   # .stack of compile_site() label overrides
+
+_guard = {
+    "armed": False,        # record_compile counts steady-state violations
+    "armed_by": None,      # "serving" / "spmd.trainer" / "warmup_steps" / ...
+    "warned": False,       # warn mode fires exactly once per arming
+    "boundaries": 0,       # step boundaries seen toward the warmup auto-arm
+    "paused": 0,           # compile_guard_paused() nesting depth
+}
+
+
+def _guard_mode():
+    """None (off), "warn" or "raise".  ``set_config(compile_guard=...)``
+    wins over MXNET_COMPILE_GUARD: "warn"/"raise" select a mode, any
+    OTHER non-None value (``"off"``, ``False``) forces the guard off even
+    with the env var exported; ``None`` (the default) defers to the
+    env."""
+    v = _config.get("compile_guard")
+    if v is None:
+        v = os.environ.get("MXNET_COMPILE_GUARD") or None
+    if v in ("warn", "raise"):
+        return v
+    return None
+
+
+def _guard_warmup_steps():
+    v = _config.get("compile_warmup_steps")
+    if v is None:
+        return _env_int("MXNET_COMPILE_WARMUP_STEPS", 32)
+    return int(v)
+
+
+def jit_cache_size(fn):
+    """pjit's aval-cache size for a jitted callable — THE exact, O(1)
+    did-this-call-compile probe for sites whose one persistent jit
+    wrapper is shared across signatures (kvstore flatten, fused
+    group_apply): a cache growth across a call IS one compile.  Returns
+    -1 when the private ``_cache_size`` API is unavailable, in which case
+    callers must skip recording (under-reporting a site beats fabricating
+    phantom compiles that could trip a raise-mode guard on a cache
+    hit)."""
+    try:
+        return fn._cache_size()
+    except Exception:
+        return -1
+
+
+def compile_cost_enabled():
+    """Whether lazily-jitted sites should lower a second time purely for
+    XLA cost accounting (``MXNET_COMPILE_COST=1`` /
+    ``set_config(compile_cost=True)``).  Off by default: the extra
+    ``fn.lower()`` roughly doubles each site's compile wall time."""
+    v = _config.get("compile_cost")
+    if v is None:
+        return os.environ.get("MXNET_COMPILE_COST", "0") == "1"
+    return bool(v)
+
+
+def arm_compile_guard(source="manual"):
+    """Arm the steady-state compile guard: from now on every compilation
+    reported to the registry counts as a steady-state violation
+    (``recompile_steady_state``), and ``MXNET_COMPILE_GUARD=warn|raise``
+    escalates.  ``serving.InferenceServer.start()`` arms it after bucket
+    warmup; ``SPMDTrainer`` after its first compiled step."""
+    with _compile_lock:
+        if not _guard["armed"]:
+            _guard["armed"] = True
+            _guard["armed_by"] = source
+
+
+def disarm_compile_guard():
+    """Disarm the guard and reset its warn-once latch (tests; re-warming a
+    model after a deliberate shape change)."""
+    with _compile_lock:
+        _guard["armed"] = False
+        _guard["armed_by"] = None
+        _guard["warned"] = False
+        _guard["boundaries"] = 0
+
+
+def compile_guard_state():
+    with _compile_lock:
+        return {"armed": _guard["armed"], "armed_by": _guard["armed_by"],
+                "mode": _guard_mode(), "paused": _guard["paused"] > 0,
+                "warmup_steps": _guard_warmup_steps(),
+                "boundaries": _guard["boundaries"]}
+
+
+class compile_guard_paused:
+    """``with profiler.compile_guard_paused():`` — compilations inside the
+    block are registered but not judged (a declared re-warm phase, e.g.
+    rebinding a server for a new bucket ladder)."""
+
+    def __enter__(self):
+        with _compile_lock:
+            _guard["paused"] += 1
+        return self
+
+    def __exit__(self, *a):
+        with _compile_lock:
+            _guard["paused"] -= 1
+        return False
+
+
+def _guard_tick():
+    """Count one step boundary toward the MXNET_COMPILE_WARMUP_STEPS
+    auto-arm (runs on every boundary, profiler active or not — the guard
+    is independent of tracing)."""
+    if _guard["armed"] or _guard_mode() is None:
+        return
+    with _compile_lock:
+        _guard["boundaries"] += 1
+        if _guard["boundaries"] >= _guard_warmup_steps():
+            _guard["armed"] = True
+            _guard["armed_by"] = "warmup_steps"
+
+
+class compile_site:
+    """``with profiler.compile_site('serving.warmup'):`` — nested
+    ``record_compile`` calls on this thread report under the given site
+    label instead of their own (innermost wins).  The serving tier wraps
+    its bucket warmup and its dispatch path so an executor compile is
+    attributed to the serving phase that triggered it."""
+
+    __slots__ = ("_label",)
+
+    def __init__(self, label):
+        self._label = str(label)
+
+    def __enter__(self):
+        st = getattr(_site_tls, "stack", None)
+        if st is None:
+            st = _site_tls.stack = []
+        st.append(self._label)
+        return self
+
+    def __exit__(self, *a):
+        _site_tls.stack.pop()
+        return False
+
+
+def _active_site(site):
+    st = getattr(_site_tls, "stack", None)
+    return st[-1] if st else site
+
+
+# -- signature tokens --------------------------------------------------------
+# A compile signature is a flat dict ``{arg_name: token}`` where a token is
+# either an array descriptor or a static-value descriptor; the optional
+# "__program__" entry namespaces signatures within a site (two different
+# ops compiled by the dispatch cache are different programs, not a
+# recompile of one another).  Sites build tokens with sig_array/sig_static
+# so the diff below can classify drift precisely.
+
+
+def sig_array(a):
+    """Signature token for an array-like argument: shape, dtype, and (for
+    mesh-sharded arrays) the partition spec."""
+    try:
+        tok = {"k": "array", "shape": tuple(int(d) for d in a.shape),
+               "dtype": str(a.dtype)}
+    except Exception:
+        return sig_static(type(a).__name__)
+    spec = getattr(getattr(a, "sharding", None), "spec", None)
+    if spec is not None:
+        tok["sharding"] = str(spec)
+    return tok
+
+
+def sig_static(v):
+    """Signature token for a static (baked-into-the-trace) value."""
+    return {"k": "static", "value": repr(v)[:120]}
+
+
+def _tok_str(tok):
+    if not isinstance(tok, dict):
+        return str(tok)
+    if tok.get("k") == "array":
+        s = "x".join(str(d) for d in tok.get("shape", ()))
+        out = f"{tok.get('dtype', '?')}[{s}]"
+        if "sharding" in tok:
+            out += f"@{tok['sharding']}"
+        return out
+    return str(tok.get("value"))
+
+
+_DRIFT_NAMES = {"shape": "shape drift", "dtype": "dtype flip",
+                "static": "new static value", "sharding": "sharding change",
+                "kind": "array/static kind change", "added": "new argument",
+                "removed": "argument removed"}
+
+
+def diff_signatures(old, new):
+    """Classify what changed between two compile signatures.  Returns a
+    list of findings ``{"arg", "kind", "old", "new"}`` where kind is one
+    of shape / dtype / sharding / static / kind / added / removed —
+    the vocabulary of the recompile attribution line."""
+    findings = []
+    for name in sorted(set(old) | set(new)):
+        if name == "__program__":
+            continue
+        o, n = old.get(name), new.get(name)
+        if o == n:
+            continue
+        if o is None or n is None:
+            findings.append({"arg": name,
+                             "kind": "added" if o is None else "removed",
+                             "old": _tok_str(o) if o else None,
+                             "new": _tok_str(n) if n else None})
+            continue
+        o = o if isinstance(o, dict) else {"k": "static", "value": str(o)}
+        n = n if isinstance(n, dict) else {"k": "static", "value": str(n)}
+        if o.get("k") != n.get("k"):
+            kind = "kind"
+        elif o.get("k") == "array":
+            if tuple(o.get("shape", ())) != tuple(n.get("shape", ())):
+                kind = "shape"
+            elif o.get("dtype") != n.get("dtype"):
+                kind = "dtype"
+            else:
+                kind = "sharding"
+        else:
+            kind = "static"
+        findings.append({"arg": name, "kind": kind,
+                         "old": _tok_str(o), "new": _tok_str(n)})
+    return findings
+
+
+def _attribution_line(findings):
+    if not findings:
+        return "identical signature recompiled (jit cache evicted?)"
+    f = findings[0]
+    line = (f"argument {f['arg']!r}: {_DRIFT_NAMES.get(f['kind'], f['kind'])}"
+            f" {f['old']} -> {f['new']}")
+    if len(findings) > 1:
+        line += f" (+{len(findings) - 1} more drifted)"
+    return line
+
+
+def _sig_key(signature):
+    return repr(sorted(
+        (k, sorted(v.items()) if isinstance(v, dict) else v)
+        for k, v in signature.items()))
+
+
+def _sig_similarity(a, b):
+    """Field-granular similarity score used to pick the NEAREST cached
+    signature a recompile is diffed against: an exact argument match
+    scores 4, a partially-matching array token scores 1 per equal
+    subfield (shape / dtype / sharding)."""
+    score = 0
+    for k, av in a.items():
+        bv = b.get(k)
+        if bv is None:
+            continue
+        if av == bv:
+            score += 4
+        elif (isinstance(av, dict) and isinstance(bv, dict)
+                and av.get("k") == "array" and bv.get("k") == "array"):
+            score += (tuple(av.get("shape", ())) == tuple(bv.get("shape", ())))
+            score += (av.get("dtype") == bv.get("dtype"))
+            score += (av.get("sharding") == bv.get("sharding"))
+    return score
+
+
+def _extract_cost(lowered):
+    """Best-effort XLA cost/memory accounting from a ``Lowered`` (or
+    already-``Compiled``) stage.  Returns a flat dict or None; never
+    raises (accounting must not take the compiling site down)."""
+    try:
+        compiled = lowered.compile() if hasattr(lowered, "compile") else lowered
+    except Exception:
+        return None
+    out = {}
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        if ca:
+            if "flops" in ca:
+                out["flops"] = float(ca["flops"])
+            if "bytes accessed" in ca:
+                out["bytes_accessed"] = float(ca["bytes accessed"])
+    except Exception:
+        pass
+    try:
+        ma = compiled.memory_analysis()
+        for src, dst in (("temp_size_in_bytes", "temp_bytes"),
+                         ("argument_size_in_bytes", "argument_bytes"),
+                         ("output_size_in_bytes", "output_bytes"),
+                         ("generated_code_size_in_bytes", "code_bytes")):
+            v = getattr(ma, src, None)
+            if v is not None:
+                out[dst] = int(v)
+    except Exception:
+        pass
+    return out or None
+
+
+def record_compile(site, signature, wall_ms, fn=None, args=None, kwargs=None,
+                   lowered=None):
+    """Report one jit compilation into the process-wide compile registry.
+
+    Parameters
+    ----------
+    site : str — the compiling subsystem (``"ops.dispatch"``,
+        ``"spmd.step"``, ...); a surrounding :class:`compile_site` scope
+        overrides it.
+    signature : dict name -> :func:`sig_array`/:func:`sig_static` token
+        (+ optional ``"__program__"`` namespacing distinct programs at one
+        site).  THE unit recompile attribution diffs.
+    wall_ms : float — wall time of the compiling call (trace + compile +
+        first execution for lazily-jitted sites).
+    fn, args, kwargs : optional jitted callable + example arguments; when
+        :func:`compile_cost_enabled`, the helper lowers once more to
+        extract XLA cost/memory analysis.  ``lowered`` short-circuits that
+        with a site-provided ``Lowered``/``Compiled`` stage.
+
+    Returns the record dict appended to the registry.  In guard raise
+    mode this RAISES CompileGuardError after the bookkeeping — call it
+    outside any except-and-fallback block.
+    """
+    site = _active_site(str(site))
+    signature = dict(signature or {})
+    program = signature.get("__program__")
+    wall_ms = float(wall_ms)
+    if lowered is None and fn is not None and compile_cost_enabled():
+        try:
+            lowered = fn.lower(*(args or ()), **(kwargs or {}))
+        except Exception:
+            lowered = None
+    cost = _extract_cost(lowered) if lowered is not None else None
+
+    key = _sig_key(signature)
+    now = _perf()
+    with _compile_lock:
+        ent = _compile_sites.setdefault(
+            site, {"count": 0, "ms": 0.0, "recompiles": 0,
+                   "sigs": _OrderedDict()})
+        sigs = ent["sigs"]
+        recompile = False
+        findings = []
+        if key in sigs:
+            # the site compiled a signature it had already compiled: its
+            # own cache (or jax's) dropped the entry — still a recompile
+            recompile = True
+            sigs.move_to_end(key)
+        else:
+            peers = [s for s in sigs.values()
+                     if s.get("__program__") == program]
+            if peers:
+                recompile = True
+                # nearest cached signature at FIELD granularity (a dtype
+                # flip should diff against the same-shape signature, not
+                # whichever was cached first); newest wins ties
+                nearest = max(reversed(peers),
+                              key=lambda s: _sig_similarity(s, signature))
+                findings = diff_signatures(nearest, signature)
+            sigs[key] = signature
+            while len(sigs) > _MAX_SITE_SIGS:
+                sigs.popitem(last=False)
+        ent["count"] += 1
+        ent["ms"] += wall_ms
+        if recompile:
+            ent["recompiles"] += 1
+        armed = _guard["armed"] and _guard["paused"] == 0
+        attribution = _attribution_line(findings) if recompile else None
+        rec = {"site": site, "program": program, "signature": signature,
+               "wall_ms": round(wall_ms, 3), "step": _step_id,
+               "time_unix": time.time(), "recompile": recompile,
+               "attribution": attribution, "findings": findings,
+               "steady_state": armed, "cost": cost}
+        _compile_records.append(rec)
+        while len(_compile_records) > _MAX_COMPILE_RECORDS:
+            _compile_records.pop(0)
+    incr("compile_total")
+    incr("compile_ms_total", int(round(wall_ms)))
+    if armed:
+        incr("recompile_steady_state")
+    if _active:
+        t0 = now - wall_ms / 1e3
+        record_span("compile.jit", "compile", t0, now,
+                    args={"site": site, "wall_ms": round(wall_ms, 3),
+                          "program": program})
+        if recompile:
+            record_span("compile.recompile", "compile", now, now,
+                        args={"site": site, "attribution": attribution})
+    if recompile:
+        # THE attribution line: one structured log naming the exact
+        # offending argument, whatever the guard mode
+        _logger.info("recompile at %s%s: %s (wall %.1f ms, step %d)",
+                     site, f" [{program}]" if program else "", attribution,
+                     wall_ms, rec["step"])
+    if armed:
+        mode = _guard_mode()
+        if mode == "raise":
+            raise CompileGuardError(
+                f"steady-state compile guard (armed by "
+                f"{_guard['armed_by']}): {site} compiled "
+                f"{'— ' + attribution if attribution else 'a new program'} "
+                f"after warmup (wall {wall_ms:.1f} ms)")
+        if mode == "warn":
+            with _compile_lock:
+                first = not _guard["warned"]
+                _guard["warned"] = True
+            if first:
+                _logger.warning(
+                    "steady-state compile guard (armed by %s): %s compiled "
+                    "after warmup%s (wall %.1f ms) — further violations "
+                    "count in recompile_steady_state without logging",
+                    _guard["armed_by"], site,
+                    f" — {attribution}" if attribution else "", wall_ms)
+    return rec
+
+
+def compile_registry():
+    """Snapshot of the compile registry: ``{"sites": {site: {count, ms,
+    recompiles, signatures}}, "records": [...]}`` — what ``dump()`` embeds
+    under ``otherData.compiles`` and ``tools/compile_report.py`` reads."""
+    with _compile_lock:
+        sites = {s: {"count": e["count"], "ms": round(e["ms"], 3),
+                     "recompiles": e["recompiles"],
+                     "signatures": len(e["sigs"])}
+                 for s, e in _compile_sites.items()}
+        records = [dict(r) for r in _compile_records]
+    return {"sites": sites, "records": records}
+
+
+def compile_stats():
+    """Per-site compile summary only (no per-record detail)."""
+    return compile_registry()["sites"]
+
+
+def reset_compiles():
+    """Drop every compile record and cached signature (tests; a fresh
+    measurement window).  Guard state is separate — see
+    :func:`disarm_compile_guard`."""
+    with _compile_lock:
+        _compile_records.clear()
+        _compile_sites.clear()
+
+
+def _compile_provider():
+    """Built-in ``compile`` metrics provider: per-site compile counts and
+    wall totals as flat gauges (``mxnet_compile_<site>_total`` etc.)."""
+    out = {}
+    with _compile_lock:
+        total = ms = rec = 0
+        for site, e in _compile_sites.items():
+            k = site.replace(".", "_")
+            out[f"{k}_total"] = e["count"]
+            out[f"{k}_ms"] = round(e["ms"], 3)
+            out[f"{k}_recompiles"] = e["recompiles"]
+            total += e["count"]
+            ms += e["ms"]
+            rec += e["recompiles"]
+    out["total"] = total
+    out["ms_total"] = round(ms, 3)
+    out["recompiles"] = rec
+    out["guard_armed"] = 1 if _guard["armed"] else 0
+    return out
+
+
+register_metrics_provider("compile", _compile_provider)
+
+
+# ---------------------------------------------------------------------------
 # Control surface
 # ---------------------------------------------------------------------------
 
@@ -1058,8 +1588,12 @@ def set_config(**kwargs):
     ignored (the reference has many backend-specific flags).  Meaningful
     keys here: ``filename``, ``ring_size``, ``slow_step_ms``,
     ``slow_step_auto``, ``slow_step_auto_mult``, ``step_window``,
-    ``memory_sampling``.  ``ring_size`` takes effect at the NEXT
-    ``start()`` — live rings keep the capacity they were built with."""
+    ``memory_sampling``, plus the compile-observability knobs
+    ``compile_guard`` ("warn"/"raise"/None — overrides
+    MXNET_COMPILE_GUARD), ``compile_warmup_steps`` and ``compile_cost``
+    (overrides MXNET_COMPILE_COST).  ``ring_size`` takes effect at the
+    NEXT ``start()`` — live rings keep the capacity they were built
+    with."""
     global _telemetry, _active, _step_t0
     _config.update(kwargs)
     if "slow_step_ms" in kwargs:
@@ -1244,6 +1778,8 @@ def dump(finished=True, profile_process="worker"):
             "steps": step_stats(),
             "memory_watermark_bytes": memory_watermark(),
             "recorder": recorder_stats(),
+            "compiles": compile_registry(),
+            "compile_guard": compile_guard_state(),
             "xprof_dir": _state["dir"],
         },
     }
@@ -1357,6 +1893,15 @@ def dumps(reset=False):
         lines.append("Device memory watermark (bytes_in_use peak):")
         for dev, b in sorted(wm.items()):
             lines.append(f"{dev:<40}{b:>16}")
+    csites = compile_stats()
+    if csites:
+        lines.append("")
+        lines.append("Compilations (per jit site; see compile_report.py):")
+        lines.append(f"{'Site':<28}{'Count':>8}{'Total(ms)':>12}"
+                     f"{'Recompiles':>12}")
+        for s, e in sorted(csites.items(), key=lambda kv: -kv[1]["ms"]):
+            lines.append(f"{s:<28}{e['count']:>8}{e['ms']:>12.1f}"
+                         f"{e['recompiles']:>12}")
     if _state["dir"]:
         dev = _device_op_stats(_state["dir"])
         if dev:
@@ -1376,6 +1921,7 @@ def dumps(reset=False):
             _step_window.clear()
             _mem_watermark.clear()
         reset_counters()
+        reset_compiles()
     return "\n".join(lines)
 
 

@@ -124,9 +124,14 @@ class InferenceServer:
         through ``amp.convert_model`` at bind time (per-server tier).
     input_dtypes : dict name -> numpy dtype of the batch buffers
         (default float32 for every input).
-    unpad_output_axis : axis of a PER-SAMPLE output slice to cut back to
-        the request's true length; ``"auto"`` = axis 0 when any input is
-        variable-length, else no un-padding; None disables.
+    unpad_output_axis : per-output axis spec cutting each PER-SAMPLE
+        output slice back to the request's true length.  ``"auto"`` =
+        axis 0 for every output when any input is variable-length, else
+        no un-padding; ``None`` disables; an int applies to every output;
+        a sequence gives one axis (or None) per output in graph order; a
+        dict maps output index -> axis (unlisted outputs are not
+        un-padded).  Multi-output models return a LIST of arrays per
+        request (single-output models keep returning the bare array).
     pad_value : fill for padded positions/rows (default 0.0).
     name : metrics-provider key (``providers[name]`` in
         ``metrics_snapshot()``; Prometheus gauges ``mxnet_<name>_*``).
@@ -182,7 +187,9 @@ class InferenceServer:
             raise ValueError("batch_buckets must cover max_batch_size")
         if unpad_output_axis == "auto":
             unpad_output_axis = 0 if self._has_variable else None
-        self._unpad_axis = unpad_output_axis
+        self._unpad_spec = unpad_output_axis
+        self._unpad_axes = None   # resolved per-output at first dispatch
+                                  # (output count known only post-bind)
         self._dtypes = {iname: _np.dtype((input_dtypes or {}).get(
             iname, "float32")) for iname in self._spec}
 
@@ -201,6 +208,12 @@ class InferenceServer:
                                self._shapes_for(
                                    self._batch_bucketer.buckets[0], first_lb),
                                dev_type=dev_type, dev_id=dev_id)
+        # a sequence-form unpad spec can be checked NOW (the symbol knows
+        # its output count): a misconfiguration must fail at construction,
+        # not poison every batch from the scheduler thread
+        if (self._unpad_spec is not None
+                and not isinstance(self._unpad_spec, (int, dict))):
+            self._unpad_for(self._pred.num_outputs())
 
         # -- queue / scheduler state -----------------------------------
         self._lock = threading.Lock()
@@ -249,12 +262,22 @@ class InferenceServer:
         if self._do_warmup:
             lbs = (self._len_bucketer.buckets
                    if self._len_bucketer else (0,))
-            for bb in self._batch_bucketer.buckets:
-                for lb in lbs:
-                    self._pred.reshape(self._shapes_for(bb, lb))
-                    self._pred.forward()
-                    self._warm.add((bb, lb))
+            # warmup compiles are expected and declared: they register in
+            # the compile registry under their own site AND are exempt
+            # from a guard another subsystem may already have armed
+            with profiler.compile_site("serving.warmup"), \
+                    profiler.compile_guard_paused():
+                for bb in self._batch_bucketer.buckets:
+                    for lb in lbs:
+                        self._pred.reshape(self._shapes_for(bb, lb))
+                        self._pred.forward()
+                        self._warm.add((bb, lb))
         self._warm_done = True
+        if self._do_warmup:
+            # the bucket set is closed and compiled: any further compile
+            # is a steady-state violation (MXNET_COMPILE_GUARD escalates).
+            # warmup=False opted out of that contract, so no auto-arm.
+            profiler.arm_compile_guard("serving")
         self._thread = threading.Thread(
             target=self._loop, name=f"mxtpu-{self.name}-scheduler",
             daemon=True)
@@ -354,6 +377,28 @@ class InferenceServer:
         self._queue = [r for r in self._queue if id(r) not in taken]
         return chosen
 
+    def _unpad_for(self, n_outputs):
+        """Resolve ``unpad_output_axis`` into one axis-or-None per output
+        (cached; the output count is only known after the first bind)."""
+        axes = self._unpad_axes
+        if axes is not None and len(axes) == n_outputs:
+            return axes
+        spec = self._unpad_spec
+        if spec is None:
+            axes = (None,) * n_outputs
+        elif isinstance(spec, int):
+            axes = (spec,) * n_outputs
+        elif isinstance(spec, dict):
+            axes = tuple(spec.get(i) for i in range(n_outputs))
+        else:
+            axes = tuple(spec)
+            if len(axes) != n_outputs:
+                raise ValueError(
+                    f"unpad_output_axis has {len(axes)} entries but the "
+                    f"model produces {n_outputs} outputs")
+        self._unpad_axes = axes
+        return axes
+
     def _loop(self):
         while True:
             batch = None
@@ -410,8 +455,16 @@ class InferenceServer:
                     self._miss_after_warmup += 1
 
         t_disp = _perf()
-        self._pred.reshape(shapes)
-        out = self._pred.predict(**arrays)
+        # compile-registry attribution: a bind/compile triggered by live
+        # traffic reports as serving.dispatch — in steady state this site
+        # must never appear (the guard armed at start() enforces it)
+        with profiler.compile_site("serving.dispatch"):
+            self._pred.reshape(shapes)
+            for iname, buf in arrays.items():
+                self._pred.set_input(iname, buf)
+            self._pred.forward()
+        outs = self._pred.get_outputs()
+        unpad = self._unpad_for(len(outs))
         self._warm.add(key)
         if profiler._active:
             profiler.record_span(
@@ -424,11 +477,16 @@ class InferenceServer:
         t_done = _perf()
         lats = []
         for i, r in enumerate(reqs):
-            res = out[i]
-            if self._unpad_axis is not None and r.length is not None:
-                sl = [slice(None)] * res.ndim
-                sl[self._unpad_axis] = slice(0, r.length)
-                res = res[tuple(sl)]
+            slices = []
+            for out, axis in zip(outs, unpad):
+                res = out[i]
+                if axis is not None and r.length is not None:
+                    sl = [slice(None)] * res.ndim
+                    sl[axis] = slice(0, r.length)
+                    res = res[tuple(sl)]
+                slices.append(res)
+            # single-output models keep the bare-array contract
+            res = slices[0] if len(slices) == 1 else slices
             lat_ms = (t_done - r.t_enqueue) * 1e3
             lats.append(lat_ms)
             if lat_ms > self.slo_ms:

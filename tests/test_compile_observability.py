@@ -1,0 +1,485 @@
+"""Compilation observability (ISSUE 10): the global compile registry,
+recompile attribution (each drift kind named exactly), the steady-state
+compile guard (warn fires once, raise raises), per-site wiring of the jit
+sites, serving warmup accounting, and the compile_report CLI."""
+import io
+import json
+import logging
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import engine, profiler
+from incubator_mxnet_tpu.gluon import nn
+import incubator_mxnet_tpu.symbol as S
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def clean_compiles():
+    """Fresh registry + disarmed guard before AND after (module-global
+    state; a leftover armed guard would tag every later test's compiles
+    as steady-state violations)."""
+    profiler.reset_compiles()
+    profiler.disarm_compile_guard()
+    profiler.set_config(compile_guard=None, compile_warmup_steps=None,
+                        compile_cost=None)
+    profiler.reset_counters()
+    yield
+    profiler.reset_compiles()
+    profiler.disarm_compile_guard()
+    profiler.set_config(compile_guard=None, compile_warmup_steps=None,
+                        compile_cost=None)
+    profiler.reset_counters()
+
+
+def _arr(shape, dtype="float32", sharding=None):
+    tok = {"k": "array", "shape": tuple(shape), "dtype": dtype}
+    if sharding is not None:
+        tok["sharding"] = sharding
+    return tok
+
+
+class TestSignatureDiff:
+    """Each drift kind must be named correctly — the attribution contract."""
+
+    def test_shape_drift(self):
+        f = profiler.diff_signatures({"x": _arr((4, 8))}, {"x": _arr((4, 16))})
+        assert f == [{"arg": "x", "kind": "shape",
+                      "old": "float32[4x8]", "new": "float32[4x16]"}]
+
+    def test_dtype_flip(self):
+        f = profiler.diff_signatures({"x": _arr((4, 8))},
+                                     {"x": _arr((4, 8), "bfloat16")})
+        assert f[0]["kind"] == "dtype" and f[0]["arg"] == "x"
+
+    def test_static_value_drift(self):
+        f = profiler.diff_signatures({"k": profiler.sig_static(3)},
+                                     {"k": profiler.sig_static(4)})
+        assert f == [{"arg": "k", "kind": "static", "old": "3", "new": "4"}]
+
+    def test_sharding_change(self):
+        f = profiler.diff_signatures(
+            {"x": _arr((4, 8), sharding="PartitionSpec('dp',)")},
+            {"x": _arr((4, 8), sharding="PartitionSpec(None,)")})
+        assert f[0]["kind"] == "sharding"
+
+    def test_added_and_removed(self):
+        f = profiler.diff_signatures({"a": _arr((2,))},
+                                     {"b": _arr((2,))})
+        kinds = {x["arg"]: x["kind"] for x in f}
+        assert kinds == {"a": "removed", "b": "added"}
+
+    def test_program_key_ignored(self):
+        assert profiler.diff_signatures({"__program__": "f"},
+                                        {"__program__": "g"}) == []
+
+
+class TestRecordCompile:
+    def test_first_compile_is_not_a_recompile(self, clean_compiles):
+        r = profiler.record_compile("t.site", {"__program__": "p",
+                                               "x": _arr((2, 2))}, 5.0)
+        assert not r["recompile"] and r["attribution"] is None
+        assert profiler.counters()["compile_total"] == 1
+        assert profiler.counters()["compile_ms_total"] == 5
+
+    def test_recompile_names_exact_argument(self, clean_compiles):
+        profiler.record_compile("t.site", {"__program__": "p",
+                                           "a": _arr((2, 2)),
+                                           "b": _arr((3, 3))}, 1.0)
+        r = profiler.record_compile("t.site", {"__program__": "p",
+                                               "a": _arr((2, 2)),
+                                               "b": _arr((3, 5))}, 1.0)
+        assert r["recompile"]
+        assert "argument 'b'" in r["attribution"]
+        assert "shape drift" in r["attribution"]
+        assert "'a'" not in r["attribution"]
+
+    def test_different_program_is_not_a_recompile(self, clean_compiles):
+        profiler.record_compile("t.site", {"__program__": "p",
+                                           "x": _arr((2, 2))}, 1.0)
+        r = profiler.record_compile("t.site", {"__program__": "q",
+                                               "x": _arr((4, 4))}, 1.0)
+        assert not r["recompile"]
+
+    def test_nearest_signature_wins(self, clean_compiles):
+        # dtype flip must diff against the SAME-shape cached signature,
+        # not the older different-shape one
+        profiler.record_compile("t.site", {"__program__": "p",
+                                           "x": _arr((4, 8))}, 1.0)
+        profiler.record_compile("t.site", {"__program__": "p",
+                                           "x": _arr((4, 16))}, 1.0)
+        r = profiler.record_compile(
+            "t.site", {"__program__": "p",
+                       "x": _arr((4, 16), "bfloat16")}, 1.0)
+        assert "dtype flip" in r["attribution"]
+        assert "float32[4x16]" in r["attribution"]
+
+    def test_identical_signature_recompile(self, clean_compiles):
+        sig = {"__program__": "p", "x": _arr((2, 2))}
+        profiler.record_compile("t.site", sig, 1.0)
+        r = profiler.record_compile("t.site", sig, 1.0)
+        assert r["recompile"]
+        assert "evicted" in r["attribution"]
+
+    def test_compile_site_override(self, clean_compiles):
+        with profiler.compile_site("outer.phase"):
+            r = profiler.record_compile("inner.site", {"x": _arr((1,))}, 1.0)
+        assert r["site"] == "outer.phase"
+        r2 = profiler.record_compile("inner.site", {"x": _arr((1,))}, 1.0)
+        assert r2["site"] == "inner.site"
+
+    def test_registry_and_provider(self, clean_compiles):
+        profiler.record_compile("prov.site", {"x": _arr((1,))}, 2.0)
+        reg = profiler.compile_registry()
+        assert reg["sites"]["prov.site"]["count"] == 1
+        assert len(reg["records"]) == 1
+        prov = profiler.metrics_snapshot()["providers"]["compile"]
+        assert prov["prov_site_total"] == 1
+        assert prov["total"] == 1
+
+    def test_dump_embeds_registry(self, clean_compiles, tmp_path):
+        profiler.set_config(filename=str(tmp_path / "t.json"))
+        profiler.record_compile("d.site", {"x": _arr((1,))}, 2.0)
+        profiler.start()
+        path = profiler.dump()
+        with open(path) as f:
+            doc = json.load(f)
+        assert "d.site" in doc["otherData"]["compiles"]["sites"]
+        assert doc["otherData"]["compile_guard"]["armed"] is False
+
+    def test_cost_extraction_opt_in(self, clean_compiles):
+        import jax
+        import jax.numpy as jnp
+
+        f = jax.jit(lambda x: (x @ x).sum())
+        x = jnp.ones((16, 16))
+        f(x)
+        r = profiler.record_compile("c.site", {"x": profiler.sig_array(x)},
+                                    1.0, fn=f, args=(x,))
+        assert r["cost"] is None  # off by default
+        profiler.set_config(compile_cost=True)
+        r2 = profiler.record_compile("c.site", {"x": profiler.sig_array(x),
+                                                "v": profiler.sig_static(2)},
+                                     1.0, fn=f, args=(x,))
+        assert r2["cost"] and r2["cost"]["flops"] > 0
+
+
+class TestCompileGuard:
+    def test_counts_only_when_armed(self, clean_compiles):
+        profiler.record_compile("g.site", {"x": _arr((1,))}, 1.0)
+        assert profiler.counters()["recompile_steady_state"] == 0
+        profiler.arm_compile_guard("test")
+        profiler.record_compile("g.site", {"x": _arr((2,))}, 1.0)
+        assert profiler.counters()["recompile_steady_state"] == 1
+
+    def test_warn_fires_exactly_once(self, clean_compiles, caplog):
+        profiler.set_config(compile_guard="warn")
+        profiler.arm_compile_guard("test")
+        with caplog.at_level(logging.WARNING, logger=profiler.__name__):
+            profiler.record_compile("g.site", {"x": _arr((1,))}, 1.0)
+            profiler.record_compile("g.site", {"x": _arr((2,))}, 1.0)
+            profiler.record_compile("g.site", {"x": _arr((3,))}, 1.0)
+        warns = [r for r in caplog.records
+                 if "steady-state compile guard" in r.message]
+        assert len(warns) == 1
+        assert "armed by test" in warns[0].message
+        # every violation still counts, silently
+        assert profiler.counters()["recompile_steady_state"] == 3
+
+    def test_raise_mode_raises(self, clean_compiles):
+        profiler.set_config(compile_guard="raise")
+        profiler.arm_compile_guard("test")
+        with pytest.raises(profiler.CompileGuardError) as ei:
+            profiler.record_compile("g.site", {"x": _arr((1,))}, 1.0)
+        assert "g.site" in str(ei.value)
+        # the record was still appended before raising
+        assert profiler.compile_registry()["sites"]["g.site"]["count"] == 1
+
+    def test_guard_paused_exempts(self, clean_compiles):
+        profiler.set_config(compile_guard="raise")
+        profiler.arm_compile_guard("test")
+        with profiler.compile_guard_paused():
+            profiler.record_compile("g.site", {"x": _arr((1,))}, 1.0)
+        assert profiler.counters()["recompile_steady_state"] == 0
+
+    def test_auto_arm_after_warmup_steps(self, clean_compiles):
+        profiler.set_config(compile_guard="warn", compile_warmup_steps=2)
+        assert not profiler.compile_guard_state()["armed"]
+        profiler.step_boundary()
+        assert not profiler.compile_guard_state()["armed"]
+        profiler.step_boundary()
+        st = profiler.compile_guard_state()
+        assert st["armed"] and st["armed_by"] == "warmup_steps"
+
+    def test_no_auto_arm_without_mode(self, clean_compiles):
+        profiler.set_config(compile_warmup_steps=1)
+        profiler.step_boundary()
+        profiler.step_boundary()
+        assert not profiler.compile_guard_state()["armed"]
+
+    def test_config_off_overrides_env(self, clean_compiles, monkeypatch):
+        # set_config wins over the env: "off" must silence an exported
+        # MXNET_COMPILE_GUARD=raise (deliberate re-shape phases)
+        monkeypatch.setenv("MXNET_COMPILE_GUARD", "raise")
+        profiler.arm_compile_guard("test")
+        profiler.set_config(compile_guard="off")
+        profiler.record_compile("g.site", {"x": _arr((1,))}, 1.0)  # no raise
+        assert profiler.compile_guard_state()["mode"] is None
+        profiler.set_config(compile_guard=None)   # defer to env again
+        assert profiler.compile_guard_state()["mode"] == "raise"
+
+
+class TestSiteWiring:
+    def test_dispatch_site_registers(self, clean_compiles):
+        a = mx.nd.array(np.ones((5, 7), np.float32))
+        for _ in range(3):
+            (a + a).asnumpy()   # warmup=1: second sighting compiles
+        sites = profiler.compile_stats()
+        assert sites.get("ops.dispatch", {}).get("count", 0) >= 1
+
+    def test_bulk_site_registers(self, clean_compiles):
+        a = mx.nd.array(np.ones((3, 3), np.float32))
+        with engine.bulk(8):
+            b = a + 7.0
+            c = b * 3.0
+        c.asnumpy()
+        sites = profiler.compile_stats()
+        assert sites.get("engine.bulk", {}).get("count", 0) >= 1
+
+    def test_predictor_recompile_attributed_to_input(self, clean_compiles):
+        S.symbol._reset_naming()
+        data = S.var("data")
+        fc = S.FullyConnected(data, num_hidden=3, name="fc1")
+        params = {"arg:fc1_weight": mx.nd.array(
+                      np.ones((3, 4), np.float32)),
+                  "arg:fc1_bias": mx.nd.array(np.zeros(3, np.float32))}
+        from incubator_mxnet_tpu.predictor import Predictor
+
+        pred = Predictor(fc, params, {"data": (2, 4)})
+        pred.predict(data=np.ones((2, 4), np.float32))
+        pred.reshape({"data": (6, 4)})
+        pred.predict(data=np.ones((6, 4), np.float32))
+        recs = [r for r in profiler.compile_registry()["records"]
+                if r["site"] == "predictor.forward" and r["recompile"]]
+        assert recs, "reshape-driven recompile not registered"
+        assert "argument 'data'" in recs[-1]["attribution"]
+        assert "shape drift" in recs[-1]["attribution"]
+
+    def test_pytree_token_expands_to_leaves(self, clean_compiles):
+        # a list-of-arrays positional ("t" cache-key token) must expand
+        # into per-leaf signature entries so a drift inside the list
+        # attributes at the leaf with its real kind, not as an opaque
+        # static value
+        from incubator_mxnet_tpu.ops.registry import _compile_sig
+
+        def fake_op():
+            pass
+
+        tok = ("t", "list", (("a", (2, 3), np.dtype("float32"), False, None),
+                             ("a", (4,), np.dtype("float32"), False, None)))
+        sig = _compile_sig(fake_op, (tok,), ())
+        assert sig["arg0[0]"]["shape"] == (2, 3)
+        assert sig["arg0[1]"]["shape"] == (4,)
+        tok2 = ("t", "list", (("a", (2, 3), np.dtype("float32"), False, None),
+                              ("a", (9,), np.dtype("float32"), False, None)))
+        sig2 = _compile_sig(fake_op, (tok2,), ())
+        f = profiler.diff_signatures(sig, sig2)
+        assert f == [{"arg": "arg0[1]", "kind": "shape",
+                      "old": "float32[4]", "new": "float32[9]"}]
+
+    def test_raise_during_donating_fused_step_keeps_weights(
+            self, clean_compiles):
+        # the guard fires AFTER the donated group dispatch: the new
+        # buffers must still be wired into the weights before the error
+        # surfaces, or the whole group would be left pointing at deleted
+        # jax buffers
+        from incubator_mxnet_tpu.optimizer import fused as F
+
+        rng = np.random.RandomState(7)
+        w_np = [rng.rand(3, 4).astype(np.float32),
+                rng.rand(5).astype(np.float32)]
+        g_np = [rng.rand(3, 4).astype(np.float32),
+                rng.rand(5).astype(np.float32)]
+        ws = [mx.nd.array(a) for a in w_np]
+        gs = [mx.nd.array(a) for a in g_np]
+        opt = mx.optimizer.create("sgd", learning_rate=0.1, wd=0.0)
+        opt.aggregate_num = 100
+        items = [(i, w, g) for i, (w, g) in enumerate(zip(ws, gs))]
+        states = {0: None, 1: None}
+        profiler.set_config(compile_guard="raise")
+        profiler.arm_compile_guard("test")
+        with pytest.raises(profiler.CompileGuardError):
+            F.fused_update(opt, items, states)  # fresh group -> compile
+        profiler.set_config(compile_guard=None)
+        profiler.disarm_compile_guard()
+        # the donated-and-replaced weights took the SGD update exactly
+        for w, wn, gn in zip(ws, w_np, g_np):
+            np.testing.assert_allclose(w.asnumpy(), wn - 0.1 * gn,
+                                       rtol=1e-6, atol=1e-7)
+
+    def test_group_apply_site(self, clean_compiles):
+        import jax.numpy as jnp
+
+        from incubator_mxnet_tpu.ops import optimizer_ops as K
+
+        ws = [jnp.ones((4, 4)), jnp.ones((6,))]
+        K.group_apply(K.sgd_step, ws, ws, [(), ()], [0.1, 0.1],
+                      [0.0, 0.0], [0, 0], {"rescale": 1.0, "clip": -1.0})
+        assert "optimizer.group_apply" in profiler.compile_stats()
+
+
+def _serving_model():
+    S.symbol._reset_naming()
+    data = S.var("data")
+    fc = S.FullyConnected(data, num_hidden=6, flatten=False, name="fc1")
+    sym = S.Activation(fc, act_type="tanh", name="t1")
+    rng = np.random.RandomState(0)
+    params = {"arg:fc1_weight": mx.nd.array(rng.randn(6, 4)
+                                            .astype(np.float32)),
+              "arg:fc1_bias": mx.nd.array(rng.randn(6).astype(np.float32))}
+    return sym, params
+
+
+class TestServingCompiles:
+    def test_warmup_registered_per_bucket_zero_steady(self, clean_compiles):
+        from incubator_mxnet_tpu.serving import InferenceServer
+
+        sym, params = _serving_model()
+        srv = InferenceServer(sym, params, {"data": (None, 4)},
+                              max_batch_size=4, max_queue_ms=20.0,
+                              length_buckets=[8, 16], batch_buckets=[2, 4],
+                              name="compile_test")
+        try:
+            sites = profiler.compile_stats()
+            # 2 batch buckets x 2 length buckets, all under serving.warmup
+            assert sites["serving.warmup"]["count"] >= 4
+            assert profiler.compile_guard_state()["armed_by"] == "serving"
+            before_total = profiler.counters()["compile_total"]
+            before_steady = profiler.counters()["recompile_steady_state"]
+            rng = np.random.RandomState(1)
+            for L in (3, 8, 11, 16):
+                out = srv.infer({"data": rng.rand(L, 4).astype(np.float32)},
+                                timeout=30.0)
+                assert out.shape == (L, 6)
+            # in-bucket steady traffic: NOTHING compiled, guard silent
+            assert profiler.counters()["compile_total"] == before_total
+            assert (profiler.counters()["recompile_steady_state"]
+                    == before_steady)
+        finally:
+            srv.close()
+
+    def test_warmup_exempt_from_prearmed_guard(self, clean_compiles):
+        from incubator_mxnet_tpu.serving import InferenceServer
+
+        profiler.set_config(compile_guard="raise")
+        profiler.arm_compile_guard("elsewhere")
+        sym, params = _serving_model()
+        # warmup compiles run under compile_guard_paused(): no raise
+        srv = InferenceServer(sym, params, {"data": (None, 4)},
+                              max_batch_size=2, max_queue_ms=20.0,
+                              length_buckets=[8], name="compile_test2")
+        srv.close()
+
+
+class TestCompileReportCLI:
+    def _dump(self, tmp_path):
+        profiler.record_compile("spmd.step",
+                                {"__program__": "step",
+                                 "input0": _arr((16, 12)),
+                                 "label": _arr((16,))}, 50.0)
+        profiler.record_compile("spmd.step",
+                                {"__program__": "step",
+                                 "input0": _arr((24, 12)),
+                                 "label": _arr((24,))}, 40.0)
+        path = tmp_path / "reg.json"
+        with open(path, "w") as f:
+            json.dump(profiler.compile_registry(), f)
+        return str(path)
+
+    def test_report_lists_site_and_culprit(self, clean_compiles, tmp_path):
+        path = self._dump(tmp_path)
+        out = subprocess.run(
+            [sys.executable, os.path.join(_REPO, "tools",
+                                          "compile_report.py"), path],
+            capture_output=True, text=True, cwd=_REPO)
+        assert out.returncode == 0, out.stderr
+        assert "spmd.step" in out.stdout
+        assert "input0" in out.stdout          # exact culprit argument
+        assert "shape" in out.stdout
+        assert "90.0 ms total" in out.stdout
+
+    def test_json_summary(self, clean_compiles, tmp_path):
+        path = self._dump(tmp_path)
+        out = subprocess.run(
+            [sys.executable, os.path.join(_REPO, "tools",
+                                          "compile_report.py"), path,
+             "--json"],
+            capture_output=True, text=True, cwd=_REPO)
+        assert out.returncode == 0, out.stderr
+        summ = json.loads(out.stdout)
+        assert summ["total_compiles"] == 2
+        cu = summ["culprits"][0]
+        assert (cu["site"], cu["arg"], cu["kind"]) == ("spmd.step",
+                                                       "input0", "shape")
+
+    def test_empty_registry_exits_2(self, clean_compiles, tmp_path):
+        path = tmp_path / "empty.json"
+        with open(path, "w") as f:
+            json.dump({"sites": {}, "records": []}, f)
+        out = subprocess.run(
+            [sys.executable, os.path.join(_REPO, "tools",
+                                          "compile_report.py"), str(path)],
+            capture_output=True, text=True, cwd=_REPO)
+        assert out.returncode == 2
+        assert "empty" in out.stderr
+
+def test_load_registry_from_trace(tmp_path, clean_compiles):
+    sys.path.insert(0, os.path.join(_REPO, "tools"))
+    try:
+        import compile_report
+    finally:
+        sys.path.pop(0)
+    profiler.set_config(filename=str(tmp_path / "trace.json"))
+    profiler.record_compile("x.site", {"x": _arr((2,))}, 3.0)
+    profiler.start()
+    trace = profiler.dump()
+    reg = compile_report.load_registry(trace)
+    assert "x.site" in reg["sites"]
+    buf = io.StringIO()
+    compile_report.report(reg, out=buf)
+    assert "x.site" in buf.getvalue()
+
+
+class TestSPMDTrainerGuard:
+    def test_first_step_arms_and_drift_attributed(self, clean_compiles):
+        from incubator_mxnet_tpu.gluon.loss import SoftmaxCrossEntropyLoss
+        from incubator_mxnet_tpu.parallel import SPMDTrainer
+
+        mx.random.seed(5)
+        net = nn.HybridSequential()
+        net.add(nn.Dense(8, activation="relu"), nn.Dense(4))
+        net.initialize()
+        net(mx.nd.zeros((2, 12)))
+        spmd = SPMDTrainer(net, SoftmaxCrossEntropyLoss(), "sgd",
+                           {"learning_rate": 0.01})
+        rng = np.random.RandomState(0)
+        x = rng.randn(16, 12).astype(np.float32)
+        y = rng.randint(0, 4, size=(16,)).astype(np.float32)
+        spmd.step(x, y)
+        assert profiler.compile_guard_state()["armed_by"] == "spmd.trainer"
+        steady0 = profiler.counters()["recompile_steady_state"]
+        spmd.step(x, y)   # warm replay: no compile
+        assert profiler.counters()["recompile_steady_state"] == steady0
+        spmd.step(rng.randn(24, 12).astype(np.float32),
+                  rng.randint(0, 4, size=(24,)).astype(np.float32))
+        assert profiler.counters()["recompile_steady_state"] == steady0 + 1
+        recs = [r for r in profiler.compile_registry()["records"]
+                if r["site"] == "spmd.step" and r["recompile"]]
+        assert recs and "argument 'input0'" in recs[-1]["attribution"]
+        assert "shape drift" in recs[-1]["attribution"]

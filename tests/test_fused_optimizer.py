@@ -106,6 +106,37 @@ def test_equiv_clip_gradient():
     _assert_equiv("sgd", {"learning_rate": 0.1, "clip_gradient": 0.05})
 
 
+def test_equiv_rmsprop():
+    _assert_equiv("rmsprop", {"learning_rate": 0.01, "wd": 0.01}, tol=1e-5)
+
+
+def test_equiv_rmsprop_centered():
+    _assert_equiv("rmsprop", {"learning_rate": 0.01, "centered": True,
+                              "momentum": 0.9}, tol=1e-5)
+
+
+def test_equiv_lamb():
+    _assert_equiv("lamb", {"learning_rate": 0.01, "wd": 0.01}, tol=1e-5)
+
+
+def test_equiv_lamb_bounds_no_bias_correction():
+    # the per-group norm handling: every parameter keeps its OWN trust
+    # ratio inside the fused group, bounds applied per tensor
+    _assert_equiv("lamb", {"learning_rate": 0.01, "bias_correction": False,
+                           "lower_bound": 0.1, "upper_bound": 2.0}, tol=1e-5)
+
+
+def test_rmsprop_lamb_take_fused_path():
+    for name, kw in (("rmsprop", {"learning_rate": 0.01}),
+                     ("lamb", {"learning_rate": 0.01})):
+        profiler.reset_counters()
+        _run_steps(name, kw, 256, n=6, steps=2)
+        c = _c()
+        assert c["fused_step_call"] == 2, name
+        assert c["fused_step_params"] == 12, name
+        assert c["fused_step_fallback_params"] == 0, name
+
+
 @pytest.mark.parametrize("name,args", [
     ("sgd", {"learning_rate": 0.1, "momentum": 0.9, "multi_precision": True}),
     ("sgd", {"learning_rate": 0.1, "multi_precision": True}),
@@ -157,9 +188,10 @@ def test_env_escape_hatch(monkeypatch):
 
 
 def test_unsupported_optimizer_falls_back():
-    ref, _ = _run_steps("rmsprop", {"learning_rate": 0.01}, 0, steps=2)
+    # ftrl has no fused group adapter (rmsprop/lamb graduated in ISSUE 10)
+    ref, _ = _run_steps("ftrl", {"learning_rate": 0.01}, 0, steps=2)
     profiler.reset_counters()
-    out, _ = _run_steps("rmsprop", {"learning_rate": 0.01}, 256, steps=2)
+    out, _ = _run_steps("ftrl", {"learning_rate": 0.01}, 256, steps=2)
     assert _c()["fused_step_call"] == 0
     assert _c()["fused_step_fallback_params"] > 0
     for a, b in zip(ref, out):

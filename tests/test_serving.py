@@ -172,6 +172,85 @@ class TestBatchFormation:
             srv.close()
 
 
+def _model2(seed=0):
+    """Two-output padding-safe model: Group([tanh(fc), fc]) — both
+    per-position (batch, length, HID)."""
+    S.symbol._reset_naming()
+    data = S.var("data")
+    fc = S.FullyConnected(data, num_hidden=HID, flatten=False, name="fc1")
+    t = S.Activation(fc, act_type="tanh", name="t1")
+    rng = np.random.RandomState(seed)
+    w = rng.randn(HID, FEAT).astype(np.float32)
+    b = rng.randn(HID).astype(np.float32)
+    params = {"arg:fc1_weight": mx.nd.array(w), "arg:fc1_bias": mx.nd.array(b)}
+    return S.Group([t, fc]), params, w, b
+
+
+class TestMultiOutput:
+    def test_list_result_and_per_output_unpad(self):
+        sym, params, w, b = _model2()
+        srv = InferenceServer(sym, params, {"data": (None, FEAT)},
+                              max_batch_size=4, max_queue_ms=50.0,
+                              max_length=16, unpad_output_axis=[0, 0],
+                              name="mo_test")
+        try:
+            rng = np.random.RandomState(1)
+            x = rng.rand(5, FEAT).astype(np.float32)
+            out = srv.infer({"data": x}, timeout=30.0)
+            assert isinstance(out, list) and len(out) == 2
+            assert out[0].shape == (5, HID) and out[1].shape == (5, HID)
+            ref = x @ w.T + b
+            np.testing.assert_allclose(out[1], ref, rtol=1e-5, atol=1e-6)
+            np.testing.assert_allclose(out[0], np.tanh(ref),
+                                       rtol=1e-5, atol=1e-6)
+        finally:
+            srv.close()
+
+    def test_auto_unpads_every_output(self):
+        sym, params, _, _ = _model2()
+        srv = InferenceServer(sym, params, {"data": (None, FEAT)},
+                              max_batch_size=4, max_queue_ms=50.0,
+                              max_length=16, name="mo_auto")   # auto spec
+        try:
+            out = srv.infer({"data": np.ones((3, FEAT), np.float32)},
+                            timeout=30.0)
+            assert [o.shape for o in out] == [(3, HID), (3, HID)]
+        finally:
+            srv.close()
+
+    def test_dict_spec_leaves_unlisted_outputs_padded(self):
+        sym, params, _, _ = _model2()
+        srv = InferenceServer(sym, params, {"data": (None, FEAT)},
+                              max_batch_size=4, max_queue_ms=50.0,
+                              length_buckets=[8], unpad_output_axis={0: 0},
+                              name="mo_dict")
+        try:
+            out = srv.infer({"data": np.ones((5, FEAT), np.float32)},
+                            timeout=30.0)
+            assert out[0].shape == (5, HID)     # unpadded
+            assert out[1].shape == (8, HID)     # bucket-padded, untouched
+        finally:
+            srv.close()
+
+    def test_wrong_spec_length_fails_at_construction(self):
+        sym, params, _, _ = _model2()
+        with pytest.raises(ValueError, match="3 entries.*2 outputs"):
+            InferenceServer(sym, params, {"data": (None, FEAT)},
+                            max_batch_size=4, max_queue_ms=20.0,
+                            max_length=16, unpad_output_axis=[0, 0, 0],
+                            name="mo_bad", warmup=False, autostart=False)
+
+    def test_single_output_keeps_bare_array_contract(self):
+        sym, params = _model()
+        srv = _server(sym, params)
+        try:
+            out = srv.infer({"data": np.ones((4, FEAT), np.float32)},
+                            timeout=30.0)
+            assert isinstance(out, np.ndarray)   # not a 1-element list
+        finally:
+            srv.close()
+
+
 class TestExactness:
     def _reference(self, sym, params, sample, bucket):
         pred = Predictor(sym, params, {"data": (1, bucket, FEAT)})

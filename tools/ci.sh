@@ -135,10 +135,13 @@ for tier in "${TIERS[@]}"; do
         profiler)
             # tracing smoke: recorder-on train loop -> valid chrome trace,
             # trace_report runs clean, counter-name lint passes (incl. the
-            # docs/observability.md counter-table diff), and the 2-process
+            # docs/observability.md counter-table diff), the 2-process
             # cluster smoke: per-rank traces -> offset-corrected merge with
             # one process row per rank, rank-0 /metrics scrape sees both
-            # ranks, straggler attribution fires exactly once
+            # ranks, straggler attribution fires exactly once — and the
+            # compile-observability smoke: short train+serve run where
+            # compile_report must list every jit site and attribute a
+            # deliberately forced shape drift to the exact argument
             # per-run trace path: concurrent ci.sh runs on one box must
             # not race on a shared file
             run_tier profiler "${CPU_ENV[@]}" bash -c '
@@ -148,7 +151,8 @@ for tier in "${TIERS[@]}"; do
                 python tools/profiler_smoke.py --out "$trace"
                 python tools/trace_report.py "$trace" --top 10 >/dev/null
                 python tools/lint_counters.py
-                python tools/dist_trace_smoke.py'
+                python tools/dist_trace_smoke.py
+                python tools/compile_smoke.py >/dev/null'
             ;;
         chaos)
             # deterministic fault injection: the seed pins the p= fault

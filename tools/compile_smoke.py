@@ -1,0 +1,182 @@
+#!/usr/bin/env python
+"""Compile-observability smoke (tools/ci.sh ``profiler`` tier).
+
+Drives a short train + serve run that touches the jit sites the compile
+registry must see — eager dispatch, hybridized CachedOp, engine bulk
+flush, fused optimizer group_apply, SPMD step, serving bucket warmup —
+then DELIBERATELY drifts the SPMD batch shape after the steady-state
+guard has armed and asserts:
+
+* every expected site appears in the registry and in
+  ``tools/compile_report.py``'s output;
+* the forced drift is attributed to the EXACT offending argument
+  (``input0``, shape drift) and counted as a steady-state recompile;
+* serving registered one warmup compile per (batch, length) bucket pair
+  and compiled NOTHING for in-bucket steady traffic;
+* XLA cost accounting (MXNET_COMPILE_COST=1) captured FLOPs for the
+  predictor-path compiles.
+
+Exit 0 = all of the above; non-zero with a one-line diagnosis otherwise.
+"""
+import io
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+os.environ.setdefault("MXNET_COMPILE_COST", "1")
+
+import numpy as np  # noqa: E402
+
+
+def fail(msg):
+    print(f"compile_smoke: FAIL — {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def main():
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import autograd, engine, profiler
+    from incubator_mxnet_tpu.gluon import Trainer, nn
+    from incubator_mxnet_tpu.gluon.loss import SoftmaxCrossEntropyLoss
+    from incubator_mxnet_tpu.parallel import SPMDTrainer
+    from incubator_mxnet_tpu.serving import InferenceServer
+    import incubator_mxnet_tpu.symbol as S
+
+    profiler.reset_compiles()
+    profiler.disarm_compile_guard()
+
+    # -- eager dispatch + bulk micro-graph ------------------------------
+    a = mx.nd.array(np.ones((4, 4), np.float32))
+    for _ in range(3):
+        (a + a).asnumpy()           # level-1 cache compile (warmup=1)
+    with engine.bulk(8):
+        b = a + 1.0
+        c = b * 2.0
+    c.asnumpy()                     # flush -> engine.bulk compile
+
+    # -- hybridized CachedOp + fused optimizer group_apply --------------
+    mx.random.seed(0)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, activation="relu"), nn.Dense(4))
+    net.initialize()
+    net.hybridize()
+    x = mx.nd.array(np.random.RandomState(0).rand(4, 6).astype(np.float32))
+    net(x)
+    opt = mx.optimizer.create("adam", learning_rate=0.01)
+    opt.aggregate_num = 100
+    tr = Trainer(net.collect_params(), opt)
+    for _ in range(2):
+        with autograd.record():
+            loss = (net(x) * net(x)).sum()
+        loss.backward()
+        tr.step(4)
+
+    # -- SPMD train: 2 steps, guard arms, then a FORCED shape drift -----
+    mx.random.seed(1)
+    net2 = nn.HybridSequential()
+    net2.add(nn.Dense(16, activation="relu"), nn.Dense(4))
+    net2.initialize()
+    net2(mx.nd.zeros((2, 12)))
+    loss_fn = SoftmaxCrossEntropyLoss()
+    spmd = SPMDTrainer(net2, loss_fn, "sgd", {"learning_rate": 0.01})
+    rng = np.random.RandomState(2)
+    xb = rng.randn(16, 12).astype(np.float32)
+    yb = rng.randint(0, 4, size=(16,)).astype(np.float32)
+    steady0 = profiler.counters()["recompile_steady_state"]
+    spmd.step(xb, yb)
+    spmd.step(xb, yb)
+    if not profiler.compile_guard_state()["armed"]:
+        fail("guard not armed after the first SPMD step")
+    # the deliberate drift: batch 16 -> 24 must recompile AND be caught
+    spmd.step(rng.randn(24, 12).astype(np.float32),
+              rng.randint(0, 4, size=(24,)).astype(np.float32))
+    steady1 = profiler.counters()["recompile_steady_state"]
+    if steady1 <= steady0:
+        fail("forced shape drift was not counted as a steady-state "
+             f"recompile ({steady0} -> {steady1})")
+
+    # -- serving: bucket warmup + in-bucket steady traffic --------------
+    S.symbol._reset_naming()
+    data = S.var("data")
+    fc = S.FullyConnected(data, num_hidden=6, flatten=False, name="fc1")
+    sym = S.Activation(fc, act_type="tanh", name="t1")
+    srng = np.random.RandomState(3)
+    params = {"arg:fc1_weight": mx.nd.array(
+                  srng.randn(6, 4).astype(np.float32)),
+              "arg:fc1_bias": mx.nd.array(srng.randn(6).astype(np.float32))}
+    srv = InferenceServer(sym, params, {"data": (None, 4)},
+                          max_batch_size=4, max_queue_ms=20.0,
+                          length_buckets=[8, 16], batch_buckets=[4],
+                          name="compile_smoke")
+    try:
+        warm_sites = profiler.compile_stats()
+        nwarm = warm_sites.get("serving.warmup", {}).get("count", 0)
+        if nwarm < 2:   # 1 batch bucket x 2 length buckets
+            fail(f"serving.warmup registered {nwarm} compiles, "
+                 "expected one per bucket pair (>= 2)")
+        before = profiler.counters()["compile_total"]
+        for L in (3, 8, 12, 16, 5):
+            out = srv.infer({"data": srng.rand(L, 4).astype(np.float32)},
+                            timeout=30.0)
+            if out.shape != (L, 6):
+                fail(f"serving output shape {out.shape} != ({L}, 6)")
+        if profiler.counters()["compile_total"] != before:
+            fail("in-bucket steady serving traffic compiled something")
+    finally:
+        srv.close()
+
+    # -- registry dump -> compile_report --------------------------------
+    reg = profiler.compile_registry()
+    path = os.path.join(tempfile.gettempdir(),
+                        f"compile_smoke_{os.getpid()}.json")
+    with open(path, "w") as f:
+        json.dump(reg, f)
+    try:
+        import compile_report
+
+        expected_sites = ["ops.dispatch", "engine.bulk", "block.cached_op",
+                          "optimizer.group_apply", "spmd.step",
+                          "serving.warmup"]
+        for site in expected_sites:
+            if site not in reg["sites"]:
+                fail(f"site {site} missing from the registry "
+                     f"(saw {sorted(reg['sites'])})")
+        buf = io.StringIO()
+        compile_report.report(compile_report.load_registry(path), out=buf)
+        text = buf.getvalue()
+        print(text)
+        for site in expected_sites:
+            if site not in text:
+                fail(f"compile_report output misses site {site}")
+        summ = compile_report.summarize(reg)
+        culprit = next((c for c in summ["culprits"]
+                        if c["site"] == "spmd.step"), None)
+        if culprit is None:
+            fail("compile_report found no spmd.step recompile culprit")
+        if culprit["arg"] != "input0" or culprit["kind"] != "shape":
+            fail("forced drift misattributed: expected (input0, shape), "
+                 f"got ({culprit['arg']}, {culprit['kind']})")
+        # MXNET_COMPILE_COST=1: the predictor-path warmup compiles must
+        # carry XLA cost analysis
+        if not any((r.get("cost") or {}).get("flops")
+                   for r in reg["records"]
+                   if r["site"] == "serving.warmup"):
+            fail("no FLOPs captured for serving.warmup despite "
+                 "MXNET_COMPILE_COST=1")
+    finally:
+        os.unlink(path)
+
+    print("compile_smoke OK: "
+          f"{len(reg['sites'])} sites, "
+          f"{sum(e['count'] for e in reg['sites'].values())} compiles, "
+          "forced drift attributed to input0 (shape), "
+          f"{steady1 - steady0} steady-state recompile(s) caught")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,124 +1,33 @@
 #!/usr/bin/env python
-"""Analytic FLOP accounting for the bench configs (VERDICT r4 weak #3/#7:
-SSD/YOLO MFU unstated).
+"""DEPRECATED shim — the analytic FLOP accounting moved into
+``tools/compile_report.py --analytic`` (one CLI surface for all compile
+cost accounting: registry dumps, xplane device tables, and this analytic
+bench-config table).  This entry point stays so existing invocations and
+PERF_NOTES recipes keep working:
 
-Builds each model exactly as bench.py does, exports the pure forward via
-``Block.export_jittable()``, and reads XLA's HLO cost analysis on CPU at
-B=1 to get fwd FLOPs/sample.  Training FLOPs use the standard fwd+bwd=3x
-convention (the same accounting PERF_NOTES applies to BERT/transformer).
-MFU = measured_items_per_sec x 3 x fwd_flops / peak, peak = 197 TFLOP/s
-bf16 (TPU v5e chip).
-
-Run on CPU (no chip needed):
     env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu python tools/flops_report.py
-Emits a markdown table + one JSON line per config for PERF_NOTES.
+
+is now exactly
+
+    ... python tools/compile_report.py --analytic
 """
-import json
 import os
 import sys
+import warnings
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-import numpy as np
-
-PEAK_TFLOPS = float(os.environ.get("MXNET_TPU_PEAK_TFLOPS", "197"))
-
-# measured per-chip throughput to fold in (round-4 driver-era numbers;
-# refresh from BENCH_EVIDENCE_r05 when the capture lands)
-MEASURED = {
-    "resnet50": ("img/s", 2455.0),
-    "ssd512-resnet18": ("img/s", 867.0),
-    "ssd512-vgg16": ("img/s", None),     # never measured pre-r5
-    "yolo3-darknet53": ("img/s", 566.0),  # r3 number (r4 blocked by wedge)
-    # cross-checks of PERF_NOTES' analytic accounting (68.5 GFLOP/sample
-    # BERT => fwd ~22.8; 0.66 GFLOP/token transformer => fwd/sample at
-    # S=256 ~56.3 over both streams)
-    "bert-base-mlm": ("samples/s", 1474.0),
-    "transformer-big": ("samples/s", None),
-}
-
-
-def _fwd_flops_per_sample(net, *inputs):
-    import jax
-
-    fn, params = net.export_jittable()
-    lowered = jax.jit(lambda p, *xs: fn(p, *xs)).lower(params, *inputs)
-    cost = lowered.compile().cost_analysis()
-    if isinstance(cost, (list, tuple)):
-        cost = cost[0]
-    return float(cost["flops"]) / inputs[0].shape[0]
-
-
-def _build(config):
-    import jax
-    import jax.numpy as jnp
-
-    import incubator_mxnet_tpu as mx
-
-    cpu = jax.local_devices(backend="cpu")[0]
-    with jax.default_device(cpu):
-        mx.random.seed(0)
-        if config == "resnet50":
-            from incubator_mxnet_tpu.gluon.model_zoo.vision import resnet50_v1
-            net = resnet50_v1()
-            x = jnp.zeros((1, 3, 224, 224), jnp.float32)
-        elif config == "ssd512-resnet18":
-            from incubator_mxnet_tpu.gluon.model_zoo.ssd import ssd_512_resnet18
-            net = ssd_512_resnet18()
-            x = jnp.zeros((1, 3, 512, 512), jnp.float32)
-        elif config == "ssd512-vgg16":
-            from incubator_mxnet_tpu.gluon.model_zoo.ssd import ssd_512_vgg16_atrous
-            net = ssd_512_vgg16_atrous()
-            x = jnp.zeros((1, 3, 512, 512), jnp.float32)
-        elif config == "yolo3-darknet53":
-            from incubator_mxnet_tpu.gluon.model_zoo.yolo import yolo3_darknet53
-            net = yolo3_darknet53()
-            x = jnp.zeros((1, 3, 416, 416), jnp.float32)
-        elif config == "bert-base-mlm":
-            from incubator_mxnet_tpu.gluon.model_zoo.bert import (
-                BERTForPretrain, bert_base)
-            net = BERTForPretrain(bert_base(vocab_size=30522, max_length=512,
-                                            dropout=0.0), vocab_size=30522)
-            S, Pn = 128, 20
-            xs = (jnp.zeros((1, S), jnp.int32), jnp.zeros((1, S), jnp.int32),
-                  jnp.zeros((1, Pn), jnp.int32))
-        elif config == "transformer-big":
-            from incubator_mxnet_tpu.gluon.model_zoo.transformer import (
-                transformer_big)
-            net = transformer_big(vocab_size=32768, max_length=512, dropout=0.0)
-            S = 256
-            xs = (jnp.zeros((1, S), jnp.int32), jnp.zeros((1, S), jnp.int32))
-        else:
-            raise ValueError(config)
-        net.initialize()
-        if config in ("bert-base-mlm", "transformer-big"):
-            net(*[mx.nd.array(np.asarray(v)) for v in xs])
-            return net, xs
-        net(mx.nd.array(np.asarray(x)))  # materialize deferred shapes
-        return net, (x,)
+from compile_report import MEASURED, PEAK_TFLOPS, analytic_report  # noqa: F401,E402
+from compile_report import _build, _fwd_flops_per_sample  # noqa: F401,E402
 
 
 def main():
-    rows = []
-    for config, (unit, rate) in MEASURED.items():
-        net, xs = _build(config)
-        gflops = _fwd_flops_per_sample(net, *xs) / 1e9
-        mfu = (rate * 3 * gflops / (PEAK_TFLOPS * 1e3)) if rate else None
-        rows.append((config, gflops, rate, mfu))
-        print(json.dumps({
-            "metric": f"{config}_fwd_gflops_per_sample",
-            "value": round(gflops, 2),
-            "measured_per_sec": rate,
-            "train_mfu_at_measured": round(mfu, 4) if mfu else None,
-        }), flush=True)
-
-    print(f"\n| config | fwd GFLOP/sample | measured/s/chip | train MFU "
-          f"(3x fwd, {PEAK_TFLOPS:.0f} TF peak) |")
-    print("|---|---|---|---|")
-    for config, gflops, rate, mfu in rows:
-        print(f"| {config} | {gflops:.1f} | {rate if rate else '—'} | "
-              f"{f'{100 * mfu:.1f}%' if mfu else '—'} |")
+    warnings.warn(
+        "tools/flops_report.py is deprecated; use "
+        "tools/compile_report.py --analytic", DeprecationWarning,
+        stacklevel=2)
+    return analytic_report()
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())
