@@ -83,8 +83,9 @@ def poisson_arrivals(n, rate, seed):
 
 
 def _pct(xs, q):
-    xs = sorted(xs)
-    return float(xs[min(len(xs) - 1, int(q * len(xs)))])
+    from incubator_mxnet_tpu import profiler
+
+    return float(profiler.percentile(xs, q))
 
 
 def _trial_line(n, rate, elapsed, lats, slo_ms):

@@ -94,6 +94,39 @@ def _is_tracing():
     return bool(getattr(_tls, "tracing", 0))
 
 
+@contextlib.contextmanager
+def traced_params(params, arrays):
+    """Trace-scope ceremony for hand-built pure jit programs that call
+    Gluon blocks with parameters BAKED IN as captured constants (the
+    KV-cache decode discipline: per-leaf jit argument processing costs
+    ~0.5 ms/arg on slow hosts, and inference params are frozen anyway).
+
+    For each ``(param, array)`` pair: sets ``param._traced_data`` so
+    ``Parameter.data()`` returns the traced stand-in, pushes a traced
+    PRNG key and an empty aux frame, enters eval-mode autograd and marks
+    the block-tracing TLS — and restores ALL of it on exit, exception or
+    not.  Shared by ``model_zoo.transformer._KVCacheDecoder`` and the
+    serving tier's generation programs so the fragile save/restore
+    protocol exists exactly once."""
+    saved = []
+    for p, a in zip(params, arrays):
+        saved.append(getattr(p, "_traced_data", None))
+        p._traced_data = NDArray(a)
+    push_traced_key(jax.random.PRNGKey(0))
+    _aux_stack().append([])
+    prev = getattr(_tls, "tracing", 0)
+    _tls.tracing = prev + 1
+    try:
+        with autograd._scope(False, False):
+            yield
+    finally:
+        _tls.tracing = prev
+        _aux_stack().pop()
+        pop_traced_key()
+        for p, s in zip(params, saved):
+            p._traced_data = s
+
+
 class _BlockScope:
     """Name-scope manager for Blocks (parity: ``_BlockScope`` in the
     reference — naming discipline matters for checkpoint compat)."""

@@ -205,10 +205,8 @@ class _KVCacheDecoder:
         import jax.numpy as jnp
         from jax import lax
 
-        from ... import autograd
-        from ...gluon.block import _aux_stack, _tls as _block_tls
+        from ...gluon.block import traced_params
         from ...ndarray.ndarray import NDArray
-        from ...random import push_traced_key, pop_traced_key
 
         model = self._model
         cells = self._cells
@@ -235,56 +233,41 @@ class _KVCacheDecoder:
         param_arrays = list(self._param_arrays)
 
         def pure(tok, t, self_k, self_v, mem_k, mem_v):
-            saved = []
-            for p, a in zip(params, param_arrays):
-                saved.append(getattr(p, "_traced_data", None))
-                p._traced_data = NDArray(a)
-            push_traced_key(jax.random.PRNGKey(0))
-            _aux_stack().append([])
-            prev = getattr(_block_tls, "tracing", 0)
-            _block_tls.tracing = prev + 1
-            try:
-                with autograd._scope(False, False):  # eval mode
-                    B = tok.shape[0]
-                    x = model.embed(NDArray(tok))._data * math.sqrt(units)
-                    x = x + lax.dynamic_slice_in_dim(
-                        jnp.asarray(pos_table), t, 1, 0).astype(x.dtype)
-                    valid = jnp.arange(bucket) <= t
-                    new_k, new_v = [], []
-                    for l, cell in enumerate(cells):
-                        h = cell.ln_self(NDArray(x))._data
-                        qkv = cell.self_attention.qkv(NDArray(h))._data
-                        qkv = qkv.reshape(B, 1, 3, H, dh)
-                        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
-                        ck = lax.dynamic_update_slice(
-                            self_k[l], k.astype(self_k.dtype), (0, t, 0, 0))
-                        cv = lax.dynamic_update_slice(
-                            self_v[l], v.astype(self_v.dtype), (0, t, 0, 0))
-                        new_k.append(ck)
-                        new_v.append(cv)
-                        out = attend(q, ck, cv, valid).reshape(B, 1, units)
-                        x = x + cell.self_attention.out_proj(NDArray(out))._data
-                        h = cell.ln_cross(NDArray(x))._data
-                        q2 = cell.cross_attention.q_proj(NDArray(h))._data
-                        q2 = q2.reshape(B, 1, H, dh)
-                        S = mem_k.shape[2]
-                        out2 = attend(q2, mem_k[l], mem_v[l],
-                                      jnp.ones((S,), bool)).reshape(B, 1, units)
-                        x = x + cell.cross_attention.out_proj(NDArray(out2))._data
-                        h = cell.ln_ffn(NDArray(x))._data
-                        x = x + cell.ffn(NDArray(h))._data
-                    if model._tie:
-                        logits = jnp.einsum(
-                            "bqd,vd->bqv", x,
-                            model.embed.weight.data()._data.astype(x.dtype))
-                    else:
-                        logits = model.proj(NDArray(x))._data
-            finally:
-                _block_tls.tracing = prev
-                _aux_stack().pop()
-                pop_traced_key()
-                for p, s in zip(params, saved):
-                    p._traced_data = s
+            with traced_params(params, param_arrays):  # eval mode
+                B = tok.shape[0]
+                x = model.embed(NDArray(tok))._data * math.sqrt(units)
+                x = x + lax.dynamic_slice_in_dim(
+                    jnp.asarray(pos_table), t, 1, 0).astype(x.dtype)
+                valid = jnp.arange(bucket) <= t
+                new_k, new_v = [], []
+                for l, cell in enumerate(cells):
+                    h = cell.ln_self(NDArray(x))._data
+                    qkv = cell.self_attention.qkv(NDArray(h))._data
+                    qkv = qkv.reshape(B, 1, 3, H, dh)
+                    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+                    ck = lax.dynamic_update_slice(
+                        self_k[l], k.astype(self_k.dtype), (0, t, 0, 0))
+                    cv = lax.dynamic_update_slice(
+                        self_v[l], v.astype(self_v.dtype), (0, t, 0, 0))
+                    new_k.append(ck)
+                    new_v.append(cv)
+                    out = attend(q, ck, cv, valid).reshape(B, 1, units)
+                    x = x + cell.self_attention.out_proj(NDArray(out))._data
+                    h = cell.ln_cross(NDArray(x))._data
+                    q2 = cell.cross_attention.q_proj(NDArray(h))._data
+                    q2 = q2.reshape(B, 1, H, dh)
+                    S = mem_k.shape[2]
+                    out2 = attend(q2, mem_k[l], mem_v[l],
+                                  jnp.ones((S,), bool)).reshape(B, 1, units)
+                    x = x + cell.cross_attention.out_proj(NDArray(out2))._data
+                    h = cell.ln_ffn(NDArray(x))._data
+                    x = x + cell.ffn(NDArray(h))._data
+                if model._tie:
+                    logits = jnp.einsum(
+                        "bqd,vd->bqv", x,
+                        model.embed.weight.data()._data.astype(x.dtype))
+                else:
+                    logits = model.proj(NDArray(x))._data
             return logits[:, 0], jnp.stack(new_k), jnp.stack(new_v)
 
         return jax.jit(pure, donate_argnums=(2, 3))

@@ -21,9 +21,11 @@ exactly what ``serving.InferenceServer``'s single scheduler thread does.
 """
 from __future__ import annotations
 
+import time as _time
+
 import numpy as _np
 
-__all__ = ["Predictor", "load_checkpoint"]
+__all__ = ["Predictor", "StatefulExecutor", "load_checkpoint"]
 
 
 def _split_param_key(name):
@@ -69,6 +71,117 @@ def load_checkpoint(symbol_file, param_file):
         nd = v if isinstance(v, NDArray) else array(_np.asarray(v))
         (auxs if kind == "aux" else args)[name] = nd
     return sym, args, auxs
+
+
+class StatefulExecutor:
+    """Bind pure step programs over a shared, donated device state.
+
+    The decode loop of the generation tier (``serving/generation.py``) is
+    a *stateful* workload: every iteration consumes the KV cache buffers
+    and produces their successors.  A plain ``Predictor`` models the
+    opposite contract (stateless forward over immutable parameters), so
+    this is the second binding substrate: named jitted programs that all
+    read and return one ``{name: array}`` state dict, with the state
+    donated on every dispatch — steady-state HBM holds exactly one copy
+    of the cache, and mutation is buffer aliasing, not allocation.
+
+    Programs are plain functions ``fn(state, inputs) -> (outputs,
+    new_state)`` where ``new_state`` must carry every state key (pass
+    unchanged entries straight through — XLA aliases them back onto the
+    donated input buffers).  ``run()`` rebinds the state BEFORE reporting
+    a detected compile, so a raise-mode compile guard can never leave the
+    executor pointing at deleted buffers (the PR 9 ``group_apply``
+    discipline).
+
+    Not thread-safe — same contract as :class:`Predictor`: the single
+    scheduler thread owns all dispatches.
+    """
+
+    def __init__(self, state, name="stateful", compile_site=None):
+        self._state = dict(state or {})
+        self._name = str(name)
+        self._site = compile_site or f"executor.{self._name}"
+        self._programs = {}
+        self._calls = {}
+        self._compiles = 0
+
+    @property
+    def state(self):
+        """The live state dict (read-only by convention; entries are the
+        donated/rebound jax arrays)."""
+        return self._state
+
+    def add_program(self, name, fn, donate_state=True):
+        """Register ``fn(state, inputs) -> (outputs, new_state)`` under
+        ``name``.  ``donate_state`` (default) donates the whole state
+        pytree on every call."""
+        import jax
+
+        if name in self._programs:
+            raise ValueError(f"program {name!r} already bound")
+        self._programs[name] = jax.jit(
+            fn, donate_argnums=(0,) if donate_state else ())
+        self._calls[name] = 0
+        return self
+
+    def _signature(self, program, inputs):
+        from . import profiler
+
+        sig = {"__program__": program}
+        for k, v in self._state.items():
+            sig[f"state:{k}"] = profiler.sig_array(v)
+        for k, v in (inputs or {}).items():
+            sig[k] = (profiler.sig_array(v) if hasattr(v, "shape")
+                      else profiler.sig_static(v))
+        return sig
+
+    def run(self, program, **inputs):
+        """Dispatch ``program`` on the current state; rebind the returned
+        state; return the outputs.  A call that grew the program's jit
+        cache is reported to the compile registry under this executor's
+        site (guard raise mode raises AFTER the state is rebound)."""
+        from . import profiler
+
+        jfn = self._programs[program]
+        before = profiler.jit_cache_size(jfn)
+        t0 = _time.perf_counter()
+        outputs, new_state = jfn(self._state, inputs)
+        wall_ms = (_time.perf_counter() - t0) * 1e3
+        missing = set(self._state) - set(new_state)
+        if missing:
+            raise RuntimeError(
+                f"program {program!r} dropped state keys {sorted(missing)} "
+                f"— donated buffers are gone; every program must return "
+                f"the full state")
+        sig = None
+        if profiler.jit_cache_size(jfn) > before >= 0:
+            sig = self._signature(program, inputs)  # before rebinding
+        self._state = dict(new_state)
+        self._calls[program] += 1
+        if sig is not None:
+            self._compiles += 1
+            profiler.record_compile(self._site, sig, wall_ms, fn=jfn)
+        return outputs
+
+    def is_warm(self, program):
+        """True when ``program`` has at least one compiled entry."""
+        from . import profiler
+
+        return profiler.jit_cache_size(self._programs[program]) > 0
+
+    def compile_stats(self):
+        """{"programs", "entries", "compiles", "calls"} — the generation
+        harness diffs this around a traffic run to prove the decode loop
+        never compiled after warmup."""
+        from . import profiler
+
+        entries = 0
+        for fn in self._programs.values():
+            n = profiler.jit_cache_size(fn)
+            if n > 0:
+                entries += n
+        return {"programs": len(self._programs), "entries": entries,
+                "compiles": self._compiles, "calls": dict(self._calls)}
 
 
 class Predictor:

@@ -202,6 +202,15 @@ _counters = {
     "serving_bucket_miss": 0,         # batches that had to bind/compile
     "serving_slo_violation": 0,       # requests completing past their SLO
     "serving_queue_depth_peak": 0,    # high-watermark of the request queue
+    "generation_request": 0,          # prompts accepted by GenerationServer
+    "generation_shed": 0,             # submissions rejected by admission control
+    "generation_prefill": 0,          # compiled prefill dispatches
+    "generation_slot_join": 0,        # requests joining the decode batch
+    "generation_slot_leave": 0,       # requests leaving (finish/cancel/error)
+    "generation_decode_iter": 0,      # per-pool compiled decode steps
+    "generation_token": 0,            # tokens emitted by decode steps
+    "generation_cancelled": 0,        # requests cancelled mid-stream
+    "generation_slo_violation": 0,    # completions past their tenant's SLO
     "compile_total": 0,               # jit compilations across every site
     "compile_ms_total": 0,            # wall ms those compilations cost
     "recompile_steady_state": 0,      # compiles after the guard armed
@@ -607,6 +616,17 @@ def _median(xs):
     xs = sorted(xs)
     n = len(xs)
     return xs[n // 2] if n % 2 else 0.5 * (xs[n // 2 - 1] + xs[n // 2])
+
+
+def percentile(xs, q):
+    """Nearest-rank percentile (the serving tier's latency convention);
+    None on empty input.  THE shared helper — the serving/generation
+    servers and the opperf harnesses all quote percentiles through it so
+    one method governs every p50/p99 the repo reports."""
+    if not xs:
+        return None
+    xs = sorted(xs)
+    return xs[min(len(xs) - 1, int(q * len(xs)))]
 
 
 def step_boundary():

@@ -1,23 +1,33 @@
-"""``mx.serving`` — the inference serving tier (ISSUE 8).
+"""``mx.serving`` — the inference serving tier (ISSUEs 8 and 11).
 
-Continuous batching under a latency SLO on top of ``mx.predictor``:
+Two servers over one discipline (a closed, warm set of compiled
+programs; zero recompiles in steady state, guard-enforced):
 
-* :class:`InferenceServer` — thread-safe request queue + scheduler loop
-  forming dynamic batches (``max_batch_size`` / ``max_queue_ms``, early
-  dispatch when the oldest request would miss its deadline);
-* :class:`ShapeBucketer` — pad variable-length traffic up to a small
-  closed set of bucket shapes so every batch hits a warm compiled
-  ``Predictor`` entry (zero recompiles after warmup);
-* an AMP tier (``amp_dtype="bfloat16"``) routing the bound model through
-  ``amp.convert_model``;
-* full observability: ``serving.*`` spans, ``serving_*`` counters, and a
-  metrics provider feeding queue depth / p50-p99 latency into
-  ``profiler.metrics_snapshot()`` (and so the Prometheus endpoint).
+* :class:`InferenceServer` — single-forward requests: thread-safe queue
+  + scheduler loop forming dynamic batches under a latency SLO
+  (``max_batch_size`` / ``max_queue_ms``), (batch, length) shape
+  bucketing via :class:`ShapeBucketer`, per-server AMP tier;
+* :class:`GenerationServer` — autoregressive decode: iteration-level
+  **continuous batching** over a device-resident slot KV cache
+  (:mod:`~.kv_cache`) — finished sequences leave and queued prefills
+  join BETWEEN decode steps — with a streaming token surface
+  (:class:`GenerationResult`), mid-stream cancellation, and
+  multi-tenant admission control (per-tenant queue caps, slot caps,
+  TTFT/TPOT SLOs, queue-depth load shedding → :class:`AdmissionError`);
+* full observability for both: ``serving.*``/``generation.*`` spans,
+  ``serving_*``/``generation_*`` counters, and metrics providers
+  feeding ``profiler.metrics_snapshot()`` (and so the Prometheus
+  endpoint).
 
-See docs/serving.md for the tour and benchmark/opperf/serving.py for the
-throughput-at-SLO harness.
+See docs/serving.md for the tour; benchmark/opperf/serving.py and
+benchmark/opperf/generation.py are the throughput-at-SLO harnesses.
 """
 from .bucketing import ShapeBucketer
+from .generation import (AdmissionError, GenerationResult, GenerationServer,
+                         Tenant)
+from .kv_cache import KVCacheLadder, SlotKVCache
 from .server import InferenceServer, PendingResult
 
-__all__ = ["InferenceServer", "PendingResult", "ShapeBucketer"]
+__all__ = ["InferenceServer", "PendingResult", "ShapeBucketer",
+           "GenerationServer", "GenerationResult", "AdmissionError",
+           "Tenant", "KVCacheLadder", "SlotKVCache"]

@@ -26,8 +26,11 @@ class ShapeBucketer:
     buckets : explicit ascending ladder (iterable of positive ints), or
         None to derive powers of two.
     max_length : largest length the ladder must cover (required when
-        ``buckets`` is None; with explicit buckets the ladder's top IS
-        the cover).
+        ``buckets`` is None).  With explicit buckets it is an optional
+        admission CEILING below the ladder's top: lengths past it are
+        rejected even though a bucket could hold them (an operator capping
+        request size without retuning the ladder).  Never above the top
+        bucket — a ceiling the ladder can't serve is a config error.
     min_bucket : smallest derived bucket (default 8 — tinier buckets
         multiply compiled programs for negligible padding savings).
     """
@@ -37,6 +40,16 @@ class ShapeBucketer:
             ladder = sorted({int(b) for b in buckets})
             if not ladder or ladder[0] <= 0:
                 raise ValueError(f"buckets must be positive ints: {buckets!r}")
+            if max_length is not None:
+                max_length = int(max_length)
+                if max_length <= 0:
+                    raise ValueError(f"max_length must be positive, got "
+                                     f"{max_length}")
+                if max_length > ladder[-1]:
+                    raise ValueError(
+                        f"max_length {max_length} exceeds the top bucket "
+                        f"{ladder[-1]} — requests admitted under that "
+                        f"ceiling could never be served")
         else:
             if max_length is None or int(max_length) <= 0:
                 raise ValueError(
@@ -49,24 +62,36 @@ class ShapeBucketer:
                 b *= 2
             ladder.append(max_length)
         self._buckets = tuple(ladder)
+        self._max_length = int(max_length) if max_length is not None \
+            else self._buckets[-1]
 
     @property
     def buckets(self):
         return self._buckets
 
+    @property
+    def max_length(self):
+        """The admission ceiling: the largest length :meth:`bucket_for`
+        accepts.  Servers check requests against this at ``submit()`` so
+        an oversized request fails at the door with a clear error instead
+        of surfacing as a scheduler-thread failure."""
+        return self._max_length
+
     def bucket_for(self, length):
-        """Smallest bucket >= ``length``.  Raises ValueError past the top
-        of the ladder (the server surfaces this to the submitter — a
-        too-long request must fail loudly, not recompile)."""
+        """Smallest bucket >= ``length``.  Raises ValueError past the
+        ``max_length`` ceiling (the server surfaces this to the submitter
+        — a too-long request must fail loudly, not recompile)."""
         length = int(length)
         if length < 0:
             raise ValueError(f"negative length {length}")
-        i = bisect.bisect_left(self._buckets, length)
-        if i == len(self._buckets):
+        if length > self._max_length:
             raise ValueError(
-                f"length {length} exceeds the largest bucket "
-                f"{self._buckets[-1]} (buckets: {list(self._buckets)})")
+                f"length {length} exceeds max_length {self._max_length} "
+                f"(buckets: {list(self._buckets)}) — the request can never "
+                f"be served by this ladder")
+        i = bisect.bisect_left(self._buckets, length)
         return self._buckets[i]
 
     def __repr__(self):
-        return f"ShapeBucketer(buckets={list(self._buckets)})"
+        return (f"ShapeBucketer(buckets={list(self._buckets)}, "
+                f"max_length={self._max_length})")

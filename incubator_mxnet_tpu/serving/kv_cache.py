@@ -1,0 +1,229 @@
+"""Slot-based, device-resident KV cache for autoregressive serving.
+
+The decode loop of :class:`~.generation.GenerationServer` runs ONE jitted
+step over a fixed-capacity cache: requests do not own tensors, they own
+**slots** — rows of pre-allocated device buffers.  A request joining the
+batch costs a slot allocation (host-side free-list pop) plus one compiled
+memory-insert dispatch; a request leaving costs nothing on device at all
+(the slot is simply marked free and its rows are overwritten by the next
+occupant before they are ever read).  That is what keeps the steady-state
+loop recompile-free: every program ever run is shaped by the POOL, never
+by the traffic.
+
+Capacity is **bucketed by a max-length ladder**: one :class:`SlotKVCache`
+pool per total-decode-length bucket, so a 16-token chat completion does
+not pay attention over the 512-position cache sized for the long tail.
+:class:`KVCacheLadder` owns the pools and routes an admission to the
+smallest bucket that covers the request's token budget.
+
+This module is model-free bookkeeping: device buffers are plain
+``jnp.zeros`` with the conventional layouts
+
+* ``self_k`` / ``self_v`` — ``[layers, slots, bucket, heads, head_dim]``
+  (the per-slot decoded-token cache, written at ``pos[slot]`` each step),
+* ``mem_k`` / ``mem_v`` — ``[layers, slots, mem_width, heads, head_dim]``
+  (the per-slot prefill product: encoder memory through each decoder
+  layer's KV projection, masked by ``mem_len``),
+
+while the jitted programs that read/write them live with the model
+adapter in ``serving/generation.py``.  Host-side per-slot state (``pos``,
+``mem_len``, ``last_token``, ``active``) is numpy: join/leave is pure
+array indexing, never a trace.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from .bucketing import ShapeBucketer
+
+__all__ = ["SlotKVCache", "KVCacheLadder"]
+
+
+class SlotKVCache:
+    """One fixed-capacity pool of KV slots at a single length bucket.
+
+    Parameters
+    ----------
+    layers, heads, head_dim : decoder geometry.
+    slots : pool capacity (concurrent requests at this bucket).
+    bucket : decode-position capacity per slot (the total-length bucket).
+    mem_width : per-slot memory (prefill) width — the top of the prompt
+        ladder, shared across pools.
+    dtype : cache dtype (default float32).
+    """
+
+    def __init__(self, layers, slots, bucket, mem_width, heads, head_dim,
+                 dtype="float32"):
+        import jax.numpy as jnp
+
+        if slots <= 0 or bucket <= 0 or mem_width <= 0:
+            raise ValueError(
+                f"SlotKVCache needs positive slots/bucket/mem_width, got "
+                f"{slots}/{bucket}/{mem_width}")
+        self.layers = int(layers)
+        self.slots = int(slots)
+        self.bucket = int(bucket)
+        self.mem_width = int(mem_width)
+        self.heads = int(heads)
+        self.head_dim = int(head_dim)
+        self.dtype = _np.dtype(dtype)
+        kv_shape = (self.layers, self.slots, self.bucket, self.heads,
+                    self.head_dim)
+        mem_shape = (self.layers, self.slots, self.mem_width, self.heads,
+                     self.head_dim)
+        # the ONLY device allocations this pool ever makes; every later
+        # mutation is a donated-buffer jitted update in place of these
+        self.state = {
+            "self_k": jnp.zeros(kv_shape, self.dtype),
+            "self_v": jnp.zeros(kv_shape, self.dtype),
+            "mem_k": jnp.zeros(mem_shape, self.dtype),
+            "mem_v": jnp.zeros(mem_shape, self.dtype),
+        }
+        # host-side per-slot registers (pure indexing on join/leave)
+        self.pos = _np.zeros(self.slots, _np.int32)
+        self.last_token = _np.zeros(self.slots, _np.int32)
+        # mem_len stays >= 1 even for free slots: a zero-valid cross-
+        # attention row would softmax over an all-masked set and write
+        # NaN into the pool's shared buffers
+        self.mem_len = _np.ones(self.slots, _np.int32)
+        self.active = _np.zeros(self.slots, bool)
+        self.owners = [None] * self.slots
+        self._free = list(range(self.slots - 1, -1, -1))
+        self.joins = 0
+        self.leaves = 0
+
+    # -- slot lifecycle -------------------------------------------------
+    def alloc(self, owner, mem_len, first_token):
+        """Claim a free slot for ``owner``; returns the slot index or
+        ``None`` when the pool is full.  The caller is responsible for
+        dispatching the memory insert for this slot before the next
+        decode step reads it."""
+        if not self._free:
+            return None
+        s = self._free.pop()
+        self.pos[s] = 0
+        self.last_token[s] = int(first_token)
+        self.mem_len[s] = max(1, int(mem_len))
+        self.active[s] = True
+        self.owners[s] = owner
+        self.joins += 1
+        return s
+
+    def free(self, slot):
+        """Release a slot.  Device rows are NOT cleared — the decode step
+        writes position ``pos`` before attending to it, and the mask
+        ``<= pos`` hides everything beyond, so a new occupant can never
+        read its predecessor's rows."""
+        if not self.active[slot]:
+            raise ValueError(f"slot {slot} is not active")
+        self.active[slot] = False
+        self.owners[slot] = None
+        self.mem_len[slot] = 1
+        self.pos[slot] = 0
+        self._free.append(slot)
+        self.leaves += 1
+
+    @property
+    def n_active(self):
+        return int(self.active.sum())
+
+    @property
+    def n_free(self):
+        return len(self._free)
+
+    def active_slots(self):
+        """Indices of live slots, ascending (the scheduler's fan-out
+        order is deterministic so equivalence tests can rely on it)."""
+        return _np.nonzero(self.active)[0]
+
+    def stats(self):
+        return {
+            "bucket": self.bucket,
+            "slots": self.slots,
+            "active": self.n_active,
+            "free": self.n_free,
+            "joins": self.joins,
+            "leaves": self.leaves,
+        }
+
+    def __repr__(self):
+        return (f"SlotKVCache(bucket={self.bucket}, slots={self.slots}, "
+                f"active={self.n_active}/{self.slots})")
+
+
+class KVCacheLadder:
+    """Pools over a total-decode-length ladder.
+
+    A request admits into the smallest bucket covering its token budget
+    (prompt-independent: the decode cache holds only GENERATED positions;
+    the prompt lives in the ``mem_*`` buffers at ``mem_width``).
+
+    Parameters
+    ----------
+    layers, heads, head_dim, mem_width, dtype : forwarded to every pool.
+    buckets : explicit decode-length ladder, or None to derive powers of
+        two up to ``max_length`` (:class:`ShapeBucketer` rules).
+    max_length : ladder cover when ``buckets`` is None, and the hard
+        admission ceiling either way.
+    slots_per_bucket : pool capacity — an int for all pools or a dict
+        ``{bucket: slots}`` (missing buckets fall back to ``default``).
+    """
+
+    def __init__(self, layers, heads, head_dim, mem_width, *, buckets=None,
+                 max_length=None, slots_per_bucket=4, min_bucket=8,
+                 dtype="float32"):
+        self._bucketer = ShapeBucketer(buckets=buckets, max_length=max_length,
+                                       min_bucket=min_bucket)
+        self.pools = {}
+        for b in self._bucketer.buckets:
+            n = (slots_per_bucket.get(b, slots_per_bucket.get("default", 4))
+                 if isinstance(slots_per_bucket, dict)
+                 else int(slots_per_bucket))
+            self.pools[b] = SlotKVCache(layers, n, b, mem_width, heads,
+                                        head_dim, dtype=dtype)
+
+    @property
+    def buckets(self):
+        return self._bucketer.buckets
+
+    @property
+    def max_length(self):
+        return self._bucketer.max_length
+
+    def bucket_for(self, total_len):
+        """Smallest bucket covering ``total_len`` (ValueError past the
+        ladder — admission must reject at submit, not here)."""
+        return self._bucketer.bucket_for(total_len)
+
+    def try_alloc(self, total_len, owner, mem_len, first_token):
+        """Allocate a slot in the smallest covering pool with capacity,
+        walking UP the ladder when the tight pool is full (a long-bucket
+        slot can always serve a short request; the reverse cannot).
+        Returns ``(pool, slot)`` or ``None`` when every covering pool is
+        exhausted."""
+        start = self._bucketer.bucket_for(total_len)
+        for b in self._bucketer.buckets:
+            if b < start:
+                continue
+            s = self.pools[b].alloc(owner, mem_len, first_token)
+            if s is not None:
+                return self.pools[b], s
+        return None
+
+    @property
+    def n_active(self):
+        return sum(p.n_active for p in self.pools.values())
+
+    @property
+    def n_slots(self):
+        return sum(p.slots for p in self.pools.values())
+
+    def stats(self):
+        return {
+            "buckets": {b: p.stats() for b, p in self.pools.items()},
+            "active": self.n_active,
+            "slots": self.n_slots,
+        }
+
+    def __repr__(self):
+        return f"KVCacheLadder({[repr(p) for p in self.pools.values()]})"

@@ -180,11 +180,11 @@ class InferenceServer:
                                                max_length=max_length)
         else:
             self._len_bucketer = None
+        # explicit batch_buckets that don't cover max_batch_size are
+        # rejected by the bucketer itself (max_length past the top bucket)
         self._batch_bucketer = ShapeBucketer(
             buckets=batch_buckets, max_length=self.max_batch_size,
             min_bucket=1)
-        if self._batch_bucketer.buckets[-1] < self.max_batch_size:
-            raise ValueError("batch_buckets must cover max_batch_size")
         if unpad_output_axis == "auto":
             unpad_output_axis = 0 if self._has_variable else None
         self._unpad_spec = unpad_output_axis
@@ -510,17 +510,10 @@ class InferenceServer:
                       "latency_ms_max": round(max(lats), 3) if lats else 0})
 
     # -- observability -------------------------------------------------
-    @staticmethod
-    def _pct(sorted_xs, q):
-        if not sorted_xs:
-            return None
-        i = min(len(sorted_xs) - 1, int(q * len(sorted_xs)))
-        return sorted_xs[i]
-
     def stats(self):
         """Live serving stats (also the metrics-provider payload)."""
         with self._lock:
-            lat = sorted(self._latencies)
+            lat = self._latencies
             return {
                 "queue_depth": len(self._queue),
                 "queue_depth_peak": self._depth_peak,
@@ -534,8 +527,8 @@ class InferenceServer:
                 "bucket_miss_after_warmup": self._miss_after_warmup,
                 "slo_violations": self._n_slo_violations,
                 "slo_ms": self.slo_ms,
-                "latency_ms_p50": self._pct(lat, 0.50),
-                "latency_ms_p99": self._pct(lat, 0.99),
+                "latency_ms_p50": profiler.percentile(lat, 0.50),
+                "latency_ms_p99": profiler.percentile(lat, 0.99),
                 "warm_buckets": len(self._warm),
             }
 

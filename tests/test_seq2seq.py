@@ -52,7 +52,16 @@ class TestTransformerSeq2Seq:
         assert np.abs(l1[:, 5:] - l2[:, 5:]).max() > 1e-4
 
     def test_copy_task_trains_and_decodes(self):
-        """Train on the copy task until greedy decode reproduces inputs."""
+        """Train on the copy task until greedy decode reproduces inputs.
+
+        Deterministic by construction: every PRNG is seeded (model init
+        via ``_tiny`` -> ``mx.random.seed(0)``, per-step batches by step
+        index) and convergence is judged on a FIXED held-out batch — the
+        old version asserted on whatever the last *random* training
+        batch's loss happened to be, which sat right at the threshold
+        (measured 0.54 vs 0.5 at step 150).  At 200 steps the held-out
+        loss is 0.040; the 0.25 threshold leaves >6x margin."""
+        mx.random.seed(0)
         net = _tiny()
         B, S = 16, 8
 
@@ -63,14 +72,18 @@ class TestTransformerSeq2Seq:
         net(mx.nd.array(src0, dtype="int32"), mx.nd.array(tgt0, dtype="int32"))
         trainer = SPMDTrainer(net, loss_fn, "adam", {"learning_rate": 3e-3},
                               mesh=make_mesh())
-        for i in range(150):
+        for i in range(200):
             src, tgt_in, tgt_out = _copy_batch(B, S, seed=i)
-            loss = trainer.step((mx.nd.array(src, dtype="int32"),
-                                 mx.nd.array(tgt_in, dtype="int32")),
-                                mx.nd.array(tgt_out, dtype="int32"))
-        final = float(loss.asnumpy())
-        assert final < 0.5, final
+            trainer.step((mx.nd.array(src, dtype="int32"),
+                          mx.nd.array(tgt_in, dtype="int32")),
+                         mx.nd.array(tgt_out, dtype="int32"))
         trainer.sync_to_block()
+        src, tgt_in, tgt_out = _copy_batch(B, S, seed=9999)  # held out
+        out = net(mx.nd.array(src, dtype="int32"),
+                  mx.nd.array(tgt_in, dtype="int32"))
+        final = float(loss_fn(out, mx.nd.array(tgt_out, dtype="int32"))
+                      .asnumpy().mean())
+        assert final < 0.25, final
 
         # greedy decode should now copy (teacher-free)
         src = np.array([[5, 9, 12, 7, 5, 11, 4, 8]], np.int32)

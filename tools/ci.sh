@@ -24,7 +24,10 @@
 #   8. serving   — inference serving tier: the open-loop throughput-at-SLO
 #                  harness in --smoke mode (exits non-zero if any batch
 #                  recompiled after warmup — the bucket-miss regression
-#                  guard) plus the non-slow serving tests
+#                  guard), the continuous-batching generation harness in
+#                  --smoke mode (guard raise mode armed; non-zero exit on
+#                  any post-warmup compile in the decode loop), plus the
+#                  non-slow serving + generation tests
 #   9. io        — input-pipeline tier: the synthetic host-bound harness in
 #                  --smoke mode (exits non-zero if the async infeed's
 #                  consumer stalled after warmup — the host-starvation
@@ -161,13 +164,16 @@ for tier in "${TIERS[@]}"; do
                 python -m pytest tests/test_chaos.py -q ${CI_PYTEST_ARGS:-}
             ;;
         serving)
-            # serving tier: the smoke harness IS the bucket-miss regression
-            # guard (non-zero exit if any batch bound/compiled after
-            # warmup), then the fast serving tests
+            # serving tier: the smoke harnesses ARE the regression guards
+            # (serving.py exits non-zero if any batch bound/compiled after
+            # warmup; generation.py exits non-zero if the continuous-
+            # batching decode loop compiled anything post-warmup under
+            # guard raise mode), then the fast serving + generation tests
             run_tier serving "${CPU_ENV[@]}" bash -c '
                 set -e
                 python benchmark/opperf/serving.py --smoke >/dev/null
-                python -m pytest tests/test_serving.py -q -m "not slow" '"${CI_PYTEST_ARGS:-}"
+                python benchmark/opperf/generation.py --smoke >/dev/null
+                python -m pytest tests/test_serving.py tests/test_generation.py -q -m "not slow" '"${CI_PYTEST_ARGS:-}"
             ;;
         io)
             # input-pipeline tier: the smoke harness IS the
