@@ -155,12 +155,12 @@ def memory_info(ctx=None):
         devices = [_resolve_jax_device(ctx.device_type, ctx.device_id)]
     else:
         devices = jax.local_devices()
+    from . import profiler
+
+    shared = profiler.device_memory_stats(devices)
     out = {}
     for d in devices:
-        try:
-            stats = d.memory_stats() or {}
-        except Exception:
-            stats = {}
+        stats = shared.get(str(d)) or {}
         out[str(d)] = {
             "bytes_in_use": stats.get("bytes_in_use"),
             "peak_bytes_in_use": stats.get("peak_bytes_in_use"),

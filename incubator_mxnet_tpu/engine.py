@@ -575,7 +575,7 @@ class _BulkQueue:
                     _flush_jits.pop(graph_key, None)
                 try:
                     results = _run_spec(_spec_of(ops), consts, live)
-                except Exception:
+                except Exception as flush_err:
                     # the flush is lost (ops already drained): poison the
                     # surviving outputs so a later enqueue can't wire their
                     # stale ('d', i, j) indices into a fresh graph — reads
@@ -585,6 +585,10 @@ class _BulkQueue:
                             d = wr()
                             if d is not None:
                                 d._src = None
+                    # bulk flush is an OOM choke point: a device allocation
+                    # failure gets one postmortem naming the ledger's top
+                    # owners before it surfaces (no-op otherwise)
+                    profiler.maybe_oom_postmortem(flush_err, "engine.flush")
                     raise
             profiler.incr("bulk_flush")
             profiler.incr("bulk_ops_flushed", len(ops))

@@ -34,6 +34,12 @@ from .random import get_key, push_traced_key, pop_traced_key
 __all__ = ["Executor"]
 
 
+def _release_executor_memory(nbytes):
+    """weakref.finalize hook: a collected executor's bound arrays leave
+    the device-memory ledger (module-level — must not reference self)."""
+    _profiler.track_memory("executor.bound", "params").free(nbytes)
+
+
 def _as_ndarray(v, dtype=None):
     if isinstance(v, NDArray):
         return v
@@ -83,8 +89,28 @@ class Executor:
         # "predictor.forward" and the serving tier overrides both with a
         # profiler.compile_site scope ("serving.warmup"/"serving.dispatch")
         self._compile_site = "executor.forward"
+        # device-memory ledger: the bound arg/aux/grad arrays, released at
+        # GC (weakref.finalize — executors have no close()).  A Predictor
+        # immediately calls _release_memory(): its executors share the
+        # predictor-accounted parameter store by object, and double
+        # counting would inflate the owner past the real footprint.
+        import weakref as _weakref
+
+        # shape x dtype via the shared helper — touching ._data.nbytes
+        # would force-resolve a pending bulk-deferred buffer at bind time
+        nb = sum(_profiler.array_nbytes(v)
+                 for d in (self._arg_dict, self._aux_dict, self._grad_dict)
+                 for v in d.values() if v is not None)
+        _profiler.track_memory("executor.bound", "params").alloc(nb)
+        self._mem_finalizer = _weakref.finalize(
+            self, _release_executor_memory, nb)
         from .base import register_jit_cache_owner
         register_jit_cache_owner(self)
+
+    def _release_memory(self):
+        """Drop this executor's ledger row early (idempotent; the
+        Predictor calls it to keep shared-store bytes singly counted)."""
+        self._mem_finalizer()
 
     def _invalidate_jit_cache(self):
         self._fwd_cache.clear()

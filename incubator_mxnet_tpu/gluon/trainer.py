@@ -63,6 +63,19 @@ class Trainer:
         self._states = {}
         self._last_scale_set = None   # last rescale_grad THIS trainer wrote
         self._grad_versions = {}      # index -> grad buffer version at last update
+        # device-memory ledger accounting (docs/observability.md#device-
+        # memory-observability): indices whose weight+grad+state bytes
+        # have been reported, and the totals to release on close() — or
+        # at GC via the finalizer, so a trainer dropped without close()
+        # (the common local path) cannot leak ledger bytes.
+        # Donation-aware by construction — the fused step swaps buffers
+        # of identical shape/dtype, so accounted bytes never move.
+        import weakref as _weakref
+
+        self._mem_idx = set()
+        self._mem_bytes = [0, 0]      # [params+grads, optimizer state]
+        self._mem_finalizer = _weakref.finalize(
+            self, _release_trainer_memory, self._mem_bytes)
 
     def _init_optimizer(self, optimizer, optimizer_params):
         param_dict = {i: p for i, p in enumerate(self._params)}
@@ -204,6 +217,7 @@ class Trainer:
             if i not in self._states:
                 self._states[i] = self._optimizer.create_state_multi_precision(i, p.data())
             touched.append((i, p))
+        self._account_memory(touched)
         # fused whole-group fast path; leftovers (unsupported optimizer,
         # lazy row-sparse params, NaiveEngine, aggregation disabled) take
         # the per-tensor loop below
@@ -221,6 +235,26 @@ class Trainer:
                     and p._data._grad is not None:
                 self._grad_versions[i] = p.grad_version
 
+    def _account_memory(self, touched):
+        """Report newly-tracked weight+grad+state buffers into the
+        device-memory ledger (shared ``trainer.params`` /
+        ``trainer.optimizer_state`` owners — several trainers compose by
+        deltas).  Steady state is a no-op: the index set is stable and
+        donated buffer swaps keep every size constant."""
+        new = [(i, p) for i, p in touched if i not in self._mem_idx]
+        if not new:
+            return
+        pb = sb = 0
+        for i, p in new:
+            self._mem_idx.add(i)
+            pb += 2 * _nd_nbytes(p._data)     # weight + grad buffer
+            sb += _nd_nbytes(self._states.get(i))
+        self._mem_bytes[0] += pb
+        self._mem_bytes[1] += sb
+        _profiler.track_memory("trainer.params", "params").alloc(pb)
+        _profiler.track_memory("trainer.optimizer_state",
+                               "optimizer_state").alloc(sb)
+
     def close(self):
         """Release distributed resources.  Against an elastic dist store
         (``dist_async``) this deregisters the rank — peers' barrier and
@@ -234,6 +268,10 @@ class Trainer:
             kv = self._kvstore_type
         if kv is not None and hasattr(kv, "close"):
             kv.close()
+        # release this trainer's ledger share (idempotent — the finalizer
+        # zeroes the shared cell, so a later GC pass frees nothing more)
+        self._mem_finalizer()
+        self._mem_idx.clear()
 
     def __enter__(self):
         return self
@@ -280,6 +318,25 @@ class Trainer:
         self._optimizer._index_update_count = dict(counts)
         self._optimizer.num_update = num_update
         self._optimizer.begin_num_update = num_update
+
+
+# shape-x-dtype footprint (never resolves a pending deferred buffer) —
+# the shared rule lives beside the ledger itself
+_nd_nbytes = _profiler.array_nbytes
+
+
+def _release_trainer_memory(cell):
+    """weakref.finalize hook (also the close() body): free this trainer's
+    share of the shared ledger owners and zero the mutable cell so the
+    release can only ever happen once (module-level — must not reference
+    the trainer)."""
+    pb, sb = cell
+    cell[0] = cell[1] = 0
+    if pb:
+        _profiler.track_memory("trainer.params", "params").free(pb)
+    if sb:
+        _profiler.track_memory("trainer.optimizer_state",
+                               "optimizer_state").free(sb)
 
 
 def _states_to_numpy(st):

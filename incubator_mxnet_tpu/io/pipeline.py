@@ -348,6 +348,11 @@ class _Engine:
         x = threading.Thread(target=self._transfer, args=(gen,), daemon=True,
                              name=f"mxtpu-{self.name}-transfer")
         self._threads.append(x)
+        # device-memory ledger: this pipeline's infeed buffer occupancy
+        # (alloc on transfer-in, free on consumer pop; name is per-
+        # pipeline unique, so trackers never collide).  Created BEFORE
+        # the threads start — the transfer stage accounts its first batch
+        self._mem = _profiler.track_memory(f"io.{self.name}", "infeed")
         for t in self._threads:
             t.start()
         _profiler.register_metrics_provider(self.name, self._provider)
@@ -388,6 +393,9 @@ class _Engine:
             self._buf = []
             self._ready = {}
         _profiler.unregister_metrics_provider(self.name)
+        mem = getattr(self, "_mem", None)
+        if mem is not None:
+            mem.close()   # buffered bytes leave the ledger with the buffer
 
     def reset(self):
         """End the epoch: stop the stages, reset/re-open the source, and
@@ -487,6 +495,7 @@ class _Engine:
                     return
                 batch, err = self._ready.pop(next_seq)
             next_seq += 1
+            nbytes = 0
             if err is None and batch is not _EOS:
                 t0 = _perf() if _profiler._active else None
                 try:
@@ -508,11 +517,17 @@ class _Engine:
                     self._buf_cond.wait(timeout=0.05)
                 if self._dead(gen):
                     return
-                self._buf.append((batch, err))
+                if nbytes:
+                    # alloc BEFORE the append becomes visible: a consumer
+                    # racing next() could otherwise pop-and-free first and
+                    # drive the tracker transiently negative
+                    self._mem.alloc(nbytes)
+                self._buf.append((batch, err, nbytes))
                 self._buf_cond.notify_all()
             if batch is _EOS:
                 return
-            self._maybe_autotune()
+            _profiler.maybe_sample_memory()  # pipeline tick: keep the
+            self._maybe_autotune()           # watermark/counter track live
 
     def _place(self, batch):
         """Move one prepped batch's leaves host→device with the mesh data
@@ -581,8 +596,10 @@ class _Engine:
                 stalled_t0 = t0
             else:
                 stalled_t0 = None
-            batch, err = self._buf.pop(0)
+            batch, err, nbytes = self._buf.pop(0)
             self._buf_cond.notify_all()
+        if nbytes:
+            self._mem.free(nbytes)   # the consumer owns the batch now
         if stalled_t0 is not None:
             _profiler.incr("io_pipeline_stalls")
             if _profiler._active:
@@ -612,23 +629,17 @@ class _Engine:
 
     @staticmethod
     def _default_device_pressure(frac):
+        # ONE shared admission API for the whole repo (profiler.
+        # MemoryBudget over profiler.device_memory_stats) instead of a
+        # private memory_stats() probe: reads CURRENT bytes_in_use —
+        # deliberately not peak_bytes_in_use, whose never-decaying
+        # watermark would report a warmup compile spike as pressure
+        # forever — against the device bytes_limit AND any explicit
+        # MXNET_MEM_BUDGET_MB process budget
         try:
-            for d in jax.local_devices():
-                ms = getattr(d, "memory_stats", None)
-                stats = ms() if callable(ms) else None
-                if not stats:
-                    continue
-                limit = stats.get("bytes_limit", 0)
-                # CURRENT occupancy, deliberately not peak_bytes_in_use:
-                # the lifetime high-watermark never decays, so one warmup
-                # compilation spike would report pressure forever and pin
-                # the depth at the floor
-                used = stats.get("bytes_in_use", 0)
-                if limit and used > frac * limit:
-                    return True
+            return _profiler.memory_budget().under_pressure(frac)
         except Exception:
-            pass  # telemetry must never take the infeed down
-        return False
+            return False  # telemetry must never take the infeed down
 
     def _maybe_autotune(self):
         if not self._autotune:
@@ -681,7 +692,7 @@ class _Engine:
                 "depth": self._depth,
                 "max_depth": self._max_depth,
                 "buffer_occupancy": len(self._buf),
-                "buffer_bytes": self._batch_bytes * len(self._buf),
+                "buffer_bytes": sum(n for _, _, n in self._buf),
                 "batch_bytes": self._batch_bytes,
                 "bytes_total": self._bytes_total,
                 "batches": self._n_batches,

@@ -190,6 +190,13 @@ def fused_update(optimizer, items, states):
                         raise
                     new_w, new_s = res
                     guard_err = e
+                except Exception as e:
+                    # donated-buffer dispatch is an OOM choke point: the
+                    # step's fresh outputs are the allocation that fails
+                    # when HBM is exhausted — name the owners before the
+                    # error surfaces (no-op for unrelated errors)
+                    _profiler.maybe_oom_postmortem(e, "optimizer.group_apply")
+                    raise
                 if t0 is not None:
                     _profiler.record_span("fused.group_apply", "optimizer",
                                           t0, args={"params": len(chunk)})

@@ -76,6 +76,15 @@ def _state_to_ndarrays(st):
     return st
 
 
+def _release_spmd_memory(param_bytes, state_bytes):
+    """weakref.finalize hook: a collected trainer's donated buffers leave
+    the device-memory ledger (no self reference — the finalizer must not
+    keep the trainer alive)."""
+    _profiler.track_memory("spmd.params", "params").free(param_bytes)
+    _profiler.track_memory("spmd.optimizer_state",
+                           "optimizer_state").free(state_bytes)
+
+
 class SPMDTrainer:
     """Compile a Gluon block + loss + optimizer into one sharded train step.
 
@@ -162,6 +171,20 @@ class SPMDTrainer:
         self._step_cache = {}
         self._guard_armed = False   # steady-state compile guard armed after
                                     # the first compiled step completes
+        # device-memory ledger: the trainer owns its donated param/state
+        # copies outright (donation keeps sizes constant, so these totals
+        # are exact for the process lifetime); freed when the trainer is
+        # collected
+        import weakref as _weakref
+        pb = sum(int(a.nbytes) for a in self._param_arrays)
+        sb = sum(int(leaf.nbytes)
+                 for st in self._opt_states
+                 for leaf in jax.tree_util.tree_leaves(st))
+        _profiler.track_memory("spmd.params", "params").alloc(pb)
+        _profiler.track_memory("spmd.optimizer_state",
+                               "optimizer_state").alloc(sb)
+        self._mem_finalizer = _weakref.finalize(
+            self, _release_spmd_memory, pb, sb)
         from ..base import register_jit_cache_owner
         register_jit_cache_owner(self)
         if jax.process_count() > 1:
@@ -280,7 +303,14 @@ class SPMDTrainer:
         tc = _perf() if fresh else None
         t0 = _perf() if _profiler._active else None
         try:
-            new_params, new_states, loss = fn(*call_args)
+            try:
+                new_params, new_states, loss = fn(*call_args)
+            except Exception as e:
+                # the fused step is THE training-tier OOM choke point:
+                # a RESOURCE_EXHAUSTED here gets one postmortem naming
+                # the top ledger owners before it surfaces
+                _profiler.maybe_oom_postmortem(e, "spmd.step")
+                raise
             self._param_arrays = new_params
             self._opt_states = new_states
             if tc is not None:
@@ -341,7 +371,11 @@ class SPMDTrainer:
         tc = _perf() if fresh else None
         t0 = _perf() if _profiler._active else None
         try:
-            new_params, new_states, loss = fn(*call_args)
+            try:
+                new_params, new_states, loss = fn(*call_args)
+            except Exception as e:
+                _profiler.maybe_oom_postmortem(e, "spmd.step_bulk")
+                raise
             self._param_arrays = new_params
             self._opt_states = new_states
             if tc is not None:

@@ -28,6 +28,23 @@ import numpy as _np
 __all__ = ["Predictor", "StatefulExecutor", "load_checkpoint"]
 
 
+def _nd_store_nbytes(nd):
+    """Footprint of one stored NDArray — the shared shape-x-dtype rule
+    (``profiler.array_nbytes``; never touches the raw buffer)."""
+    from . import profiler
+
+    return profiler.array_nbytes(nd)
+
+
+def _release_predictor_memory(cell):
+    """weakref.finalize hook for a predictor's ledger share (mutable cell:
+    late-bound zero-filled parameters grow it after construction)."""
+    from . import profiler
+
+    profiler.track_memory("predictor.params", "params").free(cell[0])
+    cell[0] = 0
+
+
 def _split_param_key(name):
     """Split a checkpoint key into (kind, bare_name).
 
@@ -145,7 +162,14 @@ class StatefulExecutor:
         jfn = self._programs[program]
         before = profiler.jit_cache_size(jfn)
         t0 = _time.perf_counter()
-        outputs, new_state = jfn(self._state, inputs)
+        try:
+            outputs, new_state = jfn(self._state, inputs)
+        except Exception as e:
+            # the stateful dispatch (decode step / KV-cache insert) is an
+            # OOM choke point: emit one postmortem naming the top ledger
+            # owners before the error surfaces (no-op otherwise)
+            profiler.maybe_oom_postmortem(e, f"{self._site}:{program}")
+            raise
         wall_ms = (_time.perf_counter() - t0) * 1e3
         missing = set(self._state) - set(new_state)
         if missing:
@@ -197,7 +221,10 @@ class Predictor:
 
     def __init__(self, symbol_file, param_file, input_shapes, dev_type="cpu",
                  dev_id=0):
+        import weakref as _weakref
+
         from . import context as ctx_mod
+        from . import profiler
 
         self._sym, self._arg_store, self._aux_store = load_checkpoint(
             symbol_file, param_file)
@@ -205,7 +232,25 @@ class Predictor:
         self._input_shapes = {k: tuple(v) for k, v in input_shapes.items()}
         self._exe_cache = {}   # shape signature -> Executor (jit caches ride)
         self._outputs = None
+        # device-memory ledger: the shared parameter store is accounted
+        # ONCE here (executors share it by object, so their own bound-
+        # array accounting is released in _executor_for); freed on
+        # close() or GC, whichever first
+        self._mem_cell = [sum(
+            _nd_store_nbytes(nd)
+            for store in (self._arg_store, self._aux_store)
+            for nd in store.values())]
+        profiler.track_memory("predictor.params", "params").alloc(
+            self._mem_cell[0])
+        self._mem_finalizer = _weakref.finalize(
+            self, _release_predictor_memory, self._mem_cell)
         self._exe = self._executor_for(self._input_shapes)
+
+    def close(self):
+        """Release this predictor's share of the device-memory ledger
+        (the arrays themselves are freed by GC as usual).  Idempotent;
+        also runs at GC via ``weakref.finalize``."""
+        self._mem_finalizer()
 
     @staticmethod
     def _sig(shapes):
@@ -247,6 +292,7 @@ class Predictor:
                         f"parameter {name!r}")
                 dtype = _as_np_dtype(dt or "float32")
                 nd = self._arg_store[name] = NDArray(jnp.zeros(shp, dtype))
+                self._mem_account(nd)
             elif shp is not None and tuple(nd.shape) != tuple(shp):
                 raise ValueError(
                     f"predictor: parameter {name!r} has shape "
@@ -262,6 +308,7 @@ class Predictor:
                 dtype = _as_np_dtype(dt or "float32")
                 nd = self._aux_store[name] = NDArray(
                     jnp.zeros(shp if shp is not None else (1,), dtype))
+                self._mem_account(nd)
             auxs[name] = nd
         exe = Executor(self._sym, self._ctx, args=args, grad_req="null",
                        aux_states=auxs)
@@ -269,8 +316,20 @@ class Predictor:
         # bind reports as the predictor's, not a bare executor's (the
         # serving tier further overrides via profiler.compile_site)
         exe._compile_site = "predictor.forward"
+        # memory attribution: the executor's bound arrays ARE the shared
+        # store this predictor already accounted — drop the executor's own
+        # ledger row so the bytes are never counted twice
+        exe._release_memory()
         self._exe_cache[sig] = exe
         return exe
+
+    def _mem_account(self, nd):
+        n = _nd_store_nbytes(nd)
+        if n:
+            from . import profiler
+
+            self._mem_cell[0] += n
+            profiler.track_memory("predictor.params", "params").alloc(n)
 
     def reshape(self, new_shapes):
         """Rebind for a new input-shape signature, sharing the parameter

@@ -51,6 +51,16 @@ argument, XLA cost accounting, and a steady-state compile guard
 (``MXNET_COMPILE_GUARD``) — see the Compilation observability section
 below and ``tools/compile_report.py``.
 
+Since ISSUE 12 it owns **device-memory observability** too: a live HBM
+ledger every buffer-holding subsystem registers into (``track_memory``;
+donation-aware, exact by construction), OOM forensics (the dispatch
+choke points route ``RESOURCE_EXHAUSTED`` through
+``maybe_oom_postmortem`` — one structured report naming the top owners
+by bytes), a ``MemoryBudget`` admission API
+(``MXNET_MEM_BUDGET_MB``), and a per-device memory counter track in the
+chrome trace — see the Device-memory observability section below and
+``tools/memory_report.py``.
+
 Counters are **strict** since ISSUE 5: ``incr`` on an undeclared name
 raises (a typo'd instrumentation site fails loudly instead of reporting
 zeros forever); extensions register theirs via ``declare_counter()``.
@@ -90,7 +100,13 @@ __all__ = ["set_config", "start", "stop", "dump", "dumps", "pause", "resume",
            "compile_stats", "reset_compiles", "sig_array", "sig_static",
            "diff_signatures", "compile_cost_enabled", "jit_cache_size",
            "arm_compile_guard", "disarm_compile_guard", "compile_guard_state",
-           "compile_guard_paused", "CompileGuardError"]
+           "compile_guard_paused", "CompileGuardError",
+           # -- device-memory observability (ISSUE 12) --
+           "track_memory", "memory_ledger", "memory_postmortems",
+           "array_nbytes", "device_memory_stats", "sample_device_memory",
+           "maybe_sample_memory", "memory_budget", "MemoryBudget",
+           "MemoryBudgetError", "oom_postmortem", "maybe_oom_postmortem",
+           "is_resource_exhausted"]
 
 _logger = logging.getLogger(__name__)
 
@@ -214,6 +230,8 @@ _counters = {
     "compile_total": 0,               # jit compilations across every site
     "compile_ms_total": 0,            # wall ms those compilations cost
     "recompile_steady_state": 0,      # compiles after the guard armed
+    "memory_oom_postmortem": 0,       # OOM/budget-breach postmortems emitted
+    "memory_budget_refusal": 0,       # admissions deferred by a MemoryBudget
 }
 _counter_lock = _threading.Lock()
 
@@ -567,30 +585,102 @@ def step_stats():
 
 
 def memory_watermark():
-    """Peak ``bytes_in_use`` observed per device at step boundaries (empty
-    when the backend exposes no ``memory_stats``, e.g. CPU)."""
+    """Peak ``bytes_in_use`` observed per device (empty when the backend
+    exposes no ``memory_stats``, e.g. CPU).  Sampled at step boundaries,
+    on every ``metrics_snapshot()``, and on serving/generation/pipeline
+    scheduler ticks — a serving-only process (no trainer steps) still
+    reports a live watermark."""
     with _counter_lock:
         return dict(_mem_watermark)
 
 
-def _sample_memory():
+def device_memory_stats(devices=None):
+    """THE shared ``Device.memory_stats()`` probe (one parse rule for the
+    whole repo — the watermark sampler, the io-pipeline pressure backoff,
+    ``util.get_gpu_memory`` and ``config.memory_info`` all read through
+    it).  Returns ``{device_str: {"bytes_in_use", "peak_bytes_in_use",
+    "bytes_limit"}}``; devices that expose no stats (CPU) are simply
+    absent.  Never raises."""
     global _devices_cache
+    out = {}
     try:
-        if _devices_cache is None:
-            _devices_cache = jax.local_devices()
-        for d in _devices_cache:
+        if devices is None:
+            if _devices_cache is None:
+                _devices_cache = jax.local_devices()
+            devices = _devices_cache
+        for d in devices:
             ms = getattr(d, "memory_stats", None)
-            stats = ms() if callable(ms) else None
+            try:
+                stats = ms() if callable(ms) else None
+            except Exception:
+                stats = None
             if not stats:
                 continue
-            used = stats.get("peak_bytes_in_use",
-                             stats.get("bytes_in_use", 0))
-            key = str(d)
-            with _counter_lock:
-                if used > _mem_watermark.get(key, -1):
-                    _mem_watermark[key] = used
+            used = int(stats.get("bytes_in_use", 0) or 0)
+            out[str(d)] = {
+                "bytes_in_use": used,
+                "peak_bytes_in_use": int(
+                    stats.get("peak_bytes_in_use", used) or used),
+                "bytes_limit": int(stats.get("bytes_limit", 0) or 0),
+            }
     except Exception:
         pass  # telemetry must never take training down
+    return out
+
+
+# memory counter-track samples for the chrome trace: (perf_t,
+# {device: bytes_in_use}, {category: ledger_bytes}); bounded FIFO,
+# cleared per fresh recording session
+_mem_samples = []
+_MAX_MEM_SAMPLES = _env_int("MXNET_PROFILER_MEM_SAMPLES", 4096)
+_mem_last = [0.0]   # perf_counter of the last sample (throttle)
+
+
+def sample_device_memory():
+    """Take one device-memory sample: update the per-device watermark and
+    (while the recorder is armed) append a counter-track point carrying
+    per-device ``bytes_in_use`` plus the ledger's per-category totals —
+    ``dump()`` serializes these as chrome-trace ``C`` events, which
+    Perfetto renders as a memory timeline.  No-op when
+    ``set_config(memory_sampling=False)``."""
+    if not _config.get("memory_sampling", True):
+        return
+    now = _perf()
+    _mem_last[0] = now
+    stats = device_memory_stats()
+    dev_use = {}
+    with _counter_lock:
+        for key, s in stats.items():
+            dev_use[key] = s["bytes_in_use"]
+            used = s["peak_bytes_in_use"]
+            if used > _mem_watermark.get(key, -1):
+                _mem_watermark[key] = used
+    if _recording:
+        cats = _ledger_categories()
+        if dev_use or cats:
+            with _counter_lock:
+                _mem_samples.append((now, dev_use, cats))
+                while len(_mem_samples) > _MAX_MEM_SAMPLES:
+                    _mem_samples.pop(0)
+
+
+# back-compat alias: the pre-ISSUE-12 step-boundary sampler
+_sample_memory = sample_device_memory
+
+
+def maybe_sample_memory(min_interval_s=None):
+    """Throttled :func:`sample_device_memory` — the scheduler-tick entry
+    (serving dispatch, generation iteration, pipeline transfer,
+    ``metrics_snapshot``).  Samples at most every
+    ``MXNET_PROFILER_MEM_SAMPLE_S`` seconds (default 0.05) so a hot
+    serving loop never turns telemetry into a hot path."""
+    if not _config.get("memory_sampling", True):
+        return
+    if min_interval_s is None:
+        min_interval_s = _env_float("MXNET_PROFILER_MEM_SAMPLE_S", 0.05)
+    if _perf() - _mem_last[0] < min_interval_s:
+        return
+    sample_device_memory()
 
 
 def _slow_threshold_ms():
@@ -764,6 +854,9 @@ def metrics_snapshot():
     the Prometheus endpoint renders it."""
     global _metrics_seq
     incr("metrics_snapshot")
+    # sample device memory on the snapshot tick: a serving-only process
+    # (no trainer step boundaries) must still report a live watermark
+    maybe_sample_memory()
     with _counter_lock:
         _metrics_seq += 1
         seq = _metrics_seq
@@ -1084,6 +1177,440 @@ def straggler_report():
             "comms_ms": worst.get("comms_ms", 0.0),
             "device_ms": worst.get("device_ms", 0.0),
             "ranks_compared": len(rows)}
+
+
+# ---------------------------------------------------------------------------
+# Device-memory observability (ISSUE 12): live HBM ledger with per-subsystem
+# attribution, OOM forensics, and budgeted admission
+# ---------------------------------------------------------------------------
+
+# The compile registry answers "what compiled"; this ledger answers "what
+# OWNS the bytes".  Every subsystem that holds device buffers registers an
+# owner via ``track_memory(owner, category)`` and accounts its allocations
+# with plain integer deltas (``alloc``/``free``/``set``) — no device probe
+# on the accounting path, so the ledger is exact for what is wired and
+# free when nothing is.  Donation-aware by construction: a donated buffer
+# is REPLACED by its same-shaped successor, so the owner's bytes never
+# move on a fused optimizer step or a KV-cache decode.  On top of it:
+#
+# * ``MemoryBudget`` — the one admission API (``MXNET_MEM_BUDGET_MB`` or
+#   an explicit per-subsystem cap); GenerationServer slot admission and
+#   the DataPipeline autotuner consult it instead of raw memory_stats();
+# * OOM forensics — the dispatch choke points (engine flush, SPMD step,
+#   serving dispatch, stateful-executor/KV insert, fused optimizer step)
+#   route ``RESOURCE_EXHAUSTED`` through :func:`maybe_oom_postmortem`,
+#   which emits ONE structured report naming the top owners by bytes and
+#   the failed allocation size before the error re-raises;
+# * a per-device memory **counter track** in the chrome trace (Perfetto
+#   renders a timeline), sampled at step boundaries, metrics snapshots
+#   and serving/pipeline ticks; ``tools/trace_merge.py`` carries it
+#   across ranks and ``tools/memory_report.py`` summarizes it offline.
+#
+# See docs/observability.md#device-memory-observability.
+
+_mem_lock = _threading.Lock()
+_mem_owners = {}        # owner name -> MemoryTracker (THE ledger)
+_mem_postmortems = []   # bounded FIFO of postmortem report dicts
+_MAX_POSTMORTEMS = 64
+
+
+class MemoryTracker:
+    """Owner-scoped accounting handle returned by :func:`track_memory`.
+
+    ``alloc``/``free`` move bytes in and out of the owner's row;
+    ``set`` pins an absolute total (sites that recompute their footprint
+    rather than tracking deltas).  Handles are shared: a second
+    ``track_memory`` of the same owner returns the SAME tracker, so
+    multiple instances (two KV pools at one bucket, two trainers) compose
+    by deltas.  ``close()`` removes the owner from the ledger outright —
+    only sole owners should call it; shared sites ``free`` their own
+    bytes instead."""
+
+    __slots__ = ("owner", "category", "bytes", "peak", "allocs", "frees")
+
+    def __init__(self, owner, category):
+        self.owner = str(owner)
+        self.category = str(category)
+        self.bytes = 0
+        self.peak = 0
+        self.allocs = 0
+        self.frees = 0
+
+    def alloc(self, nbytes):
+        n = int(nbytes)
+        with _mem_lock:
+            self.bytes += n
+            self.allocs += 1
+            if self.bytes > self.peak:
+                self.peak = self.bytes
+        return self
+
+    def free(self, nbytes):
+        with _mem_lock:
+            self.bytes -= int(nbytes)
+            self.frees += 1
+        return self
+
+    def set(self, nbytes):
+        with _mem_lock:
+            self.bytes = int(nbytes)
+            if self.bytes > self.peak:
+                self.peak = self.bytes
+        return self
+
+    def close(self):
+        with _mem_lock:
+            self.bytes = 0
+            if _mem_owners.get(self.owner) is self:
+                del _mem_owners[self.owner]
+
+    def __repr__(self):
+        return (f"MemoryTracker({self.owner!r}, {self.category!r}, "
+                f"bytes={self.bytes})")
+
+
+def array_nbytes(x):
+    """Device-buffer footprint of an array / NDArray / state tree,
+    computed from shape x dtype — THE shared helper every accounting
+    site uses (gluon Trainer, executor, predictor).  Deliberately never
+    touches the raw buffer: reading ``.nbytes`` off a pending
+    bulk-deferred array would force-flush the engine's micro-graph, and
+    accounting must never do that.  None and unshaped objects count 0."""
+    import numpy as _np
+
+    if x is None:
+        return 0
+    if isinstance(x, (list, tuple)):
+        return sum(array_nbytes(s) for s in x)
+    try:
+        shape, dtype = x.shape, x.dtype
+    except Exception:
+        return 0
+    n = 1
+    for d in shape:
+        n *= int(d)
+    try:
+        return n * _np.dtype(dtype).itemsize
+    except Exception:
+        return 0
+
+
+def track_memory(owner, category="other"):
+    """Register (or look up) a ledger owner and return its
+    :class:`MemoryTracker`.  ``category`` groups owners for the
+    per-category rollup (house categories: ``params``,
+    ``optimizer_state``, ``kv_cache``, ``infeed``, ``programs``); the
+    first registration's category wins."""
+    with _mem_lock:
+        t = _mem_owners.get(str(owner))
+        if t is None:
+            t = MemoryTracker(owner, category)
+            _mem_owners[str(owner)] = t
+        return t
+
+
+def memory_ledger():
+    """Snapshot of the device-memory ledger::
+
+        {"owners": {owner: {category, bytes, peak, allocs, frees}},
+         "by_category": {category: bytes}, "total_bytes": int}
+
+    ``dump()`` embeds it under ``otherData.memory.ledger``;
+    ``tools/memory_report.py`` renders it."""
+    with _mem_lock:
+        owners = {o: {"category": t.category, "bytes": t.bytes,
+                      "peak": t.peak, "allocs": t.allocs, "frees": t.frees}
+                  for o, t in _mem_owners.items()}
+    by_cat = {}
+    total = 0
+    for info in owners.values():
+        by_cat[info["category"]] = (by_cat.get(info["category"], 0)
+                                    + info["bytes"])
+        total += info["bytes"]
+    return {"owners": owners, "by_category": by_cat, "total_bytes": total}
+
+
+def _ledger_categories():
+    """Flat ``{category: bytes}`` + ``total`` for the counter track (one
+    Perfetto series per category)."""
+    with _mem_lock:
+        if not _mem_owners:
+            return {}
+        cats = {}
+        total = 0
+        for t in _mem_owners.values():
+            cats[t.category] = cats.get(t.category, 0) + t.bytes
+            total += t.bytes
+    cats["total"] = total
+    return cats
+
+
+def memory_postmortems():
+    """The postmortem reports emitted so far (bounded FIFO; newest
+    last)."""
+    with _mem_lock:
+        return [dict(r) for r in _mem_postmortems]
+
+
+# -- OOM forensics -----------------------------------------------------------
+
+_OOM_TOKENS = ("RESOURCE_EXHAUSTED", "Out of memory", "OutOfMemory",
+               "out of memory")
+
+
+def is_resource_exhausted(exc):
+    """Whether an exception looks like a device allocation failure (XLA
+    surfaces OOM as ``XlaRuntimeError: RESOURCE_EXHAUSTED: Out of memory
+    while trying to allocate N bytes``)."""
+    if exc is None:
+        return False
+    if type(exc).__name__ in ("XlaRuntimeError", "MemoryBudgetError"):
+        msg = str(exc)
+        return any(t in msg for t in _OOM_TOKENS) or "budget" in msg
+    msg = str(exc)
+    return any(t in msg for t in _OOM_TOKENS)
+
+
+_ALLOC_RE = None  # compiled lazily (re import off the hot path)
+
+
+def _parse_failed_bytes(msg):
+    """Best-effort size of the failed allocation from an XLA OOM message
+    (``... trying to allocate 4294967296 bytes ...`` /
+    ``Attempting to reserve 5.81G ...``).  None when unparseable."""
+    global _ALLOC_RE
+    if _ALLOC_RE is None:
+        import re
+        _ALLOC_RE = re.compile(
+            r"(?:allocat\w+|reserve)\s+([0-9][0-9.]*)\s*"
+            r"(bytes?|[KMG]i?B?\b)?", re.IGNORECASE)
+    m = _ALLOC_RE.search(msg or "")
+    if not m:
+        return None
+    try:
+        val = float(m.group(1))
+    except ValueError:
+        return None
+    unit = (m.group(2) or "bytes").upper()
+    mult = {"K": 1 << 10, "M": 1 << 20, "G": 1 << 30}.get(unit[0], 1)
+    return int(val * mult)
+
+
+def _fmt_bytes(n):
+    if n is None:
+        return "?"
+    for unit, div in (("GiB", 1 << 30), ("MiB", 1 << 20), ("KiB", 1 << 10)):
+        if abs(n) >= div:
+            return f"{n / div:.2f} {unit}"
+    return f"{n} B"
+
+
+def oom_postmortem(where, failed_bytes=None, error=None, kind="oom"):
+    """Emit ONE structured device-memory postmortem: the top ledger
+    owners by bytes, per-category totals, live device stats, and the
+    failed allocation size.  Logged as a single ERROR line, appended to
+    :func:`memory_postmortems`, counted in ``memory_oom_postmortem``.
+    Returns the report dict."""
+    led = memory_ledger()
+    top = sorted(led["owners"].items(), key=lambda kv: -kv[1]["bytes"])[:8]
+    report = {
+        "kind": kind,                  # "oom" | "budget"
+        "where": str(where),
+        "time_unix": time.time(),
+        "step": _step_id,
+        "failed_bytes": failed_bytes,
+        "error": str(error)[:500] if error is not None else None,
+        "device": device_memory_stats(),
+        "ledger_total_bytes": led["total_bytes"],
+        "by_category": led["by_category"],
+        "top_owners": [{"owner": o, **info} for o, info in top],
+    }
+    with _mem_lock:
+        _mem_postmortems.append(report)
+        while len(_mem_postmortems) > _MAX_POSTMORTEMS:
+            _mem_postmortems.pop(0)
+    incr("memory_oom_postmortem")
+    owners_line = ", ".join(
+        f"{o}={_fmt_bytes(i['bytes'])} ({i['category']})"
+        for o, i in top[:4]) or "no registered owners"
+    _logger.error(
+        "device-memory postmortem at %s: failed to allocate %s "
+        "(%s); ledger attributes %s — top owners: %s "
+        "[see profiler.memory_postmortems() / tools/memory_report.py]",
+        where, _fmt_bytes(failed_bytes), kind,
+        _fmt_bytes(led["total_bytes"]), owners_line)
+    return report
+
+
+def maybe_oom_postmortem(exc, where):
+    """Choke-point hook: when ``exc`` is a device allocation failure,
+    emit exactly ONE postmortem per exception object (the report is
+    attached to the exception, so nested choke points — an engine flush
+    inside an SPMD step — cannot double-report as it propagates).
+    Returns the report, or None for unrelated errors.  Callers re-raise
+    the original exception afterwards."""
+    if exc is None or not is_resource_exhausted(exc):
+        return None
+    rep = getattr(exc, "_mx_postmortem", None)
+    if rep is not None:
+        return rep
+    rep = oom_postmortem(where, failed_bytes=_parse_failed_bytes(str(exc)),
+                         error=exc)
+    try:
+        exc._mx_postmortem = rep
+    except Exception:
+        pass
+    return rep
+
+
+# -- budgeted admission ------------------------------------------------------
+
+
+class MemoryBudgetError(RuntimeError):
+    """An allocation was refused by :meth:`MemoryBudget.check` — the
+    budget's postmortem rides on ``._mx_postmortem``."""
+
+
+class MemoryBudget:
+    """The one admission API device-buffer holders consult instead of raw
+    ``memory_stats()`` probes.
+
+    Parameters
+    ----------
+    limit_mb : explicit byte budget (MiB); ``None`` reads
+        ``MXNET_MEM_BUDGET_MB`` (0/unset = no explicit cap, only the
+        device's own ``bytes_limit`` caps).
+    pressure_frac : occupancy fraction treated as pressure
+        (``MXNET_MEM_PRESSURE_FRAC``, default 0.95).
+
+    ``usage_bytes()`` is the device's live ``bytes_in_use`` (max across
+    local devices) when the backend reports it, else the ledger total —
+    so budgets work on CPU tests exactly as on HBM."""
+
+    def __init__(self, limit_mb=None, pressure_frac=None):
+        # an explicit limit_mb is pinned; None follows the env DYNAMICALLY
+        # (the process singleton is created lazily by whoever probes first
+        # — a pipeline tick must not freeze a budget the user exports
+        # just before building their server)
+        self._limit_mb = limit_mb
+        self.pressure_frac = (
+            float(pressure_frac) if pressure_frac is not None
+            else _env_float("MXNET_MEM_PRESSURE_FRAC", 0.95))
+
+    @property
+    def limit_bytes(self):
+        mb = self._limit_mb
+        if mb is None:
+            mb = _env_float("MXNET_MEM_BUDGET_MB", 0.0)
+        return int(float(mb) * (1 << 20)) if mb else None
+
+    @staticmethod
+    def _usage(stats):
+        if stats:
+            return max(s["bytes_in_use"] for s in stats.values())
+        return memory_ledger()["total_bytes"]
+
+    def usage_bytes(self):
+        return self._usage(device_memory_stats())
+
+    def headroom_bytes(self):
+        """Bytes left under the explicit limit; None when uncapped."""
+        limit = self.limit_bytes
+        if limit is None:
+            return None
+        return limit - self.usage_bytes()
+
+    def would_fit(self, nbytes=0):
+        """Whether an ``nbytes`` allocation fits: under the explicit
+        limit when one is set, else under every device's own
+        ``bytes_limit`` (trivially True when neither exists).  One
+        device probe per call — this runs on admission hot paths."""
+        n = int(nbytes)
+        stats = device_memory_stats()
+        limit = self.limit_bytes
+        if limit is not None:
+            return self._usage(stats) + n <= limit
+        for s in stats.values():
+            if s["bytes_limit"] and s["bytes_in_use"] + n > s["bytes_limit"]:
+                return False
+        return True
+
+    def under_pressure(self, frac=None):
+        """Whether occupancy exceeds ``frac`` of the capacity (device
+        ``bytes_limit`` and/or the explicit budget) — the backoff signal
+        the DataPipeline autotuner and GenerationServer admission read.
+        One device probe per call."""
+        frac = self.pressure_frac if frac is None else float(frac)
+        stats = device_memory_stats()
+        for s in stats.values():
+            if s["bytes_limit"] and s["bytes_in_use"] > frac * s["bytes_limit"]:
+                return True
+        limit = self.limit_bytes
+        if limit is not None:
+            return self._usage(stats) > frac * limit
+        return False
+
+    def check(self, nbytes, owner="?"):
+        """Raise :class:`MemoryBudgetError` (with exactly one postmortem)
+        when ``nbytes`` does not fit — the loud variant of
+        :meth:`would_fit` for sites that must fail an admission rather
+        than defer it."""
+        if self.would_fit(nbytes):
+            return
+        rep = oom_postmortem(f"budget:{owner}", failed_bytes=int(nbytes),
+                             kind="budget")
+        err = MemoryBudgetError(
+            f"memory budget refused {_fmt_bytes(int(nbytes))} for "
+            f"{owner!r}: usage {_fmt_bytes(self.usage_bytes())} of "
+            f"limit {_fmt_bytes(self.limit_bytes)} "
+            f"(MXNET_MEM_BUDGET_MB / MemoryBudget)")
+        err._mx_postmortem = rep
+        raise err
+
+    def stats(self):
+        return {"limit_bytes": self.limit_bytes,
+                "pressure_frac": self.pressure_frac,
+                "usage_bytes": self.usage_bytes()}
+
+
+_process_budget = None
+
+
+def memory_budget():
+    """The process-wide :class:`MemoryBudget` (``MXNET_MEM_BUDGET_MB``-
+    configured singleton) — what subsystems consult when no explicit
+    budget object was handed to them."""
+    global _process_budget
+    if _process_budget is None:
+        _process_budget = MemoryBudget()
+    return _process_budget
+
+
+def _memory_provider():
+    """Built-in ``memory`` metrics provider: ledger totals per category,
+    owner count, postmortem count and live device occupancy as flat
+    gauges (``mxnet_memory_ledger_bytes``, ``mxnet_memory_<cat>_bytes``,
+    ...)."""
+    led = memory_ledger()
+    out = {"ledger_bytes": led["total_bytes"],
+           "owners": len(led["owners"])}
+    for cat, b in led["by_category"].items():
+        out[f"{cat}_bytes"] = b
+    with _mem_lock:
+        out["postmortems"] = len(_mem_postmortems)
+    stats = device_memory_stats()
+    if stats:
+        out["device_bytes_in_use"] = max(s["bytes_in_use"]
+                                         for s in stats.values())
+        out["device_bytes_limit"] = max(s["bytes_limit"]
+                                        for s in stats.values())
+    b = _process_budget
+    if b is not None and b.limit_bytes is not None:
+        out["budget_limit_bytes"] = b.limit_bytes
+    return out
+
+
+register_metrics_provider("memory", _memory_provider)
 
 
 # ---------------------------------------------------------------------------
@@ -1464,6 +1991,15 @@ def record_compile(site, signature, wall_ms, fn=None, args=None, kwargs=None,
         except Exception:
             lowered = None
     cost = _extract_cost(lowered) if lowered is not None else None
+    if cost and cost.get("code_bytes"):
+        # compiled-executable footprint rides the PR 9 memory_analysis
+        # into the ledger: programs own bytes too (opt-in with the cost
+        # accounting itself).  CUMULATIVE by design — executables live in
+        # process-wide jit caches whose evictions are invisible from
+        # here, so this owner is an upper bound on resident code, not an
+        # exact balance like the buffer owners.
+        track_memory("compiled_programs", "programs").alloc(
+            cost["code_bytes"])
 
     key = _sig_key(signature)
     now = _perf()
@@ -1680,6 +2216,7 @@ def _arm(fresh):
             # would skew the slow-step percentile baseline
             _step_window.clear()
             _mem_watermark.clear()
+            _mem_samples.clear()
     _armed_at = _step_t0 = _perf()
     _step_thread = _threading.get_ident()
     _recording = True
@@ -1768,6 +2305,18 @@ def _trace_events():
     events.extend({"ph": "M", "pid": pid, "tid": r.tid, "name": "thread_name",
                    "args": {"name": r.tname}} for r in rings)
     events.extend(e for _, e in keyed)
+    # memory counter track: chrome-trace "C" events Perfetto renders as a
+    # per-device bytes_in_use timeline plus one ledger series per category
+    with _counter_lock:
+        samples = list(_mem_samples)
+    for t, dev_use, cats in samples:
+        ts = (t - _EPOCH) * 1e6
+        for dev, b in dev_use.items():
+            events.append({"ph": "C", "name": f"memory {dev}", "pid": pid,
+                           "ts": ts, "args": {"bytes_in_use": b}})
+        if cats:
+            events.append({"ph": "C", "name": "memory ledger", "pid": pid,
+                           "ts": ts, "args": dict(cats)})
     return events
 
 
@@ -1797,6 +2346,14 @@ def dump(finished=True, profile_process="worker"):
             "counters": counters(),
             "steps": step_stats(),
             "memory_watermark_bytes": memory_watermark(),
+            "memory": {
+                "ledger": memory_ledger(),
+                "postmortems": memory_postmortems(),
+                "budget": (memory_budget().stats()
+                           if _process_budget is not None
+                           or os.environ.get("MXNET_MEM_BUDGET_MB")
+                           else None),
+            },
             "recorder": recorder_stats(),
             "compiles": compile_registry(),
             "compile_guard": compile_guard_state(),
@@ -1913,6 +2470,17 @@ def dumps(reset=False):
         lines.append("Device memory watermark (bytes_in_use peak):")
         for dev, b in sorted(wm.items()):
             lines.append(f"{dev:<40}{b:>16}")
+    led = memory_ledger()
+    if led["owners"]:
+        lines.append("")
+        lines.append("Device memory ledger (see tools/memory_report.py):")
+        lines.append(f"{'Owner':<36}{'Category':<18}{'Bytes':>14}"
+                     f"{'Peak':>14}")
+        for o, i in sorted(led["owners"].items(),
+                           key=lambda kv: -kv[1]["bytes"]):
+            lines.append(f"{o:<36}{i['category']:<18}{i['bytes']:>14}"
+                         f"{i['peak']:>14}")
+        lines.append(f"{'TOTAL':<36}{'':<18}{led['total_bytes']:>14}")
     csites = compile_stats()
     if csites:
         lines.append("")
@@ -1940,6 +2508,11 @@ def dumps(reset=False):
             _agg.clear()
             _step_window.clear()
             _mem_watermark.clear()
+            _mem_samples.clear()
+        with _mem_lock:
+            # postmortems are EVENTS (reset like counters); the ledger is
+            # live buffers and survives — those bytes are still allocated
+            _mem_postmortems.clear()
         reset_counters()
         reset_compiles()
     return "\n".join(lines)

@@ -505,10 +505,22 @@ class GenerationServer:
                  prompt_buckets=None, max_new_tokens=None,
                  decode_buckets=None, slots_per_bucket=None, tenants=None,
                  batching="continuous", max_prefills_per_iter=None,
-                 name=None, warmup=True, autostart=True):
+                 memory_budget=None, name=None, warmup=True, autostart=True):
         if batching not in ("continuous", "static"):
             raise ValueError(f"batching must be 'continuous' or 'static', "
                              f"got {batching!r}")
+        # memory_budget: a profiler.MemoryBudget slot admission consults —
+        # while it reports pressure, queued prefills DEFER (requeued at
+        # the front, memory_budget_refusal counts) instead of pushing the
+        # device into RESOURCE_EXHAUSTED mid-decode.  The gate is OPT-IN:
+        # an explicit budget object, or the process budget while
+        # MXNET_MEM_BUDGET_MB is set (checked per admission — the env
+        # limit is dynamic) — a serving deployment sized to legitimately
+        # fill HBM past the pressure fraction must not have every
+        # admission deferred by default.
+        self._budget_explicit = memory_budget is not None
+        self._budget = (memory_budget if memory_budget is not None
+                        else profiler.memory_budget())
         self.bos, self.eos = int(bos), int(eos)
         self.name = str(name) if name is not None else _default_name()
         self.batching = batching
@@ -800,6 +812,27 @@ class GenerationServer:
                 profiler.incr("generation_cancelled")
                 req.result._finish("cancelled", req.t_submit)
                 continue
+            if (self._budget is not None
+                    and (self._budget_explicit
+                         or self._budget.limit_bytes is not None)
+                    and not (self._closing and self._drain)
+                    and self._budget.under_pressure()):
+                # no memory headroom: defer the admission (requeued at
+                # the FRONT of its tenant's queue) rather than push the
+                # decode loop into RESOURCE_EXHAUSTED.  A draining close
+                # is exempt — termination outranks headroom.  The brief
+                # wait only happens with NOTHING decoding (it keeps a
+                # fully-blocked queue from hot-spinning; while slots are
+                # live the decode loop itself paces the scheduler, and a
+                # wait here would tax every in-flight request's TPOT).
+                profiler.incr("memory_budget_refusal")
+                with self._cond:
+                    self._queues[req.tenant.name].appendleft(req)
+                    self._rr.remove(req.tenant.name)
+                    self._rr.insert(0, req.tenant.name)
+                    if self._ladder.n_active == 0:
+                        self._cond.wait(0.02)
+                return
             got = self._ladder.try_alloc(req.max_new, req, req.prompt.size,
                                          self.bos)
             if got is None:
@@ -930,6 +963,9 @@ class GenerationServer:
         self._harvest_cancelled()
         self._admit()
         self._decode_all()
+        # memory-counter-track tick: serving-only processes have no step
+        # boundaries, so the scheduler samples the watermark (throttled)
+        profiler.maybe_sample_memory()
 
     # -- observability -------------------------------------------------
     def stats(self):
@@ -1010,6 +1046,7 @@ class GenerationServer:
         if self._thread is not None:
             self._thread.join(timeout)
         profiler.unregister_metrics_provider(self.name)
+        self._ladder.release()   # pool bytes leave the device-memory ledger
         with self._cond:
             self._closed = True
             # _closing stays latched: there is no reopen (start() raises

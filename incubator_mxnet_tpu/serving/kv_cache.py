@@ -39,6 +39,15 @@ from .bucketing import ShapeBucketer
 __all__ = ["SlotKVCache", "KVCacheLadder"]
 
 
+def _release_pool_memory(bucket, nbytes):
+    """weakref.finalize hook: a collected/released pool's buffers leave
+    the device-memory ledger (module-level — must not reference self)."""
+    from .. import profiler as _profiler
+
+    _profiler.track_memory(f"kv_cache.pool_{bucket}",
+                           "kv_cache").free(nbytes)
+
+
 class SlotKVCache:
     """One fixed-capacity pool of KV slots at a single length bucket.
 
@@ -79,6 +88,21 @@ class SlotKVCache:
             "mem_k": jnp.zeros(mem_shape, self.dtype),
             "mem_v": jnp.zeros(mem_shape, self.dtype),
         }
+        # device-memory ledger: one shared owner per bucket (pools of two
+        # servers at one bucket compose by deltas).  The bytes follow the
+        # BUFFERS, not this object — ownership may transfer to a
+        # StatefulExecutor (generation.py sets pool.state = None), and
+        # donation keeps every size constant, so the total registered
+        # here is exact until release()/GC.
+        import weakref as _weakref
+
+        from .. import profiler as _profiler
+
+        self.nbytes = sum(int(a.nbytes) for a in self.state.values())
+        _profiler.track_memory(f"kv_cache.pool_{self.bucket}",
+                               "kv_cache").alloc(self.nbytes)
+        self._mem_finalizer = _weakref.finalize(
+            self, _release_pool_memory, self.bucket, self.nbytes)
         # host-side per-slot registers (pure indexing on join/leave)
         self.pos = _np.zeros(self.slots, _np.int32)
         self.last_token = _np.zeros(self.slots, _np.int32)
@@ -136,6 +160,11 @@ class SlotKVCache:
         order is deterministic so equivalence tests can rely on it)."""
         return _np.nonzero(self.active)[0]
 
+    def release(self):
+        """Release this pool's share of the device-memory ledger (the
+        buffers themselves die with their executor/GC).  Idempotent."""
+        self._mem_finalizer()
+
     def stats(self):
         return {
             "bucket": self.bucket,
@@ -144,6 +173,7 @@ class SlotKVCache:
             "free": self.n_free,
             "joins": self.joins,
             "leaves": self.leaves,
+            "nbytes": self.nbytes,
         }
 
     def __repr__(self):
@@ -218,11 +248,22 @@ class KVCacheLadder:
     def n_slots(self):
         return sum(p.slots for p in self.pools.values())
 
+    @property
+    def nbytes(self):
+        return sum(p.nbytes for p in self.pools.values())
+
+    def release(self):
+        """Release every pool's ledger share (``GenerationServer.close``
+        calls this).  Idempotent."""
+        for p in self.pools.values():
+            p.release()
+
     def stats(self):
         return {
             "buckets": {b: p.stats() for b, p in self.pools.items()},
             "active": self.n_active,
             "slots": self.n_slots,
+            "nbytes": self.nbytes,
         }
 
     def __repr__(self):

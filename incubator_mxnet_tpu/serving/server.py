@@ -458,11 +458,17 @@ class InferenceServer:
         # compile-registry attribution: a bind/compile triggered by live
         # traffic reports as serving.dispatch — in steady state this site
         # must never appear (the guard armed at start() enforces it)
-        with profiler.compile_site("serving.dispatch"):
-            self._pred.reshape(shapes)
-            for iname, buf in arrays.items():
-                self._pred.set_input(iname, buf)
-            self._pred.forward()
+        try:
+            with profiler.compile_site("serving.dispatch"):
+                self._pred.reshape(shapes)
+                for iname, buf in arrays.items():
+                    self._pred.set_input(iname, buf)
+                self._pred.forward()
+        except Exception as e:
+            # serving dispatch is an OOM choke point: one postmortem
+            # naming the ledger's top owners before the batch fails
+            profiler.maybe_oom_postmortem(e, "serving.dispatch")
+            raise
         outs = self._pred.get_outputs()
         unpad = self._unpad_for(len(outs))
         self._warm.add(key)
@@ -508,6 +514,9 @@ class InferenceServer:
                 "serving.complete", "serving", t_done,
                 args={"batch": n,
                       "latency_ms_max": round(max(lats), 3) if lats else 0})
+        # memory-counter-track tick: serving-only processes have no step
+        # boundaries, so the scheduler samples the watermark (throttled)
+        profiler.maybe_sample_memory()
 
     # -- observability -------------------------------------------------
     def stats(self):
@@ -559,6 +568,7 @@ class InferenceServer:
         if self._thread is not None:
             self._thread.join(timeout)
         profiler.unregister_metrics_provider(self.name)
+        self._pred.close()   # bound params leave the device-memory ledger
         with self._cond:
             self._closed = True
             self._closing = False

@@ -117,16 +117,19 @@ def get_gpu_count():
 
 def get_gpu_memory(dev_id=0):
     """Parity: ``mx.util.get_gpu_memory`` -> (free, total) bytes for the
-    accelerator, via the backend's memory stats when available."""
+    accelerator, via the shared ``profiler.device_memory_stats`` probe
+    (one memory_stats() parse rule for the whole repo)."""
     import jax
+
+    from . import profiler
 
     devs = [d for d in jax.devices() if d.platform != "cpu"]
     if not devs:
         raise RuntimeError("no accelerator device visible")
     d = devs[min(dev_id, len(devs) - 1)]
-    stats = d.memory_stats() if hasattr(d, "memory_stats") else None
+    stats = profiler.device_memory_stats([d]).get(str(d))
     if not stats:
         return (0, 0)
-    total = int(stats.get("bytes_limit", 0))
-    used = int(stats.get("bytes_in_use", 0))
+    total = stats["bytes_limit"]
+    used = stats["bytes_in_use"]
     return (total - used, total)

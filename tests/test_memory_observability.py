@@ -1,0 +1,442 @@
+"""Device-memory observability (ISSUE 12): the live HBM ledger, OOM
+forensics, budgeted admission, and the chrome-trace memory counter track.
+
+The acceptance contracts:
+
+* **ledger exactness** — owner register/alloc/free/close account to the
+  byte; a trainer's weight+grad+state footprint matches an independent
+  computation; donated optimizer steps move ZERO ledger bytes;
+* **OOM forensics** — a ``RESOURCE_EXHAUSTED`` at a dispatch choke point
+  emits exactly ONE postmortem per failure naming the top owners and the
+  failed allocation size, however many choke points it propagates
+  through;
+* **budgeted admission** — ``MemoryBudget.check`` refuses loudly with a
+  postmortem; ``GenerationServer`` slot admission DEFERS (not crashes)
+  while the budget reports pressure;
+* **counter track** — a dumped trace carries ``"C"`` events Perfetto
+  renders as a memory timeline, and ``tools/memory_report.py`` reads
+  them back.
+"""
+import gc
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import autograd, profiler
+from incubator_mxnet_tpu.gluon import Trainer, nn
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def owner(request):
+    """A throwaway ledger owner, removed after the test."""
+    name = f"test.{request.node.name[:40]}"
+    t = profiler.track_memory(name, "test")
+    yield t
+    t.close()
+
+
+class TestLedger:
+    def test_alloc_free_exact(self, owner):
+        owner.alloc(1000)
+        owner.alloc(24)
+        led = profiler.memory_ledger()
+        row = led["owners"][owner.owner]
+        assert row["bytes"] == 1024
+        assert row["peak"] == 1024
+        assert row["allocs"] == 2
+        owner.free(24)
+        row = profiler.memory_ledger()["owners"][owner.owner]
+        assert row["bytes"] == 1000
+        assert row["peak"] == 1024          # peak survives the free
+        assert row["frees"] == 1
+
+    def test_shared_owner_composes_by_deltas(self, owner):
+        again = profiler.track_memory(owner.owner, "test")
+        assert again is owner               # same name -> same tracker
+        owner.alloc(10)
+        again.alloc(5)
+        assert profiler.memory_ledger()["owners"][owner.owner]["bytes"] == 15
+
+    def test_set_and_close(self, owner):
+        owner.set(4096)
+        assert profiler.memory_ledger()["owners"][owner.owner]["bytes"] == 4096
+        owner.close()
+        assert owner.owner not in profiler.memory_ledger()["owners"]
+
+    def test_category_rollup(self, owner):
+        owner.alloc(100)
+        led = profiler.memory_ledger()
+        assert led["by_category"]["test"] >= 100
+        assert led["total_bytes"] == sum(
+            i["bytes"] for i in led["owners"].values())
+
+    def test_memory_provider_in_snapshot(self, owner):
+        owner.alloc(123)
+        snap = profiler.metrics_snapshot()
+        mem = snap["providers"]["memory"]
+        assert mem["ledger_bytes"] >= 123
+        assert mem["owners"] >= 1
+        assert "test_bytes" in mem
+
+
+class TestTrainerAccounting:
+    def _train(self, steps=1):
+        mx.random.seed(0)
+        net = nn.HybridSequential()
+        net.add(nn.Dense(16, activation="relu"), nn.Dense(4))
+        net.initialize()
+        x = mx.nd.array(np.random.RandomState(0).rand(8, 6).astype(
+            np.float32))
+        net(x)
+        opt = mx.optimizer.create("adam", learning_rate=0.01)
+        opt.aggregate_num = 100
+        tr = Trainer(net.collect_params(), opt)
+        for _ in range(steps):
+            with autograd.record():
+                loss = (net(x) * net(x)).sum()
+            loss.backward()
+            tr.step(8)
+        return net, tr
+
+    @staticmethod
+    def _nd_bytes(x):
+        if x is None:
+            return 0
+        if isinstance(x, (list, tuple)):
+            return sum(TestTrainerAccounting._nd_bytes(s) for s in x)
+        n = 1
+        for d in x.shape:
+            n *= int(d)
+        return n * np.dtype(x.dtype).itemsize
+
+    def test_trainer_footprint_exact_and_donation_stable(self):
+        base_p = profiler.memory_ledger()["owners"].get(
+            "trainer.params", {}).get("bytes", 0)
+        base_s = profiler.memory_ledger()["owners"].get(
+            "trainer.optimizer_state", {}).get("bytes", 0)
+        net, tr = self._train(steps=1)
+        try:
+            exp_p = sum(2 * self._nd_bytes(p._data)
+                        for p in net.collect_params().values())
+            exp_s = sum(self._nd_bytes(st) for st in tr._states.values())
+            led = profiler.memory_ledger()["owners"]
+            assert led["trainer.params"]["bytes"] - base_p == exp_p
+            assert led["trainer.optimizer_state"]["bytes"] - base_s == exp_s
+            # donation-move exactness: further steps swap buffers in place
+            x = mx.nd.array(np.random.RandomState(1).rand(8, 6).astype(
+                np.float32))
+            for _ in range(3):
+                with autograd.record():
+                    loss = (net(x) * net(x)).sum()
+                loss.backward()
+                tr.step(8)
+            led2 = profiler.memory_ledger()["owners"]
+            assert led2["trainer.params"]["bytes"] - base_p == exp_p
+            assert led2["trainer.optimizer_state"]["bytes"] - base_s == exp_s
+        finally:
+            tr.close()
+        led3 = profiler.memory_ledger()["owners"]
+        assert led3.get("trainer.params", {}).get("bytes", 0) == base_p
+        assert led3.get("trainer.optimizer_state", {}).get(
+            "bytes", 0) == base_s
+        tr.close()   # idempotent: a second close must not double-free
+        assert profiler.memory_ledger()["owners"].get(
+            "trainer.params", {}).get("bytes", 0) == base_p
+
+    def test_abandoned_trainer_released_at_gc(self):
+        """A trainer dropped WITHOUT close() (the common local path) must
+        still release its ledger share via the finalizer."""
+        base = profiler.memory_ledger()["owners"].get(
+            "trainer.params", {}).get("bytes", 0)
+        net, tr = self._train(steps=1)
+        assert profiler.memory_ledger()["owners"][
+            "trainer.params"]["bytes"] > base
+        del tr
+        gc.collect()
+        assert profiler.memory_ledger()["owners"].get(
+            "trainer.params", {}).get("bytes", 0) == base
+
+
+class TestKVCacheAccounting:
+    def test_pool_register_and_release_exact(self):
+        from incubator_mxnet_tpu.serving import SlotKVCache
+
+        owner = "kv_cache.pool_16"
+        base = profiler.memory_ledger()["owners"].get(
+            owner, {}).get("bytes", 0)
+        pool = SlotKVCache(layers=2, slots=3, bucket=16, mem_width=8,
+                           heads=2, head_dim=4)
+        expected = sum(int(a.nbytes) for a in pool.state.values())
+        assert pool.nbytes == expected
+        got = profiler.memory_ledger()["owners"][owner]["bytes"]
+        assert got - base == expected
+        pool.release()
+        assert profiler.memory_ledger()["owners"].get(
+            owner, {}).get("bytes", 0) == base
+        pool.release()   # idempotent
+        assert profiler.memory_ledger()["owners"].get(
+            owner, {}).get("bytes", 0) == base
+
+    def test_abandoned_pool_released_at_gc(self):
+        from incubator_mxnet_tpu.serving import SlotKVCache
+
+        owner = "kv_cache.pool_8"
+        base = profiler.memory_ledger()["owners"].get(
+            owner, {}).get("bytes", 0)
+        pool = SlotKVCache(layers=1, slots=2, bucket=8, mem_width=4,
+                           heads=1, head_dim=2)
+        assert profiler.memory_ledger()["owners"][owner]["bytes"] > base
+        del pool
+        gc.collect()
+        assert profiler.memory_ledger()["owners"].get(
+            owner, {}).get("bytes", 0) == base
+
+
+class TestOOMForensics:
+    def test_parse_failed_bytes(self):
+        p = profiler._parse_failed_bytes
+        assert p("Out of memory while trying to allocate 4294967296 "
+                 "bytes.") == 4294967296
+        assert p("Attempting to reserve 5.81G at the bottom") == int(
+            5.81 * (1 << 30))
+        assert p("allocating 2.5MiB for buffer") == int(2.5 * (1 << 20))
+        assert p("no numbers here") is None
+
+    def test_choke_point_postmortem_exactly_once(self, owner):
+        """A RESOURCE_EXHAUSTED raised under a StatefulExecutor dispatch
+        (the KV-insert/decode choke point) yields exactly one postmortem
+        naming the top owner and the failed allocation — and re-reporting
+        the SAME exception at an outer choke point adds nothing."""
+        import jax.numpy as jnp
+
+        from incubator_mxnet_tpu.predictor import StatefulExecutor
+
+        owner.alloc(10_000_000)   # make this test's owner the top one
+        exe = StatefulExecutor({"x": jnp.zeros((4,))}, name="oomtest")
+
+        def boom(state, inputs):
+            raise RuntimeError(
+                "RESOURCE_EXHAUSTED: Out of memory while trying to "
+                "allocate 1048576 bytes.")
+
+        exe.add_program("boom", boom)
+        before = profiler.counters()["memory_oom_postmortem"]
+        with pytest.raises(RuntimeError) as ei:
+            exe.run("boom")
+        after = profiler.counters()["memory_oom_postmortem"]
+        assert after - before == 1
+        rep = getattr(ei.value, "_mx_postmortem", None)
+        assert rep is not None
+        assert rep["failed_bytes"] == 1048576
+        assert rep["kind"] == "oom"
+        assert rep["top_owners"][0]["owner"] == owner.owner
+        # nested choke point (e.g. the SPMD step around an engine flush):
+        # the marker on the exception suppresses a duplicate report
+        rep2 = profiler.maybe_oom_postmortem(ei.value, "spmd.step")
+        assert rep2 is rep
+        assert profiler.counters()["memory_oom_postmortem"] == after
+
+    def test_unrelated_errors_not_reported(self):
+        before = profiler.counters()["memory_oom_postmortem"]
+        assert profiler.maybe_oom_postmortem(
+            ValueError("shape mismatch"), "spmd.step") is None
+        assert profiler.counters()["memory_oom_postmortem"] == before
+
+
+class TestMemoryBudget:
+    def test_check_raises_with_one_postmortem(self, owner):
+        owner.alloc(5_000_000)
+        budget = profiler.MemoryBudget(limit_mb=1)
+        before = profiler.counters()["memory_oom_postmortem"]
+        with pytest.raises(profiler.MemoryBudgetError) as ei:
+            budget.check(64 << 20, "test.forced")
+        assert profiler.counters()["memory_oom_postmortem"] - before == 1
+        rep = ei.value._mx_postmortem
+        assert rep["kind"] == "budget"
+        assert rep["failed_bytes"] == 64 << 20
+        assert rep["where"] == "budget:test.forced"
+        assert profiler.memory_postmortems()[-1]["where"] == rep["where"]
+
+    def test_would_fit_and_pressure_ledger_fallback(self, owner,
+                                                    monkeypatch):
+        # no device stats (CPU): usage falls back to the ledger total
+        monkeypatch.setattr(profiler, "device_memory_stats", lambda *a: {})
+        owner.alloc(1000 * 1024)
+        budget = profiler.MemoryBudget(limit_mb=1)
+        assert budget.usage_bytes() >= 1000 * 1024
+        assert not budget.would_fit(200 * 1024)
+        assert budget.under_pressure()           # 1000K > 0.95 * 1024K
+        big = profiler.MemoryBudget(limit_mb=1024)
+        assert big.would_fit(200 * 1024)
+        assert not big.under_pressure()
+
+    def test_device_limit_caps_when_uncapped(self, monkeypatch):
+        fake = {"dev0": {"bytes_in_use": 90, "peak_bytes_in_use": 95,
+                         "bytes_limit": 100}}
+        monkeypatch.setattr(profiler, "device_memory_stats",
+                            lambda *a: dict(fake))
+        budget = profiler.MemoryBudget(limit_mb=0)   # no explicit cap
+        assert budget.usage_bytes() == 90
+        assert budget.would_fit(5)
+        assert not budget.would_fit(20)
+        assert budget.under_pressure(frac=0.85)
+        assert not budget.under_pressure(frac=0.95)
+
+    def test_pipeline_pressure_consults_shared_budget(self, monkeypatch):
+        from incubator_mxnet_tpu.io.pipeline import _Engine
+
+        fake = {"dev0": {"bytes_in_use": 95, "peak_bytes_in_use": 99,
+                         "bytes_limit": 100}}
+        monkeypatch.setattr(profiler, "device_memory_stats",
+                            lambda *a: dict(fake))
+        assert _Engine._default_device_pressure(0.9)
+        fake["dev0"]["bytes_in_use"] = 10
+        assert not _Engine._default_device_pressure(0.9)
+
+
+class TestWatermarkSampling:
+    def test_metrics_snapshot_samples_watermark(self, monkeypatch):
+        """Serving-only processes (no step boundaries) must still report
+        a watermark: metrics_snapshot() samples device memory itself."""
+        fake = {"dev0": {"bytes_in_use": 1000, "peak_bytes_in_use": 2000,
+                         "bytes_limit": 10000}}
+        monkeypatch.setattr(profiler, "device_memory_stats",
+                            lambda *a: dict(fake))
+        with profiler._counter_lock:
+            profiler._mem_watermark.clear()
+        profiler._mem_last[0] = 0.0     # defeat the sampling throttle
+        snap = profiler.metrics_snapshot()
+        assert snap["memory_watermark_bytes"] == {"dev0": 2000}
+
+    def test_sampling_respects_config_off(self, monkeypatch):
+        fake = {"dev0": {"bytes_in_use": 1, "peak_bytes_in_use": 1,
+                         "bytes_limit": 10}}
+        monkeypatch.setattr(profiler, "device_memory_stats",
+                            lambda *a: dict(fake))
+        with profiler._counter_lock:
+            profiler._mem_watermark.clear()
+        profiler._mem_last[0] = 0.0
+        profiler.set_config(memory_sampling=False)
+        try:
+            profiler.metrics_snapshot()
+            assert profiler.memory_watermark() == {}
+        finally:
+            profiler.set_config(memory_sampling=True)
+
+
+class TestCounterTrack:
+    def test_counter_track_in_dump(self, tmp_path, owner, monkeypatch):
+        fake = {"dev0": {"bytes_in_use": 4096, "peak_bytes_in_use": 8192,
+                         "bytes_limit": 1 << 20}}
+        monkeypatch.setattr(profiler, "device_memory_stats",
+                            lambda *a: dict(fake))
+        owner.alloc(777)
+        path = str(tmp_path / "mem_trace.json")
+        profiler.set_config(filename=path)
+        profiler.start()
+        try:
+            for _ in range(3):
+                profiler.step_boundary()
+        finally:
+            out = profiler.dump()
+        with open(out) as f:
+            doc = json.load(f)
+        cev = [e for e in doc["traceEvents"] if e.get("ph") == "C"]
+        ledger_ev = [e for e in cev if e["name"] == "memory ledger"]
+        dev_ev = [e for e in cev if e["name"] == "memory dev0"]
+        assert ledger_ev and dev_ev
+        assert ledger_ev[-1]["args"]["test"] >= 777
+        assert dev_ev[-1]["args"]["bytes_in_use"] == 4096
+        # the ledger itself rides otherData.memory
+        mem = doc["otherData"]["memory"]
+        assert mem["ledger"]["owners"][owner.owner]["bytes"] == 777
+
+    def test_memory_report_cli(self, tmp_path, owner, monkeypatch):
+        fake = {"dev0": {"bytes_in_use": 4096, "peak_bytes_in_use": 8192,
+                         "bytes_limit": 1 << 20}}
+        monkeypatch.setattr(profiler, "device_memory_stats",
+                            lambda *a: dict(fake))
+        owner.alloc(2048)
+        path = str(tmp_path / "mem_trace.json")
+        profiler.set_config(filename=path)
+        profiler.start()
+        try:
+            profiler.step_boundary()
+            profiler.step_boundary()
+        finally:
+            out = profiler.dump()
+        r = subprocess.run(
+            [sys.executable, os.path.join(ROOT, "tools", "memory_report.py"),
+             out], capture_output=True, text=True)
+        assert r.returncode == 0, r.stderr
+        assert owner.owner in r.stdout
+        assert "counter track" in r.stdout.lower() or "memory" in r.stdout
+
+    def test_memory_report_empty_exits_2(self, tmp_path):
+        path = str(tmp_path / "empty.json")
+        with open(path, "w") as f:
+            json.dump({"traceEvents": [], "otherData": {}}, f)
+        r = subprocess.run(
+            [sys.executable, os.path.join(ROOT, "tools", "memory_report.py"),
+             path], capture_output=True, text=True)
+        assert r.returncode == 2
+        assert "no memory data" in r.stderr
+
+
+class TestBudgetRefusedAdmission:
+    def test_generation_admission_defers_under_budget(self):
+        """A GenerationServer whose MemoryBudget reports no headroom must
+        DEFER queued prefills (memory_budget_refusal counts, the request
+        stays pending) instead of dispatching into an OOM — and admit as
+        soon as the budget recovers."""
+        from incubator_mxnet_tpu.gluon.model_zoo.transformer import \
+            Transformer
+        from incubator_mxnet_tpu.serving import GenerationServer
+
+        profiler.disarm_compile_guard()
+        mx.random.seed(0)
+        net = Transformer(17, units=16, hidden_size=32, num_heads=2,
+                          num_encoder_layers=1, num_decoder_layers=1,
+                          dropout=0.0, max_length=64)
+        net.initialize()
+        net(mx.nd.array(np.ones((1, 8), np.int32), dtype="int32"),
+            mx.nd.array(np.ones((1, 1), np.int32), dtype="int32"))
+
+        class FlipBudget:
+            blocked = True
+
+            def under_pressure(self, frac=None):
+                return self.blocked
+
+        budget = FlipBudget()
+        base_pool = profiler.memory_ledger()["owners"].get(
+            "kv_cache.pool_8", {}).get("bytes", 0)
+        srv = GenerationServer(net, bos=1, eos=2, max_prompt_length=8,
+                               max_new_tokens=8, slots_per_bucket=2,
+                               memory_budget=budget, name="memtest")
+        try:
+            before = profiler.counters()["memory_budget_refusal"]
+            res = srv.submit(np.array([3, 4, 5], np.int32))
+            deadline = time.time() + 5.0
+            while profiler.counters()["memory_budget_refusal"] == before:
+                assert time.time() < deadline, "no budget refusal recorded"
+                time.sleep(0.01)
+            assert not res.done()           # deferred, not failed
+            assert srv.stats()["active_slots"] == 0
+            budget.blocked = False          # headroom recovered
+            toks = res.result(timeout=30.0)
+            assert len(toks) >= 1
+        finally:
+            srv.close(drain=False)
+            profiler.disarm_compile_guard()   # start() armed it
+        # pools released their ledger rows on close
+        assert profiler.memory_ledger()["owners"].get(
+            "kv_cache.pool_8", {}).get("bytes", 0) == base_pool

@@ -16,7 +16,11 @@
 #                  (lint_counters.py), plus the 2-process cluster smoke
 #                  (dist_trace_smoke.py): per-rank traces merge into one
 #                  offset-corrected timeline and rank 0's /metrics scrape
-#                  aggregates every rank
+#                  aggregates every rank; memory_smoke.py: the device-
+#                  memory ledger must attribute the train+serve footprint
+#                  to named owners, the trace must carry a memory counter
+#                  track, and a forced budget breach must produce exactly
+#                  one postmortem
 #   7. chaos     — fault-injection tier (fixed seed): wire drops/dups/kills
 #                  against the async PS with exactly-once accounting, the
 #                  2-worker chaos training acceptance run, and the
@@ -155,7 +159,8 @@ for tier in "${TIERS[@]}"; do
                 python tools/trace_report.py "$trace" --top 10 >/dev/null
                 python tools/lint_counters.py
                 python tools/dist_trace_smoke.py
-                python tools/compile_smoke.py >/dev/null'
+                python tools/compile_smoke.py >/dev/null
+                python tools/memory_smoke.py >/dev/null'
             ;;
         chaos)
             # deterministic fault injection: the seed pins the p= fault

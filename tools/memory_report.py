@@ -1,0 +1,264 @@
+#!/usr/bin/env python
+"""Offline report over the profiler's device-memory ledger — "what owns
+the bytes", by owner and category.
+
+Input is either a chrome-trace JSON written by ``profiler.dump()`` (the
+ledger rides under ``otherData.memory``, watermarks under
+``otherData.memory_watermark_bytes``, the counter track as ``"C"``
+events) or a bare ledger dump (``json.dump(profiler.memory_ledger(),
+f)``); several inputs (per-rank dumps, or a ``trace_merge.py`` output
+whose ``otherData.ranks`` carries per-rank memory blocks) are merged.
+``.json.gz`` files are read transparently.
+
+Usage::
+
+    python tools/memory_report.py profile.json [--top 15] [--json]
+
+Sections:
+
+* **per-owner totals** — live bytes, peak, alloc/free counts, category;
+* **per-category rollup** + the ledger total;
+* **device watermarks + attribution** — peak ``bytes_in_use`` per device
+  and the fraction of it the ledger attributes to named owners (the
+  ≥ 90 % acceptance bar of ``tools/memory_smoke.py``);
+* **watermark timeline** — an ASCII sparkline per memory counter track
+  (the chrome-trace ``C`` events Perfetto renders graphically);
+* **postmortems** — every OOM/budget-breach report with its top owners
+  and the failed allocation size.
+
+Exit codes: 0 on success, 2 on an unreadable input or one carrying no
+memory data at all (no owners, no watermark, no samples — one-line
+diagnosis, no traceback; the sibling report CLIs' contract).
+"""
+from __future__ import annotations
+
+import argparse
+import gzip
+import json
+import os
+import sys
+from collections import defaultdict
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+SPARK = "▁▂▃▄▅▆▇█"
+
+
+def _open(path):
+    if path.endswith(".gz"):
+        return gzip.open(path, "rt")
+    return open(path)
+
+
+def _fmt_bytes(n):
+    if n is None:
+        return "?"
+    for unit, div in (("GiB", 1 << 30), ("MiB", 1 << 20), ("KiB", 1 << 10)):
+        if abs(n) >= div:
+            return f"{n / div:.2f} {unit}"
+    return f"{n} B"
+
+
+def load_memory(path):
+    """Memory document from a profiler.dump() trace, a trace_merge.py
+    output, or a bare memory_ledger() dump.  Returns
+    ``{"ledger", "postmortems", "watermark", "tracks"}`` where ``tracks``
+    maps counter-track name -> [(ts_us, {series: value})]."""
+    if os.path.getsize(path) == 0:
+        raise ValueError("empty file (0 bytes)")
+    with _open(path) as f:
+        doc = json.load(f)
+    if not isinstance(doc, dict):
+        raise ValueError("not a JSON object")
+    if "owners" in doc and "total_bytes" in doc:      # bare ledger dump
+        return {"ledger": doc, "postmortems": [], "watermark": {},
+                "tracks": {}}
+    od = doc.get("otherData") or {}
+    out = {"ledger": None, "postmortems": [], "watermark": {}, "tracks": {}}
+    blocks = []
+    if od.get("memory") is not None:
+        blocks.append((od.get("memory"),
+                       od.get("memory_watermark_bytes") or {}))
+    for rank, entry in sorted((od.get("ranks") or {}).items()):
+        if isinstance(entry, dict) and entry.get("memory") is not None:
+            blocks.append((entry["memory"],
+                           entry.get("memory_watermark_bytes") or {}))
+    if not blocks and od.get("memory_watermark_bytes"):
+        blocks.append((None, od["memory_watermark_bytes"]))
+    for mem, wm in blocks:
+        if mem:
+            out["ledger"] = merge_ledgers(
+                [x for x in (out["ledger"], mem.get("ledger")) if x])
+            out["postmortems"].extend(mem.get("postmortems") or [])
+        for dev, b in (wm or {}).items():
+            if b > out["watermark"].get(dev, -1):
+                out["watermark"][dev] = b
+    for ev in doc.get("traceEvents") or []:
+        if isinstance(ev, dict) and ev.get("ph") == "C" \
+                and str(ev.get("name", "")).startswith("memory"):
+            out["tracks"].setdefault(ev["name"], []).append(
+                (ev.get("ts", 0.0), ev.get("args") or {}))
+    if out["ledger"] is None and not out["watermark"] and not out["tracks"]:
+        raise ValueError(
+            "no memory data found (neither a memory_ledger() dump nor a "
+            "profiler.dump() trace with otherData.memory)")
+    return out
+
+
+def merge_ledgers(ledgers):
+    """Sum per-rank ledgers (same-named owners add — each rank's trainer
+    legitimately owns its own copy)."""
+    owners = defaultdict(lambda: {"category": "other", "bytes": 0,
+                                  "peak": 0, "allocs": 0, "frees": 0})
+    for led in ledgers:
+        for o, info in (led.get("owners") or {}).items():
+            d = owners[o]
+            d["category"] = info.get("category", d["category"])
+            for k in ("bytes", "peak", "allocs", "frees"):
+                d[k] += info.get(k, 0)
+    by_cat = defaultdict(int)
+    total = 0
+    for info in owners.values():
+        by_cat[info["category"]] += info["bytes"]
+        total += info["bytes"]
+    return {"owners": dict(owners), "by_category": dict(by_cat),
+            "total_bytes": total}
+
+
+def summarize(mem):
+    """Machine-readable summary (--json; also what the report prints)."""
+    led = mem["ledger"] or {"owners": {}, "by_category": {},
+                            "total_bytes": 0}
+    wm = mem["watermark"]
+    attribution = None
+    if wm:
+        peak = max(wm.values())
+        if peak > 0:
+            attribution = led["total_bytes"] / peak
+    tracks = {}
+    for name, pts in mem["tracks"].items():
+        pts = sorted(pts)
+        series = defaultdict(list)
+        for _, args in pts:
+            for k, v in args.items():
+                if isinstance(v, (int, float)):
+                    series[k].append(v)
+        tracks[name] = {k: {"n": len(v), "min": min(v), "max": max(v),
+                            "last": v[-1]}
+                        for k, v in series.items() if v}
+    return {
+        "owners": led["owners"],
+        "by_category": led["by_category"],
+        "total_bytes": led["total_bytes"],
+        "watermark_bytes": wm,
+        "attributed_fraction": attribution,
+        "tracks": tracks,
+        "postmortems": mem["postmortems"],
+    }
+
+
+def _spark(vals, width=48):
+    if not vals:
+        return ""
+    if len(vals) > width:           # downsample to the display width
+        step = len(vals) / width
+        vals = [vals[int(i * step)] for i in range(width)]
+    lo, hi = min(vals), max(vals)
+    span = (hi - lo) or 1
+    return "".join(SPARK[int((v - lo) / span * (len(SPARK) - 1))]
+                   for v in vals)
+
+
+def report(mem, top=15, out=sys.stdout):
+    summ = summarize(mem)
+    w = out.write
+    owners = summ["owners"]
+    if owners:
+        w("Device-memory ledger (live bytes by owner):\n")
+        w(f"  {'owner':<34}{'category':<18}{'bytes':>12}{'peak':>12}"
+          f"{'allocs':>8}{'frees':>8}\n")
+        rows = sorted(owners.items(), key=lambda kv: -kv[1]["bytes"])
+        for o, i in rows[:top]:
+            w(f"  {o:<34}{i['category']:<18}{_fmt_bytes(i['bytes']):>12}"
+              f"{_fmt_bytes(i['peak']):>12}{i['allocs']:>8}{i['frees']:>8}\n")
+        if len(rows) > top:
+            w(f"  ... +{len(rows) - top} more owners\n")
+        w("\n  by category: "
+          + ", ".join(f"{c}={_fmt_bytes(b)}" for c, b in
+                      sorted(summ["by_category"].items(),
+                             key=lambda kv: -kv[1]))
+          + f"  |  TOTAL {_fmt_bytes(summ['total_bytes'])}\n")
+    else:
+        w("Device-memory ledger: no registered owners.\n")
+    if summ["watermark_bytes"]:
+        w("\nDevice watermarks (peak bytes_in_use):\n")
+        for dev, b in sorted(summ["watermark_bytes"].items()):
+            w(f"  {dev:<40}{_fmt_bytes(b):>12}\n")
+        if summ["attributed_fraction"] is not None:
+            w(f"  ledger attributes {summ['attributed_fraction']:.1%} of "
+              "the peak to named owners\n")
+    if mem["tracks"]:
+        w("\nMemory counter tracks (chrome-trace 'C' events; Perfetto "
+          "renders the timeline):\n")
+        for name, pts in sorted(mem["tracks"].items()):
+            pts = sorted(pts)
+            series = defaultdict(list)
+            for _, args in pts:
+                for k, v in args.items():
+                    if isinstance(v, (int, float)):
+                        series[k].append(v)
+            for k, vals in sorted(series.items()):
+                w(f"  {name} / {k}: {len(vals)} samples, "
+                  f"last {_fmt_bytes(vals[-1])}, peak "
+                  f"{_fmt_bytes(max(vals))}\n    {_spark(vals)}\n")
+    if summ["postmortems"]:
+        w(f"\nPostmortems ({len(summ['postmortems'])}):\n")
+        for rep in summ["postmortems"]:
+            tops = ", ".join(
+                f"{t['owner']}={_fmt_bytes(t['bytes'])}"
+                for t in (rep.get("top_owners") or [])[:3])
+            w(f"  [{rep.get('kind', '?')}] at {rep.get('where', '?')} "
+              f"(step {rep.get('step', '?')}): failed "
+              f"{_fmt_bytes(rep.get('failed_bytes'))}; ledger "
+              f"{_fmt_bytes(rep.get('ledger_total_bytes'))}; "
+              f"top owners: {tops or 'none'}\n")
+    return summ
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.split("\n\n")[0])
+    p.add_argument("traces", nargs="+",
+                   help="profiler.dump() trace(s), trace_merge output, or "
+                        "bare memory_ledger() dump(s); .json.gz ok")
+    p.add_argument("--top", type=int, default=15,
+                   help="owners shown in the per-owner table")
+    p.add_argument("--json", action="store_true",
+                   help="print the machine-readable summary instead")
+    args = p.parse_args(argv)
+    docs = []
+    for path in args.traces:
+        try:
+            docs.append(load_memory(path))
+        except (OSError, ValueError, json.JSONDecodeError) as e:
+            print(f"memory_report: {path}: {e}", file=sys.stderr)
+            return 2
+    mem = docs[0]
+    for other in docs[1:]:
+        mem["ledger"] = merge_ledgers(
+            [x for x in (mem["ledger"], other["ledger"]) if x])
+        mem["postmortems"].extend(other["postmortems"])
+        for dev, b in other["watermark"].items():
+            if b > mem["watermark"].get(dev, -1):
+                mem["watermark"][dev] = b
+        for name, pts in other["tracks"].items():
+            mem["tracks"].setdefault(name, []).extend(pts)
+    if args.json:
+        json.dump(summarize(mem), sys.stdout, indent=2)
+        print()
+        return 0
+    report(mem, top=args.top)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -113,6 +113,7 @@ def merge_traces(paths):
             "counters": od.get("counters"),
             "steps": od.get("steps"),
             "memory_watermark_bytes": od.get("memory_watermark_bytes"),
+            "memory": od.get("memory"),   # ledger/postmortems (ISSUE 12)
         }
     # stable ts sort keeps each file's intra-instant B/E ordering (pairing
     # is per (pid, tid), so cross-rank interleaving at equal ts is inert)
@@ -180,9 +181,14 @@ def check_merged(doc, expect_ranks=None):
                 raise ValueError(
                     f"rank {pid}: overlapping step spans after offset "
                     f"correction ({e0} > {b1})")
+    # per-device memory counter tracks ("C" events) ride the merge with
+    # their pid remapped to the rank — Perfetto shows one memory timeline
+    # per rank row
+    n_counters = sum(1 for e in events if e.get("ph") == "C")
     return {"ranks": span_pids,
             "labels": {p: names.get(p) for p in span_pids},
             "spans": n_spans,
+            "counter_events": n_counters,
             "steps_per_rank": {p: len(v) for p, v in step_ids.items()}}
 
 
@@ -211,7 +217,8 @@ def main(argv=None):
                   file=sys.stderr)
             return 2
         print(f"trace_merge check OK: ranks {summary['ranks']}, "
-              f"{summary['spans']} spans, steps/rank "
+              f"{summary['spans']} spans, "
+              f"{summary['counter_events']} counter events, steps/rank "
               f"{summary['steps_per_rank']}")
     with open_trace(args.out, "wt") as f:
         json.dump(merged, f)
