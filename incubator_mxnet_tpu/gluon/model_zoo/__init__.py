@@ -2,6 +2,8 @@
 from . import vision
 from . import bert
 from . import yolo
+from . import moe
+from .moe import MoEBlock, moe_sharding_rules
 from .vision import get_model
 from .bert import BERTModel, BERTForPretrain, bert_base, bert_large, bert_sharding_rules
 from .yolo import YOLOV3, DarknetV3, yolo3_darknet53
@@ -19,4 +21,7 @@ __all__ = [
     "YOLOV3",
     "DarknetV3",
     "yolo3_darknet53",
+    "moe",
+    "MoEBlock",
+    "moe_sharding_rules",
 ]

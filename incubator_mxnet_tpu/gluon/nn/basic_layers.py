@@ -32,6 +32,27 @@ __all__ = [
 ]
 
 
+def _split_stages(container, sizes):
+    """Shared ``split_stages`` body for the sequential containers: carve
+    the child list into consecutive stages of ``sizes[i]`` layers each.
+    Stages are new containers holding the SAME child blocks (and thus the
+    same Parameters) — exactly what ``SPMDTrainer(stages=...)`` needs:
+    the stage partition is a view, never a copy."""
+    sizes = [int(n) for n in sizes]
+    if any(n < 1 for n in sizes):
+        raise ValueError(f"every stage needs >= 1 layer, got {sizes}")
+    n = len(container)
+    if sum(sizes) != n:
+        raise ValueError(
+            f"stage sizes {sizes} sum to {sum(sizes)} but the container "
+            f"has {n} layers")
+    out, at = [], 0
+    for k in sizes:
+        out.append(container[at:at + k])
+        at += k
+    return out
+
+
 class Sequential(Block):
     """Sequential container (parity: ``nn.Sequential``)."""
 
@@ -41,6 +62,13 @@ class Sequential(Block):
     def add(self, *blocks):
         for b in blocks:
             self.register_child(b)
+
+    def split_stages(self, sizes):
+        """Partition into pipeline stages: ``net.split_stages([2, 3, 2])``
+        → three Sequentials of 2/3/2 consecutive layers sharing this
+        container's child blocks/Parameters (for ``SPMDTrainer``'s
+        ``stages=`` pipeline tier)."""
+        return _split_stages(self, sizes)
 
     def forward(self, x, *args):
         for child in self._children.values():
@@ -75,6 +103,10 @@ class HybridSequential(HybridBlock):
     def add(self, *blocks):
         for b in blocks:
             self.register_child(b)
+
+    def split_stages(self, sizes):
+        """Partition into pipeline stages (see ``Sequential.split_stages``)."""
+        return _split_stages(self, sizes)
 
     def forward(self, x, *args):
         # container: no own params; recurse into children directly

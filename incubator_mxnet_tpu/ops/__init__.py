@@ -12,6 +12,7 @@ from . import tensor  # noqa: F401  (registers tensor ops)
 from . import nn  # noqa: F401  (registers NN ops)
 from . import rnn_ops  # noqa: F401  (registers fused RNN)
 from . import attention  # noqa: F401  (registers fused/flash attention)
+from . import moe  # noqa: F401  (registers the MoE dispatch/combine kernel)
 from . import detection  # noqa: F401  (registers MultiBox*/box_nms/box_iou)
 from . import quantization  # noqa: F401  (registers quantize_v2/dequantize/int8 ops)
 from . import linalg  # noqa: F401  (registers the la_op family)

@@ -1,0 +1,100 @@
+"""Mixture-of-Experts dispatch/combine kernel (the 'ep' mesh axis payload).
+
+One registered op, :func:`moe_ffn`, computes a full top-k-routed expert
+FFN layer: router logits → top-k gates → capacity-limited einsum
+dispatch → per-expert two-layer FFN → weighted combine.  The dispatch is
+the Mesh-TF/Switch formulation — dense one-hot [tokens, experts,
+capacity] tensors instead of gather/scatter — because it is pure MXU
+work, shards over 'ep' on the stacked expert dim with zero custom
+collectives (XLA derives the all-to-alls from the shardings), and its
+drop rule is exact and deterministic: slots are granted in (choice rank,
+token position) order by a cumsum, so token t's first choice always
+beats token t+1's first choice, which beats every second choice.
+
+Static knobs (``num_experts``/``top_k``/``capacity_factor``) arrive as
+kwargs → part of the dispatch-cache/compile signature; capacity derives
+from the static token count, so a fixed batch shape never recompiles.
+
+Returns ``(y, aux_loss, z_loss, tokens_dropped, load_min, load_max)`` —
+losses raw (callers weight them), metrics ``stop_gradient``-ed float32
+so the tuple is vjp-safe end to end.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+__all__ = ["moe_ffn", "moe_capacity"]
+
+
+def moe_capacity(n_tokens, num_experts, top_k, capacity_factor):
+    """Per-expert slot budget: ``ceil(T·k/E · capacity_factor)``, clipped
+    to [1, T].  Static — shapes and knobs only."""
+    cap = math.ceil(n_tokens * top_k / num_experts * capacity_factor)
+    return max(1, min(int(cap), int(n_tokens)))
+
+
+@register("moe_ffn")
+def moe_ffn(x, router_w, w1, b1, w2, b2, num_experts=1, top_k=1,
+            capacity_factor=1.25, activation="relu"):
+    """Top-k routed expert FFN over the last axis of ``x``.
+
+    Shapes: ``x`` [..., d]; ``router_w`` [d, E]; ``w1`` [E, d, h];
+    ``b1`` [E, h]; ``w2`` [E, h, d]; ``b2`` [E, d].  Router math runs in
+    float32 regardless of ``x.dtype`` (gate ordering must not flip with
+    an AMP cast); expert GEMMs run in ``x.dtype``.
+    """
+    E, k = int(num_experts), int(top_k)
+    d = x.shape[-1]
+    xt = x.reshape(-1, d)
+    T = xt.shape[0]
+    C = moe_capacity(T, E, k, capacity_factor)
+
+    logits = xt.astype(jnp.float32) @ router_w.astype(jnp.float32)  # [T, E]
+    z_loss = jnp.mean(jax.nn.logsumexp(logits, axis=-1) ** 2)
+    probs = jax.nn.softmax(logits, axis=-1)
+    gate_vals, idx = jax.lax.top_k(probs, k)                        # [T, k]
+    em = jax.nn.one_hot(idx, E, dtype=jnp.float32)                  # [T, k, E]
+
+    # slot grant order: choice-rank major, token order minor — the cumsum
+    # over the [k·T, E] layout IS the priority rule (deterministic drops)
+    em_flat = em.transpose(1, 0, 2).reshape(k * T, E)
+    pos_flat = jnp.cumsum(em_flat, axis=0) - em_flat
+    pos = pos_flat.reshape(k, T, E).transpose(1, 0, 2)              # [T, k, E]
+    pos_tk = jnp.sum(pos * em, axis=-1)                             # [T, k]
+    kept = (pos_tk < C).astype(jnp.float32)                         # [T, k]
+
+    gates = gate_vals.astype(jnp.float32) * kept
+    gates = gates / jnp.maximum(gates.sum(-1, keepdims=True), 1e-9)
+    disp = em * kept[..., None]                                     # [T, k, E]
+    oh_pos = jax.nn.one_hot(pos_tk.astype(jnp.int32), C,
+                            dtype=jnp.float32) * kept[..., None]    # [T, k, C]
+    dispatch = jnp.einsum("tke,tkc->tec", disp, oh_pos)             # [T, E, C]
+    combine = jnp.einsum("tke,tkc,tk->tec", disp, oh_pos, gates)
+
+    cdt = x.dtype
+    expert_in = jnp.einsum("tec,td->ecd", dispatch.astype(cdt), xt)
+    h = jnp.einsum("ecd,edh->ech", expert_in, w1) + b1[:, None, :]
+    if activation:
+        from .nn import _ACTS
+
+        h = _ACTS[activation](h)
+    out_e = jnp.einsum("ech,ehd->ecd", h, w2) + b2[:, None, :]
+    y = jnp.einsum("tec,ecd->td", combine.astype(cdt), out_e)
+    y = y.reshape(x.shape)
+
+    # Switch-style load-balance loss: fraction routed × mean router prob,
+    # summed over experts, scaled by E (uniform routing → 1.0)
+    f = em.sum(axis=(0, 1)) / float(T * k)
+    p_mean = probs.mean(axis=0)
+    aux_loss = float(E) * jnp.sum(f * p_mean)
+
+    sg = jax.lax.stop_gradient
+    load = dispatch.sum(axis=(0, 2))                                # [E]
+    tokens_dropped = sg(float(k * T) - kept.sum())
+    return (y, aux_loss, z_loss, tokens_dropped,
+            sg(load.min()), sg(load.max()))

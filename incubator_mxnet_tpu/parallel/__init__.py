@@ -19,6 +19,12 @@ ICI/DCN.  There is no separate communication code path to maintain.
   equivalent of CachedOp fwd + backward + KVStore pushpull + optimizer).
 * :mod:`ring` — ring attention / sequence-parallel collectives over the
   'sp' mesh axis (capability the reference lacks; SURVEY.md §5).
+* :mod:`pipeline` — forward-only GPipe wavefront over the 'pp' axis
+  (``pipeline_apply``).
+* :mod:`schedule` — microbatched pipeline TRAINING schedules (GPipe /
+  1F1B): explicit forward/backward slots, per-stage remat, bubble
+  accounting; the engine behind ``SPMDTrainer(stages=...)``
+  (docs/pipeline_parallelism.md).
 """
 from .mesh import (
     MeshConfig,
@@ -40,6 +46,12 @@ from .sharding import (
 from .trainer import SPMDTrainer
 from .ring import ring_attention, ring_attention_sharded
 from .pipeline import pipeline_apply, stack_stage_params
+from .schedule import (
+    build_schedule,
+    simulate_schedule,
+    analytic_bubble_fraction,
+    pipeline_value_and_grad,
+)
 
 __all__ = [
     "MeshConfig",
@@ -59,4 +71,8 @@ __all__ = [
     "stack_stage_params",
     "ring_attention",
     "ring_attention_sharded",
+    "build_schedule",
+    "simulate_schedule",
+    "analytic_bubble_fraction",
+    "pipeline_value_and_grad",
 ]

@@ -40,7 +40,21 @@ __all__ = [
     "init_distributed",
     "mesh_scope",
     "sync_profiler_clock",
+    "get_shard_map",
 ]
+
+
+def get_shard_map():
+    """THE ``shard_map`` entry for the whole repo.  The stable location has
+    moved across jax releases (``jax.shard_map`` → only some versions;
+    ``jax.experimental.shard_map.shard_map`` → everywhere this repo
+    supports), and resolving it per call site already produced one broken
+    tier (TestRingAttention at HEAD) — so every user goes through here."""
+    sm = getattr(jax, "shard_map", None)
+    if sm is not None:
+        return sm
+    from jax.experimental.shard_map import shard_map as sm
+    return sm
 
 # Outermost → innermost.  jax.devices() enumerates in topology order on TPU
 # and the last axes step fastest through it, so the bandwidth-hungriest

@@ -17,6 +17,12 @@ Design notes (TPU-first):
 
 ``pipeline_apply`` composes with the rest of the stack (dp/tp axes can
 shard the batch/weights of each stage in the usual way).
+
+This module is the simple FORWARD entry.  Training — microbatched
+GPipe/1F1B schedules with an explicitly driven backward, remat options
+and bubble accounting — lives in :mod:`parallel.schedule`
+(``pipeline_value_and_grad`` / ``SPMDTrainer(stages=...)``); see
+docs/pipeline_parallelism.md.
 """
 from __future__ import annotations
 
@@ -66,10 +72,9 @@ def pipeline_apply(stage_fn, stage_params, x, mesh, n_microbatches, axis="pp"):
         raise ValueError(f"batch {B} not divisible by {n_microbatches} microbatches")
     mb = B // n_microbatches
 
-    try:
-        from jax import shard_map  # jax >= 0.4.35 stable API
-    except ImportError:  # older jax
-        from jax.experimental.shard_map import shard_map
+    from .mesh import get_shard_map
+
+    shard_map = get_shard_map()
 
     in_specs = (
         jax.tree_util.tree_map(

@@ -1,0 +1,378 @@
+"""Microbatched pipeline *training* schedules — GPipe and 1F1B.
+
+``parallel/pipeline.py`` is the forward-only GPipe primitive (shard_map +
+ppermute wavefront; ``pipeline_apply`` remains the simple entry).  This
+module is the training tier on top of it: a scheduler that drives forward
+AND backward slots explicitly per microbatch, so the step's structure is
+the pipeline schedule rather than whatever jax AD derives from reversing
+a forward loop.
+
+Three layers:
+
+* :func:`build_schedule` — the per-stage slot order for a schedule kind
+  (``"gpipe"``: all forwards then all backwards; ``"1f1b"``: warmup
+  forwards, steady one-forward-one-backward, cooldown backwards).
+* :func:`simulate_schedule` — a deterministic tick simulator over the
+  slot orders (in-order stages, F(s,m) after F(s-1,m), B(s,m) after
+  B(s+1,m) and F(s,m)), yielding the makespan, per-stage busy time and
+  the bubble fraction.  This IS the repo's bubble measurement: per-slot
+  costs are calibrated from real timed slot programs (the opperf
+  harness), and the grid accounting is exact — on the 8-process virtual
+  CPU mesh the wall clock serializes stages, so wall-clock "bubbles"
+  would measure the host, not the schedule.
+* :func:`pipeline_value_and_grad` — the executable schedule: one trace,
+  static trip count (the slot list is fixed at build time — the bubble
+  is explicit in the schedule, not dynamic control flow), every slot an
+  explicit ``jax.vjp`` forward/backward with activation stashes handed
+  from F to B slots, per-stage activation rematerialization via
+  ``jax.checkpoint``.  Called inside ``SPMDTrainer``'s jitted step, the
+  whole schedule lowers to ONE donated-buffer program.
+
+Bubble math (docs/pipeline_parallelism.md): with P stages and M
+microbatches and uniform slot costs, ANY work-conserving schedule idles
+(P−1)/(M+P−1) of the stage×time grid — 1F1B's classic win over GPipe is
+activation memory (≤P microbatches in flight instead of M), not the
+idealized bubble.  The measured difference the bench reports comes from
+the default configurations: GPipe is scheduled the way the GPipe paper
+runs it (full rematerialization, because M in-flight activations do not
+fit), so its backward slots pay an extra forward; 1F1B holds only P
+activation stashes and defaults remat off.  Recompute counts as bubble —
+it is overhead the schedule, not the model, demanded.
+"""
+from __future__ import annotations
+
+import threading as _threading
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "build_schedule",
+    "simulate_schedule",
+    "analytic_bubble_fraction",
+    "pipeline_value_and_grad",
+    "in_backward_trace",
+    "current_slot",
+]
+
+_SCHEDULES = ("gpipe", "1f1b")
+
+
+def build_schedule(n_stages, n_microbatches, kind="1f1b"):
+    """Per-stage ordered slot lists: ``[[('F', m) | ('B', m), ...], ...]``.
+
+    * ``gpipe`` — stage s runs F(0..M−1) then B(0..M−1): the all-forward
+      phase holds M activation stashes (hence remat by default).
+    * ``1f1b`` — stage s warms up with min(M, P−1−s) forwards, then
+      alternates F/B so at most P−s microbatches are in flight, then
+      drains the remaining backwards.
+    """
+    P, M = int(n_stages), int(n_microbatches)
+    if P < 1 or M < 1:
+        raise ValueError(f"need n_stages >= 1 and n_microbatches >= 1, got {P}, {M}")
+    kind = str(kind).lower()
+    if kind not in _SCHEDULES:
+        raise ValueError(f"unknown schedule {kind!r}; pick one of {_SCHEDULES}")
+    out = []
+    for s in range(P):
+        slots = []
+        if kind == "gpipe":
+            slots += [("F", m) for m in range(M)]
+            slots += [("B", m) for m in range(M)]
+        else:  # 1f1b
+            warm = min(M, P - 1 - s)
+            slots += [("F", m) for m in range(warm)]
+            for m in range(M - warm):
+                slots.append(("F", m + warm))
+                slots.append(("B", m))
+            slots += [("B", m) for m in range(M - warm, M)]
+        out.append(slots)
+    return out
+
+
+def analytic_bubble_fraction(n_stages, n_microbatches):
+    """The idealized pipeline fill/drain bound: (P−1)/(M+P−1)."""
+    P, M = int(n_stages), int(n_microbatches)
+    return (P - 1) / (M + P - 1) if M + P > 1 else 0.0
+
+
+def _remat_flags(remat, n_stages):
+    if isinstance(remat, (list, tuple)):
+        if len(remat) != n_stages:
+            raise ValueError(
+                f"per-stage remat needs {n_stages} flags, got {len(remat)}")
+        return [bool(r) for r in remat]
+    return [bool(remat)] * n_stages
+
+
+def simulate_schedule(n_stages, n_microbatches, kind="1f1b",
+                      tf=1.0, tb=None, remat=False):
+    """Deterministic tick simulation of a schedule.
+
+    Dependency rules: stages execute their slot lists in order; F(s, m)
+    needs F(s−1, m) done; B(s, m) needs B(s+1, m) and F(s, m) done.  A
+    forward slot costs ``tf``, a backward slot ``tb`` (default 2·tf) plus
+    ``tf`` recompute when the stage rematerializes.
+
+    Returns a dict with ``total`` (makespan), ``per_stage_busy`` /
+    ``per_stage_useful`` (busy includes recompute, useful does not),
+    ``idle_fraction`` (1 − busy/(P·total)), ``bubble_fraction``
+    (1 − useful/(P·total): idle AND recompute overhead), the slot
+    ``timeline`` [(stage, op, microbatch, start, end)], and
+    ``analytic_bound`` = (P−1)/(M+P−1).
+    """
+    P, M = int(n_stages), int(n_microbatches)
+    tf = float(tf)
+    tb = 2.0 * tf if tb is None else float(tb)
+    flags = _remat_flags(remat, P)
+    orders = build_schedule(P, M, kind)
+    ptr = [0] * P               # next slot index per stage
+    free = [0.0] * P            # stage ready time
+    done = {}                   # (op, s, m) -> finish time
+    busy = [0.0] * P
+    useful = [0.0] * P
+    timeline = []
+    remaining = sum(len(o) for o in orders)
+    while remaining:
+        progressed = False
+        for s in range(P):
+            if ptr[s] >= len(orders[s]):
+                continue
+            op, m = orders[s][ptr[s]]
+            if op == "F":
+                dep = 0.0 if s == 0 else done.get(("F", s - 1, m))
+                cost = tf
+                use = tf
+            else:
+                up = 0.0 if s == P - 1 else done.get(("B", s + 1, m))
+                own = done.get(("F", s, m))
+                dep = None if (up is None or own is None) else max(up, own)
+                cost = tb + (tf if flags[s] else 0.0)
+                use = tb
+            if dep is None:
+                continue  # dependency not scheduled yet — revisit next pass
+            start = max(free[s], dep)
+            end = start + cost
+            free[s] = end
+            done[(op, s, m)] = end
+            busy[s] += cost
+            useful[s] += use
+            timeline.append((s, op, m, start, end))
+            ptr[s] += 1
+            remaining -= 1
+            progressed = True
+        if not progressed:
+            raise RuntimeError(
+                f"schedule deadlock: {kind} P={P} M={M} (builder bug)")
+    total = max(free) if P else 0.0
+    grid = P * total if total else 1.0
+    return {
+        "kind": kind,
+        "n_stages": P,
+        "n_microbatches": M,
+        "tf": tf,
+        "tb": tb,
+        "remat": flags,
+        "total": total,
+        "per_stage_busy": busy,
+        "per_stage_useful": useful,
+        "idle_fraction": 1.0 - sum(busy) / grid,
+        "bubble_fraction": 1.0 - sum(useful) / grid,
+        "analytic_bound": analytic_bubble_fraction(P, M),
+        "timeline": sorted(timeline, key=lambda t: (t[3], t[0])),
+    }
+
+
+# --------------------------------------------------------------------------
+# Executable schedule
+# --------------------------------------------------------------------------
+
+_tls = _threading.local()
+
+
+def in_backward_trace():
+    """True while the scheduler is tracing a backward slot (including a
+    ``jax.checkpoint`` recompute inside one).  Stage closures that collect
+    side outputs (BatchNorm aux, MoE losses) consult this so a remat
+    stage's recompute trace does not double-collect — values captured
+    during a backward re-trace belong to the remat primitive's inner
+    scope and must not leak into the loss graph."""
+    return bool(getattr(_tls, "backward", 0))
+
+
+class _backward_scope:
+    def __enter__(self):
+        _tls.backward = getattr(_tls, "backward", 0) + 1
+
+    def __exit__(self, *exc):
+        _tls.backward -= 1
+        return False
+
+
+def current_slot():
+    """The (stage, microbatch) the scheduler is currently tracing, or
+    None outside a slot.  Set around BOTH a slot's forward trace and its
+    backward invocation (a ``jax.checkpoint`` recompute re-runs the stage
+    closure and must observe the SAME slot — e.g. so a per-microbatch
+    dropout key folds identically in the recompute)."""
+    return getattr(_tls, "slot", None)
+
+
+class _slot_scope:
+    def __init__(self, s, m):
+        self._slot = (s, m)
+
+    def __enter__(self):
+        self._prev = getattr(_tls, "slot", None)
+        _tls.slot = self._slot
+
+    def __exit__(self, *exc):
+        _tls.slot = self._prev
+        return False
+
+
+def _split_microbatches(tree, n_micro):
+    """Split every leaf of ``tree`` into ``n_micro`` equal chunks along
+    axis 0; returns a list of per-microbatch trees."""
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    for leaf in leaves:
+        if leaf.shape[0] % n_micro:
+            raise ValueError(
+                f"batch axis {leaf.shape[0]} not divisible by "
+                f"{n_micro} microbatches")
+    out = []
+    for m in range(n_micro):
+        chunk = [
+            leaf[m * (leaf.shape[0] // n_micro):(m + 1) * (leaf.shape[0] // n_micro)]
+            for leaf in leaves
+        ]
+        out.append(jax.tree_util.tree_unflatten(treedef, chunk))
+    return out
+
+
+def pipeline_value_and_grad(stage_fns, loss_fn, stage_params, inputs, labels,
+                            n_microbatches, schedule="1f1b", remat=False,
+                            stage_outputs="plain"):
+    """Run one pipelined forward+backward over ``n_microbatches``.
+
+    Parameters
+    ----------
+    stage_fns : list of callables
+        ``stage_outputs="plain"``: ``stage_fns[s](params_s, h) -> h`` —
+        pure per-stage computation (stage 0 receives the microbatch input
+        tree; intermediate activations may be any pytree).
+        ``stage_outputs="rich"``: ``stage_fns[s](params_s, h) ->
+        (h, side_loss, metrics)`` — ``side_loss`` is a scalar folded into
+        the differentiated loss with cotangent 1 (MoE auxiliary losses:
+        their gradient reaches that stage's params through the slot's own
+        vjp, not the activation chain), ``metrics`` an arbitrary pytree of
+        non-differentiated side outputs (routing stats, BatchNorm aux),
+        collected per (stage, microbatch) via ``has_aux`` so they stay
+        valid outer-trace values even for rematerialized stages.
+    loss_fn : callable(last_stage_out, label_microbatch) -> scalar
+        Must return the SUM of per-sample losses over the microbatch, so
+        accumulated grads equal d(sum over full batch) — the
+        ``loss.backward()`` convention the unpipelined step differentiates
+        (mean reduction comes from the caller's rescale, exactly as in
+        ``SPMDTrainer``).
+    stage_params : list of pytrees (one per stage)
+    inputs, labels : pytrees with leading batch dim
+    schedule : "gpipe" | "1f1b"
+    remat : bool or per-stage sequence
+        Rematerialize that stage's activations (``jax.checkpoint``): its
+        backward slot re-runs the forward instead of holding stashes.
+
+    Returns ``(task_loss_sum, side_loss_sum, grads, metrics)``: ``grads``
+    is a list of per-stage pytrees (sum over microbatches; includes side
+    losses), ``metrics[s]`` the microbatch-ordered list of stage s's
+    metrics pytrees (empty structure under "plain").  Trace-time static:
+    the slot sequence is fixed, so under ``jax.jit`` the whole schedule
+    compiles once per (shape, schedule) signature.
+    """
+    P = len(stage_fns)
+    if P < 1:
+        raise ValueError("need at least one stage")
+    if len(stage_params) != P:
+        raise ValueError(f"{P} stage_fns but {len(stage_params)} stage_params")
+    if stage_outputs not in ("plain", "rich"):
+        raise ValueError(f"stage_outputs must be 'plain' or 'rich', "
+                         f"got {stage_outputs!r}")
+    M = int(n_microbatches)
+    flags = _remat_flags(remat, P)
+
+    if stage_outputs == "plain":
+        def _adapt(fn):
+            return lambda p, h: ((fn(p, h), jnp.zeros(())), ())
+    else:
+        def _adapt(fn):
+            def a(p, h):
+                h2, side, metrics = fn(p, h)
+                return (h2, side), metrics
+            return a
+    # ((h, side), metrics) — the differentiated pair rides the primal
+    # output, metrics ride has_aux; jax.checkpoint wraps the ADAPTED fn so
+    # a remat stage recomputes side losses identically in its backward.
+    # Built FRESH per slot: jax.checkpoint caches its trace by function
+    # identity + avals, so a shared per-stage wrapper would hand every
+    # microbatch the jaxpr traced for microbatch 0 — wrong whenever the
+    # stage closure bakes slot-dependent values in (a per-microbatch
+    # dropout key fold via current_slot())
+    def _slot_fn(s):
+        a = _adapt(stage_fns[s])
+        return jax.checkpoint(a) if flags[s] else a
+
+    micro_in = _split_microbatches(inputs, M)
+    micro_lab = _split_microbatches(labels, M)
+
+    # global execution order = simulated start-time order (a topological
+    # order by construction: the simulator only starts a slot when its
+    # dependencies have finished)
+    sim = simulate_schedule(P, M, schedule, remat=flags)
+    order = [(s, op, m) for s, op, m, _, _ in sim["timeline"]]
+
+    vjps = {}      # (s, m) -> vjp closure (activation stash lives in it)
+    acts = {}      # (s, m) -> stage output, consumed by stage s+1's F slot
+    grad_h = {}    # (s, m) -> cotangent for stage s's output
+    grads = [None] * P
+    metrics = [[None] * M for _ in range(P)]
+    task_sum = None
+    side_sum = None
+
+    for s, op, m in order:
+        if op == "F":
+            h_in = micro_in[m] if s == 0 else acts.pop((s - 1, m))
+            with _slot_scope(s, m):
+                slot_fn = _slot_fn(s)
+                if s == P - 1:
+                    lab = micro_lab[m]
+
+                    def last(p, h, _fn=slot_fn, _lab=lab):
+                        (h2, side), mx = _fn(p, h)
+                        task = loss_fn(h2, _lab)
+                        return task + side, (task, side, mx)
+
+                    total, vjp, (task, side, mx) = jax.vjp(
+                        last, stage_params[s], h_in, has_aux=True)
+                else:
+                    (h_out, side), vjp, mx = jax.vjp(
+                        slot_fn, stage_params[s], h_in, has_aux=True)
+                    acts[(s, m)] = h_out
+                    task = None
+            metrics[s][m] = mx
+            task_sum = task if task_sum is None and task is not None else (
+                task_sum + task if task is not None else task_sum)
+            side_sum = side if side_sum is None else side_sum + side
+            vjps[(s, m)] = vjp
+        else:  # backward slot: seed with the downstream cotangent
+            if s == P - 1:
+                seed = jnp.ones((), dtype=task_sum.dtype)
+            else:
+                seed = (grad_h.pop((s, m)), jnp.ones(()))
+            with _backward_scope(), _slot_scope(s, m):
+                dp, dh = vjps.pop((s, m))(seed)
+            grads[s] = dp if grads[s] is None else jax.tree_util.tree_map(
+                jnp.add, grads[s], dp)
+            if s > 0:
+                grad_h[(s - 1, m)] = dh
+    assert not vjps and not grad_h, "schedule left unconsumed slots"
+    return task_sum, side_sum, grads, metrics

@@ -55,6 +55,13 @@ class ShardingRules:
         self._rules.append((re.compile(pattern), spec))
         return self
 
+    @property
+    def default(self):
+        """The fallback spec for names no rule matches (composing rule
+        tables — e.g. ``moe_sharding_rules(base)`` — reads it instead of
+        touching the private storage)."""
+        return self._default
+
     def spec_for(self, name: str, shape, mesh: Mesh) -> P:
         for pat, spec in self._rules:
             if pat.search(name):

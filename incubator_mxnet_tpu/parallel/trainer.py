@@ -76,6 +76,30 @@ def _state_to_ndarrays(st):
     return st
 
 
+def _moe_extras(metrics):
+    """Frame metrics → the step's extras dict (raw jax scalars, fixed
+    keys — the extras pytree is part of the compile signature, so its
+    structure must be identical across every trace of one build)."""
+    if metrics is None:
+        return {}
+
+    def raw(v):
+        return v._data if isinstance(v, NDArray) else v
+
+    return {
+        "moe_tokens_dropped": raw(metrics["tokens_dropped"]),
+        "moe_expert_load_min": raw(metrics["expert_load_min"]),
+        "moe_expert_load_max": raw(metrics["expert_load_max"]),
+    }
+
+
+def _release_pipeline_observers(name):
+    """weakref.finalize hook: a collected pipelined trainer's gauges and
+    slow-step annotator leave the export surfaces."""
+    _profiler.unregister_metrics_provider(name)
+    _profiler.unregister_slow_step_annotator(name)
+
+
 def _release_spmd_memory(param_bytes, state_bytes):
     """weakref.finalize hook: a collected trainer's donated buffers leave
     the device-memory ledger (no self reference — the finalizer must not
@@ -102,6 +126,18 @@ class SPMDTrainer:
         Parameter placement (tp/fsdp).  Default: replicate (pure dp).
     sp_axis : int, optional
         Input axis to shard over 'sp' (sequence/context parallelism).
+    stages : list of Blocks, optional
+        A stage partition of ``block`` (``net.split_stages([...])`` or any
+        list of Blocks whose parameters partition the model's).  Turns the
+        step into a microbatched pipeline: forward AND backward slots run
+        per the configured schedule inside the SAME single jitted program
+        (``parallel/schedule.py``), with gradient allreduce still derived
+        by XLA from the dp sharding — overlapped against the backward
+        slots by the scheduler.
+    pipeline : dict, optional (requires ``stages``)
+        ``n_microbatches`` (required), ``schedule`` ("1f1b" default |
+        "gpipe"), ``remat`` (bool or per-stage list; defaults True for
+        gpipe — the GPipe paper's configuration — and False for 1f1b).
     """
 
     def __init__(
@@ -114,6 +150,8 @@ class SPMDTrainer:
         rules: ShardingRules | None = None,
         sp_axis: int | None = None,
         donate: bool = True,
+        stages=None,
+        pipeline=None,
     ):
         self._block = block
         self._loss_fn = loss_fn
@@ -185,6 +223,7 @@ class SPMDTrainer:
                                "optimizer_state").alloc(sb)
         self._mem_finalizer = _weakref.finalize(
             self, _release_spmd_memory, pb, sb)
+        self._setup_pipeline(stages, pipeline)
         from ..base import register_jit_cache_owner
         register_jit_cache_owner(self)
         if jax.process_count() > 1:
@@ -195,6 +234,131 @@ class SPMDTrainer:
 
     def _invalidate_jit_cache(self):
         self._step_cache.clear()
+
+    # ------------------------------------------------------------------
+    def _setup_pipeline(self, stages, pipeline):
+        """Validate the stage partition and freeze the schedule config +
+        its static bubble accounting (the unit-cost simulation: tf=1,
+        tb=2 — recompute slots add tf per the remat flags)."""
+        import threading as _threading
+
+        self._stages = list(stages) if stages else None
+        self._moe_last = {}
+        self._moe_pending = None
+        self._moe_lock = _threading.Lock()   # step thread vs scrape thread
+        self._moe_provider_name = None
+        if self._stages is None:
+            if pipeline:
+                raise ValueError("pipeline= requires stages=")
+            return
+        from . import schedule as sched_mod
+
+        self._sched_mod = sched_mod
+        cfg = dict(pipeline or {})
+        self._pipe_schedule = str(cfg.pop("schedule", "1f1b")).lower()
+        self._pipe_micro = int(cfg.pop("n_microbatches", 0) or 0)
+        default_remat = self._pipe_schedule == "gpipe"
+        self._pipe_remat = cfg.pop("remat", default_remat)
+        if cfg:
+            raise ValueError(f"unknown pipeline config keys: {sorted(cfg)}")
+        if self._pipe_micro < 1:
+            raise ValueError("pipeline= needs n_microbatches >= 1")
+        P = len(self._stages)
+        idx_of = {id(p): i for i, p in enumerate(self._params)}
+        self._stage_param_objs = []
+        self._stage_param_idx = []
+        seen = {}
+        for s, st in enumerate(self._stages):
+            ps = st.collect_params()
+            if isinstance(ps, (dict, ParameterDict)):
+                ps = list(ps.values())
+            ps.sort(key=lambda p: p.name)
+            idxs = []
+            for p in ps:
+                j = idx_of.get(id(p))
+                if j is None:
+                    raise ValueError(
+                        f"stage {s} parameter {p.name} is not a parameter "
+                        "of the trainer's block")
+                if j in seen:
+                    raise ValueError(
+                        f"parameter {p.name} appears in stages {seen[j]} "
+                        f"and {s}; stages must partition the parameters")
+                seen[j] = s
+                idxs.append(j)
+            self._stage_param_objs.append(ps)
+            self._stage_param_idx.append(idxs)
+        missing = [self._params[j].name
+                   for j in self._trainable_idx if j not in seen]
+        if missing:
+            raise ValueError(
+                f"trainable parameters not covered by any stage: {missing}")
+        self._pipe_sim = sched_mod.simulate_schedule(
+            P, self._pipe_micro, self._pipe_schedule,
+            tf=1.0, tb=2.0, remat=self._pipe_remat)
+        # per-stage modeled windows (fractions of the simulated makespan):
+        # scaled by each real step's wall time for spans/gauges
+        total = self._pipe_sim["total"] or 1.0
+        spans = []
+        for s in range(P):
+            slots = [t for t in self._pipe_sim["timeline"] if t[0] == s]
+            spans.append((min(t[3] for t in slots) / total,
+                          max(t[4] for t in slots) / total,
+                          self._pipe_sim["per_stage_busy"][s] / total))
+        self._pipe_stage_frac = spans
+        self._pipe_last = {}
+        self._pipe_last_step = None   # step id of this trainer's last
+                                      # dispatch (slow-step attribution
+                                      # stays scoped to OUR steps)
+        self._register_pipeline_observers()
+
+    def _register_pipeline_observers(self):
+        """Metrics provider + slow-step annotator, holding the trainer
+        only weakly (a provider closure owning ``self`` would pin the
+        donated buffers past the trainer's lifetime)."""
+        import weakref as _weakref
+
+        ref = _weakref.ref(self)
+
+        def provider():
+            tr = ref()
+            if tr is None:
+                return {}
+            tr._drain_moe_extras()
+            out = {
+                "stages": len(tr._stages),
+                "microbatches": tr._pipe_micro,
+                "bubble_fraction": round(
+                    tr._pipe_sim["bubble_fraction"], 4),
+            }
+            out.update(tr._pipe_last)
+            return out
+
+        def annotator(stats):
+            tr = ref()
+            if tr is None or not tr._pipe_last:
+                return None
+            if stats.get("step") != tr._pipe_last_step:
+                # a slow step this trainer did not dispatch (another
+                # trainer's loop, or a not-yet-collected stale trainer):
+                # its stage attribution would be fiction — stay silent
+                return None
+            busy = {int(k[len("stage"):-len("_busy_ms")]): v
+                    for k, v in tr._pipe_last.items()
+                    if k.startswith("stage") and k.endswith("_busy_ms")}
+            if not busy:
+                return None
+            worst = max(busy, key=busy.get)
+            return (f"stage {worst} modeled busy {busy[worst]:.1f} ms of "
+                    f"{stats.get('wall_ms', 0.0):.1f} ms wall (schedule "
+                    f"{tr._pipe_schedule}, bubble "
+                    f"{tr._pipe_sim['bubble_fraction']:.0%})")
+
+        name = _profiler.register_metrics_provider_unique("pipeline", provider)
+        self._pipe_provider_name = name
+        _profiler.register_slow_step_annotator(name, annotator)
+        self._obs_finalizer = _weakref.finalize(
+            self, _release_pipeline_observers, name)
 
     # ------------------------------------------------------------------
     def _sharding_like(self, arr, param_sh):
@@ -261,6 +425,106 @@ class SPMDTrainer:
             sig[f"input{i}"] = _profiler.sig_array(a)
         return sig
 
+    def _record_step_obs(self, extras, tw, k=1):
+        """Host-side pipeline/MoE observability for one dispatched step:
+        declared counters (always on, like every repo counter), the
+        ``pipeline.step``/``pipeline.stage``/``moe.step`` trace spans, and
+        the provider gauges.  Per-stage spans/gauges carry the SCHEDULE's
+        modeled attribution (unit-cost slot windows scaled onto the
+        host-observed step span) — on a virtual CPU mesh the wall clock
+        serializes stages, so modeled windows are the honest per-stage
+        story and are labeled as such in docs/pipeline_parallelism.md."""
+        now = _perf()
+        wall_ms = (now - tw) * 1e3
+        if self._stages is not None:
+            sim = self._pipe_sim
+            _profiler.incr("pipeline_step", k)
+            _profiler.incr("pipeline_microbatch", self._pipe_micro * k)
+            bubble_ms = sim["bubble_fraction"] * wall_ms
+            _profiler.incr("pipeline_bubble_ms", int(round(bubble_ms)))
+            last = {"wall_ms": round(wall_ms, 3)}
+            for s, (f0, f1, busy_frac) in enumerate(self._pipe_stage_frac):
+                last[f"stage{s}_busy_ms"] = round(busy_frac * wall_ms, 3)
+            self._pipe_last_step = _profiler.current_step()
+            if _profiler._active:
+                _profiler.record_span(
+                    "pipeline.step", "trainer", tw, now,
+                    args={"schedule": self._pipe_schedule,
+                          "stages": len(self._stages),
+                          "microbatches": self._pipe_micro,
+                          "bubble_ms": round(bubble_ms, 3),
+                          "bubble_fraction": round(sim["bubble_fraction"], 4)})
+                span_s = (now - tw)
+                for s, (f0, f1, busy_frac) in enumerate(self._pipe_stage_frac):
+                    _profiler.record_span(
+                        "pipeline.stage", "trainer",
+                        tw + f0 * span_s, tw + f1 * span_s,
+                        args={"stage": s,
+                              "busy_ms": round(busy_frac * wall_ms, 3),
+                              "modeled": True})
+            self._pipe_last.update(last)
+        self._drain_moe_extras()
+        if extras:
+            # stash raw device scalars; converted at the NEXT step (or a
+            # metrics read) — an immediate np.asarray would block the
+            # training thread on the whole step's device completion and
+            # forfeit dispatch/compute overlap
+            self._moe_pending = extras
+            if self._moe_provider_name is None and self._stages is None:
+                # unpipelined MoE trainer: the routing gauges still belong
+                # on the metrics surfaces — register a provider on first
+                # sight of MoE extras (weakly, like the pipeline one)
+                import weakref as _weakref
+
+                ref = _weakref.ref(self)
+
+                def moe_provider():
+                    tr = ref()
+                    if tr is None:
+                        return {}
+                    tr._drain_moe_extras()
+                    return tr._moe_last
+
+                name = _profiler.register_metrics_provider_unique(
+                    "moe", moe_provider)
+                self._moe_provider_name = name
+                self._moe_finalizer = _weakref.finalize(
+                    self, _profiler.unregister_metrics_provider, name)
+    def _drain_moe_extras(self):
+        """Convert the PREVIOUS step's stashed MoE extras (by now the
+        device has finished that step, so the read doesn't stall the
+        loop): bump the drop counter, refresh the gauges, emit the
+        ``moe.step`` marker.  Also called from the metrics provider so a
+        snapshot between steps sees current values."""
+        with self._moe_lock:
+            # swap-and-convert under the lock: the step thread and a
+            # metrics-scrape thread both drain, and an unlocked swap
+            # would let both see the same pending dict and double-bump
+            # the monotone drop counter
+            pending, self._moe_pending = self._moe_pending, None
+            if not pending:
+                return
+            vals = {key: _np.asarray(v) for key, v in pending.items()}
+        dropped = int(round(float(vals["moe_tokens_dropped"].sum())))
+        lmin = float(vals["moe_expert_load_min"].min())
+        lmax = float(vals["moe_expert_load_max"].max())
+        if dropped:
+            _profiler.incr("moe_tokens_dropped", dropped)
+        self._moe_last = {
+            "moe_tokens_dropped": dropped,
+            "moe_expert_load_min": lmin,
+            "moe_expert_load_max": lmax,
+        }
+        if self._stages is not None:
+            self._pipe_last.update(self._moe_last)
+        if _profiler._active:
+            now = _perf()
+            _profiler.record_span(
+                "moe.step", "trainer", now, now,
+                args={"tokens_dropped": dropped,
+                      "expert_load_min": lmin,
+                      "expert_load_max": lmax})
+
     def _post_step(self):
         # the guard arms AFTER the first compiled step: everything later
         # is steady state — recompiles from here on are counted (and
@@ -301,10 +565,11 @@ class SPMDTrainer:
             except Exception:
                 lowered = None
         tc = _perf() if fresh else None
-        t0 = _perf() if _profiler._active else None
+        tw = _perf()
+        t0 = tw if _profiler._active else None
         try:
             try:
-                new_params, new_states, loss = fn(*call_args)
+                new_params, new_states, loss, extras = fn(*call_args)
             except Exception as e:
                 # the fused step is THE training-tier OOM choke point:
                 # a RESOURCE_EXHAUSTED here gets one postmortem naming
@@ -319,6 +584,7 @@ class SPMDTrainer:
                     (_perf() - tc) * 1e3, lowered=lowered)
             if t0 is not None:
                 _profiler.record_span("spmd.step", "trainer", t0)
+            self._record_step_obs(extras, tw)
         finally:
             _profiler.step_boundary()
         self._post_step()
@@ -369,10 +635,11 @@ class SPMDTrainer:
             except Exception:
                 lowered = None
         tc = _perf() if fresh else None
-        t0 = _perf() if _profiler._active else None
+        tw = _perf()
+        t0 = tw if _profiler._active else None
         try:
             try:
-                new_params, new_states, loss = fn(*call_args)
+                new_params, new_states, loss, extras = fn(*call_args)
             except Exception as e:
                 _profiler.maybe_oom_postmortem(e, "spmd.step_bulk")
                 raise
@@ -385,6 +652,7 @@ class SPMDTrainer:
             if t0 is not None:
                 _profiler.record_span("spmd.step_bulk", "trainer", t0,
                                       args={"k": int(k)})
+            self._record_step_obs(extras, tw, k=int(k))
         finally:
             _profiler.step_boundary()  # one boundary per dispatch, not per k
         self._post_step()
@@ -397,13 +665,15 @@ class SPMDTrainer:
             def body(carry, xs):
                 pa, os = carry
                 key, t, lr = xs
-                pa, os, loss = pure_step(key, t, lr, rescale, pa, os, *batch)
-                return (pa, os), loss
+                pa, os, loss, extras = pure_step(
+                    key, t, lr, rescale, pa, os, *batch)
+                return (pa, os), (loss, extras)
 
-            (pa, os), losses = jax.lax.scan(
+            (pa, os), (losses, extras) = jax.lax.scan(
                 body, (param_arrs, opt_states), (keys, ts, lrs), length=k
             )
-            return pa, os, losses[-1]
+            # extras leaves arrive stacked [k]; _record_step_obs reduces
+            return pa, os, losses[-1], extras
 
         return self._jit_wrapped(bulk_step)
 
@@ -418,6 +688,10 @@ class SPMDTrainer:
             list(self._param_shardings),
             list(self._state_shardings),
             NamedSharding(self._mesh, P()),
+            # extras: a (possibly empty) dict of replicated scalars — a
+            # prefix-leaf sharding covers whatever structure the build
+            # produced
+            NamedSharding(self._mesh, P()),
         )
         donate = (4, 5) if self._donate else ()
         with self._mesh:
@@ -426,6 +700,8 @@ class SPMDTrainer:
             )
 
     def _build_pure(self, example_arrays):
+        if self._stages is not None:
+            return self._build_pure_pipeline(example_arrays)
         block = self._block
         loss_fn = self._loss_fn
         opt = self._optimizer
@@ -447,18 +723,29 @@ class SPMDTrainer:
             _aux_stack().append(collector)
             prev = getattr(_block_tls, "tracing", 0)
             _block_tls.tracing = prev + 1
+            from ..gluon.model_zoo import moe as moe_mod
             try:
                 with autograd._scope(False, True):  # training=True, no tape
-                    ins = [NDArray(b) for b in batch[:n_inputs]]
-                    out = block(*ins)
-                    label = NDArray(batch[n_inputs])
-                    loss = loss_fn(out, label)
+                    with moe_mod.moe_loss_frame() as moe_fr:
+                        ins = [NDArray(b) for b in batch[:n_inputs]]
+                        out = block(*ins)
+                        label = NDArray(batch[n_inputs])
+                        loss = loss_fn(out, label)
                     # Differentiate the SUM (matching ``loss.backward()`` on a
                     # vector loss: implicit ones head-grads); Trainer-parity
                     # mean-reduction comes from rescale_grad = 1/batch_size.
                     loss_data = loss._data.astype(jnp.float32)
                     loss_scalar = jnp.sum(loss_data)
                     loss_mean = jnp.mean(loss_data)
+                    # MoE auxiliary losses (load balance + router z) join
+                    # the differentiated scalar; routing metrics leave the
+                    # program as extras for host-side counters/gauges
+                    moe_side = moe_mod.frame_loss(moe_fr)
+                    if moe_side is not None:
+                        if isinstance(moe_side, NDArray):
+                            moe_side = moe_side._data
+                        loss_scalar = loss_scalar + moe_side.astype(jnp.float32)
+                    extras = _moe_extras(moe_mod.frame_metrics(moe_fr))
             finally:
                 _block_tls.tracing = prev
                 _aux_stack().pop()
@@ -471,54 +758,229 @@ class SPMDTrainer:
             aux_vals = tuple(
                 v._data if isinstance(v, NDArray) else v for _, v in collector
             )
-            return loss_scalar, (aux_vals, loss_mean)
+            return loss_scalar, (aux_vals, loss_mean, extras)
 
         def pure_step(key, t, lr, rescale, param_arrs, opt_states, *batch):
             train_arrs = [param_arrs[j] for j in trainable_idx]
-            (_, (aux_vals, loss_mean)), grads = jax.value_and_grad(
+            (_, (aux_vals, loss_mean, extras)), grads = jax.value_and_grad(
                 forward_loss, has_aux=True
             )(train_arrs, param_arrs, key, batch)
+            new_full, new_states = self._traced_optimizer_apply(
+                t, lr, rescale, param_arrs, opt_states, grads)
+            # aux side effects (BatchNorm running stats) overwrite their
+            # frozen params.
+            for k, v in zip(aux_idx_cell[0] if aux_idx_cell else [], aux_vals):
+                new_full[k] = v.astype(new_full[k].dtype)
+            return new_full, new_states, loss_mean, extras
 
-            # Optimizer: reuse the registered Optimizer's own update methods
-            # with traced t/lr — exact parity with the imperative Trainer.
-            save = (
+        return pure_step
+
+    def _traced_optimizer_apply(self, t, lr, rescale, param_arrs, opt_states,
+                                grads):
+        """Optimizer tail of every step build (unpipelined AND pipelined):
+        reuse the registered Optimizer's own update methods with traced
+        t/lr — exact parity with the imperative Trainer.  ``grads`` aligns
+        with ``self._trainable_idx``."""
+        opt = self._optimizer
+        save = (
+            opt._index_update_count,
+            opt.num_update,
+            opt.lr,
+            opt.lr_scheduler,
+            opt.rescale_grad,
+        )
+        opt._index_update_count = _EveryKey(t)
+        opt.num_update = t
+        opt.lr = lr
+        opt.lr_scheduler = None
+        opt.rescale_grad = rescale
+        # shadow the bookkeeping method: count is the traced t
+        opt._update_count = lambda idx: None
+        try:
+            new_full = list(param_arrs)
+            new_states = []
+            for slot, j in enumerate(self._trainable_idx):
+                w = NDArray(param_arrs[j])
+                g = NDArray(grads[slot])
+                st = _state_to_ndarrays(opt_states[slot])
+                opt.update_multi_precision(j, w, g, st)
+                new_full[j] = w._data
+                new_states.append(_state_to_arrays(st))
+        finally:
+            (
                 opt._index_update_count,
                 opt.num_update,
                 opt.lr,
                 opt.lr_scheduler,
                 opt.rescale_grad,
-            )
-            opt._index_update_count = _EveryKey(t)
-            opt.num_update = t
-            opt.lr = lr
-            opt.lr_scheduler = None
-            opt.rescale_grad = rescale
-            # shadow the bookkeeping method: count is the traced t
-            opt._update_count = lambda idx: None
-            try:
-                new_full = list(param_arrs)
-                new_states = []
-                for slot, j in enumerate(trainable_idx):
-                    w = NDArray(param_arrs[j])
-                    g = NDArray(grads[slot])
-                    st = _state_to_ndarrays(opt_states[slot])
-                    opt.update_multi_precision(j, w, g, st)
-                    new_full[j] = w._data
-                    new_states.append(_state_to_arrays(st))
-            finally:
-                (
-                    opt._index_update_count,
-                    opt.num_update,
-                    opt.lr,
-                    opt.lr_scheduler,
-                    opt.rescale_grad,
-                ) = save
-                del opt._update_count  # restore the class method
-            # aux side effects (BatchNorm running stats) overwrite their
-            # frozen params.
-            for k, v in zip(aux_idx_cell[0] if aux_idx_cell else [], aux_vals):
-                new_full[k] = v.astype(new_full[k].dtype)
-            return new_full, new_states, loss_mean
+            ) = save
+            del opt._update_count  # restore the class method
+        return new_full, new_states
+
+    # ------------------------------------------------------------------
+    def _build_pure_pipeline(self, example_arrays):
+        """The pipelined twin of ``_build_pure``: the forward/backward is
+        driven by the microbatch scheduler (``parallel/schedule.py``) —
+        explicit F/B slots per the configured schedule, activation stashes
+        handed between them, per-stage remat — followed by the SAME traced
+        optimizer tail.  Still one pure function; ``_jit_wrapped`` turns
+        it into one donated-buffer program, so the dp-axis gradient psum
+        XLA derives from the shardings is free to overlap the remaining
+        backward slots inside that single program."""
+        stages = self._stages
+        loss_fn = self._loss_fn
+        params = self._params
+        trainable_idx = self._trainable_idx
+        stage_idx = self._stage_param_idx
+        stage_objs = self._stage_param_objs
+        P = len(stages)
+        M = self._pipe_micro
+        kind = self._pipe_schedule
+        remat = self._pipe_remat
+        sched_mod = self._sched_mod
+        n_inputs = len(example_arrays) - 1
+        aux_maps = [None] * P   # per stage: global param idx per aux slot
+        from ..gluon.model_zoo import moe as moe_mod
+
+        def pure_step(key, t, lr, rescale, param_arrs, opt_states, *batch):
+            inputs = tuple(batch[:n_inputs])
+            label = batch[n_inputs]
+
+            def make_stage(s):
+                block = stages[s]
+                objs = stage_objs[s]
+
+                def fn(st_arrs, h):
+                    saved = []
+                    for p, a in zip(objs, st_arrs):
+                        saved.append(getattr(p, "_traced_data", None))
+                        p._traced_data = NDArray(a)
+                    # per-(stage, microbatch) PRNG: folding the stage alone
+                    # would hand every microbatch the same dropout masks;
+                    # the scheduler pins the slot around remat recomputes
+                    # too, so the backward re-trace folds identically
+                    slot = sched_mod.current_slot()
+                    m_idx = 0 if slot is None else slot[1]
+                    push_traced_key(jax.random.fold_in(
+                        jax.random.fold_in(key, s), m_idx))
+                    collector = []
+                    _aux_stack().append(collector)
+                    prev = getattr(_block_tls, "tracing", 0)
+                    _block_tls.tracing = prev + 1
+                    try:
+                        with autograd._scope(False, True):
+                            with moe_mod.moe_loss_frame() as fr:
+                                ins = h if isinstance(h, tuple) else (h,)
+                                out = block(*[NDArray(b) for b in ins])
+                    finally:
+                        _block_tls.tracing = prev
+                        _aux_stack().pop()
+                        pop_traced_key()
+                        for p, sv in zip(objs, saved):
+                            p._traced_data = sv
+                    side = moe_mod.frame_loss(fr)
+                    if side is None:
+                        side = jnp.zeros(())
+                    else:
+                        if isinstance(side, NDArray):
+                            side = side._data
+                        # per-microbatch aux losses average over M: the
+                        # load-balance/z regularizers are mean-style — the
+                        # batch split must not scale them
+                        side = side.astype(jnp.float32) / M
+                    moem = moe_mod.frame_metrics(fr)
+                    moe_t = () if moem is None else (
+                        moem["tokens_dropped"], moem["expert_load_min"],
+                        moem["expert_load_max"])
+                    if aux_maps[s] is None:
+                        idx_map = {id(p): i for i, p in enumerate(params)}
+                        aux_maps[s] = [idx_map[id(p)] for p, _ in collector]
+                    aux_vals = tuple(
+                        v._data if isinstance(v, NDArray) else v
+                        for _, v in collector)
+                    if isinstance(out, (list, tuple)):
+                        h_out = tuple(o._data for o in out)
+                    else:
+                        h_out = out._data
+                    return h_out, side, (aux_vals, moe_t)
+
+                return fn
+
+            loss_elems = [None]   # per-microbatch loss element count
+                                  # (static: same shape every microbatch)
+
+            def loss_slot(h, lab):
+                # last-stage loss: same ceremony, no stage params
+                slot = sched_mod.current_slot()
+                m_idx = 0 if slot is None else slot[1]
+                push_traced_key(jax.random.fold_in(
+                    jax.random.fold_in(key, P), m_idx))
+                prev = getattr(_block_tls, "tracing", 0)
+                _block_tls.tracing = prev + 1
+                try:
+                    with autograd._scope(False, True):
+                        if isinstance(h, tuple):
+                            out = [NDArray(o) for o in h]
+                        else:
+                            out = NDArray(h)
+                        loss = loss_fn(out, NDArray(lab))
+                finally:
+                    _block_tls.tracing = prev
+                    pop_traced_key()
+                loss_data = loss._data.astype(jnp.float32)
+                loss_elems[0] = int(loss_data.size)
+                return jnp.sum(loss_data)
+
+            task_sum, _side_sum, grads, metrics = sched_mod.pipeline_value_and_grad(
+                [make_stage(s) for s in range(P)], loss_slot,
+                [[param_arrs[j] for j in stage_idx[s]] for s in range(P)],
+                inputs, label, M, schedule=kind, remat=remat,
+                stage_outputs="rich")
+
+            full_grads = [None] * len(params)
+            for s in range(P):
+                for j, g in zip(stage_idx[s], grads[s]):
+                    full_grads[j] = g
+            grads_list = [
+                full_grads[j] if full_grads[j] is not None
+                else jnp.zeros_like(param_arrs[j])
+                for j in trainable_idx
+            ]
+            new_full, new_states = self._traced_optimizer_apply(
+                t, lr, rescale, param_arrs, opt_states, grads_list)
+
+            # BatchNorm-style aux: average each stage's collected values
+            # over its microbatches, then overwrite the frozen params
+            for s in range(P):
+                if not aux_maps[s]:
+                    continue
+                per_mb = [m[0] for m in metrics[s]]   # aux_vals tuples
+                for slot, j in enumerate(aux_maps[s]):
+                    mean = sum(vals[slot] for vals in per_mb) / M
+                    new_full[j] = mean.astype(new_full[j].dtype)
+
+            # MoE routing metrics: drops sum over (stage, microbatch),
+            # loads min/max across them
+            dropped = None
+            lmin = None
+            lmax = None
+            for s in range(P):
+                for m in metrics[s]:
+                    if not m[1]:
+                        continue
+                    d, mn, mx = m[1]
+                    dropped = d if dropped is None else dropped + d
+                    lmin = mn if lmin is None else jnp.minimum(lmin, mn)
+                    lmax = mx if lmax is None else jnp.maximum(lmax, mx)
+            extras = {} if dropped is None else {
+                "moe_tokens_dropped": dropped,
+                "moe_expert_load_min": lmin,
+                "moe_expert_load_max": lmax,
+            }
+            # mean over every loss ELEMENT (not per sample): exact parity
+            # with the unpipelined jnp.mean for vector/matrix losses
+            loss_mean = task_sum / (loss_elems[0] * M)
+            return new_full, new_states, loss_mean, extras
 
         return pure_step
 

@@ -227,6 +227,10 @@ _counters = {
     "generation_token": 0,            # tokens emitted by decode steps
     "generation_cancelled": 0,        # requests cancelled mid-stream
     "generation_slo_violation": 0,    # completions past their tenant's SLO
+    "pipeline_step": 0,               # scheduled pipeline steps dispatched
+    "pipeline_microbatch": 0,         # microbatches retired by those steps
+    "pipeline_bubble_ms": 0,          # modeled schedule bubble ms (rounded per step)
+    "moe_tokens_dropped": 0,          # token-choice slots dropped at expert capacity
     "compile_total": 0,               # jit compilations across every site
     "compile_ms_total": 0,            # wall ms those compilations cost
     "recompile_steady_state": 0,      # compiles after the guard armed
@@ -719,6 +723,25 @@ def percentile(xs, q):
     return xs[min(len(xs) - 1, int(q * len(xs)))]
 
 
+_slow_step_annotators = {}   # key -> fn(step_stats_dict) -> str | None
+
+
+def register_slow_step_annotator(key, fn):
+    """Attach a subsystem attribution line to the slow-step detector:
+    when a step trips the threshold, every registered annotator is called
+    with that step's stats dict and a truthy return is logged as ONE
+    extra line (``slow step N <key>: <line>``).  The pipeline tier uses
+    this to name the straggling stage the way ``straggler_report`` names
+    the straggling rank.  Re-registering a key replaces the annotator."""
+    with _counter_lock:
+        _slow_step_annotators[str(key)] = fn
+
+
+def unregister_slow_step_annotator(key):
+    with _counter_lock:
+        _slow_step_annotators.pop(str(key), None)
+
+
 def step_boundary():
     """Close the current telemetry step (called by ``gluon.Trainer.step``
     and ``SPMDTrainer.step``; safe to call directly from custom loops).
@@ -785,6 +808,19 @@ def step_boundary():
             "slow step %d: %.1f ms (host-dispatch %.1f ms, comms %.1f ms, "
             "device/other %.1f ms) [%s]",
             sid, wall_ms, host_ms, comms_ms, device_ms, why)
+        # subsystem attribution: registered annotators (the pipeline tier
+        # names its busiest stage the way straggler_report names the
+        # slowest rank) — EXACTLY one extra line per annotator per
+        # anomalous step, and a broken annotator never takes training down
+        with _counter_lock:
+            annots = list(_slow_step_annotators.items())
+        for key, fn in annots:
+            try:
+                line = fn(dict(stats))
+            except Exception:
+                line = None
+            if line:
+                _logger.warning("slow step %d %s: %s", sid, key, line)
         # cross-rank attribution: when peers' metrics snapshots are in the
         # registry (heartbeat piggyback / scrape aggregation), name the
         # slowest rank — EXACTLY one line per anomalous step, guarded by
@@ -821,6 +857,22 @@ def register_metrics_provider(key, fn):
     free.  Re-registering a key replaces the previous provider."""
     with _counter_lock:
         _metrics_providers[str(key)] = fn
+
+
+def register_metrics_provider_unique(base, fn):
+    """Register ``fn`` under ``base``, or ``base2``/``base3``/... if the
+    name is taken — probe and insert under ONE lock acquisition, so two
+    subsystems registering concurrently cannot race the probe and
+    silently replace each other (plain ``register_metrics_provider``
+    overwrites on collision by design).  Returns the chosen name, which
+    the caller passes to ``unregister_metrics_provider`` later."""
+    base = str(base)
+    with _counter_lock:
+        name, n = base, 2
+        while name in _metrics_providers:
+            name, n = f"{base}{n}", n + 1
+        _metrics_providers[name] = fn
+    return name
 
 
 def unregister_metrics_provider(key):

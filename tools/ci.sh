@@ -36,7 +36,11 @@
 #                  --smoke mode (exits non-zero if the async infeed's
 #                  consumer stalled after warmup — the host-starvation
 #                  regression guard) plus the fast pipeline tests
-#  10. tpu       — (opt-in: CI_TPU=1) on-chip correctness tier, needs a chip
+#  10. parallel  — pipeline/expert-parallel tier: the schedule harness in
+#                  --smoke mode (exits non-zero on post-warmup recompiles
+#                  in a scheduled step or a bubble-acceptance failure)
+#                  plus the fast schedule + MoE + SPMD-parallel tests
+#  11. tpu       — (opt-in: CI_TPU=1) on-chip correctness tier, needs a chip
 #
 # The unit tier is split in two so each invocation fits a ~10 min shell on
 # a 1-core box (the full suite exceeds one 600 s window there); `unit` is
@@ -77,7 +81,7 @@ TIERS=()
 for t in "$@"; do
     if [ "$t" = unit ]; then TIERS+=(unit1 unit2); else TIERS+=("$t"); fi
 done
-[ ${#TIERS[@]} -eq 0 ] && TIERS=(unit1 unit2 zoo dist examples bench profiler chaos serving io)
+[ ${#TIERS[@]} -eq 0 ] && TIERS=(unit1 unit2 zoo dist examples bench profiler chaos serving io parallel)
 [ "${CI_TPU:-0}" = "1" ] && TIERS+=(tpu)
 
 declare -A RESULT
@@ -189,6 +193,18 @@ for tier in "${TIERS[@]}"; do
                 set -e
                 python benchmark/opperf/input_pipeline.py --smoke >/dev/null
                 python -m pytest tests/test_io_pipeline.py -q -m "not slow" '"${CI_PYTEST_ARGS:-}"
+            ;;
+        parallel)
+            # pipeline/expert-parallel tier: the opperf harness in
+            # --smoke mode IS the regression guard (non-zero exit on any
+            # post-warmup recompile in a scheduled step, or if 1F1B's
+            # measured bubble stops beating GPipe's / leaves 1.5x of the
+            # analytic (P-1)/(M+P-1) bound), then the fast schedule +
+            # MoE + SPMD-parallel tests
+            run_tier parallel "${CPU_ENV[@]}" bash -c '
+                set -e
+                python benchmark/opperf/pipeline.py --smoke >/dev/null
+                python -m pytest tests/test_pipeline_moe.py tests/test_parallel.py -q -m "not slow" '"${CI_PYTEST_ARGS:-}"
             ;;
         tpu)
             # on-chip tier: runs under the ambient axon env (NOT cpu-cleaned)
