@@ -1,0 +1,34 @@
+"""Gradient-compression subsystem (docs/gradient_compression.md).
+
+Codecs are first-class objects shared by BOTH cross-host gradient paths:
+``kvstore.bucketed_pushpull``'s flat buckets (gluon Trainer against a
+dist store) and SPMDTrainer's in-program dp-axis gradient reduction.
+One policy surface (``MXNET_GRAD_COMPRESS=off|bf16|int8``) drives both.
+"""
+from .compression import (
+    Bf16Codec,
+    CompressionPolicy,
+    ErrorFeedback,
+    Int8BlockCodec,
+    account,
+    bucket_allreduce,
+    codec_from_id,
+    codec_from_params,
+    decode_np,
+    resolve_policy,
+    traced_allreduce,
+)
+
+__all__ = [
+    "Bf16Codec",
+    "CompressionPolicy",
+    "ErrorFeedback",
+    "Int8BlockCodec",
+    "account",
+    "bucket_allreduce",
+    "codec_from_id",
+    "codec_from_params",
+    "decode_np",
+    "resolve_policy",
+    "traced_allreduce",
+]

@@ -63,6 +63,8 @@ class Trainer:
         self._states = {}
         self._last_scale_set = None   # last rescale_grad THIS trainer wrote
         self._grad_versions = {}      # index -> grad buffer version at last update
+        self._grad_feedback = None    # comm.ErrorFeedback when compression
+                                      # with error feedback is active
         # device-memory ledger accounting (docs/observability.md#device-
         # memory-observability): indices whose weight+grad+state bytes
         # have been reported, and the totals to release on close() — or
@@ -175,8 +177,18 @@ class Trainer:
                  and p._data._grad is not None]
         if (len(pairs) > 1 and kv_mod.bucket_bytes() > 0
                 and self._kvstore.supports_grad_bucketing()):
+            from .. import comm
+
+            policy = comm.resolve_policy()   # MXNET_GRAD_COMPRESS tier
+            feedback = None
+            if policy is not None and policy.error_feedback:
+                if self._grad_feedback is None:
+                    self._grad_feedback = comm.ErrorFeedback()
+                feedback = self._grad_feedback
             kv_mod.bucketed_pushpull(self._kvstore,
-                                     [(i, p.grad()) for i, p in pairs])
+                                     [(i, p.grad()) for i, p in pairs],
+                                     names=[p.name for _, p in pairs],
+                                     compression=policy, feedback=feedback)
             return
         for i, p in pairs:
             self._kvstore.pushpull(i, p.grad(), out=p.grad())
@@ -293,11 +305,17 @@ class Trainer:
         flat = {}
         for i, st in self._states.items():
             flat[i] = _states_to_numpy(st)
-        atomic_write_bytes(fname, pickle.dumps({
+        payload = {
             "states": flat,
             "num_update": self._optimizer.num_update,
             "update_counts": dict(self._optimizer._index_update_count),
-        }))
+        }
+        if self._grad_feedback is not None and len(self._grad_feedback):
+            # gradient-compression residuals are optimizer-adjacent state:
+            # dropping them at restore re-injects one step's quantization
+            # error, so they ride the same snapshot
+            payload["grad_feedback"] = self._grad_feedback.state_dict()
+        atomic_write_bytes(fname, pickle.dumps(payload))
 
     def load_states(self, fname):
         import pickle
@@ -318,6 +336,20 @@ class Trainer:
         self._optimizer._index_update_count = dict(counts)
         self._optimizer.num_update = num_update
         self._optimizer.begin_num_update = num_update
+        fb = payload.get("grad_feedback")
+        if fb:
+            from .. import comm
+
+            if self._grad_feedback is None:
+                self._grad_feedback = comm.ErrorFeedback()
+            self._grad_feedback.load_state_dict(fb)
+        elif self._grad_feedback is not None:
+            # the snapshot carries NO residuals (saved before any
+            # compressed step, or by an uncompressed run): keeping this
+            # trainer's live ones would compensate the restored step with
+            # errors from a different trajectory — restores must be
+            # deterministic, so start fresh like the snapshot did
+            self._grad_feedback.load_state_dict({})
 
 
 # shape-x-dtype footprint (never resolves a pending deferred buffer) —

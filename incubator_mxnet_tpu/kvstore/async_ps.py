@@ -476,6 +476,17 @@ class ParameterServer:
             _, key, codes, threshold, rank = msg
             decoded = np.asarray(codes, np.float32) * float(threshold)
             return self.dispatch(("push", key, decoded, rank))
+        if kind == "push_enc":
+            # codec-tier wire envelope (comm/compression.py): codec id +
+            # payload arrays (int8 codes with fp32 block scales, or bf16).
+            # The server accumulates DECODED fp32 — mixed compressed and
+            # exact keys therefore combine exactly, and the stored value
+            # never depends on which codec each worker pushed under.
+            _, key, codec_id, payload, n, shape, rank = msg
+            from ..comm.compression import decode_np
+
+            decoded = decode_np(codec_id, payload, int(n)).reshape(shape)
+            return self.dispatch(("push", key, decoded, rank))
         if kind == "pull":
             _, key = msg
             with self._lock:

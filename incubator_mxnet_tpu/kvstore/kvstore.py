@@ -34,6 +34,12 @@ collapses onto XLA collectives:
   (jnp.sum's integer promotion), so code sums are exact at ANY worker
   count; int8 is the per-worker buffer/staging format (4× smaller than
   fp32 gradients), and the collective itself moves the promoted values.
+  Since ISSUE 14 the codec tier (``comm/``: bf16 truncation, block-wise
+  int8 with per-block scales) rides the same worker-side-compress
+  contract: ``set_gradient_compression({"type": "int8"|"bf16"})`` for
+  per-key pushes, and the ``MXNET_GRAD_COMPRESS`` policy for
+  ``bucketed_pushpull``'s flat buckets (codec id namespacing the bucket
+  keys beside the membership epoch) — docs/gradient_compression.md.
 """
 from __future__ import annotations
 
@@ -125,34 +131,65 @@ def _flatten(raws):
     return out
 
 
-def bucketed_pushpull(kv, items, cap_bytes=None):
+def bucketed_pushpull(kv, items, cap_bytes=None, names=None,
+                      compression=None, feedback=None):
     """Allreduce ``items`` (list of ``(key, grad_nd)``) through ``kv`` as
     size-capped flattened buckets, writing the reduced values back into each
     grad buffer in place.  Bucket assignment is deterministic (input order,
-    split per dtype), so bucket keys — and any compression residual state a
-    store hangs off them — are stable across steps."""
+    split per dtype and per codec), so bucket keys — and any compression
+    residual state hung off them — are stable across steps.
+
+    Gradient compression (docs/gradient_compression.md): ``compression``
+    resolves through ``comm.resolve_policy`` (None → the
+    ``MXNET_GRAD_COMPRESS`` env tier).  Under an active policy, fp32
+    grads whose parameter ``names`` entry is not opted out travel as
+    encoded payloads — codec id + scales in the wire envelope, bucket
+    keys namespaced by codec id beside the membership epoch — while
+    opted-out groups keep their own fp32 buckets and stay bit-exact.
+    ``feedback`` (a ``comm.ErrorFeedback``) carries per-bucket residuals
+    across steps when the policy enables error feedback."""
     import numpy as np
 
     from ..engine import DeferredArray
+    from ..comm import compression as _comp
 
     cap = bucket_bytes() if cap_bytes is None else cap_bytes
+    policy = _comp.resolve_policy(compression)
     # membership epoch namespaces the bucket keys: any store-side state a
     # backend hangs off a bucket key (e.g. a compression residual) must NOT
     # survive a change in the contributing worker set — stale error
-    # feedback from a departed worker would be re-injected forever
+    # feedback from a departed worker would be re-injected forever.  The
+    # codec id rides the key the same way (satellite of ISSUE 14): a worker
+    # toggling compression mid-run renames its buckets, and the dist
+    # store's wire-agreement check turns that into a loud error instead of
+    # peers decoding each other's garbage.
     epoch = kv.membership_epoch() if hasattr(kv, "membership_epoch") else 0
-    by_group = {}
-    for key, g in items:
+    by_group = {}   # (dtype, ctx, codec_id) -> [(key, grad, raw)]
+    codecs = {"fp32": None}
+    for i, (key, g) in enumerate(items):
         raw = g._data
         if isinstance(raw, DeferredArray):  # pending bulk op: flush first
             raw = raw._resolve()
             g._data = raw
-        # group by (dtype, context): a flat bucket lives on ONE device, and
-        # the scattered pieces are written back without a placement probe
-        by_group.setdefault((str(raw.dtype), str(g.context)),
+        codec = None
+        if policy is not None and str(raw.dtype) == "float32":
+            codec = policy.codec_for(names[i] if names is not None else None)
+        cid = codec.id if codec is not None else "fp32"
+        codecs.setdefault(cid, codec)
+        # group by (dtype, context, codec): a flat bucket lives on ONE
+        # device under ONE wire format, and the scattered pieces are
+        # written back without a placement probe
+        by_group.setdefault((str(raw.dtype), str(g.context), cid),
                             []).append((key, g, raw))
+    use_ef = (feedback is not None and policy is not None
+              and policy.error_feedback)
+    if use_ef:
+        # drop residuals from other epochs/codecs — they describe a wire
+        # format that no longer exists
+        feedback.retain(f"__grad_bucket__:{epoch}:{policy.id}:")
     bucket_id = 0
-    for (dt, _ctx), members in by_group.items():
+    for (dt, _ctx, cid), members in by_group.items():
+        codec = codecs[cid]
         itemsize = np.dtype(dt).itemsize
         start = 0
         while start < len(members):
@@ -168,22 +205,44 @@ def bucketed_pushpull(kv, items, cap_bytes=None):
             t0 = _perf() if _profiler._active else None
             grads = [g for _, g, _ in chunk]
             raws = [r for _, _, r in chunk]
-            flat = NDArray(_flatten(raws), ctx=grads[0].context)
-            kv.pushpull(f"__grad_bucket__:{epoch}:{dt}:{bucket_id}", flat,
-                        out=flat)
+            bkey = f"__grad_bucket__:{epoch}:{cid}:{dt}:{bucket_id}"
             bucket_id += 1
-            pieces = _unflatten(flat._data, [r.shape for r in raws])
+            # EVERY bucket enters the agreement check, fp32 ones included:
+            # the asymmetric toggle (one worker compressed, a peer off) is
+            # exactly the case where the off worker would otherwise issue
+            # a plain fp32 pushpull against the peer's scale/code
+            # collectives and deadlock instead of failing loudly
+            if hasattr(kv, "check_wire_agreement"):
+                kv.check_wire_agreement(bkey)
+            if codec is None:
+                flat = NDArray(_flatten(raws), ctx=grads[0].context)
+                kv.pushpull(bkey, flat, out=flat)
+                reduced, wire_bytes, codec_s = flat._data, nbytes, 0.0
+            else:
+                flat = _flatten(raws)
+                if use_ef:
+                    flat = feedback.compensate(bkey, flat)
+                reduced, resid, wire_bytes, codec_s = _comp.bucket_allreduce(
+                    codec, flat, kv.wire_allreduce)
+                if use_ef:
+                    feedback.update(bkey, resid)
+            pieces = _unflatten(reduced, [r.shape for r in raws])
             for g, piece in zip(grads, pieces):
                 g._data = piece
                 g._version += 1
             _profiler.incr("allreduce_bucket")
             _profiler.incr("allreduce_bucket_params", len(chunk))
+            _comp.account(nbytes, wire_bytes, codec_s)
             if t0 is not None:
                 # the nested kvstore.pushpull span carries the wire time;
-                # this one adds flatten/scatter overhead + bucket shape
+                # this one adds flatten/codec/scatter overhead + the raw
+                # vs encoded payload sizes (tools/trace_report.py comms)
                 _profiler.record_span("kvstore.bucketed_pushpull", "comms",
                                       t0, args={"params": len(chunk),
-                                                "bytes": nbytes})
+                                                "bytes": nbytes,
+                                                "bytes_raw": nbytes,
+                                                "bytes_wire": wire_bytes,
+                                                "codec": cid})
 
 
 def create(name="local"):
@@ -344,6 +403,12 @@ class KVStore:
         Single-process base: identity.  Returns an int array."""
         return codes
 
+    def wire_allreduce(self, arr, op="sum"):
+        """Cross-worker reduce of a raw (possibly encoded) array — the
+        transport compressed payloads ride (``comm.bucket_allreduce``).
+        Single-process base: identity."""
+        return arr
+
     def _quantize_2bit(self, key, grad):
         """Worker-side 2-bit quantization with error-feedback residual
         (parity: [U:src/kvstore/gradient_compression.cc]); returns the int8
@@ -365,13 +430,36 @@ class KVStore:
         return codes, threshold
 
     def _compressed_reduce(self, key, grad):
-        """2-bit gradient compression with error-feedback residual, applied
-        worker-side BEFORE the cross-worker reduction (parity:
-        [U:src/kvstore/kvstore_dist.cc] compresses, then ZPushes).  The wire
-        carries int8 sign codes; the aggregate is ``sum(codes) · t``."""
-        codes, threshold = self._quantize_2bit(key, grad)
-        wire = self._reduce_codes(codes)
-        return NDArray(wire.astype(grad._data.dtype) * threshold,
+        """Gradient compression applied worker-side BEFORE the cross-worker
+        reduction (parity: [U:src/kvstore/kvstore_dist.cc] compresses, then
+        ZPushes).  '2bit' (the reference scheme): int8 sign codes, aggregate
+        ``sum(codes) · t``.  'bf16'/'int8' (the comm/ codec tier): jitted
+        block-wise encode with per-key error feedback, reduced over
+        ``wire_allreduce`` — scales max-reduce first so the integer code
+        sum is exact at any worker count."""
+        ctype = self._compression.get("type", "2bit")
+        if ctype == "2bit":
+            codes, threshold = self._quantize_2bit(key, grad)
+            wire = self._reduce_codes(codes)
+            return NDArray(wire.astype(grad._data.dtype) * threshold,
+                           ctx=grad.context)
+        from ..comm import compression as _comp
+
+        codec = _comp.codec_from_params(self._compression)
+        flat = grad._data.reshape(-1)
+        use_ef = bool(self._compression.get(
+            "error_feedback", codec.error_feedback_default))
+        res_key = ("__residual__", key)
+        residual = self._store.get(res_key) if use_ef else None
+        reduced, resid, wire, codec_s = _comp.bucket_allreduce(
+            codec, flat, self.wire_allreduce,
+            residual=residual._data if residual is not None else None)
+        if use_ef:
+            self._store[res_key] = NDArray(resid, ctx=grad.context)
+        self._last_wire_dtype = ("bfloat16" if isinstance(codec, _comp.Bf16Codec)
+                                 else "int8")
+        _comp.account(int(flat.nbytes), wire, codec_s)
+        return NDArray(reduced.reshape(grad.shape).astype(grad._data.dtype),
                        ctx=grad.context)
 
     # -- optimizer plumbing ---------------------------------------------
@@ -429,7 +517,7 @@ class KVStoreDist(KVStore):
         super().__init__(name)
         self._initialized_dist = False
         self._mesh_cache = None
-        self._reduce_fn_cache = None
+        self._reduce_fn_cache = {}    # op -> jitted stacked reducer
         self._ensure_dist()
 
     def supports_grad_bucketing(self):
@@ -486,20 +574,24 @@ class KVStoreDist(KVStore):
             self._mesh_cache = Mesh(_np.array(devs), ("w",))
         return self._mesh_cache
 
-    def _allreduce(self, arr):
-        """Sum ``arr`` (host or device value, identical shape on every
-        worker) across processes with an on-device psum — no O(workers)
-        host-side gather, and no D2H round-trip for device-resident
-        gradients.  The jitted reducer is built once; jit's own
-        shape-keyed cache handles per-key shapes."""
+    def _allreduce(self, arr, op="sum"):
+        """Reduce ``arr`` (host or device value, identical shape on every
+        worker) across processes with an on-device collective — no
+        O(workers) host-side gather, and no D2H round-trip for
+        device-resident gradients.  One jitted reducer per ``op``
+        ('sum'/'max'/'min'); jit's own shape-keyed cache handles per-key
+        shapes.  Integer sums promote (int8 codes accumulate in int32),
+        so quantization-code sums are exact at any worker count."""
         import jax
         import jax.numpy as jnp
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         mesh = self._worker_mesh()
-        if self._reduce_fn_cache is None:
-            self._reduce_fn_cache = jax.jit(
-                lambda x: jnp.sum(x, axis=0),
+        fn = self._reduce_fn_cache.get(op)
+        if fn is None:
+            red = {"sum": jnp.sum, "max": jnp.max, "min": jnp.min}[op]
+            fn = self._reduce_fn_cache[op] = jax.jit(
+                lambda x, _red=red: _red(x, axis=0),
                 out_shardings=NamedSharding(mesh, P()),
             )
         my_dev = mesh.devices.flat[
@@ -509,8 +601,48 @@ class KVStoreDist(KVStore):
         local = jax.device_put(jnp.expand_dims(jnp.asarray(arr), 0), my_dev)
         garr = jax.make_array_from_single_device_arrays(
             (jax.process_count(),) + tuple(local.shape[1:]), sharding, [local])
-        out = self._reduce_fn_cache(garr)
+        out = fn(garr)
         return out.addressable_data(0)
+
+    def wire_allreduce(self, arr, op="sum"):
+        import jax
+
+        if jax.process_count() == 1:
+            return arr
+        return self._allreduce(arr, op)
+
+    def check_wire_agreement(self, key):
+        """Fail LOUDLY if any peer formats this bucket differently.  The
+        bucket key bakes in membership epoch, codec id, and dtype, so
+        one cheap hash-allreduce catches a worker toggling
+        ``MXNET_GRAD_COMPRESS`` (or its block size) mid-run — the
+        alternative is feeding int8 codes into peers' fp32 sum and
+        silently decoding garbage.  ``bucketed_pushpull`` runs this for
+        EVERY bucket, uncompressed fp32 ones too, on every step (no
+        per-key cache: a cached verdict would let the NON-toggling peer
+        skip the check and issue its full-bucket collective against the
+        toggler's hash check — exactly the mismatched-program hang this
+        exists to prevent); the check is therefore the first collective
+        each worker issues per bucket and an asymmetric toggle raises
+        on both sides.  Cost: one (2,)-int32 allreduce per bucket,
+        noise next to the payload collective it fronts."""
+        import jax
+
+        if jax.process_count() == 1:
+            return
+        import zlib
+
+        h = zlib.crc32(key.encode()) & 0x3FFFFFFF
+        # one collective: max over (h, -h) yields (max_h, -min_h)
+        pair = self._allreduce(_np.asarray([h, -h], _np.int32), "max")
+        hi, neg_lo = (int(x) for x in _np.asarray(pair))
+        if hi != h or -neg_lo != h:
+            raise RuntimeError(
+                f"gradient-bucket wire-format mismatch: this worker "
+                f"formats {key!r} but a peer disagrees — compression "
+                "codec, block size, or membership epoch toggled mid-run? "
+                "All workers must run the same MXNET_GRAD_COMPRESS "
+                "configuration.")
 
     def _reduce_across_workers(self, value):
         import jax
@@ -644,7 +776,10 @@ class KVStoreDistAsync(KVStore):
             return
         t0 = _perf() if _profiler._active else None
         agg = self._aggregate(value)
-        if self._compression is not None:
+        if self._compression is None:
+            self._client.request("push", key, _np.asarray(agg.asnumpy()),
+                                 self._rank)
+        elif self._compression.get("type", "2bit") == "2bit":
             # the int8 CODES cross the TCP wire (the whole point of
             # gradient compression is what crosses the process boundary);
             # the server decodes as codes · threshold before applying
@@ -652,10 +787,38 @@ class KVStoreDistAsync(KVStore):
             self._client.request("push_codes", key, _np.asarray(codes),
                                  threshold, self._rank)
         else:
-            self._client.request("push", key, _np.asarray(agg.asnumpy()),
-                                 self._rank)
+            self._push_encoded(key, agg)
         if t0 is not None:
             _profiler.record_span("kvstore.push", "comms", t0)
+
+    def _push_encoded(self, key, agg):
+        """Codec-tier push (comm/): jitted encode with per-key error
+        feedback worker-side, codec id + scales in the wire envelope; the
+        server accumulates decoded fp32."""
+        from ..comm import compression as _comp
+
+        codec = _comp.codec_from_params(self._compression)
+        t0 = _perf()
+        flat = agg._data.reshape(-1)
+        use_ef = bool(self._compression.get(
+            "error_feedback", codec.error_feedback_default))
+        res_key = ("__residual__", key)
+        if use_ef:
+            residual = self._store.get(res_key)
+            if residual is not None:
+                # same jitted add the bucket path compensates with
+                flat = _comp._add_fn()(flat, residual._data)
+        payload, resid = codec.encode(flat)
+        if use_ef:
+            self._store[res_key] = NDArray(resid, ctx=agg.context)
+        np_payload = {k: _np.asarray(v) for k, v in payload.items()}
+        codec_s = _perf() - t0
+        wire = sum(int(a.nbytes) for a in np_payload.values())
+        self._last_wire_dtype = str(
+            np_payload.get("codes", np_payload.get("enc")).dtype)
+        _comp.account(int(flat.nbytes), wire, codec_s)
+        self._client.request("push_enc", key, codec.id, np_payload,
+                             int(flat.size), list(agg.shape), self._rank)
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
         if isinstance(key, (list, tuple)):

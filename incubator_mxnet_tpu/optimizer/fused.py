@@ -25,6 +25,7 @@ preserving their kernels' lazy semantics.
 from __future__ import annotations
 
 import os as _os
+import re as _re
 from time import perf_counter as _perf
 
 from .. import engine as _engine
@@ -32,7 +33,27 @@ from .. import profiler as _profiler
 from ..ops import optimizer_ops as K
 from .optimizer import LAMB, NAG, RMSProp, SGD, Adam, AdamW, _swap
 
-__all__ = ["fused_update", "supports", "donation_enabled"]
+__all__ = ["fused_update", "supports", "donation_enabled",
+           "quantization_sensitive"]
+
+
+# Name-derived parameter grouping, part 2 (part 1 is the fused-step group
+# key below): the QUANTIZATION-SENSITIVE group the gradient-compression
+# policy (comm/compression.py) opts out of int8/bf16 wire formats.  Same
+# name conventions the reference's no-weight-decay grouping keys on
+# (``set_wd_mult``'s ``_gamma``/``_beta``/``_bias`` suffixes) plus
+# normalization state and embeddings: tensors with few, large-magnitude
+# gradient entries that a shared block scale would crush.
+_QUANT_SENSITIVE_RE = _re.compile(
+    r"(_gamma|_beta|_bias|_moving_mean|_moving_var|norm|embed)", _re.I)
+
+
+def quantization_sensitive(name):
+    """Whether a parameter (by name) belongs to a quantization-sensitive
+    group — the canonical per-parameter-group opt-out consulted by
+    ``comm.CompressionPolicy`` (override per run with
+    ``MXNET_GRAD_COMPRESS_SKIP=<regex>``)."""
+    return bool(_QUANT_SENSITIVE_RE.search(str(name)))
 
 
 def donation_enabled():

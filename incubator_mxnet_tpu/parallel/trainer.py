@@ -109,6 +109,12 @@ def _release_spmd_memory(param_bytes, state_bytes):
                            "optimizer_state").free(state_bytes)
 
 
+def _release_comm_memory(nbytes):
+    """weakref.finalize hook: a collected trainer's error-feedback
+    residual buffers leave the ledger."""
+    _profiler.track_memory("spmd.comm_residual", "comms").free(nbytes)
+
+
 class SPMDTrainer:
     """Compile a Gluon block + loss + optimizer into one sharded train step.
 
@@ -138,6 +144,19 @@ class SPMDTrainer:
         ``n_microbatches`` (required), ``schedule`` ("1f1b" default |
         "gpipe"), ``remat`` (bool or per-stage list; defaults True for
         gpipe — the GPipe paper's configuration — and False for 1f1b).
+    compression : str or comm.CompressionPolicy, optional
+        Gradient-compression tier for the dp-axis gradient exchange
+        (docs/gradient_compression.md): "bf16" or "int8" (or a full
+        policy).  Default: the ``MXNET_GRAD_COMPRESS`` env tier.  When
+        active (pure-dp runs only — pipelined/sharded/sp builds fall
+        back with a warning), the step's forward/backward runs per dp
+        shard inside one shard_map and the fp32 gradient psum XLA would
+        insert is replaced in-program by quantize → integer psum with
+        per-block scale max-reduction → dequantize; opted-out parameter
+        groups (norms/embeddings — ``optimizer.fused.
+        quantization_sensitive``) keep an exact fp32 psum.  Error
+        feedback residuals are donated step state, persisted through
+        ``save_states``/``load_states``.
     """
 
     def __init__(
@@ -152,6 +171,7 @@ class SPMDTrainer:
         donate: bool = True,
         stages=None,
         pipeline=None,
+        compression=None,
     ):
         self._block = block
         self._loss_fn = loss_fn
@@ -224,6 +244,7 @@ class SPMDTrainer:
         self._mem_finalizer = _weakref.finalize(
             self, _release_spmd_memory, pb, sb)
         self._setup_pipeline(stages, pipeline)
+        self._setup_compression(compression)
         from ..base import register_jit_cache_owner
         register_jit_cache_owner(self)
         if jax.process_count() > 1:
@@ -361,6 +382,86 @@ class SPMDTrainer:
             self, _release_pipeline_observers, name)
 
     # ------------------------------------------------------------------
+    def _setup_compression(self, compression):
+        """Resolve the gradient-compression policy and freeze the static
+        layout of the quantized dp-allreduce: which trainable slots
+        compress (concat offsets into ONE flat bucket) vs stay exact, the
+        shard count, the per-step raw/wire byte sizes, and — under error
+        feedback — the per-shard residual buffer (donated step state,
+        sharded over the batch axes)."""
+        import warnings as _warnings
+
+        from ..comm import compression as comp_mod
+
+        self._comm_cfg = None
+        self._comm_state = None
+        self._comm_sharding = None
+        self._comm_span_args = None
+        policy = comp_mod.resolve_policy(compression)
+        if policy is None:
+            return
+        mesh = self._mesh
+        shards = int(mesh.shape["dp"]) * int(mesh.shape["fsdp"])
+        reasons = []
+        if self._stages is not None:
+            reasons.append("pipelined stages")
+        if self._sp_axis is not None:
+            reasons.append("sequence parallelism (sp_axis)")
+        for ax in ("pp", "ep", "sp", "tp"):
+            if int(mesh.shape.get(ax, 1)) > 1:
+                reasons.append(f"mesh axis {ax!r} > 1")
+        if any(any(n is not None for n in s.spec)
+               for s in self._param_shardings):
+            reasons.append("sharded parameters (fsdp/tp rules)")
+        if reasons:
+            _warnings.warn(
+                "gradient compression requested but unsupported for this "
+                f"build ({', '.join(reasons)}); running uncompressed. The "
+                "quantized dp-allreduce needs a pure data-parallel step "
+                "(replicated parameters, no pipeline/sp).", UserWarning)
+            return
+        if shards <= 1:
+            return  # no shard boundary: nothing crosses a wire
+        comp_slots, exact_slots, spans = [], [], []
+        off = 0
+        for slot, j in enumerate(self._trainable_idx):
+            a = self._param_arrays[j]
+            codec = (policy.codec_for(self._params[j].name)
+                     if str(a.dtype) == "float32" else None)
+            if codec is None:
+                exact_slots.append(slot)
+            else:
+                spans.append((off, int(a.size), tuple(a.shape)))
+                off += int(a.size)
+                comp_slots.append(slot)
+        if not comp_slots:
+            return  # every group opted out: the plain build IS the exact one
+        codec = policy.codec
+        n_exact = sum(int(self._param_arrays[self._trainable_idx[s]].size)
+                      for s in exact_slots)
+        bytes_raw = 4 * (off + n_exact)
+        bytes_wire = int(codec.wire_nbytes(off)) + 4 * n_exact
+        self._comm_cfg = {
+            "policy": policy, "codec": codec, "ef": policy.error_feedback,
+            "comp_slots": comp_slots, "exact_slots": exact_slots,
+            "spans": spans, "n": off, "shards": shards,
+            "bytes_raw": int(bytes_raw), "bytes_wire": int(bytes_wire),
+        }
+        self._comm_span_args = {"bytes_raw": int(bytes_raw),
+                                "bytes_wire": int(bytes_wire),
+                                "codec": codec.id}
+        if policy.error_feedback:
+            import weakref as _weakref
+
+            self._comm_sharding = NamedSharding(mesh, P(("dp", "fsdp")))
+            self._comm_state = jax.device_put(
+                jnp.zeros((shards, off), jnp.float32), self._comm_sharding)
+            cb = int(self._comm_state.nbytes)
+            _profiler.track_memory("spmd.comm_residual", "comms").alloc(cb)
+            self._comm_mem_finalizer = _weakref.finalize(
+                self, _release_comm_memory, cb)
+
+    # ------------------------------------------------------------------
     def _sharding_like(self, arr, param_sh):
         spec = param_sh.spec
         fitted = []
@@ -436,6 +537,15 @@ class SPMDTrainer:
         story and are labeled as such in docs/pipeline_parallelism.md."""
         now = _perf()
         wall_ms = (now - tw) * 1e3
+        if self._comm_cfg is not None:
+            # static per-step payload sizes (the layout is frozen at
+            # build): raw = the fp32 bytes the dp exchange would have
+            # moved, wire = encoded payload (codes + scales + the exact
+            # opt-out groups' fp32)
+            from ..comm import compression as comp_mod
+
+            comp_mod.account(self._comm_cfg["bytes_raw"] * k,
+                             self._comm_cfg["bytes_wire"] * k)
         if self._stages is not None:
             sim = self._pipe_sim
             _profiler.incr("pipeline_step", k)
@@ -555,9 +665,11 @@ class SPMDTrainer:
         lr = self.learning_rate()
         rescale = self._optimizer.rescale_grad / batch_size
         key = get_key()
+        comm = self._comm_state is not None
         call_args = (key, jnp.float32(self._t), jnp.float32(lr),
                      jnp.float32(rescale), self._param_arrays,
-                     self._opt_states, *arrays)
+                     self._opt_states,
+                     *((self._comm_state,) if comm else ()), *arrays)
         lowered = None
         if fresh and _profiler.compile_cost_enabled():
             try:  # AOT lowering for XLA cost accounting (opt-in: the
@@ -569,7 +681,12 @@ class SPMDTrainer:
         t0 = tw if _profiler._active else None
         try:
             try:
-                new_params, new_states, loss, extras = fn(*call_args)
+                if comm:
+                    (new_params, new_states, new_comm,
+                     loss, extras) = fn(*call_args)
+                    self._comm_state = new_comm
+                else:
+                    new_params, new_states, loss, extras = fn(*call_args)
             except Exception as e:
                 # the fused step is THE training-tier OOM choke point:
                 # a RESOURCE_EXHAUSTED here gets one postmortem naming
@@ -583,7 +700,8 @@ class SPMDTrainer:
                     "spmd.step", self._compile_sig(arrays, "step"),
                     (_perf() - tc) * 1e3, lowered=lowered)
             if t0 is not None:
-                _profiler.record_span("spmd.step", "trainer", t0)
+                _profiler.record_span("spmd.step", "trainer", t0,
+                                      args=self._comm_span_args)
             self._record_step_obs(extras, tw)
         finally:
             _profiler.step_boundary()
@@ -625,9 +743,11 @@ class SPMDTrainer:
             lrs.append(self.learning_rate())
             keys.append(get_key())
         rescale = self._optimizer.rescale_grad / batch_size
+        comm = self._comm_state is not None
         call_args = (jnp.stack(keys), jnp.asarray(ts, jnp.float32),
                      jnp.asarray(lrs, jnp.float32), jnp.float32(rescale),
-                     self._param_arrays, self._opt_states, *arrays)
+                     self._param_arrays, self._opt_states,
+                     *((self._comm_state,) if comm else ()), *arrays)
         lowered = None
         if fresh and _profiler.compile_cost_enabled():
             try:
@@ -639,7 +759,12 @@ class SPMDTrainer:
         t0 = tw if _profiler._active else None
         try:
             try:
-                new_params, new_states, loss, extras = fn(*call_args)
+                if comm:
+                    (new_params, new_states, new_comm,
+                     loss, extras) = fn(*call_args)
+                    self._comm_state = new_comm
+                else:
+                    new_params, new_states, loss, extras = fn(*call_args)
             except Exception as e:
                 _profiler.maybe_oom_postmortem(e, "spmd.step_bulk")
                 raise
@@ -650,8 +775,18 @@ class SPMDTrainer:
                     "spmd.step", self._compile_sig(arrays, f"step_bulk[{k}]"),
                     (_perf() - tc) * 1e3, lowered=lowered)
             if t0 is not None:
+                args = {"k": int(k)}
+                if self._comm_span_args:
+                    # one span covers k scanned steps: scale the payload
+                    # args so the trace sums to the same bytes the
+                    # counters account (trace_report's comms table)
+                    args.update(self._comm_span_args,
+                                bytes_raw=(self._comm_span_args["bytes_raw"]
+                                           * int(k)),
+                                bytes_wire=(self._comm_span_args["bytes_wire"]
+                                            * int(k)))
                 _profiler.record_span("spmd.step_bulk", "trainer", t0,
-                                      args={"k": int(k)})
+                                      args=args)
             self._record_step_obs(extras, tw, k=int(k))
         finally:
             _profiler.step_boundary()  # one boundary per dispatch, not per k
@@ -660,6 +795,22 @@ class SPMDTrainer:
 
     def _build_bulk(self, example_arrays, k):
         pure_step = self._build_pure(example_arrays)
+        if self._comm_state is not None:
+            def bulk_step(keys, ts, lrs, rescale, param_arrs, opt_states,
+                          comm_state, *batch):
+                def body(carry, xs):
+                    pa, os, cs = carry
+                    key, t, lr = xs
+                    pa, os, cs, loss, extras = pure_step(
+                        key, t, lr, rescale, pa, os, cs, *batch)
+                    return (pa, os, cs), (loss, extras)
+
+                (pa, os, cs), (losses, extras) = jax.lax.scan(
+                    body, (param_arrs, opt_states, comm_state),
+                    (keys, ts, lrs), length=k)
+                return pa, os, cs, losses[-1], extras
+
+            return self._jit_wrapped(bulk_step)
 
         def bulk_step(keys, ts, lrs, rescale, param_arrs, opt_states, *batch):
             def body(carry, xs):
@@ -682,9 +833,11 @@ class SPMDTrainer:
         return self._jit_wrapped(self._build_pure(example_arrays))
 
     def _jit_wrapped(self, step_fn):
-        """jit a (keys, t(s), lr(s), rescale, params, states, *batch) step
-        with param/state donation and the trainer's output shardings."""
-        out_shardings = (
+        """jit a (keys, t(s), lr(s), rescale, params, states[, comm],
+        *batch) step with param/state (and error-feedback residual)
+        donation and the trainer's output shardings."""
+        comm = self._comm_state is not None
+        out_shardings = [
             list(self._param_shardings),
             list(self._state_shardings),
             NamedSharding(self._mesh, P()),
@@ -692,22 +845,52 @@ class SPMDTrainer:
             # prefix-leaf sharding covers whatever structure the build
             # produced
             NamedSharding(self._mesh, P()),
-        )
-        donate = (4, 5) if self._donate else ()
+        ]
+        if comm:
+            # (params, states, comm, loss, extras): the residual rides
+            # between states and loss, sharded over the batch axes
+            out_shardings.insert(2, self._comm_sharding)
+        donate = ((4, 5, 6) if comm else (4, 5)) if self._donate else ()
         with self._mesh:
             return jax.jit(
-                step_fn, donate_argnums=donate, out_shardings=out_shardings
+                step_fn, donate_argnums=donate,
+                out_shardings=tuple(out_shardings)
             )
 
     def _build_pure(self, example_arrays):
         if self._stages is not None:
             return self._build_pure_pipeline(example_arrays)
-        block = self._block
-        loss_fn = self._loss_fn
-        opt = self._optimizer
-        params = self._params
+        if self._comm_cfg is not None:
+            return self._build_pure_compressed(example_arrays)
         trainable_idx = self._trainable_idx
         n_inputs = len(example_arrays) - 1
+        forward_loss, aux_idx_cell = self._forward_loss_builder(n_inputs)
+
+        def pure_step(key, t, lr, rescale, param_arrs, opt_states, *batch):
+            train_arrs = [param_arrs[j] for j in trainable_idx]
+            (_, (aux_vals, loss_mean, extras)), grads = jax.value_and_grad(
+                forward_loss, has_aux=True
+            )(train_arrs, param_arrs, key, batch)
+            new_full, new_states = self._traced_optimizer_apply(
+                t, lr, rescale, param_arrs, opt_states, grads)
+            # aux side effects (BatchNorm running stats) overwrite their
+            # frozen params.
+            for k, v in zip(aux_idx_cell[0] if aux_idx_cell else [], aux_vals):
+                new_full[k] = v.astype(new_full[k].dtype)
+            return new_full, new_states, loss_mean, extras
+
+        return pure_step
+
+    def _forward_loss_builder(self, n_inputs):
+        """The traced forward+loss shared by the unpipelined builds (plain
+        and quantized-collective): returns ``(forward_loss,
+        aux_idx_cell)`` where ``forward_loss(train_arrs, full_arrs, key,
+        batch)`` differentiates the loss SUM over whatever batch slice it
+        is traced with."""
+        block = self._block
+        loss_fn = self._loss_fn
+        params = self._params
+        trainable_idx = self._trainable_idx
         aux_idx_cell = []
 
         def forward_loss(train_arrs, full_arrs, key, batch):
@@ -760,17 +943,105 @@ class SPMDTrainer:
             )
             return loss_scalar, (aux_vals, loss_mean, extras)
 
-        def pure_step(key, t, lr, rescale, param_arrs, opt_states, *batch):
-            train_arrs = [param_arrs[j] for j in trainable_idx]
+        return forward_loss, aux_idx_cell
+
+    # ------------------------------------------------------------------
+    def _build_pure_compressed(self, example_arrays):
+        """The quantized-collective twin of the unpipelined ``_build_pure``
+        (docs/gradient_compression.md): the forward/backward runs per dp
+        shard inside ONE ``shard_map`` over the batch axes, so the fp32
+        gradient psum XLA would derive from the shardings is replaced
+        in-program by quantize → integer psum with per-block scale
+        max-reduction → dequantize (``comm.traced_allreduce``), all
+        fused into the same donated-buffer compiled step — zero
+        steady-state recompiles under the PR 9 guard.  Opted-out
+        parameter groups keep an exact fp32 ``lax.psum``.  Note the
+        per-shard semantics shift this implies for batch statistics:
+        BatchNorm aux updates see the LOCAL batch shard and are pmean'd
+        — the multi-worker data-parallel convention, not the global-batch
+        one the uncompressed single-program build computes."""
+        from .mesh import get_shard_map
+        from ..comm import compression as comp_mod
+
+        cfg = self._comm_cfg
+        codec, ef = cfg["codec"], cfg["ef"]
+        comp_slots, exact_slots = cfg["comp_slots"], cfg["exact_slots"]
+        spans = cfg["spans"]
+        trainable_idx = self._trainable_idx
+        n_slots = len(trainable_idx)
+        n_inputs = len(example_arrays) - 1
+        forward_loss, aux_idx_cell = self._forward_loss_builder(n_inputs)
+        mesh = self._mesh
+        AX = ("dp", "fsdp")
+        fsdp = int(mesh.shape["fsdp"])
+        smap = get_shard_map()
+        P0 = P()
+        batch_specs = tuple(batch_pspec(a.ndim) for a in example_arrays)
+
+        def core(train_arrs, full_arrs, key, residual, batch):
+            # distinct PRNG stream per shard: stochastic layers
+            # decorrelate like independent data-parallel workers
+            d = jax.lax.axis_index("dp") * fsdp + jax.lax.axis_index("fsdp")
+            key = jax.random.fold_in(key, d)
             (_, (aux_vals, loss_mean, extras)), grads = jax.value_and_grad(
                 forward_loss, has_aux=True
-            )(train_arrs, param_arrs, key, batch)
+            )(train_arrs, full_arrs, key, batch)
+            new_grads = [None] * n_slots
+            for s in exact_slots:
+                new_grads[s] = jax.lax.psum(grads[s], AX)
+            flat = jnp.concatenate([grads[s].reshape(-1) for s in comp_slots])
+            reduced, resid_out = comp_mod.traced_allreduce(
+                codec, flat, residual[0] if ef else None, AX)
+            for (off, n, shape), s in zip(spans, comp_slots):
+                new_grads[s] = reduced[off:off + n].reshape(shape)
+            # host-facing scalars reduce across shards, so every export
+            # surface matches the global-batch build
+            loss_mean = jax.lax.pmean(loss_mean, AX)
+            aux_vals = tuple(jax.lax.pmean(a, AX) for a in aux_vals)
+            if extras:
+                extras = {
+                    "moe_tokens_dropped":
+                        jax.lax.psum(extras["moe_tokens_dropped"], AX),
+                    "moe_expert_load_min":
+                        jax.lax.pmin(extras["moe_expert_load_min"], AX),
+                    "moe_expert_load_max":
+                        jax.lax.pmax(extras["moe_expert_load_max"], AX),
+                }
+            new_resid = resid_out[None, :] if ef else None
+            return tuple(new_grads), new_resid, loss_mean, aux_vals, extras
+
+        if ef:
+            def shard_body(train_arrs, full_arrs, key, residual, *batch):
+                return core(train_arrs, full_arrs, key, residual, batch)
+            in_specs = (P0, P0, P0, P(AX)) + batch_specs
+            out_specs = (P0, P(AX), P0, P0, P0)
+        else:
+            def shard_body(train_arrs, full_arrs, key, *batch):
+                g, _, l, a, e = core(train_arrs, full_arrs, key, None, batch)
+                return g, l, a, e
+            in_specs = (P0, P0, P0) + batch_specs
+            out_specs = (P0, P0, P0, P0)
+
+        def pure_step(key, t, lr, rescale, param_arrs, opt_states, *rest):
+            if ef:
+                comm_state, batch = rest[0], rest[1:]
+            else:
+                comm_state, batch = None, rest
+            train_arrs = [param_arrs[j] for j in trainable_idx]
+            mapped = smap(shard_body, mesh=mesh,
+                          in_specs=in_specs, out_specs=out_specs)
+            if ef:
+                grads_t, new_comm, loss_mean, aux_vals, extras = mapped(
+                    train_arrs, list(param_arrs), key, comm_state, *batch)
+            else:
+                grads_t, loss_mean, aux_vals, extras = mapped(
+                    train_arrs, list(param_arrs), key, *batch)
             new_full, new_states = self._traced_optimizer_apply(
-                t, lr, rescale, param_arrs, opt_states, grads)
-            # aux side effects (BatchNorm running stats) overwrite their
-            # frozen params.
+                t, lr, rescale, param_arrs, opt_states, list(grads_t))
             for k, v in zip(aux_idx_cell[0] if aux_idx_cell else [], aux_vals):
                 new_full[k] = v.astype(new_full[k].dtype)
+            if ef:
+                return new_full, new_states, new_comm, loss_mean, extras
             return new_full, new_states, loss_mean, extras
 
         return pure_step
@@ -995,15 +1266,31 @@ class SPMDTrainer:
                 p._data._data = jnp.asarray(_np.asarray(a))
                 p._data._version += 1
 
+    def _comm_local_np(self):
+        """This process's rows of the sharded residual, in shard order.
+        ``np.asarray`` on the full array would refuse a multi-process
+        sharding (non-addressable devices); in single-process runs the
+        addressable shards ARE the whole array."""
+        shards = sorted(self._comm_state.addressable_shards,
+                        key=lambda s: s.index[0].start or 0)
+        return _np.concatenate([_np.asarray(s.data) for s in shards], axis=0)
+
     def save_states(self, fname):
         import pickle
 
         from ..checkpoint import atomic_write_bytes
 
         flat = jax.tree_util.tree_map(_np.asarray, self._opt_states)
+        payload = {"states": flat, "num_update": self._t}
+        if self._comm_state is not None:
+            # error-feedback residuals are step state: dropping them at
+            # restore re-injects one step's quantization error.  Each
+            # process snapshots its OWN shard rows (per-host files, like
+            # the reference's per-worker kvstore state)
+            payload["comm_residual"] = self._comm_local_np()
+            payload["comm_codec"] = self._comm_cfg["codec"].id
         # atomic (tmp + os.replace): preemption mid-write never tears it
-        atomic_write_bytes(fname, pickle.dumps(
-            {"states": flat, "num_update": self._t}))
+        atomic_write_bytes(fname, pickle.dumps(payload))
 
     def load_states(self, fname):
         import pickle
@@ -1017,3 +1304,35 @@ class SPMDTrainer:
             self._state_shardings,
         )
         self._t = payload["num_update"]
+        cr = payload.get("comm_residual")
+        if self._comm_state is not None:
+            # expected per-process shape from shard METADATA — snapshots
+            # hold local rows, and materializing the residual just to
+            # compare shapes would be a full D2H copy per restore
+            local_rows = sum(int(s.data.shape[0])
+                             for s in self._comm_state.addressable_shards)
+            expect = (local_rows,) + tuple(self._comm_state.shape[1:])
+            if (cr is not None
+                    and payload.get("comm_codec") == self._comm_cfg["codec"].id
+                    and tuple(cr.shape) == expect):
+                if jax.process_count() > 1:
+                    self._comm_state = jax.make_array_from_process_local_data(
+                        self._comm_sharding, _np.asarray(cr))
+                else:
+                    self._comm_state = jax.device_put(
+                        jnp.asarray(cr), self._comm_sharding)
+            elif cr is None:
+                # snapshot carries no residuals (saved uncompressed or
+                # pre-compression): keeping this trainer's live ones would
+                # feed post-checkpoint error into the restored trajectory
+                self._comm_state = jax.device_put(
+                    jnp.zeros_like(self._comm_state), self._comm_sharding)
+            else:
+                import warnings as _warnings
+
+                _warnings.warn(
+                    "snapshot error-feedback residuals don't match this "
+                    "trainer's compression layout (codec or shard count "
+                    "changed); starting from zero residuals", UserWarning)
+                self._comm_state = jax.device_put(
+                    jnp.zeros_like(self._comm_state), self._comm_sharding)

@@ -194,6 +194,9 @@ _counters = {
     "fused_step_fallback_params": 0,  # params that took the per-tensor loop
     "allreduce_bucket": 0,            # bucketed gradient pushpulls
     "allreduce_bucket_params": 0,     # grads carried by those buckets
+    "comms_bytes_raw": 0,             # gradient bytes before compression
+    "comms_bytes_wire": 0,            # encoded gradient bytes on the wire
+    "comms_compress_ms": 0,           # host-side codec encode/decode wall ms
     "profiler_trace_error": 0,        # jax.profiler start/stop failures
     "slow_step_detected": 0,          # slow-step detector firings
     "io_prefetch_batches": 0,         # batches produced by prefetch workers

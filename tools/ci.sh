@@ -40,7 +40,12 @@
 #                  --smoke mode (exits non-zero on post-warmup recompiles
 #                  in a scheduled step or a bubble-acceptance failure)
 #                  plus the fast schedule + MoE + SPMD-parallel tests
-#  11. tpu       — (opt-in: CI_TPU=1) on-chip correctness tier, needs a chip
+#  11. comm      — quantized-collectives tier: the collectives harness in
+#                  --smoke mode (exits non-zero on post-warmup recompiles
+#                  in the compressed SPMD step, or if the int8 tier stops
+#                  moving >= 3.5x fewer gradient bytes than fp32 on either
+#                  path — counter-verified) plus the compression tests
+#  12. tpu       — (opt-in: CI_TPU=1) on-chip correctness tier, needs a chip
 #
 # The unit tier is split in two so each invocation fits a ~10 min shell on
 # a 1-core box (the full suite exceeds one 600 s window there); `unit` is
@@ -81,7 +86,7 @@ TIERS=()
 for t in "$@"; do
     if [ "$t" = unit ]; then TIERS+=(unit1 unit2); else TIERS+=("$t"); fi
 done
-[ ${#TIERS[@]} -eq 0 ] && TIERS=(unit1 unit2 zoo dist examples bench profiler chaos serving io parallel)
+[ ${#TIERS[@]} -eq 0 ] && TIERS=(unit1 unit2 zoo dist examples bench profiler chaos serving io parallel comm)
 [ "${CI_TPU:-0}" = "1" ] && TIERS+=(tpu)
 
 declare -A RESULT
@@ -205,6 +210,17 @@ for tier in "${TIERS[@]}"; do
                 set -e
                 python benchmark/opperf/pipeline.py --smoke >/dev/null
                 python -m pytest tests/test_pipeline_moe.py tests/test_parallel.py -q -m "not slow" '"${CI_PYTEST_ARGS:-}"
+            ;;
+        comm)
+            # quantized-collectives tier: the opperf harness in --smoke
+            # mode IS the regression guard (non-zero exit on any
+            # post-warmup recompile in the compressed SPMD step, or an
+            # int8 bytes-on-wire ratio below the 3.5x acceptance floor on
+            # either gradient path), then the compression tests
+            run_tier comm "${CPU_ENV[@]}" bash -c '
+                set -e
+                python benchmark/opperf/collectives.py --smoke >/dev/null
+                python -m pytest tests/test_grad_compression.py -q -m "not slow" '"${CI_PYTEST_ARGS:-}"
             ;;
         tpu)
             # on-chip tier: runs under the ambient axon env (NOT cpu-cleaned)

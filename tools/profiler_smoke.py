@@ -50,12 +50,12 @@ def run(out_path):
     spans, other = load_spans(path)
     assert spans, "empty trace"
 
-    cats = {cat for _, cat, _, _, _ in spans}
+    cats = {cat for _, cat, _, _, _, _, _ in spans}
     need = {"dispatch", "bulk", "optimizer", "comms", "step"}
     assert need <= cats, f"missing span categories: {need - cats}"
 
-    steps = [step for _, _, _, _, step in sorted(spans, key=lambda s: s[2])
-             if step is not None]
+    steps = [step for _, _, _, _, step, _, _ in
+             sorted(spans, key=lambda s: s[2]) if step is not None]
     assert steps == sorted(steps), "step ids not monotone"
 
     assert other["counters"]["fused_step_call"] >= 3

@@ -37,9 +37,10 @@ import trace_merge  # noqa: E402 — gz-aware loader + the --merge engine
 
 def load_spans(path):
     """Parse the trace into completed spans ``(name, cat, ts_us, dur_us,
-    step)``.  Accepts both the object form ({"traceEvents": [...]}) and the
-    bare-array form of the chrome trace spec (gzipped or not); pairs B/E
-    events per thread with a stack and takes X (complete) events as-is."""
+    step, args, pid)``.  Accepts both the object form ({"traceEvents":
+    [...]}) and the bare-array form of the chrome trace spec (gzipped or
+    not); pairs B/E events per thread with a stack and takes X (complete)
+    events as-is."""
     if os.path.getsize(path) == 0:
         raise ValueError("empty trace file (0 bytes) — did profiler.dump() "
                          "run, or was the run killed mid-write?")
@@ -60,13 +61,15 @@ def load_spans(path):
             if not stacks[tkey]:
                 raise ValueError(f"unpaired E event at ts={e.get('ts')}")
             b = stacks[tkey].pop()
+            args = b.get("args") or {}
             spans.append((b.get("name", "<unk>"), b.get("cat", ""),
                           b["ts"], e["ts"] - b["ts"],
-                          (b.get("args") or {}).get("step")))
+                          args.get("step"), args, e.get("pid")))
         elif ph == "X":
+            args = e.get("args") or {}
             spans.append((e.get("name", "<unk>"), e.get("cat", ""),
                           e.get("ts", 0), e.get("dur", 0),
-                          (e.get("args") or {}).get("step")))
+                          args.get("step"), args, e.get("pid")))
     dangling = sum(len(s) for s in stacks.values())
     if dangling:
         raise ValueError(f"{dangling} B event(s) never closed")
@@ -100,7 +103,7 @@ def report(path, spans, other, top=15, bins=10, xplane=None,
 
     by_cat = defaultdict(lambda: [0, 0.0])
     by_name = defaultdict(lambda: [0, 0.0])
-    for name, cat, _, dur, _ in spans:
+    for name, cat, _, dur, _, _, _ in spans:
         by_cat[cat][0] += 1
         by_cat[cat][1] += dur
         by_name[(cat, name)][0] += 1
@@ -120,11 +123,33 @@ def report(path, spans, other, top=15, bins=10, xplane=None,
 
     w(f"\nTop {top} spans by duration:\n")
     w(f"{'name':<28}{'category':<12}{'step':>6}{'dur(ms)':>12}\n")
-    for name, cat, _, dur, step in sorted(spans, key=lambda s: -s[3])[:top]:
+    for name, cat, _, dur, step, _, _ in sorted(spans,
+                                                key=lambda s: -s[3])[:top]:
         w(f"{name:<28}{cat:<12}{step if step is not None else '-':>6}"
           f"{dur / 1e3:>12.3f}\n")
 
-    step_walls = [dur / 1e3 for name, cat, _, dur, _ in spans
+    # gradient-exchange payloads (docs/gradient_compression.md): the
+    # bucketed-pushpull and spmd-step spans carry bytes_raw/bytes_wire
+    # args; per-pid aggregation = per-RANK in a merged trace, so
+    # straggler attribution can tell "slow network" from "big payload"
+    payload = defaultdict(lambda: [0, 0, 0])   # pid -> [spans, raw, wire]
+    for name, _cat, _, _, _, args, pid in spans:
+        if args and "bytes_wire" in args and "bytes_raw" in args:
+            row = payload[pid]
+            row[0] += 1
+            row[1] += int(args.get("bytes_raw") or 0)
+            row[2] += int(args.get("bytes_wire") or 0)
+    if payload:
+        w("\nComms payload per rank (raw = fp32 bytes the gradient "
+          "exchange replaces, wire = encoded payload):\n")
+        w(f"{'rank/pid':>9}{'spans':>7}{'raw(MB)':>11}{'wire(MB)':>11}"
+          f"{'ratio':>8}\n")
+        for pid, (cnt, raw, wire) in sorted(payload.items(),
+                                            key=lambda kv: str(kv[0])):
+            w(f"{pid!s:>9}{cnt:>7}{raw / 1e6:>11.3f}{wire / 1e6:>11.3f}"
+              f"{(raw / wire if wire else 0.0):>8.2f}\n")
+
+    step_walls = [dur / 1e3 for name, cat, _, dur, _, _, _ in spans
                   if cat == "step"]
     if step_walls:
         w(f"\nStep-time histogram ({len(step_walls)} steps, ms):\n")
