@@ -208,11 +208,24 @@ def _provenance(arr):
 # ---------------------------------------------------------------------------
 
 
-def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
+def backward(heads, head_grads=None, retain_graph=False, train_mode=True,
+             grad_ready_hook=None):
     """Reverse walk from ``heads``, accumulating into leaf ``.grad`` buffers.
 
     Parity: ``mx.autograd.backward`` / ``Imperative::Backward``
     ([U:src/imperative/imperative.cc]).
+
+    ``grad_ready_hook(leaf)`` — when given, each leaf's gradient is
+    finalized (written into its ``.grad`` buffer, version bumped) the
+    moment no unprocessed tape node can still contribute to it, and the
+    hook fires right then, WHILE the rest of the backward walk continues.
+    This is the comm/compute-overlap entry ``Trainer.backward`` uses to
+    launch a gradient bucket's pushpull as soon as the bucket's grads are
+    final, hiding wire time under the remaining VJPs (docs/step_fold.md).
+    Readiness is exact: a discovery pass counts, per leaf, the reachable
+    tape nodes referencing it, and the reverse walk decrements as nodes
+    retire.  A hook exception aborts the walk loudly (gradients past that
+    point are NOT finalized) and propagates to the ``backward`` caller.
     """
     import numpy as _np
     from .ndarray import NDArray
@@ -266,6 +279,63 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
             g = _dispatch_mods()[0].resolve(g)
         seed(prov, g)
 
+    def _write_leaf(leaf):
+        """Move a leaf's accumulated gradient into its .grad buffer,
+        respecting grad_req (the one write rule — shared by the readiness
+        path and the end-of-walk sweep)."""
+        g = leaf_grads.get(id(leaf))
+        if g is None:
+            return False
+        req = getattr(leaf, "_grad_req", "write")
+        if req == "null" or leaf._grad is None:
+            return False
+        if req == "add":
+            leaf._grad._data = leaf._grad._data + g
+        else:  # write
+            leaf._grad._data = g.astype(leaf._grad._data.dtype) \
+                if g.dtype != leaf._grad._data.dtype else g
+        # freshness signal for Trainer's ignore_stale_grad tracking
+        leaf._grad._version += 1
+        return True
+
+    # grad-readiness accounting: per leaf, how many REACHABLE tape nodes
+    # still reference it.  Exact — discovered by walking the whole graph
+    # from the heads before any vjp runs (cheap: pointer chasing only)
+    pending = None
+    done = set()
+    if grad_ready_hook is not None:
+        pending = {}
+        seen = set()
+        stack = [n for n in nodes.values()]
+        while stack:
+            node = stack.pop()
+            if node.oid in seen:
+                continue
+            seen.add(node.oid)
+            for prov in node.in_prov:
+                if prov is None:
+                    continue
+                tag, payload = prov
+                if tag == "leaf":
+                    lid = id(payload)
+                    leaves.setdefault(lid, payload)
+                    pending[lid] = pending.get(lid, 0) + 1
+                else:
+                    stack.append(tag)
+
+        def _finalize(lid, leaf):
+            if lid in done:
+                return
+            done.add(lid)
+            if _write_leaf(leaf):
+                grad_ready_hook(leaf)
+
+        # heads that are themselves leaves with no node references are
+        # final the moment they are seeded
+        for lid, leaf in list(leaves.items()):
+            if pending.get(lid, 0) == 0 and lid in leaf_grads:
+                _finalize(lid, leaf)
+
     # Process nodes in reverse creation order; creation order is a valid
     # topological order because inputs exist before outputs.  New nodes may
     # be discovered while walking, so use a max-heap keyed on creation id.
@@ -277,52 +347,53 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
         nid = -heapq.heappop(heap)
         node = nodes[nid]
         slots = node_grads.pop(nid, None)
-        if slots is None:
-            continue
-        # vjp requires a cotangent per output, matching the recorded aval
-        # exactly (see _expand_cotangents)
-        present = [j for j, s in enumerate(slots) if s is not None]
-        outs = _expand_cotangents([slots[j] for j in present], present,
-                                  _out_avals(node))
-        in_gs = node.vjp_fn(outs)
-        for prov, g in zip(node.in_prov, in_gs):
-            if prov is None or g is None:
-                continue
-            tag, payload = prov
-            if tag == "leaf":
-                lid = id(payload)
-                leaves[lid] = payload
-                leaf_grads[lid] = g if lid not in leaf_grads else leaf_grads[lid] + g
-            else:
-                pnode, idx = tag, payload
-                pid = pnode.oid
-                if pid not in nodes:
-                    nodes[pid] = pnode
-                    heapq.heappush(heap, -pid)
-                slots2 = node_grads.setdefault(pid, [None] * pnode.n_out)
-                slots2[idx] = g if slots2[idx] is None else slots2[idx] + g
-        if not retain_graph:
-            # free residuals (and the replay snapshot aliasing them) eagerly
-            node.vjp_fn = None
-            node._replay_fn = None
-            node._replay_raw = None
+        if slots is not None:
+            # vjp requires a cotangent per output, matching the recorded
+            # aval exactly (see _expand_cotangents)
+            present = [j for j, s in enumerate(slots) if s is not None]
+            outs = _expand_cotangents([slots[j] for j in present], present,
+                                      _out_avals(node))
+            in_gs = node.vjp_fn(outs)
+            for prov, g in zip(node.in_prov, in_gs):
+                if prov is None or g is None:
+                    continue
+                tag, payload = prov
+                if tag == "leaf":
+                    lid = id(payload)
+                    leaves[lid] = payload
+                    leaf_grads[lid] = g if lid not in leaf_grads else leaf_grads[lid] + g
+                else:
+                    pnode, idx = tag, payload
+                    pid = pnode.oid
+                    if pid not in nodes:
+                        nodes[pid] = pnode
+                        heapq.heappush(heap, -pid)
+                    slots2 = node_grads.setdefault(pid, [None] * pnode.n_out)
+                    slots2[idx] = g if slots2[idx] is None else slots2[idx] + g
+            if not retain_graph:
+                # free residuals (and the replay snapshot aliasing them)
+                # eagerly
+                node.vjp_fn = None
+                node._replay_fn = None
+                node._replay_raw = None
+        if pending is not None:
+            # this node retired (contributions seeded above — or provably
+            # none reach it): its leaf references can no longer change
+            for prov in node.in_prov:
+                if prov is not None and prov[0] == "leaf":
+                    lid = id(prov[1])
+                    left = pending.get(lid, 0) - 1
+                    pending[lid] = left
+                    if left == 0:
+                        _finalize(lid, prov[1])
 
-    # Write into leaf .grad respecting grad_req.
+    # Write into leaf .grad respecting grad_req (readiness path: only the
+    # leftovers — e.g. leaves behind nodes that never received cotangents).
     for lid, leaf in leaves.items():
-        g = leaf_grads.get(lid)
-        if g is None:
-            continue
-        req = getattr(leaf, "_grad_req", "write")
-        if req == "null":
-            continue
-        if leaf._grad is None:
-            continue
-        if req == "add":
-            leaf._grad._data = leaf._grad._data + g
-        else:  # write
-            leaf._grad._data = g.astype(leaf._grad._data.dtype) if g.dtype != leaf._grad._data.dtype else g
-        # freshness signal for Trainer's ignore_stale_grad tracking
-        leaf._grad._version += 1
+        if pending is not None:
+            _finalize(lid, leaf)
+        elif lid not in done:
+            _write_leaf(leaf)
     _np  # silence linters
 
 

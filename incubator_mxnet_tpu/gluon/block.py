@@ -40,7 +40,8 @@ from ..ndarray.ndarray import NDArray
 from ..random import get_key, push_traced_key, pop_traced_key
 from .parameter import Parameter, ParameterDict
 
-__all__ = ["Block", "HybridBlock", "SymbolBlock", "name_scope"]
+__all__ = ["Block", "HybridBlock", "SymbolBlock", "name_scope",
+           "trace_scope", "traced_params"]
 
 _tls = threading.local()
 
@@ -95,36 +96,49 @@ def _is_tracing():
 
 
 @contextlib.contextmanager
-def traced_params(params, arrays):
-    """Trace-scope ceremony for hand-built pure jit programs that call
-    Gluon blocks with parameters BAKED IN as captured constants (the
-    KV-cache decode discipline: per-leaf jit argument processing costs
-    ~0.5 ms/arg on slow hosts, and inference params are frozen anyway).
+def trace_scope(params, arrays, key, training, collector=None):
+    """THE trace-scope ceremony shared by every whole-graph capturer in the
+    repo — the CachedOp build (``_build_cache``), ``export_jittable``, the
+    SPMDTrainer step builders (``parallel/trainer.py``) and the Gluon step
+    fold (``step_fold.py``) all enter their traces through here, so the
+    fragile save/restore protocol exists exactly once.
 
     For each ``(param, array)`` pair: sets ``param._traced_data`` so
-    ``Parameter.data()`` returns the traced stand-in, pushes a traced
-    PRNG key and an empty aux frame, enters eval-mode autograd and marks
-    the block-tracing TLS — and restores ALL of it on exit, exception or
-    not.  Shared by ``model_zoo.transformer._KVCacheDecoder`` and the
-    serving tier's generation programs so the fragile save/restore
-    protocol exists exactly once."""
+    ``Parameter.data()`` returns the traced stand-in, pushes ``key`` as the
+    traced PRNG key, pushes an aux-update frame (``collector`` or a fresh
+    throwaway) so BatchNorm-style side effects are captured instead of
+    applied, marks the block-tracing TLS, and enters recording-off autograd
+    with the given ``training`` mode — restoring ALL of it on exit,
+    exception or not.  Yields the aux frame."""
     saved = []
     for p, a in zip(params, arrays):
         saved.append(getattr(p, "_traced_data", None))
-        p._traced_data = NDArray(a)
-    push_traced_key(jax.random.PRNGKey(0))
-    _aux_stack().append([])
+        p._traced_data = a if isinstance(a, NDArray) else NDArray(a)
+    push_traced_key(key)
+    own = collector if collector is not None else []
+    _aux_stack().append(own)
     prev = getattr(_tls, "tracing", 0)
     _tls.tracing = prev + 1
     try:
-        with autograd._scope(False, False):
-            yield
+        with autograd._scope(False, training):
+            yield own
     finally:
         _tls.tracing = prev
         _aux_stack().pop()
         pop_traced_key()
         for p, s in zip(params, saved):
             p._traced_data = s
+
+
+def traced_params(params, arrays):
+    """Eval-mode :func:`trace_scope` with a fixed key — the ceremony for
+    hand-built pure jit programs that call Gluon blocks with parameters
+    BAKED IN as captured constants (the KV-cache decode discipline:
+    per-leaf jit argument processing costs ~0.5 ms/arg on slow hosts, and
+    inference params are frozen anyway).  Used by
+    ``model_zoo.transformer._KVCacheDecoder`` and the serving tier's
+    generation programs."""
+    return trace_scope(params, arrays, jax.random.PRNGKey(0), False)
 
 
 class _BlockScope:
@@ -338,10 +352,6 @@ class Block:
         """
         import jax
 
-        from .. import autograd
-        from ..ndarray.ndarray import NDArray
-        from ..random import push_traced_key, pop_traced_key
-
         params = sorted(self.collect_params().values(), key=lambda p: p.name)
         for p in params:
             if p._data is None:
@@ -353,24 +363,9 @@ class Block:
         block = self
 
         def fn(param_arrs, *inputs):
-            saved = []
-            for p, a in zip(params, param_arrs):
-                saved.append(getattr(p, "_traced_data", None))
-                p._traced_data = NDArray(a)
-            push_traced_key(key)
-            _aux_stack().append([])
-            prev = getattr(_tls, "tracing", 0)
-            _tls.tracing = prev + 1
-            try:
-                with autograd._scope(False, training):
-                    out = block(*[NDArray(x) if x is not None else None
-                                  for x in inputs])
-            finally:
-                _tls.tracing = prev
-                _aux_stack().pop()
-                pop_traced_key()
-                for p, s in zip(params, saved):
-                    p._traced_data = s
+            with trace_scope(params, param_arrs, key, training):
+                out = block(*[NDArray(x) if x is not None else None
+                              for x in inputs])
             if isinstance(out, (list, tuple)):
                 return tuple(o._data for o in out)
             return out._data
@@ -599,25 +594,8 @@ class HybridBlock(Block):
         def pure(key, *arrs):
             n_in = len(args)
             ins = [NDArray(a) for a in arrs[:n_in]]
-            traced = arrs[n_in:]
-            saved = []
-            for p, t in zip(params, traced):
-                saved.append(getattr(p, "_traced_data", None))
-                p._traced_data = NDArray(t)
-            push_traced_key(key)
-            collector = []
-            _aux_stack().append(collector)
-            prev_tracing = getattr(_tls, "tracing", 0)
-            _tls.tracing = prev_tracing + 1
-            try:
-                with autograd._scope(False, training):
-                    out = block.forward(*ins)
-            finally:
-                _tls.tracing = prev_tracing
-                _aux_stack().pop()
-                pop_traced_key()
-                for p, s in zip(params, saved):
-                    p._traced_data = s
+            with trace_scope(params, arrs[n_in:], key, training) as collector:
+                out = block.forward(*ins)
             outs = out if isinstance(out, (list, tuple)) else [out]
             if not n_out_cell:
                 n_out_cell.append(len(outs))
@@ -626,8 +604,13 @@ class HybridBlock(Block):
 
         jit_fn = jax.jit(pure)
         # Populate n_out/aux metadata via an abstract trace (no execution).
-        example_key = get_key()
-        jax.eval_shape(pure, example_key, *[jax.ShapeDtypeStruct(a.shape, a.dtype) for a in args],
+        # The probe key is an AVAL, not get_key(): consuming a real split
+        # here would shift the ambient PRNG stream by one on every fresh
+        # signature — the folded step (step_fold.py) and this path must
+        # draw identical per-step keys for dropout parity.
+        ex = jax.random.PRNGKey(0)
+        jax.eval_shape(pure, jax.ShapeDtypeStruct(ex.shape, ex.dtype),
+                       *[jax.ShapeDtypeStruct(a.shape, a.dtype) for a in args],
                        *[jax.ShapeDtypeStruct(p._data.shape, p._data.dtype) for p in params])
         return jit_fn, n_out_cell[0], aux_params_cell
 

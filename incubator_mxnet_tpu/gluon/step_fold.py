@@ -1,0 +1,879 @@
+"""One compiled program per training step — the Gluon step fold.
+
+A classic Gluon training step is several host dispatches: the hybridized
+forward (CachedOp jit), the autograd backward (one jitted vjp per tape
+node), the bucketed ``allreduce_grads`` pushpulls, and one fused
+``group_apply`` per optimizer group.  ``SPMDTrainer`` has lowered its whole
+step to ONE donated-buffer program since PR 3 — this module brings the same
+whole-program compilation to the imperative ``gluon.Trainer`` contract
+(the Julia-to-TPU full-compilation result in PAPERS.md: XLA's fusion pays
+off at program granularity, not op granularity):
+
+* :class:`StepProgram` (``Trainer.fold_step(loss_fn)``) traces Block
+  forward + loss + backward + the fused optimizer tail into one jitted,
+  donated-buffer program per (batch signature, optimizer-group-set).  The
+  capture enters the SAME ``gluon.block.trace_scope`` ceremony as the
+  CachedOp build and the SPMDTrainer step builders (the unification of the
+  repo's partial graph capturers), and the optimizer tail composes the
+  SAME per-tensor step adapters ``optimizer/fused.py`` groups with
+  (``plan_groups``), so folded numerics cannot drift from the unfused
+  kernels they inline.  Weights, optimizer state (and under error
+  feedback, compression residuals) are donated; the fresh outputs are
+  swapped back into the live ``Parameter``/state NDArrays, so folded and
+  unfused steps stay interchangeable mid-training and
+  ``save_states``/``load_states`` keep working.
+
+* Multi-process runs against a ``dist_sync`` store fold the gradient
+  exchange IN-PROGRAM: forward/backward runs per worker shard inside one
+  ``shard_map`` over the kvstore's worker mesh, and each size-capped
+  gradient bucket becomes an explicit ``psum`` (or the PR 14 codec's
+  quantize → integer psum → dequantize, ``comm.traced_allreduce``) graph
+  node that depends only on its own bucket's grads — XLA's scheduler is
+  free to start a bucket's collective while the remaining backward still
+  computes, which is where MLPerf-on-TPU-pods finds most pod-scale
+  headroom.
+
+* :func:`fold_update` is the ``MXNET_STEP_FOLD=1`` fast path inside
+  ``Trainer.step``: the whole optimizer tail — every fused group — folds
+  into ONE donated jitted dispatch instead of one ``group_apply`` per
+  group (forward/backward already ran eagerly by the time ``step()`` is
+  called, so this is the part of the step ``Trainer.step`` can fold).
+
+Escape hatches (docs/step_fold.md): ``MXNET_STEP_FOLD=0`` disables both
+entries, a block opts out with ``block._step_fold_opt_out = True``, and
+any capture failure or unsupported optimizer falls back to the eager
+record/backward/step path (counted in ``step_fold_fallback``), never
+erroring.  ``NaiveEngine`` bypasses folding entirely.
+"""
+from __future__ import annotations
+
+import os as _os
+import warnings as _warnings
+from time import perf_counter as _perf
+
+import jax
+import jax.numpy as jnp
+import numpy as _np
+
+from .. import autograd
+from .. import engine as _engine
+from .. import profiler as _profiler
+from ..ndarray.ndarray import NDArray
+from ..optimizer import fused as _fused
+from ..optimizer.optimizer import _swap
+from ..random import get_key
+from .block import trace_scope
+
+__all__ = ["StepProgram", "fold_update", "fold_enabled", "step_fast_path",
+           "host_dispatch_total", "DISPATCH_COUNTERS"]
+
+
+def fold_enabled():
+    """Whether ``Trainer.fold_step`` folds (default yes;
+    ``MXNET_STEP_FOLD=0`` is the escape hatch — the returned StepProgram
+    still works, running the eager record/backward/step path)."""
+    return _os.environ.get("MXNET_STEP_FOLD", "1") != "0"
+
+
+def step_fast_path():
+    """Whether ``Trainer.step`` routes its optimizer tail through
+    :func:`fold_update` (opt-in: ``MXNET_STEP_FOLD=1`` exactly — the
+    default keeps the established per-group ``group_apply`` path)."""
+    return _os.environ.get("MXNET_STEP_FOLD") == "1"
+
+
+# Counters that each tick once per HOST-ISSUED device dispatch.  The
+# steady-state folded step must move this total by exactly 1 (its own
+# ``step_fold_call``) — the opperf harness and tests assert the delta.
+DISPATCH_COUNTERS = (
+    "dispatch_cache_hit", "dispatch_cache_miss", "dispatch_cache_bypass",
+    "dispatch_cache_fallback", "bulk_flush", "fused_step_call",
+    "allreduce_bucket", "step_fold_call",
+)
+
+
+def host_dispatch_total(counters=None):
+    """Sum of the per-dispatch counters (see ``DISPATCH_COUNTERS``)."""
+    c = counters if counters is not None else _profiler.counters()
+    return sum(c[k] for k in DISPATCH_COUNTERS)
+
+
+# concrete jax array of an NDArray, flushing a pending bulk deferred in
+# place — THE shared flush-before-donation rule (optimizer/fused.py)
+_raw = _fused._concrete
+
+
+def _opted_out(block):
+    """Per-block opt-out: ``block._step_fold_opt_out = True`` anywhere in
+    the tree keeps the fold off (docs/step_fold.md)."""
+    if block is None:
+        return False
+    if getattr(block, "_step_fold_opt_out", False):
+        return True
+    return any(_opted_out(c) for c in getattr(block, "_children", {}).values())
+
+
+class StepProgram:
+    """The folded training step for one ``(Trainer, loss_fn)`` pair.
+
+    ``loss_fn(*batch_ndarrays) -> loss NDArray`` computes the loss from
+    the batch (calling the Block(s) whose Parameters the Trainer owns);
+    calling the program runs forward + backward + allreduce + optimizer
+    update as ONE compiled dispatch and returns the loss NDArray.
+
+    Built via ``Trainer.fold_step(loss_fn)``; see docs/step_fold.md for
+    the capture contract (what may run inside ``loss_fn``) and the escape
+    hatches.
+    """
+
+    def __init__(self, trainer, loss_fn, block=None, keep_grads=False):
+        self._trainer = trainer
+        self._loss_fn = loss_fn
+        self._block = block
+        self._keep_grads = bool(keep_grads)
+        self._cache = {}            # (batch sig, group sig) -> entry dict
+        self._fallback_reason = None
+        self._warned = False
+        self._guard_armed = False
+        self._dist = None           # _DistRegisters when folding over a mesh
+        if not fold_enabled():
+            self._fallback_reason = "MXNET_STEP_FOLD=0"
+        elif _engine.is_naive():
+            self._fallback_reason = "NaiveEngine"
+        elif _opted_out(block):
+            self._fallback_reason = "block opt-out (_step_fold_opt_out)"
+
+    # -- public surface --------------------------------------------------
+    @property
+    def folded(self):
+        """False once the program has fallen back to the eager path for
+        good (reason in ``fallback_reason``)."""
+        return self._fallback_reason is None
+
+    @property
+    def fallback_reason(self):
+        return self._fallback_reason
+
+    def __call__(self, *batch, batch_size=None):
+        tr = self._trainer
+        if not tr._kv_initialized:
+            tr._init_kvstore()
+        nds = [b if isinstance(b, NDArray) else NDArray(jnp.asarray(b))
+               for b in batch]
+        if batch_size is None:
+            batch_size = nds[0].shape[0]
+        if self._fallback_reason is not None:
+            return self._eager_step(nds, batch_size)
+        # deferred-init params can only materialize through a real eager
+        # forward — run ONE unfused step, then fold from the next call
+        # (mirrors HybridBlock.__call__'s DeferredInit retry)
+        if any(p._deferred_init is not None or p._data is None
+               for p in tr._params):
+            return self._eager_step(nds, batch_size)
+        return self._folded_step(nds, batch_size)
+
+    def sync(self):
+        """Write fold-held state back into the live Parameters/Trainer
+        (no-op for the local fold, which swaps buffers every step; the
+        multi-process fold keeps donated global registers and syncs
+        lazily — ``Trainer.save_states`` calls this)."""
+        if self._dist is not None:
+            self._dist.sync_out()
+
+    def invalidate(self):
+        """Drop compiled programs and (dist) registers so the next call
+        re-stages from the live Parameters — required after
+        ``load_states`` or direct ``set_data`` on a multi-process fold."""
+        self._cache.clear()
+        self._dist = None
+
+    # -- fallback path ---------------------------------------------------
+    def _note_fallback(self, reason):
+        if self._dist is not None:
+            # the registers hold the live trajectory; the eager path reads
+            # the Parameters — refresh them before switching over
+            self._dist.sync_out()
+            self._dist = None
+        self._fallback_reason = reason
+        if not self._warned:
+            self._warned = True
+            _warnings.warn(
+                f"step fold disabled ({reason}); running the eager "
+                "record/backward/step path instead — see docs/step_fold.md",
+                UserWarning, stacklevel=3)
+
+    def _eager_step(self, nds, batch_size):
+        """The unfused reference path: record forward+loss, tape backward,
+        ``Trainer.step`` (allreduce + fused optimizer groups).  EVERY
+        eager execution through the program counts in
+        ``step_fold_fallback`` — the counter quantifies how much of a
+        run escaped the fold, not how many distinct reasons there were."""
+        _profiler.incr("step_fold_fallback")
+        with autograd.record():
+            loss = self._loss_fn(*nds)
+        autograd.backward([loss])
+        self._trainer.step(batch_size)
+        return loss
+
+    # -- the folded step -------------------------------------------------
+    def _folded_step(self, nds, batch_size):
+        tr = self._trainer
+        opt = tr._optimizer
+        tr._check_and_rescale_grad(tr._scale / batch_size)
+        touched = []
+        for i, p in enumerate(tr._params):
+            if p.grad_req == "null" or p._data is None:
+                continue
+            if p._data._grad is None:
+                raise UserWarning(
+                    f"Gradient of Parameter `{p.name}` has no grad buffer")
+            if p.grad_req != "write":
+                # grad_req='add' accumulates across backwards — a folded
+                # step would overwrite the running sum
+                self._note_fallback(f"{p.name} has grad_req="
+                                    f"{p.grad_req!r} (fold needs 'write')")
+                return self._eager_step(nds, batch_size)
+            if i not in tr._states:
+                tr._states[i] = opt.create_state_multi_precision(i, p.data())
+            touched.append((i, p))
+        tr._account_memory(touched)
+        groups, rest = _fused.plan_groups(
+            opt, [(i, p.data(), None) for i, p in touched], tr._states)
+        if rest or not groups:
+            names = [tr._params[i].name for i, _, _ in rest][:3]
+            self._note_fallback(
+                f"no fused kernels for {type(opt).__name__} on "
+                f"{names or 'these params'} (lazy/sparse or unsupported)")
+            return self._eager_step(nds, batch_size)
+
+        # kvstore routing: a dist store either folds in-program (SPMD
+        # collectives available) or forces the eager path (async PS —
+        # server-side optimizer, host TCP wire)
+        kv = tr._kvstore
+        dist = kv is not None and kv.num_workers > 1
+        if dist and not (hasattr(kv, "_worker_mesh")
+                         and kv.supports_grad_bucketing()):
+            self._note_fallback(
+                f"kvstore {getattr(kv, 'type', kv)!r} cannot fold "
+                "(server-side optimizer / async tier)")
+            return self._eager_step(nds, batch_size)
+
+        tpos_of = {i: t for t, (i, _) in enumerate(touched)}
+        group_sig = tuple(
+            (step.__name__, dt, cx,
+             tuple(i for i, _, _, _ in members),
+             tuple(len(flat) for _, _, _, flat in members))
+            for (step, dt, cx), members in groups.items())
+        raws = [_raw(nd) for nd in nds]
+        batch_sig = tuple((tuple(a.shape), str(a.dtype)) for a in raws)
+        key_sig = (batch_sig, group_sig, bool(dist))
+
+        entry = self._cache.get(key_sig)
+        fresh = entry is None
+        if fresh:
+            try:
+                entry = self._build(raws, touched, groups, tpos_of, dist, kv)
+            except Exception as e:  # capture failure: loud sticky fallback
+                self._note_fallback(f"capture failed: {e!r:.200}")
+                return self._eager_step(nds, batch_size)
+            self._cache[key_sig] = entry
+
+        # per-step dynamic hypers: bump ALL counts first, then read lr/wd
+        # (the fused_update discipline — synchronized params all see the
+        # same num_update)
+        for i, _ in touched:
+            opt._update_count(i)
+        lrs = jnp.asarray([opt._get_lr(i) for i, _ in touched], jnp.float32)
+        wds = jnp.asarray([opt._get_wd(i) for i, _ in touched], jnp.float32)
+        ts = jnp.asarray([opt._index_update_count[i] for i, _ in touched],
+                         jnp.float32)
+        scalars = {k: jnp.asarray(v, jnp.float32)
+                   for k, v in _fused._scalars(opt).items()}
+        key = get_key()
+
+        return self._dispatch(entry, touched, key, lrs, wds, ts, scalars,
+                              raws, fresh)
+
+    def _dispatch(self, entry, touched, key, lrs, wds, ts, scalars, raws,
+                  fresh):
+        tr = self._trainer
+        if self._dist is not None:
+            call_args = self._dist.stage_call(key, lrs, wds, ts, scalars,
+                                              raws)
+        else:
+            param_arrs = [_raw(p._data) for p in entry["params"]]
+            state_arrs = [tuple(_raw(s) for s in flat)
+                          for flat in entry["state_flats"]]
+            call_args = (key, lrs, wds, ts, scalars, param_arrs, state_arrs,
+                         *raws)
+        tc = _perf() if fresh else None
+        t0 = _perf() if _profiler._active else None
+        try:
+            try:
+                out = entry["fn"](*call_args)
+            except Exception as e:
+                # the donated whole-step dispatch is an OOM choke point
+                _profiler.maybe_oom_postmortem(e, "gluon.step_fold")
+                raise
+            loss_local = self._wire_outputs(entry, touched, out)
+            if tc is not None:
+                # AFTER output wiring: a guard in raise mode must never
+                # leave Parameters pointing at donated-and-deleted buffers
+                _profiler.record_compile(
+                    "gluon.step_fold", self._compile_sig(entry, raws),
+                    (_perf() - tc) * 1e3)
+            if t0 is not None:
+                _profiler.record_span(
+                    "trainer.step_fold", "trainer", t0,
+                    args={"params": len(touched),
+                          "dist": self._dist is not None})
+            _profiler.incr("step_fold_call")
+            # freshness snapshot (Trainer._update parity): only a future
+            # backward/user write may flip a param back to fresh
+            for i, p in touched:
+                tr._grad_versions[i] = p.grad_version
+        finally:
+            _profiler.step_boundary()
+        if not self._guard_armed:
+            self._guard_armed = True
+            _profiler.arm_compile_guard("gluon.step_fold")
+        return loss_local
+
+    def _compile_sig(self, entry, raws):
+        sig = {"__program__": "step_fold" + (":dist" if entry["dist"]
+                                             else ""),
+               "params": _profiler.sig_static(len(entry["params"])),
+               "groups": _profiler.sig_static(
+                   [g[0] for g in entry["plan_names"]])}
+        for i, a in enumerate(raws):
+            sig[f"in{i}"] = {"k": "array", "shape": tuple(a.shape),
+                             "dtype": str(a.dtype)}
+        return sig
+
+    def _warn_foreign_aux(self, aux_cell):
+        """One loud warning when the capture saw aux updates for params
+        the trainer doesn't own: their OLD value is a baked trace
+        constant, so they stay FROZEN in-fold (pass the block's full
+        ``collect_params()`` to the Trainer to fold them)."""
+        foreign = aux_cell[0][1] if aux_cell else []
+        if foreign:
+            _warnings.warn(
+                "step fold: aux updates for parameters the Trainer does "
+                f"not own stay FROZEN inside the fold ({foreign[:3]}...); "
+                "construct the Trainer with the block's full "
+                "collect_params() to fold their running stats — "
+                "docs/step_fold.md", UserWarning, stacklevel=4)
+
+    def _wire_outputs(self, entry, touched, out):
+        """Swap the program's fresh buffers into the live NDArrays (local
+        fold) or registers (dist fold).  Returns the loss NDArray."""
+        if self._dist is not None:
+            return self._dist.wire(entry, touched, out, self._keep_grads)
+        it = iter(out)
+        new_params, new_states, loss_data = next(it), next(it), next(it)
+        grads = next(it) if self._keep_grads else None
+        for p, arr in zip(entry["params"], new_params):
+            _swap(p._data, arr)
+        for flat, new in zip(entry["state_flats"], new_states):
+            for s_nd, s_new in zip(flat, new):
+                _swap(s_nd, s_new)
+        if grads is not None:
+            for (_, p), g in zip(touched, grads):
+                _swap(p._data._grad, g)
+        return NDArray(loss_data)
+
+    # -- capture ---------------------------------------------------------
+    def _build(self, raws, touched, groups, tpos_of, dist, kv):
+        """Trace + jit the whole step.  Returns the cache entry dict.  The
+        capture is validated with ``jax.eval_shape`` (no device work), so
+        a loss_fn the tracer cannot swallow fails HERE — cleanly — and the
+        caller falls back to the eager path."""
+        tr = self._trainer
+        params = [p for p in tr._params if p._data is not None]
+        slot_of = {id(p): s for s, p in enumerate(params)}
+        trainable_slots = [slot_of[id(p)] for _, p in touched]
+        state_flats = [None] * len(touched)
+        plan = []        # (step_fn, [(tpos, slot)])
+        plan_names = []
+        for (step, dt, cx), members in groups.items():
+            rows = []
+            for i, w, _, flat in members:
+                t = tpos_of[i]
+                state_flats[t] = tuple(flat)
+                rows.append((t, slot_of[id(tr._params[i])]))
+            plan.append((step, tuple(rows)))
+            plan_names.append((step.__name__, dt, len(members)))
+        loss_fn = self._loss_fn
+        keep_grads = self._keep_grads
+        aux_cell = []     # [(in_slots, out_params)] discovered on trace 1
+        loss_meta = []    # [ndim] of the user loss
+
+        def forward_loss(train_arrs, full_arrs, key, batch):
+            full = list(full_arrs)
+            for s, arr in zip(trainable_slots, train_arrs):
+                full[s] = arr
+            with trace_scope(params, full, key, True) as collector:
+                loss = loss_fn(*[NDArray(b) for b in batch])
+            loss_data = loss._data
+            if not loss_meta:
+                loss_meta.append(loss_data.ndim)
+            if not aux_cell:
+                # per-POSITION ownership (slot index, or None for a param
+                # the trainer doesn't hold): owned and foreign aux may
+                # interleave in forward order.  Foreign aux updates are
+                # DROPPED, not written back — the old value is baked into
+                # the trace as a constant, so a write-back would keep
+                # re-deriving the update from the original stats forever
+                # (frozen is honest; a warning surfaces it at build).
+                kinds, foreign = [], []
+                for p, _ in collector:
+                    s = slot_of.get(id(p))
+                    kinds.append(s)
+                    if s is None:
+                        foreign.append(p.name)
+                aux_cell.append((kinds, foreign))
+            aux_vals = tuple(v._data if isinstance(v, NDArray) else v
+                             for _, v in collector)
+            # differentiate the SUM in the loss's own dtype — exact parity
+            # with loss.backward()'s implicit ones head-grads
+            return jnp.sum(loss_data), (aux_vals, loss_data)
+
+        def optimizer_tail(param_arrs, state_arrs, grads, lrs, wds, ts,
+                           scalars):
+            new_full = list(param_arrs)
+            new_states = list(state_arrs)
+            for step, rows in plan:
+                for t, s in rows:
+                    nw, ns = step(param_arrs[s], grads[t], state_arrs[t],
+                                  lrs[t], wds[t], ts[t], scalars)
+                    new_full[s] = nw
+                    new_states[t] = tuple(ns)
+            return new_full, new_states
+
+        def apply_aux(new_full, param_arrs, aux_vals):
+            kinds, _ = aux_cell[0]
+            for s, v in zip(kinds, aux_vals):
+                if s is not None:
+                    new_full[s] = v.astype(param_arrs[s].dtype)
+
+        if dist:
+            return self._build_dist(raws, touched, params, state_flats,
+                                    plan, plan_names, trainable_slots,
+                                    forward_loss, optimizer_tail, apply_aux,
+                                    aux_cell, loss_meta, kv)
+
+        def pure_step(key, lrs, wds, ts, scalars, param_arrs, state_arrs,
+                      *batch):
+            train_arrs = [param_arrs[s] for s in trainable_slots]
+            (_, (aux_vals, loss_data)), grads = jax.value_and_grad(
+                forward_loss, has_aux=True)(train_arrs, param_arrs, key,
+                                            batch)
+            new_full, new_states = optimizer_tail(
+                param_arrs, state_arrs, grads, lrs, wds, ts, scalars)
+            apply_aux(new_full, param_arrs, aux_vals)
+            out = (new_full, new_states, loss_data)
+            if keep_grads:
+                out += (list(grads),)
+            return out
+
+        # abstract validation pass — populates aux_cell/loss_meta and
+        # surfaces capture failures without any device work.  The key aval
+        # comes from a FRESH PRNGKey(0), never get_key(): splitting the
+        # ambient stream at build time would desync fold-vs-unfused
+        # dropout parity by one key.
+        ex_key = jax.random.PRNGKey(0)
+        key_aval = jax.ShapeDtypeStruct(ex_key.shape, ex_key.dtype)
+        abstract = (
+            key_aval,
+            jax.ShapeDtypeStruct((len(touched),), jnp.float32),
+            jax.ShapeDtypeStruct((len(touched),), jnp.float32),
+            jax.ShapeDtypeStruct((len(touched),), jnp.float32),
+            {k: jax.ShapeDtypeStruct((), jnp.float32)
+             for k in _fused._scalars(tr._optimizer)},
+            [jax.ShapeDtypeStruct(p._data.shape, p._data.dtype)
+             for p in params],
+            [tuple(jax.ShapeDtypeStruct(s.shape, s.dtype)
+                   for s in flat) for flat in state_flats],
+            *[jax.ShapeDtypeStruct(a.shape, a.dtype) for a in raws],
+        )
+        jax.eval_shape(pure_step, *abstract)
+        self._warn_foreign_aux(aux_cell)
+        donate = (5, 6) if _fused.donation_enabled() else ()
+        fn = jax.jit(pure_step, donate_argnums=donate)
+        return {"fn": fn, "params": params, "state_flats": state_flats,
+                "plan_names": plan_names, "dist": False}
+
+    # -- the multi-process (in-fold collectives) build -------------------
+    def _build_dist(self, raws, touched, params, state_flats, plan,
+                    plan_names, trainable_slots, forward_loss,
+                    optimizer_tail, apply_aux, aux_cell, loss_meta, kv):
+        """Fold the gradient exchange into the program: forward/backward
+        per worker shard under ONE ``shard_map`` over the kvstore's worker
+        mesh, with each size-capped gradient bucket an explicit allreduce
+        node (fp32 ``psum``, or the PR 14 codec's in-program quantized
+        exchange) that XLA may schedule as soon as that bucket's grads
+        exist — comms overlapped against the remaining backward.  The
+        optimizer tail then runs on the replicated reduced grads."""
+        from jax.sharding import PartitionSpec as P
+
+        from .. import kvstore as kv_mod
+        from ..comm import compression as comp_mod
+        from ..parallel.mesh import get_shard_map
+
+        tr = self._trainer
+        mesh = kv._worker_mesh()
+        keep_grads = self._keep_grads
+        policy = comp_mod.resolve_policy()
+        ef = policy is not None and policy.error_feedback
+
+        # THE deterministic bucket rule (kvstore.plan_buckets — shared
+        # with bucketed_pushpull and the overlap hook, so in-fold and
+        # out-of-fold paths can never draw different bucket boundaries);
+        # positions index ``touched`` order = the grads list
+        _, kv_buckets = kv_mod.plan_buckets(
+            [(i, p.grad()) for i, p in touched],
+            names=[p.name for _, p in touched], compression=policy)
+        buckets = []   # (codec|None, [(tpos, off, n, shape)])
+        for bk in kv_buckets:
+            rows, off = [], 0
+            for t in bk["positions"]:
+                a = touched[t][1]._data._data
+                rows.append((t, off, int(a.size), tuple(a.shape)))
+                off += int(a.size)
+            buckets.append((bk["codec"], tuple(rows)))
+        n_train = len(touched)
+        smap = get_shard_map()
+        P0 = P()
+        PW = P("w")
+        batch_specs = tuple(P(*(("w",) + (None,) * (a.ndim - 1)))
+                            for a in raws)
+
+        def shard_body(train_arrs, full_arrs, key, residuals, *batch):
+            # distinct PRNG stream per worker — the documented dist-fold
+            # convention (matches the SPMD quantized-collective build)
+            key = jax.random.fold_in(key, jax.lax.axis_index("w"))
+            (_, (aux_vals, loss_data)), grads = jax.value_and_grad(
+                forward_loss, has_aux=True)(train_arrs, full_arrs, key,
+                                            batch)
+            new_grads = [None] * n_train
+            new_resid = []
+            ri = 0
+            for codec, rows in buckets:
+                flat = jnp.concatenate(
+                    [grads[t].reshape(-1) for t, _, _, _ in rows])
+                if codec is None:
+                    red = jax.lax.psum(flat, "w")
+                else:
+                    red, resid = comp_mod.traced_allreduce(
+                        codec, flat, residuals[ri][0] if ef else None,
+                        ("w",))
+                    if ef:
+                        new_resid.append(resid[None, :])
+                        ri += 1
+                for t, off, n, shape in rows:
+                    new_grads[t] = red[off:off + n].reshape(shape)
+            # local loss leaves sharded over 'w' (each worker reads its
+            # own shard — parity with the per-worker eager loss); aux
+            # stats pmean so every worker applies the same running stats
+            loss_out = loss_data if loss_data.ndim >= 1 \
+                else loss_data[None]
+            aux_vals = tuple(jax.lax.pmean(a, "w") for a in aux_vals)
+            return (tuple(new_grads), tuple(new_resid), loss_out, aux_vals)
+
+        def pure_step(key, lrs, wds, ts, scalars, param_arrs, state_arrs,
+                      residuals, *batch):
+            train_arrs = [param_arrs[s] for s in trainable_slots]
+            mapped = smap(
+                shard_body, mesh=mesh,
+                in_specs=(P0, P0, P0, PW) + batch_specs,
+                out_specs=(P0, PW, PW, P0))
+            grads_t, new_resid, loss_out, aux_vals = mapped(
+                train_arrs, list(param_arrs), key, tuple(residuals), *batch)
+            new_full, new_states = optimizer_tail(
+                param_arrs, state_arrs, list(grads_t), lrs, wds, ts,
+                scalars)
+            apply_aux(new_full, param_arrs, aux_vals)
+            out = (new_full, new_states, list(new_resid), loss_out)
+            if keep_grads:
+                out += (list(grads_t),)
+            return out
+
+        if self._dist is not None:
+            # a rebuild (new batch signature): the live Parameters are
+            # stale — refresh them from the old registers before re-staging
+            self._dist.sync_out()
+        regs = _DistRegisters(tr, params, state_flats, mesh,
+                              buckets if ef else [], loss_meta)
+        self._dist = regs
+        donate = (5, 6, 7) if _fused.donation_enabled() else ()
+        with mesh:
+            fn = jax.jit(pure_step, donate_argnums=donate)
+        # validation trace (abstract; global shapes)
+        ex_key = jax.random.PRNGKey(0)
+        key_aval = jax.ShapeDtypeStruct(ex_key.shape, ex_key.dtype)
+        nw = mesh.devices.size
+        abstract = (
+            key_aval,
+            jax.ShapeDtypeStruct((n_train,), jnp.float32),
+            jax.ShapeDtypeStruct((n_train,), jnp.float32),
+            jax.ShapeDtypeStruct((n_train,), jnp.float32),
+            {k: jax.ShapeDtypeStruct((), jnp.float32)
+             for k in _fused._scalars(tr._optimizer)},
+            [jax.ShapeDtypeStruct(p._data.shape, p._data.dtype)
+             for p in params],
+            [tuple(jax.ShapeDtypeStruct(s.shape, s.dtype)
+                   for s in flat) for flat in state_flats],
+            [jax.ShapeDtypeStruct((nw, n), jnp.float32)
+             for n in regs.resid_sizes],
+            *[jax.ShapeDtypeStruct((a.shape[0] * nw,) + tuple(a.shape[1:]),
+                                   a.dtype) for a in raws],
+        )
+        with mesh:
+            jax.eval_shape(pure_step, *abstract)
+        self._warn_foreign_aux(aux_cell)
+        return {"fn": fn, "params": params, "state_flats": state_flats,
+                "plan_names": plan_names, "dist": True}
+
+
+class _DistRegisters:
+    """Donated global registers for the multi-process fold: replicated
+    params/optimizer state and sharded error-feedback residuals live as
+    jax global arrays across steps (zero per-step staging); Parameters and
+    ``trainer._states`` are refreshed lazily via ``sync_out``."""
+
+    def __init__(self, trainer, params, state_flats, mesh, ef_buckets,
+                 loss_meta):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        self._trainer = trainer
+        self._params = params
+        self._state_flats = state_flats
+        self._mesh = mesh
+        self._loss_meta = loss_meta
+        self._rep = NamedSharding(mesh, P())
+        self._row = NamedSharding(mesh, P("w"))
+        self.param_arrays = [self._replicate(_raw(p._data)) for p in params]
+        self.state_arrays = [tuple(self._replicate(_raw(s)) for s in flat)
+                             for flat in state_flats]
+        self.resid_sizes = [sum(n for _, _, n, _ in rows)
+                            for codec, rows in ef_buckets
+                            if codec is not None]
+        # error-feedback residuals persist through the trainer's
+        # ErrorFeedback store (the PR 14 contract: save_states carries
+        # them, a rebuild re-stages them — never silently zeroed); each
+        # process stages its OWN local rows, per-host-file style
+        import jax as _jax
+
+        nw = mesh.devices.size
+        local_rows = max(1, nw // _jax.process_count())
+        self.residuals = []
+        for b, n in enumerate(self.resid_sizes):
+            local = None
+            fb = trainer._grad_feedback
+            if fb is not None:
+                stored = fb._res.get(self._resid_key(b, n))
+                if stored is not None and \
+                        tuple(_np.shape(stored)) == (local_rows, n):
+                    local = _np.asarray(stored, _np.float32)
+            if local is None:
+                local = _np.zeros((local_rows, n), _np.float32)
+            self.residuals.append(self._stage_rows(local))
+
+    def _replicate(self, arr):
+        import jax as _jax
+
+        local = _jax.device_put(_np.asarray(arr),
+                                self._mesh.local_devices[0])
+        return _jax.make_array_from_single_device_arrays(
+            tuple(local.shape), self._rep, [local])
+
+    @staticmethod
+    def _resid_key(b, n):
+        return f"__fold_dist__:{b}:{n}"
+
+    def _stage_rows(self, local):
+        """This process's residual rows -> the 'w'-sharded global array."""
+        import jax as _jax
+
+        if _jax.process_count() == 1:
+            return _jax.device_put(local, self._row)
+        return _jax.make_array_from_process_local_data(self._row, local)
+
+    def _global_batch(self, arr):
+        import jax as _jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        spec = P(*(("w",) + (None,) * (arr.ndim - 1)))
+        sharding = NamedSharding(self._mesh, spec)
+        return _jax.make_array_from_process_local_data(
+            sharding, _np.asarray(arr))
+
+    def stage_call(self, key, lrs, wds, ts, scalars, raws):
+        rep = self._replicate
+        return (rep(key), rep(lrs), rep(wds), rep(ts),
+                {k: rep(v) for k, v in scalars.items()},
+                self.param_arrays, self.state_arrays, self.residuals,
+                *[self._global_batch(a) for a in raws])
+
+    def wire(self, entry, touched, out, keep_grads):
+        # everything stays DEVICE-RESIDENT: addressable_data(0) hands back
+        # this process's shard buffer without a host sync — an immediate
+        # np.asarray here would block dispatch on the whole step's device
+        # completion every step and forfeit the overlap the fold buys
+        # (the PR 12 MoE-extras lesson); sync_out() is the host boundary
+        it = iter(out)
+        new_params, new_states, new_resid, loss_out = (
+            next(it), next(it), next(it), next(it))
+        grads = next(it) if keep_grads else None
+        self.param_arrays = new_params
+        self.state_arrays = [tuple(s) for s in new_states]
+        self.residuals = list(new_resid)
+        if grads is not None:
+            for (_, p), g in zip(touched, grads):
+                p._data._grad._data = g.addressable_data(0)
+                p._data._grad._version += 1
+        local = loss_out.addressable_data(0)
+        if self._loss_meta and self._loss_meta[0] == 0:
+            local = local.reshape(())
+        return NDArray(local)
+
+    def sync_out(self):
+        """Fold registers -> live Parameters / trainer states (gathered
+        off the mesh so eager ops see single-device arrays).  Residuals
+        land in the trainer's ErrorFeedback store so ``save_states``
+        persists them and a rebuild re-stages them."""
+        with autograd.pause():
+            for p, a in zip(self._params, self.param_arrays):
+                p._data._data = jnp.asarray(_np.asarray(
+                    a.addressable_data(0)))
+                p._data._version += 1
+            for flat, arrs in zip(self._state_flats, self.state_arrays):
+                for s_nd, a in zip(flat, arrs):
+                    s_nd._data = jnp.asarray(_np.asarray(
+                        a.addressable_data(0)))
+                    s_nd._version += 1
+        if self.residuals:
+            from ..comm import compression as comp_mod
+
+            tr = self._trainer
+            if tr._grad_feedback is None:
+                tr._grad_feedback = comp_mod.ErrorFeedback()
+            for b, (n, arr) in enumerate(zip(self.resid_sizes,
+                                             self.residuals)):
+                tr._grad_feedback.update(
+                    self._resid_key(b, n),
+                    _np.asarray(arr.addressable_data(0)))
+
+
+# ---------------------------------------------------------------------------
+# The MXNET_STEP_FOLD=1 fast path inside Trainer.step: fold the whole
+# optimizer tail (every fused group) into ONE donated jitted dispatch.
+# ---------------------------------------------------------------------------
+
+_TAIL_JITS = {}
+
+
+def _tail_fn(plan_key, steps, donate):
+    fn = _TAIL_JITS.get((plan_key, donate))
+    if fn is None:
+        def body(weights, grads, states, lrs, wds, ts, scalars):
+            new_w = []
+            new_s = []
+            for g, step in enumerate(steps):
+                gw, gs = [], []
+                for m in range(len(weights[g])):
+                    nw, ns = step(weights[g][m], grads[g][m], states[g][m],
+                                  lrs[g][m], wds[g][m], ts[g][m], scalars)
+                    gw.append(nw)
+                    gs.append(list(ns))
+                new_w.append(gw)
+                new_s.append(gs)
+            return new_w, new_s
+
+        fn = jax.jit(body, donate_argnums=(0, 2) if donate else ())
+        _TAIL_JITS[(plan_key, donate)] = fn
+        while len(_TAIL_JITS) > 64:
+            _TAIL_JITS.pop(next(iter(_TAIL_JITS)))
+    return fn
+
+
+def fold_update(optimizer, items, states):
+    """Folded optimizer tail — :func:`optimizer.fused.fused_update`'s
+    drop-in twin that updates EVERY fused group in one donated jitted
+    dispatch instead of one ``group_apply`` per group (the
+    ``MXNET_STEP_FOLD=1`` fast path inside ``Trainer.step``).  Returns the
+    leftover per-tensor items, exactly like ``fused_update``."""
+    agg = int(getattr(optimizer, "aggregate_num", 0) or 0)
+    if agg <= 1 or not items or _engine.is_naive():
+        return items
+    groups, rest = _fused.plan_groups(optimizer, items, states)
+    if not groups:
+        return rest
+    # bump ALL counts first, then read lr/wd/t (fused_update discipline)
+    for members in groups.values():
+        for i, _, _, _ in members:
+            optimizer._update_count(i)
+    ws, gs, sts, lrs, wds, ts, flats = [], [], [], [], [], [], []
+    steps = []
+    plan_key_parts = []
+    for (step, dt, cx), members in groups.items():
+        steps.append(step)
+        plan_key_parts.append((step, len(members)))
+        ws.append([_fused._concrete(w) for _, w, _, _ in members])
+        gs.append([_fused._concrete(g) for _, _, g, _ in members])
+        sts.append([[_fused._concrete(s) for s in flat]
+                    for _, _, _, flat in members])
+        lrs.append(jnp.asarray([optimizer._get_lr(i)
+                                for i, _, _, _ in members], jnp.float32))
+        wds.append(jnp.asarray([optimizer._get_wd(i)
+                                for i, _, _, _ in members], jnp.float32))
+        ts.append(jnp.asarray([optimizer._index_update_count[i]
+                               for i, _, _, _ in members], jnp.float32))
+        flats.append([flat for _, _, _, flat in members])
+    scalars = {k: jnp.asarray(v, jnp.float32)
+               for k, v in _fused._scalars(optimizer).items()}
+    donate = _fused.donation_enabled()
+    fn = _tail_fn(tuple(plan_key_parts), tuple(steps), donate)
+    n_params = sum(len(m) for m in ws)
+    n0 = _profiler.jit_cache_size(fn)
+    tc = _perf()
+    t0 = tc if _profiler._active else None
+    guard_err = None
+    try:
+        new_w, new_s = fn(ws, gs, sts, lrs, wds, ts, scalars)
+    except Exception as e:
+        _profiler.maybe_oom_postmortem(e, "gluon.step_fold")
+        raise
+    compiled = n0 >= 0 and _profiler.jit_cache_size(fn) > n0
+    if compiled:
+        sig = {"__program__": "update_tail",
+               "groups": _profiler.sig_static(
+                   [(getattr(s, "__name__", "?"), n)
+                    for s, n in plan_key_parts])}
+        k = 0
+        for grp in ws:
+            for w in grp:
+                sig[f"w{k}"] = {"k": "array", "shape": tuple(w.shape),
+                                "dtype": str(w.dtype)}
+                k += 1
+        try:
+            _profiler.record_compile("gluon.step_fold", sig,
+                                     (_perf() - tc) * 1e3)
+        except _profiler.CompileGuardError as e:
+            guard_err = e   # buffers are donated: wire first, raise after
+    for g, members in enumerate(groups.values()):
+        for m, (_, w, _, _) in enumerate(members):
+            _swap(w, new_w[g][m])
+            for s_nd, s_new in zip(flats[g][m], new_s[g][m]):
+                _swap(s_nd, s_new)
+    if t0 is not None:
+        _profiler.record_span("fused.group_apply", "optimizer", t0,
+                              args={"params": n_params,
+                                    "groups": len(groups), "folded": True})
+    _profiler.incr("fused_step_call")
+    _profiler.incr("fused_step_params", n_params)
+    if guard_err is not None:
+        raise guard_err
+    if rest:
+        _profiler.incr("fused_step_fallback_params", len(rest))
+    return rest

@@ -65,6 +65,10 @@ class Trainer:
         self._grad_versions = {}      # index -> grad buffer version at last update
         self._grad_feedback = None    # comm.ErrorFeedback when compression
                                       # with error feedback is active
+        self._overlap_reduced = None  # param indices whose buckets already
+                                      # pushpulled from the grad-readiness
+                                      # hook (Trainer.backward overlap path)
+        self._fold = None             # weakref to the last fold_step program
         # device-memory ledger accounting (docs/observability.md#device-
         # memory-observability): indices whose weight+grad+state bytes
         # have been reported, and the totals to release on close() — or
@@ -160,31 +164,142 @@ class Trainer:
             # can't bill its partial time to the NEXT step's telemetry.
             _profiler.step_boundary()
 
+    def backward(self, loss, head_grads=None):
+        """Run the autograd backward for ``loss`` with the gradient
+        exchange OVERLAPPED against it: each size-capped gradient bucket's
+        ``bucketed_pushpull`` launches from a grad-readiness hook the
+        moment every grad in the bucket is final — while later (earlier-
+        layer) VJPs still run — so wire time hides under the remaining
+        backward instead of serializing after it (docs/step_fold.md; the
+        MLPerf-on-TPU-pods overlap, on the out-of-fold dist-kvstore path).
+
+        Drop-in for ``loss.backward()``: when no dist bucketing store is
+        attached (or ``MXNET_ALLREDUCE_OVERLAP=0``, or any param uses
+        ``grad_req='add'`` — a running sum must not be pushed early) it IS
+        a plain backward, and the following ``step()`` aggregates as
+        usual.  Buckets already reduced here are skipped by ``step()``'s
+        ``allreduce_grads``.  A wire failure mid-backward raises out of
+        this call with the failed bucket's grads UNTOUCHED (never
+        half-written); the step must then be abandoned on every worker —
+        peers' collectives have already advanced."""
+        import os as _os
+
+        from .. import autograd as _ag
+
+        if not self._kv_initialized:
+            self._init_kvstore()
+        heads = loss if isinstance(loss, (list, tuple)) else [loss]
+        from .. import kvstore as kv_mod
+
+        kv = self._kvstore
+        pairs = [(i, p) for i, p in enumerate(self._params)
+                 if p.grad_req != "null" and p._data is not None
+                 and p._data._grad is not None]
+        overlap = (
+            _os.environ.get("MXNET_ALLREDUCE_OVERLAP", "1") != "0"
+            and kv is not None and len(pairs) > 1
+            and kv_mod.bucket_bytes() > 0
+            and kv.supports_grad_bucketing()
+            and all(p.grad_req == "write" for _, p in pairs))
+        if not overlap:
+            self._overlap_reduced = None
+            _ag.backward(heads, head_grads)
+            return
+        policy, feedback = self._compression()
+        epoch = kv.membership_epoch() if hasattr(kv, "membership_epoch") \
+            else 0
+        items = [(i, p.grad()) for i, p in pairs]
+        _, buckets = kv_mod.plan_buckets(
+            items, names=[p.name for _, p in pairs],
+            compression=policy, epoch=epoch)
+        kv_mod.retain_feedback(policy, feedback, epoch)
+        pos_of = {id(p._data): n for n, (_, p) in enumerate(pairs)}
+        bucket_of = {}
+        remaining = []
+        for b, bucket in enumerate(buckets):
+            remaining.append(len(bucket["positions"]))
+            for pos in bucket["positions"]:
+                bucket_of[pos] = b
+        launched = {}
+        # the full plan survives into step(): leftover buckets (params the
+        # loss never touched) execute from the SAME plan, so bucket keys —
+        # and the error-feedback residuals hung off them — stay stable.
+        # ``launched`` records each reduced bucket's grad VERSIONS so
+        # step() can tell this plan from a stale one (an abandoned step
+        # followed by a fresh plain backward must re-reduce everything).
+        self._overlap_reduced = {
+            "buckets": buckets, "items": items, "policy": policy,
+            "feedback": feedback, "launched": launched,
+        }
+
+        def on_ready(leaf):
+            pos = pos_of.get(id(leaf))
+            if pos is None:
+                return   # a leaf this trainer doesn't own
+            b = bucket_of[pos]
+            remaining[b] -= 1
+            if remaining[b] == 0:
+                # bucket complete: launch its pushpull NOW — the walk (and
+                # the device's VJPs) continue while the wire carries it
+                kv_mod.execute_bucket(kv, buckets[b], items, policy,
+                                      feedback)
+                launched[b] = tuple(items[q][1]._version
+                                    for q in buckets[b]["positions"])
+                _profiler.incr("allreduce_overlap_launched")
+
+        try:
+            _ag.backward(heads, head_grads, grad_ready_hook=on_ready)
+        except BaseException:
+            # the step is lost (docs/step_fold.md failure contract): drop
+            # the plan so a RECOVERY backward + step() re-reduces
+            # everything instead of skipping the buckets this failed walk
+            # marked launched — stale skips would silently diverge workers
+            self._overlap_reduced = None
+            raise
+
     def allreduce_grads(self):
         """Aggregate gradients across devices/hosts via the kvstore facade
         (single-replica SPMD: aggregation happened inside the compiled step
         via psum, so this is a no-op unless a dist kvstore is attached).
         Against a dist store the grads travel as size-capped flat buckets —
-        a few big pushpulls instead of one per parameter."""
+        a few big pushpulls instead of one per parameter.  Buckets already
+        pushed by ``Trainer.backward``'s grad-readiness overlap are
+        skipped (their grads hold reduced values)."""
         if not self._kv_initialized:
             self._init_kvstore()
+        overlap, self._overlap_reduced = self._overlap_reduced, None
         if self._kvstore is None:
             return
         from .. import kvstore as kv_mod
 
+        if overlap is not None:
+            # the plan is only valid if no backward re-wrote the reduced
+            # grads since their buckets were pushed (versions unchanged) —
+            # an abandoned overlap step followed by a plain backward must
+            # NOT have its fresh grads skipped here
+            fresh = all(
+                tuple(overlap["items"][q][1]._version
+                      for q in overlap["buckets"][b]["positions"]) == vers
+                for b, vers in overlap["launched"].items())
+            if fresh:
+                # Trainer.backward pushed the ready buckets mid-backward;
+                # finish the leftovers from the SAME plan
+                for b, bucket in enumerate(overlap["buckets"]):
+                    if b not in overlap["launched"]:
+                        kv_mod.execute_bucket(self._kvstore, bucket,
+                                              overlap["items"],
+                                              overlap["policy"],
+                                              overlap["feedback"])
+                return
+            # stale plan: fall through to the normal full aggregation
         pairs = [(i, p) for i, p in enumerate(self._params)
                  if p.grad_req != "null" and p._data is not None
                  and p._data._grad is not None]
+        if not pairs:
+            return
         if (len(pairs) > 1 and kv_mod.bucket_bytes() > 0
                 and self._kvstore.supports_grad_bucketing()):
-            from .. import comm
-
-            policy = comm.resolve_policy()   # MXNET_GRAD_COMPRESS tier
-            feedback = None
-            if policy is not None and policy.error_feedback:
-                if self._grad_feedback is None:
-                    self._grad_feedback = comm.ErrorFeedback()
-                feedback = self._grad_feedback
+            policy, feedback = self._compression()
             kv_mod.bucketed_pushpull(self._kvstore,
                                      [(i, p.grad()) for i, p in pairs],
                                      names=[p.name for _, p in pairs],
@@ -192,6 +307,47 @@ class Trainer:
             return
         for i, p in pairs:
             self._kvstore.pushpull(i, p.grad(), out=p.grad())
+
+    def _compression(self):
+        """The gradient-compression policy (``MXNET_GRAD_COMPRESS`` tier)
+        + this trainer's lazily-created ErrorFeedback — ONE resolution
+        rule for every exchange entry (``allreduce_grads``, the overlap
+        ``backward``), so the paths can never build different wire
+        formats."""
+        from .. import comm
+
+        policy = comm.resolve_policy()
+        feedback = None
+        if policy is not None and policy.error_feedback:
+            if self._grad_feedback is None:
+                self._grad_feedback = comm.ErrorFeedback()
+            feedback = self._grad_feedback
+        return policy, feedback
+
+    def fold_step(self, loss_fn, block=None, keep_grads=False):
+        """Build the FOLDED training step for this trainer: ONE compiled,
+        donated-buffer program running Block forward + loss + backward +
+        (dist) gradient allreduce + the fused optimizer tail per call —
+        the ``SPMDTrainer`` discipline on the imperative Trainer contract
+        (docs/step_fold.md).
+
+        ``loss_fn(*batch) -> loss NDArray`` computes the loss from the
+        batch NDArrays (calling the Block(s) whose Parameters this
+        trainer owns).  Returns a :class:`~.step_fold.StepProgram`;
+        ``program(data, label)`` replaces the whole
+        record/forward/backward/``step()`` sequence and returns the loss.
+        Escape hatches: ``MXNET_STEP_FOLD=0``, ``block=`` with
+        ``_step_fold_opt_out``, or any unsupported construct — all fall
+        back to the eager path (``step_fold_fallback`` counter), never
+        erroring."""
+        import weakref as _weakref
+
+        from . import step_fold as _sf
+
+        sp = _sf.StepProgram(self, loss_fn, block=block,
+                             keep_grads=keep_grads)
+        self._fold = _weakref.ref(sp)
+        return sp
 
     def update(self, batch_size, ignore_stale_grad=False):
         """Optimizer update only (assumes grads already aggregated)."""
@@ -232,8 +388,14 @@ class Trainer:
         self._account_memory(touched)
         # fused whole-group fast path; leftovers (unsupported optimizer,
         # lazy row-sparse params, NaiveEngine, aggregation disabled) take
-        # the per-tensor loop below
-        rest = _fused.fused_update(
+        # the per-tensor loop below.  MXNET_STEP_FOLD=1 folds EVERY group
+        # into one donated dispatch (step_fold.fold_update) instead of one
+        # group_apply per group — the step() half of the step fold.
+        from . import step_fold as _sf
+
+        updater = (_sf.fold_update if _sf.step_fast_path()
+                   else _fused.fused_update)
+        rest = updater(
             self._optimizer,
             [(i, p.data(), p.grad()) for i, p in touched],
             self._states)
@@ -302,6 +464,12 @@ class Trainer:
 
         from ..checkpoint import atomic_write_bytes
 
+        fold = self._fold() if self._fold is not None else None
+        if fold is not None:
+            # a multi-process fold holds params/states in donated global
+            # registers; pull them into the live NDArrays first so the
+            # snapshot sees the current trajectory (no-op for local folds)
+            fold.sync()
         flat = {}
         for i, st in self._states.items():
             flat[i] = _states_to_numpy(st)
@@ -336,6 +504,11 @@ class Trainer:
         self._optimizer._index_update_count = dict(counts)
         self._optimizer.num_update = num_update
         self._optimizer.begin_num_update = num_update
+        fold = self._fold() if self._fold is not None else None
+        if fold is not None:
+            # restored state lives in the Parameter/state NDArrays now; a
+            # multi-process fold must re-stage its registers from them
+            fold.invalidate()
         fb = payload.get("grad_feedback")
         if fb:
             from .. import comm

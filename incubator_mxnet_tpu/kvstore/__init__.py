@@ -1,8 +1,10 @@
 from .kvstore import (KVStore, KVStoreLocal, KVStoreDist, KVStoreDistAsync,
-                      bucket_bytes, bucketed_pushpull, create)
+                      bucket_bytes, bucketed_pushpull, plan_buckets,
+                      execute_bucket, retain_feedback, create)
 
 __all__ = ["KVStore", "KVStoreLocal", "KVStoreDist", "KVStoreDistAsync",
-           "bucket_bytes", "bucketed_pushpull", "create",
+           "bucket_bytes", "bucketed_pushpull", "plan_buckets",
+           "execute_bucket", "retain_feedback", "create",
            "PSError", "PSKeyError", "PSProtocolError", "PSTimeoutError"]
 
 _ASYNC_PS_NAMES = ("PSError", "PSKeyError", "PSProtocolError",

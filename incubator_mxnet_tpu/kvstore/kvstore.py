@@ -52,7 +52,8 @@ from .. import profiler as _profiler
 from ..ndarray.ndarray import NDArray, array, zeros
 
 __all__ = ["KVStore", "KVStoreLocal", "KVStoreDist", "KVStoreDistAsync",
-           "bucket_bytes", "bucketed_pushpull", "create"]
+           "bucket_bytes", "bucketed_pushpull", "plan_buckets",
+           "execute_bucket", "retain_feedback", "create"]
 
 
 # -- bucketed gradient allreduce --------------------------------------------
@@ -131,6 +132,147 @@ def _flatten(raws):
     return out
 
 
+def plan_buckets(items, names=None, cap_bytes=None, compression=None,
+                 epoch=0):
+    """THE deterministic bucket-assignment rule (input order, split per
+    (dtype, context, codec), size-capped), shared by
+    :func:`bucketed_pushpull` and the Trainer's grad-readiness overlap
+    hook (``Trainer.backward`` — docs/step_fold.md): both must format
+    IDENTICAL buckets or peers' collectives would split.
+
+    Returns ``(policy, buckets)`` where each bucket is a dict holding the
+    wire key, the codec (or None for exact fp32), the positions of its
+    member ``items``, and the raw fp32 byte count.  Only METADATA is read
+    (dtype/shape/context) — gradient values may still be pending, so the
+    plan can be drawn up before backward runs."""
+    import numpy as np
+
+    from ..comm import compression as _comp
+
+    cap = bucket_bytes() if cap_bytes is None else cap_bytes
+    policy = _comp.resolve_policy(compression)
+    by_group = {}   # (dtype, ctx, codec_id) -> [(position, codec)]
+    codecs = {"fp32": None}
+    for i, (key, g) in enumerate(items):
+        codec = None
+        if policy is not None and str(g.dtype) == "float32":
+            codec = policy.codec_for(names[i] if names is not None else None)
+        cid = codec.id if codec is not None else "fp32"
+        codecs.setdefault(cid, codec)
+        # group by (dtype, context, codec): a flat bucket lives on ONE
+        # device under ONE wire format, and the scattered pieces are
+        # written back without a placement probe
+        by_group.setdefault((str(g.dtype), str(g.context), cid),
+                            []).append(i)
+    buckets = []
+    bucket_id = 0
+    for (dt, _ctx, cid), members in by_group.items():
+        itemsize = np.dtype(dt).itemsize
+        start = 0
+        while start < len(members):
+            end, nbytes = start, 0
+            while end < len(members):
+                sz = items[members[end]][1].size * itemsize
+                if end > start and nbytes + sz > cap:
+                    break
+                nbytes += sz
+                end += 1
+            # membership epoch + codec id namespace the bucket keys: any
+            # store-side state hung off a key (compression residuals) must
+            # not survive a worker-set change, and a worker toggling
+            # MXNET_GRAD_COMPRESS mid-run renames its buckets so the
+            # wire-agreement check fails loudly instead of peers decoding
+            # each other's garbage
+            buckets.append({
+                "key": f"__grad_bucket__:{epoch}:{cid}:{dt}:{bucket_id}",
+                "codec": codecs[cid],
+                "cid": cid,
+                "positions": tuple(members[start:end]),
+                "nbytes": nbytes,
+            })
+            bucket_id += 1
+            start = end
+    return policy, buckets
+
+
+def execute_bucket(kv, bucket, items, policy, feedback):
+    """Allreduce ONE planned bucket through ``kv`` and scatter the reduced
+    values back into its members' grad buffers in place.  The per-bucket
+    wire: agreement check, jitted flatten, plain pushpull or the codec
+    exchange (docs/gradient_compression.md), jitted scatter, counters +
+    span.  Raises loudly — never scatters — when the wire fails (including
+    the ``kvstore.bucket_drop_reply`` fault point of the chaos tier)."""
+    from ..engine import DeferredArray
+    from ..comm import compression as _comp
+    from ..utils import faultinject
+
+    t0 = _perf() if _profiler._active else None
+    chunk = [items[i] for i in bucket["positions"]]
+    grads = [g for _, g in chunk]
+    raws = []
+    for g in grads:
+        raw = g._data
+        if isinstance(raw, DeferredArray):  # pending bulk op: flush first
+            raw = raw._resolve()
+            g._data = raw
+        raws.append(raw)
+    codec = bucket["codec"]
+    bkey = bucket["key"]
+    nbytes = bucket["nbytes"]
+    use_ef = (feedback is not None and policy is not None
+              and policy.error_feedback and codec is not None)
+    # EVERY bucket enters the agreement check, fp32 ones included: the
+    # asymmetric toggle (one worker compressed, a peer off) is exactly the
+    # case where the off worker would otherwise issue a plain fp32
+    # pushpull against the peer's scale/code collectives and deadlock
+    # instead of failing loudly
+    if hasattr(kv, "check_wire_agreement"):
+        kv.check_wire_agreement(bkey)
+    if codec is None:
+        flat = NDArray(_flatten(raws), ctx=grads[0].context)
+        kv.pushpull(bkey, flat, out=flat)
+        reduced, wire_bytes, codec_s = flat._data, nbytes, 0.0
+    else:
+        flat = _flatten(raws)
+        if use_ef:
+            flat = feedback.compensate(bkey, flat)
+        reduced, resid, wire_bytes, codec_s = _comp.bucket_allreduce(
+            codec, flat, kv.wire_allreduce)
+        if use_ef:
+            feedback.update(bkey, resid)
+    if faultinject.active() and faultinject.fire("kvstore.bucket_drop_reply"):
+        # chaos tier: the reduced payload never arrives.  Raise BEFORE the
+        # scatter so the member grads keep their pre-exchange values — a
+        # dropped reply must error loudly, never half-write a bucket.
+        raise faultinject.FaultInjected(
+            f"injected fault: reply for gradient bucket {bkey!r} dropped")
+    pieces = _unflatten(reduced, [r.shape for r in raws])
+    for g, piece in zip(grads, pieces):
+        g._data = piece
+        g._version += 1
+    _profiler.incr("allreduce_bucket")
+    _profiler.incr("allreduce_bucket_params", len(chunk))
+    _comp.account(nbytes, wire_bytes, codec_s)
+    if t0 is not None:
+        # the nested kvstore.pushpull span carries the wire time; this one
+        # adds flatten/codec/scatter overhead + the raw vs encoded payload
+        # sizes (tools/trace_report.py comms)
+        _profiler.record_span("kvstore.bucketed_pushpull", "comms",
+                              t0, args={"params": len(chunk),
+                                        "bytes": nbytes,
+                                        "bytes_raw": nbytes,
+                                        "bytes_wire": wire_bytes,
+                                        "codec": bucket["cid"]})
+
+
+def retain_feedback(policy, feedback, epoch):
+    """Drop error-feedback residuals from other epochs/codecs — they
+    describe a wire format that no longer exists.  Must run once per step
+    BEFORE the first bucket executes (both entry points call it)."""
+    if feedback is not None and policy is not None and policy.error_feedback:
+        feedback.retain(f"__grad_bucket__:{epoch}:{policy.id}:")
+
+
 def bucketed_pushpull(kv, items, cap_bytes=None, names=None,
                       compression=None, feedback=None):
     """Allreduce ``items`` (list of ``(key, grad_nd)``) through ``kv`` as
@@ -148,101 +290,12 @@ def bucketed_pushpull(kv, items, cap_bytes=None, names=None,
     opted-out groups keep their own fp32 buckets and stay bit-exact.
     ``feedback`` (a ``comm.ErrorFeedback``) carries per-bucket residuals
     across steps when the policy enables error feedback."""
-    import numpy as np
-
-    from ..engine import DeferredArray
-    from ..comm import compression as _comp
-
-    cap = bucket_bytes() if cap_bytes is None else cap_bytes
-    policy = _comp.resolve_policy(compression)
-    # membership epoch namespaces the bucket keys: any store-side state a
-    # backend hangs off a bucket key (e.g. a compression residual) must NOT
-    # survive a change in the contributing worker set — stale error
-    # feedback from a departed worker would be re-injected forever.  The
-    # codec id rides the key the same way (satellite of ISSUE 14): a worker
-    # toggling compression mid-run renames its buckets, and the dist
-    # store's wire-agreement check turns that into a loud error instead of
-    # peers decoding each other's garbage.
     epoch = kv.membership_epoch() if hasattr(kv, "membership_epoch") else 0
-    by_group = {}   # (dtype, ctx, codec_id) -> [(key, grad, raw)]
-    codecs = {"fp32": None}
-    for i, (key, g) in enumerate(items):
-        raw = g._data
-        if isinstance(raw, DeferredArray):  # pending bulk op: flush first
-            raw = raw._resolve()
-            g._data = raw
-        codec = None
-        if policy is not None and str(raw.dtype) == "float32":
-            codec = policy.codec_for(names[i] if names is not None else None)
-        cid = codec.id if codec is not None else "fp32"
-        codecs.setdefault(cid, codec)
-        # group by (dtype, context, codec): a flat bucket lives on ONE
-        # device under ONE wire format, and the scattered pieces are
-        # written back without a placement probe
-        by_group.setdefault((str(raw.dtype), str(g.context), cid),
-                            []).append((key, g, raw))
-    use_ef = (feedback is not None and policy is not None
-              and policy.error_feedback)
-    if use_ef:
-        # drop residuals from other epochs/codecs — they describe a wire
-        # format that no longer exists
-        feedback.retain(f"__grad_bucket__:{epoch}:{policy.id}:")
-    bucket_id = 0
-    for (dt, _ctx, cid), members in by_group.items():
-        codec = codecs[cid]
-        itemsize = np.dtype(dt).itemsize
-        start = 0
-        while start < len(members):
-            end, nbytes = start, 0
-            while end < len(members):
-                sz = members[end][2].size * itemsize
-                if end > start and nbytes + sz > cap:
-                    break
-                nbytes += sz
-                end += 1
-            chunk = members[start:end]
-            start = end
-            t0 = _perf() if _profiler._active else None
-            grads = [g for _, g, _ in chunk]
-            raws = [r for _, _, r in chunk]
-            bkey = f"__grad_bucket__:{epoch}:{cid}:{dt}:{bucket_id}"
-            bucket_id += 1
-            # EVERY bucket enters the agreement check, fp32 ones included:
-            # the asymmetric toggle (one worker compressed, a peer off) is
-            # exactly the case where the off worker would otherwise issue
-            # a plain fp32 pushpull against the peer's scale/code
-            # collectives and deadlock instead of failing loudly
-            if hasattr(kv, "check_wire_agreement"):
-                kv.check_wire_agreement(bkey)
-            if codec is None:
-                flat = NDArray(_flatten(raws), ctx=grads[0].context)
-                kv.pushpull(bkey, flat, out=flat)
-                reduced, wire_bytes, codec_s = flat._data, nbytes, 0.0
-            else:
-                flat = _flatten(raws)
-                if use_ef:
-                    flat = feedback.compensate(bkey, flat)
-                reduced, resid, wire_bytes, codec_s = _comp.bucket_allreduce(
-                    codec, flat, kv.wire_allreduce)
-                if use_ef:
-                    feedback.update(bkey, resid)
-            pieces = _unflatten(reduced, [r.shape for r in raws])
-            for g, piece in zip(grads, pieces):
-                g._data = piece
-                g._version += 1
-            _profiler.incr("allreduce_bucket")
-            _profiler.incr("allreduce_bucket_params", len(chunk))
-            _comp.account(nbytes, wire_bytes, codec_s)
-            if t0 is not None:
-                # the nested kvstore.pushpull span carries the wire time;
-                # this one adds flatten/codec/scatter overhead + the raw
-                # vs encoded payload sizes (tools/trace_report.py comms)
-                _profiler.record_span("kvstore.bucketed_pushpull", "comms",
-                                      t0, args={"params": len(chunk),
-                                                "bytes": nbytes,
-                                                "bytes_raw": nbytes,
-                                                "bytes_wire": wire_bytes,
-                                                "codec": cid})
+    policy, buckets = plan_buckets(items, names=names, cap_bytes=cap_bytes,
+                                   compression=compression, epoch=epoch)
+    retain_feedback(policy, feedback, epoch)
+    for bucket in buckets:
+        execute_bucket(kv, bucket, items, policy, feedback)
 
 
 def create(name="local"):

@@ -33,7 +33,7 @@ from .. import profiler as _profiler
 from ..ops import optimizer_ops as K
 from .optimizer import LAMB, NAG, RMSProp, SGD, Adam, AdamW, _swap
 
-__all__ = ["fused_update", "supports", "donation_enabled",
+__all__ = ["fused_update", "plan_groups", "supports", "donation_enabled",
            "quantization_sensitive"]
 
 
@@ -155,16 +155,16 @@ def _concrete(nd):
     return raw
 
 
-def fused_update(optimizer, items, states):
-    """Update every supported ``(index, weight, grad)`` in ``items`` via
-    grouped single-dispatch jitted calls; returns the leftover items the
-    caller must update per-tensor.  ``states`` maps index -> the state the
-    per-tensor path would use — the SAME NDArray objects are swapped in
-    place, so fused and per-tensor steps are interchangeable mid-training.
-    """
-    agg = int(getattr(optimizer, "aggregate_num", 0) or 0)
-    if agg <= 1 or not items or _engine._engine_type == "NaiveEngine":
-        return items
+def plan_groups(optimizer, items, states):
+    """THE fused-group planning rule, shared by :func:`fused_update` and
+    the step fold (``gluon/step_fold.py``): map ``(index, weight, grad)``
+    triples onto their fused step adapters, grouped by
+    ``(adapter, dtype, context)``.  Returns ``(groups, rest)`` where
+    ``groups`` is an insertion-ordered dict ``key -> [(i, w, g, flat)]``
+    (``flat`` = the adapter's flat tuple of state NDArrays, aliasing
+    ``states[i]``) and ``rest`` collects the items with no fused kernel
+    (unsupported optimizer, lazy row-sparse, mp fallbacks) that must take
+    the per-tensor path."""
     groups, rest = {}, []
     for item in items:
         i, w, g = item
@@ -175,6 +175,20 @@ def fused_update(optimizer, items, states):
         step, flat = sel
         key = (step, str(w.dtype), str(w.context))
         groups.setdefault(key, []).append((i, w, g, flat))
+    return groups, rest
+
+
+def fused_update(optimizer, items, states):
+    """Update every supported ``(index, weight, grad)`` in ``items`` via
+    grouped single-dispatch jitted calls; returns the leftover items the
+    caller must update per-tensor.  ``states`` maps index -> the state the
+    per-tensor path would use — the SAME NDArray objects are swapped in
+    place, so fused and per-tensor steps are interchangeable mid-training.
+    """
+    agg = int(getattr(optimizer, "aggregate_num", 0) or 0)
+    if agg <= 1 or not items or _engine._engine_type == "NaiveEngine":
+        return items
+    groups, rest = plan_groups(optimizer, items, states)
     if groups:
         donate = donation_enabled()
         scalars = _scalars(optimizer)

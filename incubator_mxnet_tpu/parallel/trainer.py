@@ -29,7 +29,7 @@ from .. import autograd
 from .. import optimizer as opt_mod
 from ..ndarray.ndarray import NDArray
 from ..random import get_key, push_traced_key, pop_traced_key
-from ..gluon.block import _aux_stack, _tls as _block_tls
+from ..gluon.block import _tls as _block_tls
 from ..gluon.parameter import ParameterDict
 from .mesh import current_mesh, local_mesh
 from .sharding import ShardingRules, default_rules, batch_pspec, param_sharding
@@ -897,44 +897,29 @@ class SPMDTrainer:
             full = list(full_arrs)
             for j, arr in zip(trainable_idx, train_arrs):
                 full[j] = arr
-            saved = []
-            for p, a in zip(params, full):
-                saved.append(getattr(p, "_traced_data", None))
-                p._traced_data = NDArray(a)
-            push_traced_key(key)
-            collector = []
-            _aux_stack().append(collector)
-            prev = getattr(_block_tls, "tracing", 0)
-            _block_tls.tracing = prev + 1
+            from ..gluon.block import trace_scope
             from ..gluon.model_zoo import moe as moe_mod
-            try:
-                with autograd._scope(False, True):  # training=True, no tape
-                    with moe_mod.moe_loss_frame() as moe_fr:
-                        ins = [NDArray(b) for b in batch[:n_inputs]]
-                        out = block(*ins)
-                        label = NDArray(batch[n_inputs])
-                        loss = loss_fn(out, label)
-                    # Differentiate the SUM (matching ``loss.backward()`` on a
-                    # vector loss: implicit ones head-grads); Trainer-parity
-                    # mean-reduction comes from rescale_grad = 1/batch_size.
-                    loss_data = loss._data.astype(jnp.float32)
-                    loss_scalar = jnp.sum(loss_data)
-                    loss_mean = jnp.mean(loss_data)
-                    # MoE auxiliary losses (load balance + router z) join
-                    # the differentiated scalar; routing metrics leave the
-                    # program as extras for host-side counters/gauges
-                    moe_side = moe_mod.frame_loss(moe_fr)
-                    if moe_side is not None:
-                        if isinstance(moe_side, NDArray):
-                            moe_side = moe_side._data
-                        loss_scalar = loss_scalar + moe_side.astype(jnp.float32)
-                    extras = _moe_extras(moe_mod.frame_metrics(moe_fr))
-            finally:
-                _block_tls.tracing = prev
-                _aux_stack().pop()
-                pop_traced_key()
-                for p, s in zip(params, saved):
-                    p._traced_data = s
+            with trace_scope(params, full, key, True) as collector:
+                with moe_mod.moe_loss_frame() as moe_fr:
+                    ins = [NDArray(b) for b in batch[:n_inputs]]
+                    out = block(*ins)
+                    label = NDArray(batch[n_inputs])
+                    loss = loss_fn(out, label)
+                # Differentiate the SUM (matching ``loss.backward()`` on a
+                # vector loss: implicit ones head-grads); Trainer-parity
+                # mean-reduction comes from rescale_grad = 1/batch_size.
+                loss_data = loss._data.astype(jnp.float32)
+                loss_scalar = jnp.sum(loss_data)
+                loss_mean = jnp.mean(loss_data)
+                # MoE auxiliary losses (load balance + router z) join
+                # the differentiated scalar; routing metrics leave the
+                # program as extras for host-side counters/gauges
+                moe_side = moe_mod.frame_loss(moe_fr)
+                if moe_side is not None:
+                    if isinstance(moe_side, NDArray):
+                        moe_side = moe_side._data
+                    loss_scalar = loss_scalar + moe_side.astype(jnp.float32)
+                extras = _moe_extras(moe_mod.frame_metrics(moe_fr))
             if not aux_idx_cell:
                 idx_map = {id(p): i for i, p in enumerate(params)}
                 aux_idx_cell.append([idx_map[id(p)] for p, _ in collector])
@@ -1122,33 +1107,21 @@ class SPMDTrainer:
                 objs = stage_objs[s]
 
                 def fn(st_arrs, h):
-                    saved = []
-                    for p, a in zip(objs, st_arrs):
-                        saved.append(getattr(p, "_traced_data", None))
-                        p._traced_data = NDArray(a)
+                    from ..gluon.block import trace_scope
+
                     # per-(stage, microbatch) PRNG: folding the stage alone
                     # would hand every microbatch the same dropout masks;
                     # the scheduler pins the slot around remat recomputes
                     # too, so the backward re-trace folds identically
                     slot = sched_mod.current_slot()
                     m_idx = 0 if slot is None else slot[1]
-                    push_traced_key(jax.random.fold_in(
-                        jax.random.fold_in(key, s), m_idx))
-                    collector = []
-                    _aux_stack().append(collector)
-                    prev = getattr(_block_tls, "tracing", 0)
-                    _block_tls.tracing = prev + 1
-                    try:
-                        with autograd._scope(False, True):
-                            with moe_mod.moe_loss_frame() as fr:
-                                ins = h if isinstance(h, tuple) else (h,)
-                                out = block(*[NDArray(b) for b in ins])
-                    finally:
-                        _block_tls.tracing = prev
-                        _aux_stack().pop()
-                        pop_traced_key()
-                        for p, sv in zip(objs, saved):
-                            p._traced_data = sv
+                    slot_key = jax.random.fold_in(
+                        jax.random.fold_in(key, s), m_idx)
+                    with trace_scope(objs, st_arrs, slot_key, True) \
+                            as collector:
+                        with moe_mod.moe_loss_frame() as fr:
+                            ins = h if isinstance(h, tuple) else (h,)
+                            out = block(*[NDArray(b) for b in ins])
                     side = moe_mod.frame_loss(fr)
                     if side is None:
                         side = jnp.zeros(())

@@ -192,6 +192,10 @@ _counters = {
     "fused_step_call": 0,             # grouped optimizer dispatches
     "fused_step_params": 0,           # params updated through fused groups
     "fused_step_fallback_params": 0,  # params that took the per-tensor loop
+    "step_fold_call": 0,              # folded-step single-program dispatches
+    "step_fold_fallback": 0,          # fold entries that ran the eager path
+    "allreduce_overlap_launched": 0,  # buckets pushed from the grad-readiness
+                                      # hook DURING backward (overlap path)
     "allreduce_bucket": 0,            # bucketed gradient pushpulls
     "allreduce_bucket_params": 0,     # grads carried by those buckets
     "comms_bytes_raw": 0,             # gradient bytes before compression

@@ -1,0 +1,112 @@
+"""Worker body for the 2-process step-fold tier: the IN-FOLD gradient
+exchange (forward/backward per worker shard inside one shard_map over the
+dist_sync worker mesh, per-bucket psum/codec allreduce nodes scheduled by
+XLA against the remaining backward) must train to the same trajectory as
+the out-of-fold path (eager backward + bucketed pushpull + fused update).
+
+Run at process_count == 2 via tools/launch_local.py (tests/test_step_fold
+launches it like tests/test_dist.py does its workers).  Exits non-zero on
+any failure; prints the marker line once per rank on success.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("MXNET_KVSTORE_BUCKET_BYTES", "2048")
+
+import numpy as np
+
+
+def main():
+    try:  # drop the tunneled-TPU backend registered by sitecustomize, if any
+        from jax._src import xla_bridge as _xb
+
+        _xb._backend_factories.pop("axon", None)
+    except Exception:
+        pass
+
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import autograd, gluon, profiler
+
+    L2 = gluon.loss.L2Loss()
+    kv = mx.kv.create("dist_sync")
+    rank, nw = kv.rank, kv.num_workers
+    assert nw == 2, nw
+
+    def build(seed):
+        mx.random.seed(seed)
+        np.random.seed(seed)
+        net = gluon.nn.HybridSequential()
+        net.add(gluon.nn.Dense(32, activation="relu"),
+                gluon.nn.Dense(32, activation="relu"),
+                gluon.nn.Dense(4))
+        net.initialize()
+        net.hybridize()
+        # per-rank local batch shard (different data per worker — the
+        # exchange has to actually carry information)
+        rs = np.random.RandomState(100 + rank)
+        x = mx.nd.array(rs.rand(8, 6).astype(np.float32))
+        y = mx.nd.array(rs.rand(8, 4).astype(np.float32))
+        net(mx.nd.zeros((2, 6)))
+        return net, x, y
+
+    # --- out-of-fold reference: eager backward + bucketed pushpull ------
+    net1, x, y = build(5)
+    tr1 = gluon.Trainer(net1.collect_params(), "sgd",
+                        {"learning_rate": 0.05}, kvstore=kv)
+    losses1 = []
+    for _ in range(6):
+        with autograd.record():
+            loss = L2(net1(x), y)
+        loss.backward()
+        tr1.step(8)
+        losses1.append(float(loss.mean().asscalar()))
+
+    # --- in-fold: ONE compiled program incl. per-bucket allreduce -------
+    kv2 = mx.kv.create("dist_sync")
+    net2, x2, y2 = build(5)
+    tr2 = gluon.Trainer(net2.collect_params(), "sgd",
+                        {"learning_rate": 0.05}, kvstore=kv2)
+    program = tr2.fold_step(lambda a, b: L2(net2(a), b), block=net2)
+    c0 = profiler.counters()
+    losses2 = []
+    for _ in range(6):
+        losses2.append(float(program(x2, y2).mean().asscalar()))
+    c1 = profiler.counters()
+    assert program.folded, program.fallback_reason
+    assert c1["step_fold_call"] - c0["step_fold_call"] == 6
+    assert c1["recompile_steady_state"] == c0["recompile_steady_state"], \
+        "in-fold dist step recompiled in steady state"
+
+    # local loss parity (this rank's shard, step for step) and global
+    # param parity: grads crossed the wire inside the program.  The dist
+    # fold holds params in donated global registers — sync them into the
+    # live Parameters before reading.
+    program.sync()
+    np.testing.assert_allclose(losses1, losses2, rtol=1e-4, atol=1e-6)
+    for pa, pb in zip(sorted(net1.collect_params().values(),
+                             key=lambda p: p.name),
+                      sorted(net2.collect_params().values(),
+                             key=lambda p: p.name)):
+        np.testing.assert_allclose(pa.data().asnumpy(),
+                                   pb.data().asnumpy(),
+                                   rtol=2e-4, atol=2e-6, err_msg=pa.name)
+
+    # save/load through the dist fold's global registers
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as d:
+        fname = os.path.join(d, f"states_{rank}")
+        tr2.save_states(fname)   # syncs fold registers first
+        tr2.load_states(fname)   # invalidates → next call re-stages
+    losses3 = [float(program(x2, y2).mean().asscalar()) for _ in range(2)]
+    assert all(np.isfinite(v) for v in losses3)
+
+    kv.barrier()
+    print(f"fold_worker rank {rank}/{nw}: all assertions passed",
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()

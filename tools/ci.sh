@@ -45,7 +45,11 @@
 #                  in the compressed SPMD step, or if the int8 tier stops
 #                  moving >= 3.5x fewer gradient bytes than fp32 on either
 #                  path — counter-verified) plus the compression tests
-#  12. tpu       — (opt-in: CI_TPU=1) on-chip correctness tier, needs a chip
+#  12. fold      — step-fold tier: the opperf harness in --smoke mode
+#                  (exits non-zero if a steady-state folded step is ever
+#                  more than ONE host dispatch or recompiles after
+#                  warmup) plus the fast fold/overlap tests
+#  13. tpu       — (opt-in: CI_TPU=1) on-chip correctness tier, needs a chip
 #
 # The unit tier is split in two so each invocation fits a ~10 min shell on
 # a 1-core box (the full suite exceeds one 600 s window there); `unit` is
@@ -86,7 +90,7 @@ TIERS=()
 for t in "$@"; do
     if [ "$t" = unit ]; then TIERS+=(unit1 unit2); else TIERS+=("$t"); fi
 done
-[ ${#TIERS[@]} -eq 0 ] && TIERS=(unit1 unit2 zoo dist examples bench profiler chaos serving io parallel comm)
+[ ${#TIERS[@]} -eq 0 ] && TIERS=(unit1 unit2 zoo dist examples bench profiler chaos serving io parallel comm fold)
 [ "${CI_TPU:-0}" = "1" ] && TIERS+=(tpu)
 
 declare -A RESULT
@@ -221,6 +225,16 @@ for tier in "${TIERS[@]}"; do
                 set -e
                 python benchmark/opperf/collectives.py --smoke >/dev/null
                 python -m pytest tests/test_grad_compression.py -q -m "not slow" '"${CI_PYTEST_ARGS:-}"
+            ;;
+        fold)
+            # step-fold tier: the opperf harness in --smoke mode IS the
+            # regression guard (non-zero exit if the folded step stops
+            # being exactly ONE host dispatch, or recompiles in steady
+            # state after warmup), then the fast fold/overlap tests
+            run_tier fold "${CPU_ENV[@]}" bash -c '
+                set -e
+                python benchmark/opperf/step_fold.py --smoke >/dev/null
+                python -m pytest tests/test_step_fold.py -q -m "not slow" '"${CI_PYTEST_ARGS:-}"
             ;;
         tpu)
             # on-chip tier: runs under the ambient axon env (NOT cpu-cleaned)

@@ -173,6 +173,21 @@ def report(reg, top=15, out=sys.stdout):
           f"{summ['steady_state_by_site'].get(site, 0):>8}{gflop:>10}"
           f"{mb:>10}\n")
 
+    # step-fold callout (docs/step_fold.md): the fold site compiles once
+    # per (batch signature, optimizer-group-set); ANY steady-state compile
+    # here means the single-program-per-step contract broke
+    fold_records = [r for r in records if r.get("site") == "gluon.step_fold"]
+    if fold_records:
+        progs = defaultdict(int)
+        for r in fold_records:
+            progs[str(r.get("program") or "step_fold")] += 1
+        steady_fold = summ["steady_state_by_site"].get("gluon.step_fold", 0)
+        w("\nStep fold (gluon.step_fold): "
+          + ", ".join(f"{p} x{n}" for p, n in sorted(progs.items()))
+          + (f" — {steady_fold} STEADY-STATE recompile(s): the one-"
+             "dispatch-per-step contract broke" if steady_fold
+             else " — zero steady-state recompiles") + "\n")
+
     if summ["culprits"]:
         w(f"\nTop recompile culprits (by wall cost):\n")
         w(f"{'site':<26}{'argument':<16}{'drift':<12}{'count':>6}"

@@ -128,6 +128,49 @@ def report(path, spans, other, top=15, bins=10, xplane=None,
         w(f"{name:<28}{cat:<12}{step if step is not None else '-':>6}"
           f"{dur / 1e3:>12.3f}\n")
 
+    # step-fold attribution (docs/step_fold.md): host-issued device
+    # dispatches PER STEP — the number whole-program folding exists to
+    # drive to 1.  A regression back to multi-dispatch (a fold falling
+    # back, an op escaping the fold) is visible here as the median
+    # jumping above 1 while trainer.step_fold spans are present.
+    _DISPATCH_SPANS = frozenset((
+        "dispatch.cache_hit", "dispatch.jit_compile", "dispatch.fallback",
+        "dispatch.raw", "dispatch.backward", "bulk.flush",
+        "fused.group_apply", "kvstore.pushpull", "kvstore.push",
+        "kvstore.pull", "kvstore.bucketed_pushpull", "trainer.step_fold",
+    ))
+    # one bucket exchange = ONE dispatch: its kvstore.pushpull (or
+    # push+pull) children nest inside the kvstore.bucketed_pushpull span
+    # and must not count again
+    _WIRE_CHILDREN = frozenset(("kvstore.pushpull", "kvstore.push",
+                                "kvstore.pull"))
+    buckets_by_pid = defaultdict(list)   # pid -> [(ts, ts_end)]
+    for name, _cat, ts, dur, _, _, pid in spans:
+        if name == "kvstore.bucketed_pushpull":
+            buckets_by_pid[pid].append((ts, ts + dur))
+    per_step = defaultdict(int)
+    fold_steps = set()
+    for name, _cat, ts, _, step, _, pid in spans:
+        if step is None or name not in _DISPATCH_SPANS:
+            continue
+        if name in _WIRE_CHILDREN and any(
+                lo <= ts <= hi for lo, hi in buckets_by_pid.get(pid, ())):
+            continue
+        per_step[step] += 1
+        if name == "trainer.step_fold":
+            fold_steps.add(step)
+    if per_step:
+        counts = sorted(per_step.values())
+        med = counts[len(counts) // 2]
+        w("\nHost dispatches per step "
+          f"({len(per_step)} steps with dispatch spans): "
+          f"median {med}, min {counts[0]}, max {counts[-1]}")
+        if fold_steps:
+            fold_counts = sorted(per_step[s] for s in fold_steps)
+            w(f"; folded steps: {len(fold_steps)} "
+              f"(median {fold_counts[len(fold_counts) // 2]} dispatch/step)")
+        w("\n")
+
     # gradient-exchange payloads (docs/gradient_compression.md): the
     # bucketed-pushpull and spmd-step spans carry bytes_raw/bytes_wire
     # args; per-pid aggregation = per-RANK in a merged trace, so
