@@ -134,16 +134,37 @@ def save_sharded(prefix, step, trainer, blocking=True, keep=None):
                 json.dump(meta, f)
             os.replace(mpath + ".tmp", mpath)
         if keep:
-            my_shards = sorted(glob.glob(f"{prefix}-*.shard{proc}.npz"))
-            for old in my_shards[:-keep]:
+            # keep-by-commit-marker, NOT keep-by-count-of-files: the
+            # shmeta is the commit marker, and an interrupted later write
+            # leaves shard files with no shmeta — counting those toward
+            # ``keep`` would age out the newest COMMITTED step's shards.
+            committed = []
+            for mpath in sorted(glob.glob(f"{prefix}-*.shmeta")):
+                try:
+                    with open(mpath) as f:
+                        committed.append(int(json.load(f)["step"]))
+                except (OSError, ValueError, KeyError, json.JSONDecodeError):
+                    continue
+            committed.sort()
+            keep_steps = set(committed[-keep:])
+            newest = committed[-1] if committed else step
+            for old in glob.glob(f"{prefix}-*.shard{proc}.npz"):
+                try:
+                    s = int(os.path.basename(old)[len(os.path.basename(prefix)) + 1:].split(".", 1)[0])
+                except ValueError:
+                    continue
+                # steps newer than the newest commit may still be
+                # mid-write on a peer — never prune those
+                if s in keep_steps or s > newest:
+                    continue
                 try:
                     os.remove(old)
                 except OSError:
                     pass
             if proc == 0:
-                for old in sorted(glob.glob(f"{prefix}-*.shmeta"))[:-keep]:
+                for s in committed[:-keep]:
                     try:
-                        os.remove(old)
+                        os.remove(f"{prefix}-{s:07d}.shmeta")
                     except OSError:
                         pass
 
@@ -303,19 +324,41 @@ class CheckpointManager:
             ).encode())
             self._gc(step)
 
-    def _gc(self, newest_step):
-        metas = sorted(glob.glob(f"{self._prefix}-*.meta"))
-        for old in metas[:-self._keep] if self._keep else []:
+    def _meta_files(self, meta):
+        base = os.path.dirname(self._prefix) or "."
+        return [os.path.join(base, meta[key])
+                for key in ("params", "states") if meta.get(key)]
+
+    def _complete_metas(self, reverse=False):
+        """[(meta_path, meta_dict)] for every checkpoint whose meta (the
+        commit marker — written last) AND every file it references exist;
+        sorted oldest-first unless ``reverse``."""
+        out = []
+        for mpath in sorted(glob.glob(f"{self._prefix}-*.meta"),
+                            reverse=reverse):
             try:
-                with open(old) as f:
+                with open(mpath) as f:
                     meta = json.load(f)
-                base = os.path.dirname(self._prefix) or "."
-                for key in ("params", "states"):
-                    if meta.get(key):
-                        p = os.path.join(base, meta[key])
-                        if os.path.exists(p):
-                            os.remove(p)
-                os.remove(old)
+            except (OSError, ValueError, json.JSONDecodeError):
+                continue
+            if all(os.path.exists(p) for p in self._meta_files(meta)):
+                out.append((mpath, meta))
+        return out
+
+    def _gc(self, newest_step):
+        # keep-by-commit-marker: only COMPLETE checkpoints (meta + every
+        # referenced file present) count toward ``keep``, so a later
+        # interrupted write — or a meta whose data files were torn away —
+        # can never age out the newest restorable snapshot
+        if not self._keep:
+            return
+        complete = self._complete_metas()
+        for mpath, meta in complete[:-self._keep]:
+            try:
+                for p in self._meta_files(meta):
+                    if os.path.exists(p):
+                        os.remove(p)
+                os.remove(mpath)
             except OSError:
                 pass
 
@@ -345,11 +388,12 @@ class CheckpointManager:
 
     # ------------------------------------------------------------------
     def latest_step(self):
-        metas = sorted(glob.glob(f"{self._prefix}-*.meta"))
-        if not metas:
-            return None
-        with open(metas[-1]) as f:
-            return json.load(f)["step"]
+        """Newest COMPLETE checkpoint's step — a meta whose referenced
+        files went missing (torn write, external deletion) is skipped in
+        favor of the next older complete one, never half-restored."""
+        for _mpath, meta in self._complete_metas(reverse=True):
+            return meta["step"]
+        return None
 
     def restore(self):
         """Load the newest complete checkpoint into net/trainer.  Returns

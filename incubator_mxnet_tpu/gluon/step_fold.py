@@ -170,7 +170,15 @@ class StepProgram:
         if any(p._deferred_init is not None or p._data is None
                for p in tr._params):
             return self._eager_step(nds, batch_size)
-        return self._folded_step(nds, batch_size)
+        # the folded program embeds the gradient collectives — arm the
+        # collective watchdog around the whole dispatch (import at call
+        # time: gluon must not import the parallel package at load)
+        from ..parallel import elastic as _elastic
+        _elastic.watchdog_arm("step_fold.call")
+        try:
+            return self._folded_step(nds, batch_size)
+        finally:
+            _elastic.watchdog_disarm()
 
     def sync(self):
         """Write fold-held state back into the live Parameters/Trainer

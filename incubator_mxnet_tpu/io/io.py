@@ -231,6 +231,45 @@ class NDArrayIter(DataIter):
         base = self._epoch_order()
         self._order = _np.concatenate([self._carry, base]) if len(self._carry) else base
 
+    def state_dict(self):
+        """Everything needed to resume THIS iterator mid-epoch with the
+        exact remaining batch sequence (elastic run snapshots —
+        ``parallel.elastic.RunCheckpoint``): the cursor pair, the
+        roll_over carry, the epoch's materialized index order, and the
+        shared RNG stream so every FUTURE epoch re-permutes identically
+        on every host."""
+        return {
+            "kind": "NDArrayIter",
+            "cursor": int(self.cursor),
+            "consumed": int(self._consumed),
+            "carry": self._carry.copy(),
+            "order": self._order.copy(),
+            "rng": self._rng.get_state(),
+            "num_parts": self.num_parts,
+            "part_index": self.part_index,
+        }
+
+    def load_state_dict(self, state):
+        """Restore :meth:`state_dict` output.  The iterator must be built
+        over the same data with the same batch_size/sharding — the saved
+        order indexes the ORIGINAL arrays; a part-layout mismatch raises
+        (an elastic resize must restart the epoch instead)."""
+        if state.get("kind") not in (None, "NDArrayIter"):
+            raise ValueError(
+                f"not an NDArrayIter state: {state.get('kind')!r}")
+        if (int(state.get("num_parts", self.num_parts)) != self.num_parts
+                or int(state.get("part_index", self.part_index))
+                != self.part_index):
+            raise ValueError(
+                "sharding layout changed: saved part "
+                f"{state.get('part_index')}/{state.get('num_parts')}, this "
+                f"iterator is part {self.part_index}/{self.num_parts}")
+        self.cursor = int(state["cursor"])
+        self._consumed = int(state["consumed"])
+        self._carry = _np.asarray(state["carry"], dtype=_np.int64)
+        self._order = _np.asarray(state["order"])
+        self._rng.set_state(state["rng"])
+
     def iter_next(self):
         self.cursor += self.batch_size
         if self.last_batch_handle in ("discard", "roll_over"):

@@ -279,6 +279,12 @@ class _Engine:
         self._closed = False
         self._finished = False       # epoch exhausted; reset() rearms
         self._epoch = 0
+        self._resume_skip = 0        # one-shot: post-stride batches the
+                                     # next epoch's reader drops (cursor
+                                     # resume — load_state_dict)
+        self._resume_resets = 0      # one-shot: extra source resets that
+                                     # replay the shuffle stream up to the
+                                     # snapshot epoch
         self._gen = 0                # bumped per start(): a zombie stage
                                      # thread that outlived close()'s join
                                      # timeout (prep_fn stuck) can never
@@ -333,11 +339,16 @@ class _Engine:
             self._buf = []
             self._ready = {}
             self._gen += 1
-            self._epoch_batches = 0
+            # a resumed epoch starts its delivered-count at the snapshot
+            # cursor, so a LATER snapshot of the same epoch stays exact
+            skip, self._resume_skip = self._resume_skip, 0
+            resets, self._resume_resets = self._resume_resets, 0
+            self._epoch_batches = skip
             gen = self._gen
         q = self._prep_q = _queue.Queue(maxsize=self._num_workers * 2)
         self._threads = []
-        t = threading.Thread(target=self._reader, args=(q, gen), daemon=True,
+        t = threading.Thread(target=self._reader, args=(q, gen, skip, resets),
+                             daemon=True,
                              name=f"mxtpu-{self.name}-reader")
         self._threads.append(t)
         for i in range(self._num_workers):
@@ -408,15 +419,60 @@ class _Engine:
         self.start()
 
     # ------------------------------------------------------------------
+    # cursor resume (elastic run snapshots)
+    # ------------------------------------------------------------------
+    def state_dict(self):
+        """The CONSUMER's cursor — epoch and batches delivered this
+        epoch.  Deliberately not the reader's position: the reader runs
+        ahead, and snapshotting its source state would lose the batches
+        buffered but not yet delivered.  Resume replays instead (see
+        ``load_state_dict``), which is exact for any deterministic
+        seeded source."""
+        with self._lock:
+            return {"kind": "DataPipeline",
+                    "epoch": self._epoch,
+                    "delivered": self._epoch_batches}
+
+    def load_state_dict(self, state):
+        """Arm the next ``start()`` to resume mid-epoch: the source is
+        reset forward to the snapshot epoch (replaying its seeded
+        shuffle stream — the pipeline must wrap a FRESHLY-built source
+        identical to the original run's), and the reader drops the first
+        ``delivered`` post-stride batches, so the consumer sees exactly
+        the remaining batch sequence — same permutation, no duplicates,
+        no omissions.  Call before the pipeline starts (build it with
+        ``autostart=False``)."""
+        if state.get("kind") not in (None, "DataPipeline"):
+            raise ValueError(
+                f"not a DataPipeline state: {state.get('kind')!r}")
+        with self._lock:
+            if self._started:
+                raise RuntimeError(
+                    "load_state_dict before start(): the reader already "
+                    "consumed source batches this epoch")
+            epoch = int(state["epoch"])
+            self._epoch = epoch
+            self._resume_skip = int(state["delivered"])
+            # _open_epoch itself resets once when epoch > 0; a fresh
+            # source needs epoch resets total to reach this epoch's
+            # permutation
+            self._resume_resets = max(0, epoch - 1) if epoch > 0 else 0
+
+    # ------------------------------------------------------------------
     # stages
     # ------------------------------------------------------------------
-    def _open_epoch(self):
+    def _open_epoch(self, extra_resets=0):
         src = self._source
         if callable(src) and not hasattr(src, "next") \
                 and not hasattr(src, "__next__"):
             return iter(src())
         if hasattr(src, "reset") and hasattr(src, "next"):
             if self._epoch > 0 or getattr(self, "_source_used", False):
+                src.reset()
+            # cursor resume: a freshly-built source sits at epoch 0 — the
+            # extra resets replay its (deterministic, seeded) shuffle
+            # stream forward to the snapshot epoch's permutation
+            for _ in range(extra_resets):
                 src.reset()
             self._source_used = True
             return iter(src)
@@ -426,17 +482,25 @@ class _Engine:
     def _dead(self, gen):
         return self._stop.is_set() or gen != self._gen
 
-    def _reader(self, q, gen):
+    def _reader(self, q, gen, skip=0, extra_resets=0):
         """Single sequencer: pulls source batches in order, applies the
         batch-stride shard filter, and assigns each surviving batch the
-        seq its delivery position demands."""
+        seq its delivery position demands.  ``skip`` drops the first N
+        post-stride batches — cursor resume replays the epoch up to the
+        snapshot point (stride phase included: the dropped batches are
+        still pulled from the source, so a shared strided source stays
+        aligned across parts)."""
         seq = 0
+        skipped = 0
         try:
-            it = self._open_epoch()
+            it = self._open_epoch(extra_resets)
             for i, batch in enumerate(it):
                 if self._dead(gen):
                     return
                 if self._stride and i % self.num_parts != self.part_index:
+                    continue
+                if skipped < skip:
+                    skipped += 1
                     continue
                 self._put_prep(q, gen, (seq, batch, None))
                 seq += 1
@@ -765,6 +829,17 @@ class DataPipeline:
 
     def stats(self):
         return self._eng.stats()
+
+    def state_dict(self):
+        """Cursor snapshot for exact mid-epoch resume (see
+        :meth:`_Engine.state_dict`)."""
+        return self._eng.state_dict()
+
+    def load_state_dict(self, state):
+        """Arm the next epoch to resume at the snapshot cursor — call on
+        a freshly-built, not-yet-started pipeline over the same source
+        configuration (see :meth:`_Engine.load_state_dict`)."""
+        self._eng.load_state_dict(state)
 
     def __iter__(self):
         self._eng.ensure_epoch()

@@ -204,6 +204,7 @@ def execute_bucket(kv, bucket, items, policy, feedback):
     the ``kvstore.bucket_drop_reply`` fault point of the chaos tier)."""
     from ..engine import DeferredArray
     from ..comm import compression as _comp
+    from ..parallel import elastic as _elastic
     from ..utils import faultinject
 
     t0 = _perf() if _profiler._active else None
@@ -226,20 +227,26 @@ def execute_bucket(kv, bucket, items, policy, feedback):
     # case where the off worker would otherwise issue a plain fp32
     # pushpull against the peer's scale/code collectives and deadlock
     # instead of failing loudly
-    if hasattr(kv, "check_wire_agreement"):
-        kv.check_wire_agreement(bkey)
-    if codec is None:
-        flat = NDArray(_flatten(raws), ctx=grads[0].context)
-        kv.pushpull(bkey, flat, out=flat)
-        reduced, wire_bytes, codec_s = flat._data, nbytes, 0.0
-    else:
-        flat = _flatten(raws)
-        if use_ef:
-            flat = feedback.compensate(bkey, flat)
-        reduced, resid, wire_bytes, codec_s = _comp.bucket_allreduce(
-            codec, flat, kv.wire_allreduce)
-        if use_ef:
-            feedback.update(bkey, resid)
+    # a dead peer hangs the exchange forever — the collective watchdog
+    # (parallel/elastic.py) bounds every bucket dispatch
+    _elastic.watchdog_arm("kvstore.bucket")
+    try:
+        if hasattr(kv, "check_wire_agreement"):
+            kv.check_wire_agreement(bkey)
+        if codec is None:
+            flat = NDArray(_flatten(raws), ctx=grads[0].context)
+            kv.pushpull(bkey, flat, out=flat)
+            reduced, wire_bytes, codec_s = flat._data, nbytes, 0.0
+        else:
+            flat = _flatten(raws)
+            if use_ef:
+                flat = feedback.compensate(bkey, flat)
+            reduced, resid, wire_bytes, codec_s = _comp.bucket_allreduce(
+                codec, flat, kv.wire_allreduce)
+            if use_ef:
+                feedback.update(bkey, resid)
+    finally:
+        _elastic.watchdog_disarm()
     if faultinject.active() and faultinject.fire("kvstore.bucket_drop_reply"):
         # chaos tier: the reduced payload never arrives.  Raise BEFORE the
         # scatter so the member grads keep their pre-exchange values — a

@@ -25,6 +25,10 @@ ICI/DCN.  There is no separate communication code path to maintain.
   1F1B): explicit forward/backward slots, per-stage remat, bubble
   accounting; the engine behind ``SPMDTrainer(stages=...)``
   (docs/pipeline_parallelism.md).
+* :mod:`elastic` — preemption tolerance for this path: the collective
+  watchdog, two-phase-commit run snapshots (``RunCheckpoint``), and the
+  control-socket client workers use to talk to ``tools/supervise.py``
+  (docs/fault_tolerance.md).
 """
 from .mesh import (
     MeshConfig,
@@ -44,6 +48,8 @@ from .sharding import (
     replicate,
 )
 from .trainer import SPMDTrainer
+from . import elastic
+from .elastic import CollectiveWatchdog, ElasticClient, RunCheckpoint
 from .ring import ring_attention, ring_attention_sharded
 from .pipeline import pipeline_apply, stack_stage_params
 from .schedule import (
@@ -67,6 +73,10 @@ __all__ = [
     "shard_array",
     "replicate",
     "SPMDTrainer",
+    "elastic",
+    "CollectiveWatchdog",
+    "ElasticClient",
+    "RunCheckpoint",
     "pipeline_apply",
     "stack_stage_params",
     "ring_attention",

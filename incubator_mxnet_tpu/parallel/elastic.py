@@ -1,0 +1,704 @@
+"""Preemption-tolerant elastic training for the dist_sync/SPMD path.
+
+PR 5 made the *async-PS* path elastic; every performance win since (step
+fold, quantized collectives, pipeline/MoE) rides dist_sync/SPMD, where one
+dead rank hangs every psum forever and a mid-write kill can tear the
+checkpoint.  This module closes that gap with three worker-side pieces —
+the fourth (the run supervisor that spawns/monitors/relaunches ranks)
+lives in ``tools/supervise.py``:
+
+* **ElasticClient** — a lightweight control-socket client.  The
+  supervisor passes its listener address via ``MXNET_ELASTIC_SOCKET``;
+  workers send periodic heartbeats (the async-PS lease pattern from
+  ``kvstore/async_ps.py``, one-way here: the supervisor tracks the last
+  beat per rank and declares a lease expired when it goes stale) plus
+  one-shot structured events (hang reports, snapshot commits).  Every
+  send failure is swallowed: a worker must run identically without a
+  supervisor.
+
+* **CollectiveWatchdog** — a per-rank daemon thread armed around every
+  collective dispatch (``SPMDTrainer.step``, bucketed pushpull, folded
+  ``StepProgram`` calls).  A rank that blocks in a collective past the
+  timeout — ``MXNET_COLLECTIVE_TIMEOUT_S``, or auto-scaled from the
+  rolling step median like the slow-step detector — emits exactly ONE
+  structured ``ELASTIC_HANG`` report line (naming the likely-stuck rank
+  via ``profiler.straggler_report()`` peer telemetry when available),
+  bumps ``collective_timeout``, and exits non-zero so the supervisor can
+  re-form the job instead of hanging silently.  The first armed window
+  uses a generous warmup timeout (``MXNET_COLLECTIVE_WARMUP_S``) because
+  it contains the XLA compile.
+
+* **RunCheckpoint** — exact-resume run snapshots over
+  ``checkpoint.atomic_write_bytes``: params + trainer states (optimizer
+  moments, update counts, error-feedback residuals and step-fold global
+  registers all ride through ``save_states``/``load_states``), step/epoch
+  counters, the data-pipeline cursor (``NDArrayIter``/``DataPipeline``
+  ``state_dict``), RNG stream state, and arbitrary user extras.
+  Multi-host writes are **two-phase**: every rank ``atomic_write_bytes``s
+  its own ``.rank{r}.runstate`` shard, a barrier confirms all ranks
+  landed, and only then does rank 0 write the ``.commit`` marker.
+  ``restore()`` refuses snapshots without a commit marker, so a SIGKILL
+  at ANY instant never yields a torn restore — the previous committed
+  snapshot stays both present (GC keeps by commit marker) and loadable.
+
+Environment knobs (all optional; see docs/fault_tolerance.md):
+
+``MXNET_ELASTIC_SOCKET``         supervisor control address ``host:port``
+``MXNET_ELASTIC_HEARTBEAT_S``    worker heartbeat period (default 2)
+``MXNET_COLLECTIVE_TIMEOUT_S``   fixed watchdog timeout; unset/``auto``
+                                 → ``max(MIN, FACTOR × rolling median)``
+``MXNET_COLLECTIVE_TIMEOUT_MIN_S``    auto-mode floor (default 20)
+``MXNET_COLLECTIVE_TIMEOUT_FACTOR``   auto-mode multiplier (default 8)
+``MXNET_COLLECTIVE_WARMUP_S``    first-window timeout covering the XLA
+                                 compile (default 300)
+``MXNET_COLLECTIVE_WARMUP_ARMS`` how many leading arm windows get the
+                                 warmup timeout (default 1)
+``MXNET_ELASTIC_WATCHDOG_EXIT``  watchdog exit code (default 43)
+``MXNET_ELASTIC_RESTART``        generation index, set by the supervisor
+                                 (0 on the first launch) — exported as a
+                                 metrics gauge and used by fault gating
+"""
+from __future__ import annotations
+
+import glob
+import json
+import os
+import pickle
+import socket
+import struct
+import sys
+import tempfile
+import threading
+import time
+
+from .. import profiler as _profiler
+from ..checkpoint import atomic_write_bytes
+from ..utils import faultinject as _fi
+
+__all__ = [
+    "ElasticClient", "CollectiveWatchdog", "RunCheckpoint",
+    "enabled", "init", "install_watchdog", "uninstall_watchdog",
+    "watchdog_arm", "watchdog_disarm", "restart_generation",
+]
+
+# same length-prefixed-pickle wire shape as kvstore/async_ps.py — kept
+# local (a few lines) so this module never imports the PS stack
+_LEN = struct.Struct("!I")
+
+
+def _send_obj(sock, obj):
+    data = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    sock.sendall(_LEN.pack(len(data)) + data)
+
+
+def _env_float(name, default):
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+def _env_int(name, default):
+    try:
+        return int(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+def enabled():
+    """True when a supervisor exported its control socket to us."""
+    return bool(os.environ.get("MXNET_ELASTIC_SOCKET"))
+
+
+def restart_generation():
+    """0 on a fresh launch; N after the supervisor's Nth relaunch."""
+    return _env_int("MXNET_ELASTIC_RESTART", 0)
+
+
+def _dmlc_rank():
+    return _env_int("DMLC_WORKER_ID", 0)
+
+
+def _dmlc_world():
+    return _env_int("DMLC_NUM_WORKER", 1)
+
+
+# ---------------------------------------------------------------------------
+# Control-socket client
+# ---------------------------------------------------------------------------
+
+
+class ElasticClient:
+    """One-way control channel to the run supervisor.
+
+    Heartbeats renew this rank's liveness lease; ``event()`` ships
+    structured one-shot reports.  Connection state is lazy with
+    reconnect-on-failure, and every network error is swallowed — losing
+    the supervisor must never take down a healthy worker (the reverse
+    direction, the supervisor reacting to OUR death, is the whole point).
+    """
+
+    def __init__(self, addr=None, rank=None):
+        addr = addr or os.environ.get("MXNET_ELASTIC_SOCKET", "")
+        host, _, port = addr.rpartition(":")
+        self._addr = (host or "127.0.0.1", int(port)) if port else None
+        self._rank = _dmlc_rank() if rank is None else int(rank)
+        self._sock = None
+        self._lock = threading.Lock()
+        self._hb_stop = threading.Event()
+        self._hb_thread = None
+
+    # -- wire ----------------------------------------------------------
+    def _send(self, msg):
+        if self._addr is None:
+            return False
+        with self._lock:
+            for _ in range(2):  # one reconnect attempt on a stale socket
+                try:
+                    if self._sock is None:
+                        self._sock = socket.create_connection(
+                            self._addr, timeout=2.0)
+                    _send_obj(self._sock, msg)
+                    return True
+                except OSError:
+                    try:
+                        if self._sock is not None:
+                            self._sock.close()
+                    except OSError:
+                        pass
+                    self._sock = None
+        return False
+
+    # -- API -----------------------------------------------------------
+    def heartbeat(self, payload=None):
+        return self._send(("hb", self._rank, payload or {}))
+
+    def event(self, kind, payload=None):
+        return self._send(("event", self._rank, str(kind), payload or {}))
+
+    def start_heartbeat(self, interval_s=None):
+        if self._hb_thread is not None:
+            return self._hb_thread
+        interval = interval_s or _env_float("MXNET_ELASTIC_HEARTBEAT_S", 2.0)
+
+        def beat():
+            while not self._hb_stop.wait(interval):
+                self.heartbeat({"t": time.time()})
+
+        self.heartbeat({"t": time.time()})  # announce immediately
+        self._hb_thread = threading.Thread(
+            target=beat, name="elastic-heartbeat", daemon=True)
+        self._hb_thread.start()
+        return self._hb_thread
+
+    def close(self):
+        self._hb_stop.set()
+        with self._lock:
+            if self._sock is not None:
+                try:
+                    self._sock.close()
+                except OSError:
+                    pass
+                self._sock = None
+
+
+# ---------------------------------------------------------------------------
+# Collective watchdog
+# ---------------------------------------------------------------------------
+
+
+class CollectiveWatchdog(threading.Thread):
+    """Daemon thread that turns a silent collective hang into a clean,
+    attributable, supervisor-visible failure.
+
+    ``arm(tag)`` before a dispatch that blocks on peers, ``disarm()``
+    after; arms nest (the folded step arms around the whole program call,
+    the kvstore arms around each bucket inside it) and every arm
+    refreshes the deadline.  On expiry the watchdog fires exactly once:
+    one ``ELASTIC_HANG {json}`` line, the ``collective_timeout`` counter,
+    an optional supervisor event, then ``on_expire(code)`` — by default
+    ``os._exit`` with ``MXNET_ELASTIC_WATCHDOG_EXIT`` (43), because a
+    rank stuck inside an XLA collective cannot unwind through normal
+    exception flow.
+    """
+
+    def __init__(self, timeout_s=None, on_expire=None, client=None,
+                 report_stream=None, poll_s=0.05, rank=None):
+        super().__init__(name="collective-watchdog", daemon=True)
+        spec = (os.environ.get("MXNET_COLLECTIVE_TIMEOUT_S", "")
+                if timeout_s is None else str(timeout_s))
+        self._fixed = None
+        if spec and spec.lower() not in ("auto", "0"):
+            try:
+                self._fixed = float(spec)
+            except ValueError:
+                self._fixed = None
+        self._min_s = _env_float("MXNET_COLLECTIVE_TIMEOUT_MIN_S", 20.0)
+        self._factor = _env_float("MXNET_COLLECTIVE_TIMEOUT_FACTOR", 8.0)
+        self._warmup_s = _env_float("MXNET_COLLECTIVE_WARMUP_S", 300.0)
+        self._warmup_arms = _env_int("MXNET_COLLECTIVE_WARMUP_ARMS", 1)
+        self._exit_code = _env_int("MXNET_ELASTIC_WATCHDOG_EXIT", 43)
+        self._on_expire = on_expire
+        self._client = client
+        self._stream = report_stream
+        self._poll_s = poll_s
+        self._rank = _dmlc_rank() if rank is None else int(rank)
+        self._lock = threading.Lock()
+        self._depth = 0
+        self._arms = 0          # completed outermost arm windows
+        self._tag = None
+        self._deadline = None
+        self._timeout_used = None
+        self._fired = False
+        self._stop = threading.Event()
+
+    # -- timeout policy ------------------------------------------------
+    def _resolve_timeout(self):
+        if self._arms < self._warmup_arms:
+            # the first window contains jit tracing + XLA compilation,
+            # which dwarfs any steady-state step — never auto-scale it
+            return max(self._warmup_s,
+                       self._fixed if self._fixed is not None else 0.0)
+        if self._fixed is not None:
+            return self._fixed
+        try:
+            window = _profiler.step_stats() or []
+        except Exception:
+            window = []
+        walls = sorted(s["wall_ms"] for s in window[-32:]
+                       if isinstance(s.get("wall_ms"), (int, float)))
+        if not walls:
+            return self._warmup_s  # no telemetry yet: stay generous
+        median_s = walls[len(walls) // 2] / 1e3
+        return max(self._min_s, self._factor * median_s)
+
+    # -- arm/disarm ----------------------------------------------------
+    def arm(self, tag):
+        with self._lock:
+            self._depth += 1
+            self._tag = tag
+            self._timeout_used = self._resolve_timeout()
+            self._deadline = time.monotonic() + self._timeout_used
+
+    def disarm(self):
+        with self._lock:
+            if self._depth == 0:
+                return
+            self._depth -= 1
+            if self._depth == 0:
+                self._deadline = None
+                self._tag = None
+                self._arms += 1
+
+    @property
+    def fired(self):
+        return self._fired
+
+    # -- expiry --------------------------------------------------------
+    def _fire(self, tag, timeout_s):
+        report = {
+            "event": "collective_timeout",
+            "rank": self._rank,
+            "generation": restart_generation(),
+            "tag": tag,
+            "timeout_s": round(float(timeout_s), 3),
+        }
+        try:
+            report["straggler"] = _profiler.straggler_report()
+        except Exception:
+            report["straggler"] = None
+        try:
+            window = _profiler.step_stats()
+            report["last_step"] = window[-1] if window else None
+        except Exception:
+            report["last_step"] = None
+        line = "ELASTIC_HANG " + json.dumps(report, default=str)
+        stream = self._stream if self._stream is not None else sys.stderr
+        try:
+            print(line, file=stream, flush=True)
+        except (OSError, ValueError):
+            pass
+        try:
+            _profiler.incr("collective_timeout")
+        except Exception:
+            pass
+        if self._client is not None:
+            self._client.event("collective_timeout", report)
+        if self._on_expire is not None:
+            self._on_expire(self._exit_code)
+        else:
+            os._exit(self._exit_code)
+
+    def run(self):
+        while not self._stop.wait(self._poll_s):
+            with self._lock:
+                expired = (not self._fired
+                           and self._deadline is not None
+                           and time.monotonic() > self._deadline)
+                if expired:
+                    self._fired = True
+                    tag, timeout_s = self._tag, self._timeout_used
+            if expired:
+                self._fire(tag, timeout_s)
+                return
+
+    def stop(self):
+        self._stop.set()
+
+
+# module-level singleton so instrumentation sites stay one attribute
+# read + branch when no watchdog is installed (the common case)
+_watchdog = None
+_client = None
+
+
+def install_watchdog(**kwargs):
+    """Install (and start) the process-wide collective watchdog."""
+    global _watchdog
+    if _watchdog is not None:
+        return _watchdog
+    _watchdog = CollectiveWatchdog(**kwargs)
+    _watchdog.start()
+    return _watchdog
+
+
+def uninstall_watchdog():
+    global _watchdog
+    wd, _watchdog = _watchdog, None
+    if wd is not None:
+        wd.stop()
+
+
+def watchdog():
+    return _watchdog
+
+
+def watchdog_arm(tag):
+    wd = _watchdog
+    if wd is not None:
+        wd.arm(tag)
+
+
+def watchdog_disarm():
+    wd = _watchdog
+    if wd is not None:
+        wd.disarm()
+
+
+def init(watchdog=True, heartbeat=True):
+    """Wire this worker into an ambient supervisor.  No-op (returns None)
+    when ``MXNET_ELASTIC_SOCKET`` is unset, so training scripts can call
+    it unconditionally."""
+    global _client
+    _profiler.register_metrics_provider(
+        "elastic", lambda: {"restarts": restart_generation()})
+    if not enabled():
+        return None
+    if _client is None:
+        _client = ElasticClient()
+        if heartbeat:
+            _client.start_heartbeat()
+    if watchdog:
+        install_watchdog(client=_client)
+    return _client
+
+
+# ---------------------------------------------------------------------------
+# Exact-resume run snapshots (two-phase commit)
+# ---------------------------------------------------------------------------
+
+
+def _default_barrier(step):
+    """Cross-process ack for phase 2 when the caller didn't supply one."""
+    try:
+        import jax
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+            multihost_utils.sync_global_devices(f"elastic_snap_{step}")
+    except Exception:
+        raise
+
+
+class RunCheckpoint:
+    """Run-level snapshot with exact resume and torn-write immunity.
+
+    Layout (per step)::
+
+        {prefix}-{step:07d}.rank{r}.runstate   every rank's shard (phase 1)
+        {prefix}-{step:07d}.commit             rank 0 marker (phase 2)
+
+    A shard is a pickled dict: step/epoch counters, params (host numpy),
+    the trainer's ``save_states`` payload verbatim (optimizer state +
+    update counts + error-feedback residuals + step-fold registers), the
+    data iterator/pipeline cursor, python+numpy RNG stream state, and
+    caller extras.  Phase 2 runs only after a barrier confirms every
+    rank's phase 1 landed; ``restore()`` walks commit markers newest →
+    oldest and refuses anything uncommitted or world-size-mismatched.
+    """
+
+    def __init__(self, prefix, net=None, trainer=None, keep=3,
+                 rank=None, world=None):
+        self._prefix = prefix
+        self._net = net
+        self._trainer = trainer
+        self._keep = int(keep)
+        self._rank = _dmlc_rank() if rank is None else int(rank)
+        self._world = _dmlc_world() if world is None else int(world)
+
+    # -- paths ---------------------------------------------------------
+    def _shard_path(self, step, rank=None):
+        r = self._rank if rank is None else rank
+        return f"{self._prefix}-{step:07d}.rank{r}.runstate"
+
+    def _commit_path(self, step):
+        return f"{self._prefix}-{step:07d}.commit"
+
+    def _committed_steps(self):
+        out = []
+        for path in sorted(glob.glob(f"{self._prefix}-*.commit")):
+            try:
+                with open(path) as f:
+                    info = json.load(f)
+                out.append((int(info["step"]), int(info.get("world", 0))))
+            except (OSError, ValueError, KeyError, json.JSONDecodeError):
+                continue
+        return out
+
+    # -- state capture -------------------------------------------------
+    def _trainer_states_bytes(self):
+        if self._trainer is None or not hasattr(self._trainer, "save_states"):
+            return None
+        fd, tmp = tempfile.mkstemp(suffix=".states",
+                                   dir=os.path.dirname(self._prefix) or ".")
+        os.close(fd)
+        try:
+            self._trainer.save_states(tmp)
+            with open(tmp, "rb") as f:
+                return f.read()
+        finally:
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+
+    def _params_numpy(self):
+        if self._net is None:
+            return None
+        import numpy as np
+        if self._trainer is not None and hasattr(self._trainer, "sync_to_block"):
+            self._trainer.sync_to_block()
+        return {p.name: np.asarray(p._data._data)
+                for p in self._net.collect_params().values()
+                if p._data is not None}
+
+    @staticmethod
+    def _rng_state():
+        import random as pyrandom
+        state = {"python": pyrandom.getstate()}
+        try:
+            import numpy as np
+            state["numpy"] = np.random.get_state()
+        except Exception:
+            pass
+        try:
+            # mx.random's global PRNG key stream — the source of every
+            # get_key() draw (dropout, init, traced step seeds).
+            import numpy as np
+            from .. import random as mxrandom
+            state["mx_key"] = np.asarray(mxrandom._ensure().key)
+        except Exception:
+            pass
+        return state
+
+    @staticmethod
+    def _restore_rng(state):
+        if not state:
+            return
+        import random as pyrandom
+        if state.get("python") is not None:
+            pyrandom.setstate(state["python"])
+        if state.get("numpy") is not None:
+            import numpy as np
+            np.random.set_state(state["numpy"])
+        if state.get("mx_key") is not None:
+            try:
+                import jax.numpy as jnp
+                from .. import random as mxrandom
+                mxrandom._ensure().key = jnp.asarray(
+                    state["mx_key"], dtype=jnp.uint32)
+            except Exception:
+                pass
+
+    # -- save ----------------------------------------------------------
+    def save(self, step, epoch=0, data=None, extra=None, barrier=None):
+        """Two-phase snapshot at ``step``.  ``data`` is anything with a
+        ``state_dict()`` (``NDArrayIter``/``DataPipeline``); ``barrier``
+        is the phase-2 ack callable (e.g. ``kv.barrier``) — defaults to a
+        jax global-devices sync in multi-process runs.  Returns the shard
+        path.  Fault points (chaos tier): ``elastic.kill_before_shard``,
+        ``elastic.kill_after_shard``, ``elastic.kill_before_commit``,
+        ``elastic.kill_after_commit`` — a SIGKILL at any of them must
+        leave the previous committed snapshot restorable."""
+        t0 = time.perf_counter()
+        # trainer states FIRST: for a folded trainer save_states syncs the
+        # donated step-fold registers back into the live Parameters, which
+        # _params_numpy then reads — the other order snapshots stale params.
+        states = self._trainer_states_bytes()
+        payload = {
+            "step": int(step),
+            "epoch": int(epoch),
+            "rank": self._rank,
+            "world": self._world,
+            "generation": restart_generation(),
+            "params": self._params_numpy(),
+            "trainer_states": states,
+            "data": data.state_dict() if data is not None else None,
+            "rng": self._rng_state(),
+            "extra": extra,
+        }
+        blob = pickle.dumps(payload, protocol=pickle.HIGHEST_PROTOCOL)
+        _fi.maybe_kill("elastic.kill_before_shard")
+        atomic_write_bytes(self._shard_path(step), blob)
+        _fi.maybe_kill("elastic.kill_after_shard")
+        # phase 2: every rank acks its shard before rank 0 commits
+        if barrier is not None:
+            barrier()
+        elif self._world > 1:
+            _default_barrier(step)
+        if self._rank == 0:
+            _fi.maybe_kill("elastic.kill_before_commit")
+            atomic_write_bytes(self._commit_path(step), json.dumps(
+                {"step": int(step), "world": self._world,
+                 "time": time.time()}).encode())
+            _fi.maybe_kill("elastic.kill_after_commit")
+        self._gc()
+        ms = (time.perf_counter() - t0) * 1e3
+        try:
+            _profiler.incr("snapshot_commit_ms", max(1, int(round(ms))))
+        except Exception:
+            pass
+        if _profiler._active:
+            _profiler.record_span("elastic.snapshot", "checkpoint", t0,
+                                  args={"step": int(step),
+                                        "ms": round(ms, 2)})
+        if _client is not None:
+            _client.event("snapshot_commit",
+                          {"step": int(step), "ms": round(ms, 2)})
+        return self._shard_path(step)
+
+    # -- GC (keep-by-commit-marker) ------------------------------------
+    def _gc(self):
+        """Retain the newest ``keep`` COMMITTED snapshots plus anything
+        newer than the newest commit (a peer may still be mid-write on
+        it).  Keyed on commit markers, never mtime: an interrupted later
+        write must not age out the newest restorable snapshot."""
+        committed = sorted(s for s, _w in self._committed_steps())
+        if not committed:
+            return
+        keep_steps = set(committed[-self._keep:]) if self._keep else set(committed)
+        newest = committed[-1]
+        if self._rank == 0:
+            for s in committed:
+                if s not in keep_steps:
+                    try:
+                        os.remove(self._commit_path(s))
+                    except OSError:
+                        pass
+        for path in glob.glob(f"{self._prefix}-*.rank{self._rank}.runstate"):
+            base = os.path.basename(path)
+            pre = os.path.basename(self._prefix) + "-"
+            try:
+                s = int(base[len(pre):].split(".", 1)[0])
+            except ValueError:
+                continue
+            if s in keep_steps or s > newest:
+                continue
+            try:
+                os.remove(path)
+            except OSError:
+                pass
+
+    # -- restore -------------------------------------------------------
+    def latest_step(self):
+        """Newest committed step this rank can restore, or None."""
+        for s, world in sorted(self._committed_steps(), reverse=True):
+            if world == self._world and os.path.exists(self._shard_path(s)):
+                return s
+        return None
+
+    def restore(self, step=None, data=None):
+        """Load the newest committed snapshot (or ``step``) into
+        net/trainer/RNG — and into ``data`` (anything with
+        ``load_state_dict``) when given.  Uncommitted shards are REFUSED
+        — only a step with a commit marker, a matching world size, and a
+        readable shard for this rank qualifies.  Returns the payload dict
+        (with ``step``/``epoch``/``data``/``extra``) or None."""
+        t0 = time.perf_counter()
+        if step is not None:
+            candidates = [step]
+        else:
+            candidates = [s for s, w in
+                          sorted(self._committed_steps(), reverse=True)
+                          if w == self._world]
+        for s in candidates:
+            if not os.path.exists(self._commit_path(s)):
+                continue  # torn/uncommitted: refuse
+            try:
+                with open(self._shard_path(s), "rb") as f:
+                    payload = pickle.load(f)
+            except (OSError, pickle.UnpicklingError, EOFError, ValueError):
+                continue
+            self._apply(payload)
+            if data is not None and payload.get("data") is not None and \
+                    hasattr(data, "load_state_dict"):
+                data.load_state_dict(payload["data"])
+            if _profiler._active:
+                _profiler.record_span("elastic.restore", "checkpoint", t0,
+                                      args={"step": int(s)})
+            return payload
+        return None
+
+    def _apply(self, payload):
+        params = payload.get("params")
+        if params is not None and self._net is not None:
+            import jax.numpy as jnp
+            import numpy as np
+            live = list(self._net.collect_params().values())
+            # Names regenerate identically in a fresh process; if the
+            # gluon auto-prefix counter has drifted (same model rebuilt
+            # in-process) the name sets are disjoint — fall back to
+            # positional matching rather than silently restoring nothing.
+            by_name = {p.name: params[p.name] for p in live
+                       if p.name in params}
+            if not by_name and len(params) == len(live):
+                by_name = {p.name: v for p, v in zip(live, params.values())}
+            for p in live:
+                if p.name in by_name and p._data is not None:
+                    p._data._data = jnp.asarray(np.asarray(by_name[p.name]),
+                                                dtype=p._data.dtype)
+                    p._data._version += 1
+        states = payload.get("trainer_states")
+        if states is not None and self._trainer is not None and \
+                hasattr(self._trainer, "load_states"):
+            fd, tmp = tempfile.mkstemp(
+                suffix=".states", dir=os.path.dirname(self._prefix) or ".")
+            os.close(fd)
+            try:
+                with open(tmp, "wb") as f:
+                    f.write(states)
+                self._trainer.load_states(tmp)
+            finally:
+                try:
+                    os.remove(tmp)
+                except OSError:
+                    pass
+        # SPMDTrainer keeps device copies — refresh from the net's params
+        if self._trainer is not None and self._net is not None and \
+                hasattr(self._trainer, "_param_arrays"):
+            import jax
+            import numpy as np
+            self._trainer._param_arrays = [
+                jax.device_put(np.asarray(p._data._data), sh)
+                for p, sh in zip(self._trainer._params,
+                                 self._trainer._param_shardings)]
+        self._restore_rng(payload.get("rng"))

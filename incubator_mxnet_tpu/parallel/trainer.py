@@ -25,6 +25,7 @@ import jax.numpy as jnp
 import numpy as _np
 
 from .. import profiler as _profiler
+from . import elastic as _elastic
 from .. import autograd
 from .. import optimizer as opt_mod
 from ..ndarray.ndarray import NDArray
@@ -679,6 +680,9 @@ class SPMDTrainer:
         tc = _perf() if fresh else None
         tw = _perf()
         t0 = tw if _profiler._active else None
+        # the fused step is one XLA program whose collectives block on
+        # every peer — the watchdog turns a dead peer into a clean exit
+        _elastic.watchdog_arm("spmd.step")
         try:
             try:
                 if comm:
@@ -704,6 +708,7 @@ class SPMDTrainer:
                                       args=self._comm_span_args)
             self._record_step_obs(extras, tw)
         finally:
+            _elastic.watchdog_disarm()
             _profiler.step_boundary()
         self._post_step()
         return NDArray(loss)
@@ -757,6 +762,7 @@ class SPMDTrainer:
         tc = _perf() if fresh else None
         tw = _perf()
         t0 = tw if _profiler._active else None
+        _elastic.watchdog_arm("spmd.step_bulk")
         try:
             try:
                 if comm:
@@ -789,6 +795,7 @@ class SPMDTrainer:
                                       args=args)
             self._record_step_obs(extras, tw, k=int(k))
         finally:
+            _elastic.watchdog_disarm()
             _profiler.step_boundary()  # one boundary per dispatch, not per k
         self._post_step()
         return NDArray(loss)

@@ -238,6 +238,9 @@ _counters = {
     "pipeline_microbatch": 0,         # microbatches retired by those steps
     "pipeline_bubble_ms": 0,          # modeled schedule bubble ms (rounded per step)
     "moe_tokens_dropped": 0,          # token-choice slots dropped at expert capacity
+    "elastic_restart": 0,             # supervisor job re-formations
+    "collective_timeout": 0,          # collective-watchdog expiries
+    "snapshot_commit_ms": 0,          # two-phase run-snapshot commit wall ms
     "compile_total": 0,               # jit compilations across every site
     "compile_ms_total": 0,            # wall ms those compilations cost
     "recompile_steady_state": 0,      # compiles after the guard armed
@@ -1086,6 +1089,23 @@ _metrics_http = None      # (ThreadingHTTPServer, serving thread)
 _metrics_exporter = None  # _MetricsExporter
 _metrics_lock = _threading.Lock()
 
+# process health for the /healthz endpoint: "serving" (200) until a
+# graceful drain begins (serving.install_sigterm_drain), then "draining"
+# (503) so external load balancers stop routing here before in-flight
+# work finishes
+_health = "serving"
+
+
+def set_health(state):
+    """Set the process health reported by ``/healthz`` ("serving" → 200,
+    anything else → 503 with the state in the body)."""
+    global _health
+    _health = str(state)
+
+
+def health_state():
+    return _health
+
 
 def _make_metrics_handler():
     from http.server import BaseHTTPRequestHandler
@@ -1102,6 +1122,18 @@ def _make_metrics_handler():
                                    "peers": {str(r): s for r, s in
                                              peer_metrics().items()}}).encode()
                 ctype = "application/json"
+            elif path == "/healthz":
+                # load-balancer health check: 200 only while serving —
+                # a draining process must leave rotation immediately,
+                # even though /metrics keeps answering 200
+                state = health_state()
+                body = (state + "\n").encode()
+                self.send_response(200 if state == "serving" else 503)
+                self.send_header("Content-Type", "text/plain; charset=utf-8")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                return
             else:
                 self.send_error(404)
                 return

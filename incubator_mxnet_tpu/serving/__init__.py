@@ -26,8 +26,10 @@ from .bucketing import ShapeBucketer
 from .generation import (AdmissionError, GenerationResult, GenerationServer,
                          Tenant)
 from .kv_cache import KVCacheLadder, SlotKVCache
-from .server import InferenceServer, PendingResult
+from .server import (InferenceServer, PendingResult, ServerDrainingError,
+                     install_sigterm_drain)
 
 __all__ = ["InferenceServer", "PendingResult", "ShapeBucketer",
            "GenerationServer", "GenerationResult", "AdmissionError",
-           "Tenant", "KVCacheLadder", "SlotKVCache"]
+           "Tenant", "KVCacheLadder", "SlotKVCache",
+           "ServerDrainingError", "install_sigterm_drain"]

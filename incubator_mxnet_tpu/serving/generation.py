@@ -60,6 +60,7 @@ from .. import profiler
 from ..predictor import StatefulExecutor
 from .bucketing import ShapeBucketer
 from .kv_cache import KVCacheLadder
+from .server import ServerDrainingError
 
 __all__ = ["GenerationServer", "GenerationResult", "AdmissionError",
            "Tenant"]
@@ -674,9 +675,12 @@ class GenerationServer:
                              f"{sorted(self.tenants)}")
         t0 = _perf()
         with self._cond:
-            if self._closing or self._closed or not self._started:
-                raise RuntimeError("server is not accepting requests "
-                                   "(closed or not started)")
+            if self._closing or self._closed:
+                raise ServerDrainingError(
+                    "server is draining/closed — retry against another "
+                    "replica")
+            if not self._started:
+                raise RuntimeError("server is not started")
             q = self._queues[ten.name]
             if len(q) >= ten.max_queue:
                 ten.shed += 1
@@ -1024,8 +1028,11 @@ class GenerationServer:
     # -- lifecycle -----------------------------------------------------
     def close(self, drain=True, timeout=60.0):
         """Stop accepting requests.  ``drain=True`` (default) finishes
-        everything queued and in flight; ``drain=False`` fails queued
-        requests and cancels in-flight ones at the next boundary."""
+        everything queued and in flight under a ``timeout`` deadline —
+        whatever the drain could not finish in time fails with a
+        retriable :class:`ServerDrainingError` instead of hanging its
+        clients; ``drain=False`` fails queued requests immediately and
+        cancels in-flight ones at the next boundary."""
         with self._cond:
             if self._closed:
                 return
@@ -1037,7 +1044,9 @@ class GenerationServer:
                         req.tenant.failed += 1
                         req.result._finish(
                             "error", req.t_submit,
-                            exc=RuntimeError("server closed"))
+                            exc=ServerDrainingError(
+                                "server closed without drain — retry "
+                                "against another replica"))
                     q.clear()
                 for pool in self._ladder.pools.values():
                     for s in pool.active_slots():
@@ -1045,6 +1054,25 @@ class GenerationServer:
             self._cond.notify_all()
         if self._thread is not None:
             self._thread.join(timeout)
+            if self._thread.is_alive():
+                # drain deadline exceeded: fail what's still queued
+                # retriably and cancel the in-flight remainder so no
+                # client blocks on a server that will never answer
+                with self._cond:
+                    for q in self._queues.values():
+                        for req in q:
+                            req.tenant.failed += 1
+                            req.result._finish(
+                                "error", req.t_submit,
+                                exc=ServerDrainingError(
+                                    f"drain deadline ({timeout}s) "
+                                    "exceeded — retry against another "
+                                    "replica"))
+                        q.clear()
+                    for pool in self._ladder.pools.values():
+                        for s in pool.active_slots():
+                            pool.owners[s].result._cancel = True
+                    self._cond.notify_all()
         profiler.unregister_metrics_provider(self.name)
         self._ladder.release()   # pool bytes leave the device-memory ledger
         with self._cond:

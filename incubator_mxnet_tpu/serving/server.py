@@ -39,7 +39,48 @@ from .. import profiler
 from ..predictor import Predictor, load_checkpoint
 from .bucketing import ShapeBucketer
 
-__all__ = ["InferenceServer", "PendingResult"]
+
+class ServerDrainingError(RuntimeError):
+    """The server is draining (SIGTERM) or closed: the request was NOT
+    admitted and is safe to retry against another replica.  A
+    ``RuntimeError`` subclass so pre-drain callers that caught the old
+    generic refusal keep working."""
+
+
+def install_sigterm_drain(*servers, deadline_s=30.0):
+    """Chain a SIGTERM handler that drains ``servers`` gracefully:
+    ``/healthz`` flips to 503 ("draining") so load balancers stop
+    routing here, admission stops (``submit`` raises
+    :class:`ServerDrainingError`), in-flight and queued work shares
+    ``deadline_s`` to finish, the remainder fails retriably, and then
+    any previously-installed handler runs (e.g. ``CheckpointManager``'s
+    save-on-SIGTERM).  Call from the main thread; returns the installed
+    handler."""
+    import os
+    import signal
+
+    prev = {"h": None}
+
+    def handler(signum, frame):
+        profiler.set_health("draining")
+        share = deadline_s / max(1, len(servers))
+        for s in servers:
+            try:
+                s.close(drain=True, timeout=share)
+            except Exception:
+                pass
+        ph = prev["h"]
+        if callable(ph):
+            ph(signum, frame)
+        elif ph == signal.SIG_DFL:
+            signal.signal(signal.SIGTERM, signal.SIG_DFL)
+            os.kill(os.getpid(), signal.SIGTERM)
+
+    prev["h"] = signal.signal(signal.SIGTERM, handler)
+    return handler
+
+__all__ = ["InferenceServer", "PendingResult", "ServerDrainingError",
+           "install_sigterm_drain"]
 
 _perf = time.perf_counter
 
@@ -320,10 +361,12 @@ class InferenceServer:
 
         t0 = _perf()
         with self._cond:
-            if self._closing or self._closed or not self._started:
-                raise RuntimeError(
-                    "server is not accepting requests (closed or not "
-                    "started)")
+            if self._closing or self._closed:
+                raise ServerDrainingError(
+                    "server is draining/closed — retry against another "
+                    "replica")
+            if not self._started:
+                raise RuntimeError("server is not started")
             self._rid += 1
             rid = request_id if request_id is not None else self._rid
             req = _Request(rid, inputs, length, bucket, t0,
@@ -553,20 +596,35 @@ class InferenceServer:
     def close(self, drain=True, timeout=30.0):
         """Stop accepting requests and shut the scheduler down.  With
         ``drain=True`` (default) every queued request is still dispatched
-        (deadline rules suspended — the queue flushes in bucket groups);
-        with ``drain=False`` queued requests fail with RuntimeError."""
+        (deadline rules suspended — the queue flushes in bucket groups)
+        under a ``timeout`` deadline; whatever the drain could not finish
+        in time fails with a retriable :class:`ServerDrainingError`
+        instead of hanging its clients.  ``drain=False`` fails queued
+        requests immediately (same error)."""
         with self._cond:
             if self._closed:
                 return
             self._closing = True
             if not drain:
                 for r in self._queue:
-                    r.pending._set(exc=RuntimeError("server closed"))
+                    r.pending._set(exc=ServerDrainingError(
+                        "server closed without drain — retry against "
+                        "another replica"))
                     self._n_failed += 1
                 self._queue = []
             self._cond.notify_all()
         if self._thread is not None:
             self._thread.join(timeout)
+            if self._thread.is_alive():
+                # drain deadline exceeded: fail the remainder retriably
+                with self._cond:
+                    for r in self._queue:
+                        r.pending._set(exc=ServerDrainingError(
+                            f"drain deadline ({timeout}s) exceeded — "
+                            "retry against another replica"))
+                        self._n_failed += 1
+                    self._queue = []
+                    self._cond.notify_all()
         profiler.unregister_metrics_provider(self.name)
         self._pred.close()   # bound params leave the device-memory ledger
         with self._cond:

@@ -37,6 +37,30 @@ Known points (see docs/fault_tolerance.md):
                              replying (client retries on a fresh socket)
 ====================== ====================================================
 
+**Process-level points** (ISSUE 16, dist_sync/elastic chaos tier) take
+extra *gating* params — ``rank=R`` (only that DMLC rank), ``at=K`` (only
+when the training step equals K; matched by ``step_faults``), ``gen=G``
+(only in supervisor restart generation G, read from
+``MXNET_ELASTIC_RESTART`` — so a kill fires once, not on every
+relaunch).  A gated hit that doesn't match is not counted.
+
+====================== ====================================================
+``proc.kill_rank``           SIGKILL this process (preemption) — the
+                             supervisor must re-form the job
+``proc.hang_collective``     sleep ``s`` (default 3600) INSIDE the step,
+                             so peers block in the collective and their
+                             watchdog must fire
+``proc.slow_rank``           sleep ``s`` (default 0.05) — a straggler
+``elastic.kill_before_shard``  SIGKILL before the runstate shard write
+``elastic.kill_after_shard``   SIGKILL after the shard, before commit
+``elastic.kill_before_commit`` SIGKILL on rank 0 before the marker
+``elastic.kill_after_commit``  SIGKILL on rank 0 after the marker
+====================== ====================================================
+
+The four ``elastic.kill_*`` points are the torn-restore proof: at every
+one of them, ``RunCheckpoint.restore`` must still load the previous
+COMMITTED snapshot and refuse the partial one.
+
 Every fired fault bumps the ``fault_injected`` profiler counter, so a chaos
 run's injected-fault count is part of its evidence.
 """
@@ -44,10 +68,13 @@ from __future__ import annotations
 
 import os
 import random
+import signal
 import threading
+import time
 import zlib
 
-__all__ = ["FaultInjected", "configure", "active", "fire", "param", "stats"]
+__all__ = ["FaultInjected", "configure", "active", "fire", "param", "stats",
+           "fire_gated", "maybe_kill", "step_faults"]
 
 
 class FaultInjected(ConnectionError):
@@ -141,6 +168,54 @@ def stats():
     """{point: (hits, fired)} — chaos-test evidence."""
     with _lock:
         return {p: (_hits.get(p, 0), _fired.get(p, 0)) for p in _spec}
+
+
+# ---------------------------------------------------------------------------
+# Process-level points (dist_sync/elastic chaos tier)
+# ---------------------------------------------------------------------------
+
+
+def fire_gated(point, step=None, rank=None):
+    """Like :func:`fire`, but the point's optional ``rank=``/``at=``/
+    ``gen=`` params must match this hit's coordinates first; a
+    non-matching hit neither counts nor fires (the trigger — n/every/p —
+    sees only the gated stream, so ``n=1:at=3`` means "once, at step 3",
+    in whichever generation the gate admits)."""
+    cfg = _spec.get(point)
+    if cfg is None:
+        return False
+    if "rank" in cfg and (rank is None or int(rank) != int(cfg["rank"])):
+        return False
+    if "at" in cfg and (step is None or int(step) != int(cfg["at"])):
+        return False
+    if "gen" in cfg:
+        gen = int(os.environ.get("MXNET_ELASTIC_RESTART", "0") or 0)
+        if gen != int(cfg["gen"]):
+            return False
+    return fire(point)
+
+
+def maybe_kill(point):
+    """SIGKILL this process when ``point`` fires — no atexit hooks, no
+    flushes, exactly the preemption/torn-write shape the two-phase
+    snapshot commit must survive."""
+    if _spec and fire(point):
+        os.kill(os.getpid(), signal.SIGKILL)
+
+
+def step_faults(step, rank=None):
+    """Per-training-step chaos hook (elastic workers call it at the top
+    of each step): kill-rank-N-at-step-K, hang-collective, slow-rank."""
+    if not _spec:
+        return
+    if rank is None:
+        rank = int(os.environ.get("DMLC_WORKER_ID", "0") or 0)
+    if fire_gated("proc.kill_rank", step=step, rank=rank):
+        os.kill(os.getpid(), signal.SIGKILL)
+    if fire_gated("proc.hang_collective", step=step, rank=rank):
+        time.sleep(param("proc.hang_collective", "s", 3600.0))
+    if fire_gated("proc.slow_rank", step=step, rank=rank):
+        time.sleep(param("proc.slow_rank", "s", 0.05))
 
 
 configure()

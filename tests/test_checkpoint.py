@@ -108,6 +108,50 @@ class TestCheckpointManager:
         assert len(metas) == 2
         assert mgr.latest_step() == 4
 
+    def _torn_meta(self, mgr, step):
+        """Fabricate an interrupted write: a meta landed but the data files
+        it references never did (killed between the two)."""
+        import json
+        pth, sth, mth = mgr._paths(step)
+        with open(mth, "w") as f:
+            json.dump({"step": step,
+                       "params": os.path.basename(pth),
+                       "states": os.path.basename(sth)}, f)
+
+    def test_latest_step_skips_torn_meta(self, tmp_path):
+        net, trainer = self._make(tmp_path)
+        mgr = CheckpointManager(str(tmp_path / "ck"), net=net, trainer=trainer,
+                                save_on_sigterm=False, async_write=False)
+        w0 = net.weight.data().asnumpy().copy()
+        mgr.save(2, blocking=True)
+        self._torn_meta(mgr, 5)   # newest meta is torn
+        assert mgr.latest_step() == 2
+        net.weight.data()[:] = 99.0
+        assert mgr.restore() == 2
+        np.testing.assert_allclose(net.weight.data().asnumpy(), w0)
+
+    def test_gc_counts_committed_not_files(self, tmp_path):
+        """A torn later write must never age out the newest COMPLETE
+        checkpoint: GC keeps by commit (complete meta), not by file count
+        or mtime."""
+        net, trainer = self._make(tmp_path)
+        mgr = CheckpointManager(str(tmp_path / "ck"), net=net, trainer=trainer,
+                                save_on_sigterm=False, keep=2, async_write=False)
+        mgr.save(1, blocking=True)
+        mgr.save(2, blocking=True)
+        self._torn_meta(mgr, 3)   # interrupted write after step 2
+        mgr.save(4, blocking=True)
+        # keep=2 complete checkpoints: {2, 4}.  If the torn step-3 meta
+        # counted, step 2 — the newest checkpoint that was committed when
+        # the interruption hit — would have been deleted.
+        steps = sorted(m["step"] for _, m in mgr._complete_metas())
+        assert steps == [2, 4]
+        assert mgr.latest_step() == 4
+        # step 1's files are gone, step 2's survive
+        assert not any(p.startswith("ck-0000001") for p in os.listdir(tmp_path))
+        assert any(p.startswith("ck-0000002") and p.endswith(".meta")
+                   for p in os.listdir(tmp_path))
+
 
 def test_sigterm_mid_fit_resumes_same_curve(tmp_path):
     """kill -TERM a training process mid-fit; a fresh process restores and

@@ -380,6 +380,8 @@ def async_store(monkeypatch):
     monkeypatch.setattr(async_ps, "_SERVER", None)
     kv = mx.kv.create("dist_async")
     yield kv
+    kv.close()   # stops the heartbeat thread — leaked, it trips the
+    # thread-leak teardown of every later test in the run
     kv._server.stop()
 
 

@@ -429,6 +429,7 @@ def test_async_store_compression_end_to_end(monkeypatch):
         kv.pull("w", out=out)
         np.testing.assert_allclose(out.asnumpy(), [1.0, -1.0, 0.0, 0.0])
     finally:
+        kv.close()   # stop the heartbeat thread, not just the server
         kv._server.stop()
 
 

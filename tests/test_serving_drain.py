@@ -1,0 +1,153 @@
+"""SIGTERM graceful drain for the serving tier (ISSUE 16 satellite).
+
+A preempted replica must stop admitting work (retriable
+``ServerDrainingError``, a ``RuntimeError`` subclass for pre-drain
+callers), drain in-flight requests under a deadline, fail the remainder
+retriably instead of hanging clients, and leave load-balancer rotation
+via ``/healthz`` (200 serving / 503 draining) the moment the drain
+starts."""
+import os
+import signal
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+import incubator_mxnet_tpu.symbol as S
+from incubator_mxnet_tpu import profiler
+from incubator_mxnet_tpu.serving import (InferenceServer,
+                                         ServerDrainingError,
+                                         install_sigterm_drain)
+
+FEAT = 4
+HID = 6
+
+
+def _model(seed=0):
+    S.symbol._reset_naming()
+    data = S.var("data")
+    fc = S.FullyConnected(data, num_hidden=HID, flatten=False, name="fc1")
+    sym = S.Activation(fc, act_type="tanh", name="t1")
+    rng = np.random.RandomState(seed)
+    params = {
+        "arg:fc1_weight": mx.nd.array(rng.randn(HID, FEAT).astype(np.float32)),
+        "arg:fc1_bias": mx.nd.array(rng.randn(HID).astype(np.float32)),
+    }
+    return sym, params
+
+
+def _server(**kw):
+    sym, params = _model()
+    kw.setdefault("max_batch_size", 2)
+    kw.setdefault("max_queue_ms", 30.0)
+    kw.setdefault("max_length", 8)
+    kw.setdefault("name", "drain_test")
+    return InferenceServer(sym, params, {"data": (None, FEAT)}, **kw)
+
+
+@pytest.fixture(autouse=True)
+def serving_health():
+    profiler.set_health("serving")
+    yield
+    profiler.set_health("serving")
+
+
+class TestDrainingError:
+    def test_submit_after_close_is_retriable_and_backcompat(self):
+        srv = _server().start()
+        srv.close()
+        with pytest.raises(ServerDrainingError, match="retry"):
+            srv.submit({"data": np.zeros((3, FEAT), np.float32)})
+        # RuntimeError subclass: pre-drain callers keep working
+        with pytest.raises(RuntimeError):
+            srv.submit({"data": np.zeros((3, FEAT), np.float32)})
+
+    def test_close_without_drain_fails_queued_retriably(self):
+        srv = _server(max_queue_ms=10_000.0).start()
+        # wedge the scheduler so submissions stay queued
+        gate = threading.Event()
+        orig = srv._pred.forward
+        srv._pred.forward = lambda: (gate.wait(10), orig())[1]
+        try:
+            pending = [srv.submit({"data": np.zeros((3, FEAT), np.float32)})
+                       for _ in range(4)]
+            srv.close(drain=False, timeout=5.0)
+            gate.set()
+            failures = 0
+            for p in pending:
+                try:
+                    p.result(timeout=10.0)
+                except ServerDrainingError:
+                    failures += 1
+            assert failures >= 2   # whatever never dispatched failed fast
+        finally:
+            gate.set()
+
+    def test_drain_deadline_fails_remainder_not_hangs(self):
+        """In-flight work shares the drain deadline; whatever cannot
+        finish fails with a retriable error instead of blocking close."""
+        srv = _server(max_queue_ms=5.0, max_batch_size=1).start()
+        orig = srv._pred.forward
+        srv._pred.forward = lambda: (time.sleep(1.5), orig())[1]
+        # one-request batches: the first dispatch wedges in-flight while
+        # the rest sit in the queue past the drain deadline
+        pending = [srv.submit({"data": np.zeros((3, FEAT), np.float32)})
+                   for _ in range(4)]
+        t0 = time.perf_counter()
+        srv.close(drain=True, timeout=0.3)
+        assert time.perf_counter() - t0 < 5.0   # close() itself returns
+        outcomes = []
+        for p in pending:
+            try:
+                p.result(timeout=10.0)
+                outcomes.append("ok")
+            except Exception as e:  # noqa: BLE001
+                outcomes.append(e)
+        assert any(isinstance(o, ServerDrainingError) for o in outcomes), \
+            outcomes
+
+
+class TestHealthz:
+    def test_healthz_flips_with_health_state(self):
+        port = profiler.start_metrics(port=0)
+        try:
+            body = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz", timeout=10)
+            assert body.status == 200
+            assert body.read().decode().strip() == "serving"
+            profiler.set_health("draining")
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/healthz", timeout=10)
+            assert ei.value.code == 503
+            assert ei.value.read().decode().strip() == "draining"
+            # /metrics keeps serving 200 while draining (scrapes continue)
+            assert urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics",
+                timeout=10).status == 200
+        finally:
+            profiler.stop_metrics()
+
+
+class TestSigtermDrain:
+    def test_sigterm_drains_flips_health_and_chains_prev_handler(self):
+        chained = []
+        prev = signal.signal(signal.SIGTERM, lambda s, f: chained.append(s))
+        srv = _server().start()
+        try:
+            install_sigterm_drain(srv, deadline_s=2.0)
+            os.kill(os.getpid(), signal.SIGTERM)
+            deadline = time.monotonic() + 10.0
+            while not chained and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert chained == [signal.SIGTERM]      # prev handler ran last
+            assert profiler.health_state() == "draining"
+            with pytest.raises(ServerDrainingError):
+                srv.submit({"data": np.zeros((3, FEAT), np.float32)})
+        finally:
+            signal.signal(signal.SIGTERM, prev)
+            srv.close()

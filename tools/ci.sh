@@ -23,8 +23,11 @@
 #                  one postmortem
 #   7. chaos     — fault-injection tier (fixed seed): wire drops/dups/kills
 #                  against the async PS with exactly-once accounting, the
-#                  2-worker chaos training acceptance run, and the
-#                  standalone-server SIGKILL+resume subprocess test
+#                  2-worker chaos training acceptance run, the
+#                  standalone-server SIGKILL+resume subprocess test, and
+#                  the elastic dist_sync tier (tests/test_elastic.py):
+#                  supervisor kill/resume smoke with exact-loss resume
+#                  and the torn-checkpoint restore-refusal matrix
 #   8. serving   — inference serving tier: the open-loop throughput-at-SLO
 #                  harness in --smoke mode (exits non-zero if any batch
 #                  recompiled after warmup — the bucket-miss regression
@@ -177,9 +180,15 @@ for tier in "${TIERS[@]}"; do
             ;;
         chaos)
             # deterministic fault injection: the seed pins the p= fault
-            # schedules so a chaos failure reproduces exactly
+            # schedules so a chaos failure reproduces exactly.
+            # test_elastic.py adds the dist_sync elastic tier: the 2-proc
+            # supervisor kill/resume acceptance (proc.kill_rank at a fixed
+            # step, exact-loss resume, zero steady-state recompiles) and
+            # the torn-checkpoint restore-refusal matrix (SIGKILL at every
+            # elastic.kill_* point)
             run_tier chaos "${CPU_ENV[@]}" env MXNET_FAULT_SEED=0 \
-                python -m pytest tests/test_chaos.py -q ${CI_PYTEST_ARGS:-}
+                python -m pytest tests/test_chaos.py tests/test_elastic.py \
+                -q ${CI_PYTEST_ARGS:-}
             ;;
         serving)
             # serving tier: the smoke harnesses ARE the regression guards
