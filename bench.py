@@ -90,11 +90,15 @@ def _fence(trainer, loss):
 
 
 def _bench_bert_folded(net, mlm_loss, mp, B, P, steps, warmup,
-                       tok, seg, pos, labels):
+                       tok, seg, pos, labels, k=1):
     """bert_base through gluon.Trainer.fold_step (MXNET_STEP_FOLD=1): one
     donated compiled program per step on the default device — the folded
     twin of the SPMD headline, so the two paths are comparable round to
-    round (docs/step_fold.md)."""
+    round (docs/step_fold.md).  With k > 1 (MXNET_STEP_FOLD_K=K) the step
+    is ``Trainer.fold_steps``: the batch is tiled to a [K, B, ...] window
+    and one dispatch runs K logical steps in an in-program scan —
+    samples/sec still counts LOGICAL steps, so the number is directly
+    comparable to the K=1 and SPMD headlines."""
     import jax
     import numpy as np
 
@@ -113,25 +117,34 @@ def _bench_bert_folded(net, mlm_loss, mp, B, P, steps, warmup,
         p._data._data = jax.device_put(p._data._data, dev)
         if p._data._grad is not None:
             p._data._grad._data = jax.device_put(p._data._grad._data, dev)
-    batch = [to_dev(a) for a in ((tok, seg, pos, labels) if P
-                                 else (tok, seg, labels))]
+    nds = (tok, seg, pos, labels) if P else (tok, seg, labels)
+    if k > 1:
+        # [K, B, ...] stacked window — the io.DataPipeline.stage_window
+        # layout; one tiled resident batch keeps H2D off the loop just
+        # like the SPMD path's pre-staged shard
+        batch = [to_dev(mx.nd.array(
+            np.repeat(np.asarray(a._data)[None], k, axis=0),
+            dtype=str(a._data.dtype))) for a in nds]
+    else:
+        batch = [to_dev(a) for a in nds]
 
     trainer = gluon.Trainer(
         net.collect_params(), "adam",
         {"learning_rate": 1e-4, "multi_precision": mp}, kvstore=None)
     if P:
-        fold = trainer.fold_step(
-            lambda t, s, pm, lb: mlm_loss(net(t, s, pm), lb), block=net)
+        loss_fn = lambda t, s, pm, lb: mlm_loss(net(t, s, pm), lb)
     else:
-        fold = trainer.fold_step(
-            lambda t, s, lb: mlm_loss(net(t, s), lb), block=net)
+        loss_fn = lambda t, s, lb: mlm_loss(net(t, s), lb)
+    fold = (trainer.fold_steps(loss_fn, k=k, block=net) if k > 1
+            else trainer.fold_step(loss_fn, block=net))
+    variant = "step_fold" if k <= 1 else f"step_fold_k[{k}]"
 
     def fence(loss):
         float(np.asarray(loss._data).mean())
         p0 = next(iter(net.collect_params().values()))
         np.asarray(p0._data._data)
 
-    for _ in range(warmup):
+    for _ in range(max(1, warmup // max(1, k))):
         loss = fold(*batch)
     fence(loss)
     if not fold.folded:
@@ -140,25 +153,29 @@ def _bench_bert_folded(net, mlm_loss, mp, B, P, steps, warmup,
         # exits 3 in this case; bench.py reports the error instead)
         print(json.dumps({
             "metric": "bert_base_samples_per_sec",
-            "variant": "step_fold",
+            "variant": variant,
             "error": f"fold fell back: {fold.fallback_reason}",
         }))
         return
+    n_windows = max(1, steps // max(1, k))
     t0 = time.perf_counter()
-    for _ in range(steps):
+    for _ in range(n_windows):
         loss = fold(*batch)
     fence(loss)
     dt = time.perf_counter() - t0
-    samples_per_sec = B * steps / dt   # single device: per-chip == total
-    print(json.dumps({
+    # per LOGICAL step: a K-window is K steps of B samples
+    samples_per_sec = B * n_windows * max(1, k) / dt
+    out = {
         "metric": "bert_base_samples_per_sec",
         "value": round(samples_per_sec, 2),
         "unit": "samples/sec/chip",
-        "variant": "step_fold",
+        "variant": variant,
         "folded": bool(fold.folded),
         "vs_baseline": round(samples_per_sec / BASELINE_SAMPLES_PER_SEC, 3),
-    }))
-    mx  # keep import
+    }
+    if k > 1:
+        out["k"] = k
+    print(json.dumps(out))
 
 
 def bench_resnet50():
@@ -564,13 +581,17 @@ def main():
         mlm_logits, _ = out
         return NDArray(streaming_softmax_ce(mlm_logits._data, label._data).mean(axis=-1))
 
-    if os.environ.get("MXNET_STEP_FOLD") == "1":
+    fold_k = int(os.environ.get("MXNET_STEP_FOLD_K", "0") or 0)
+    if os.environ.get("MXNET_STEP_FOLD") == "1" or fold_k > 1:
         # ISSUE 15: route the headline through the FOLDED imperative step
         # (gluon.Trainer.fold_step — one donated compiled program per
         # step on a single device, docs/step_fold.md) so the TPU round
-        # measures the fold against the SPMD path
+        # measures the fold against the SPMD path.  ISSUE 17: with
+        # MXNET_STEP_FOLD_K=K>1 the step is the K-step fold_steps scan —
+        # one dispatch per K logical steps on a [K, B, ...] tiled batch.
         return _bench_bert_folded(net, mlm_loss, mp, B, P, steps, warmup,
-                                  tok, seg, pos, labels)
+                                  tok, seg, pos, labels,
+                                  k=max(1, fold_k))
     mesh = make_mesh()  # pure-dp over whatever local devices exist
     trainer = SPMDTrainer(net, mlm_loss, "adam",
                           {"learning_rate": 1e-4, "multi_precision": mp}, mesh=mesh)

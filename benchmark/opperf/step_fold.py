@@ -30,10 +30,20 @@ bucket's pushpull launches from the grad-readiness hook DURING backward),
 with convergence parity between both modes asserted.  Paired medians ride
 the evidence JSON (docs/STEP_FOLD_EVIDENCE_r15.json).
 
+``--k [K ...]`` switches to the K-step fold sweep (``Trainer.fold_steps``,
+docs/step_fold.md "Multi-step fold"): the same logical step timed at fold
+widths K (default 1 vs 4 vs 16), paired per round, scored per LOGICAL
+step.  After warmup it asserts dispatches/logical-step == 1/K exactly and
+zero steady-state recompiles; non-smoke additionally requires the largest
+K to beat K=1 by >= 1.3x (the ISSUE 17 acceptance floor).
+
 Acceptance (ISSUE 15): folded >= 2x eager steps/sec on CPU; dist overlap
-per-step wall < sequential.
+per-step wall < sequential.  (ISSUE 17): K=16 >= 1.3x the K=1 folded
+step, dispatches per logical step exactly 1/K.
 
     python benchmark/opperf/step_fold.py [--smoke] [--dist] [--json PATH]
+    python benchmark/opperf/step_fold.py --k            # 1 vs 4 vs 16
+    python benchmark/opperf/step_fold.py --k 1 8 --smoke
 """
 from __future__ import annotations
 
@@ -162,6 +172,102 @@ def run(layers=12, width=32, batch=8, iters=10, warmup=4, repeats=3):
         "speedup_folded_vs_hybrid": round(
             steps_per_sec["folded"] / steps_per_sec["hybrid"], 2),
         "folded_dispatches_per_step": dispatches,
+        "recompiles_steady_state": recompiles,
+    }
+
+
+def run_k_sweep(ks=(1, 4, 16), layers=12, width=32, batch=8, iters=10,
+                warmup=3, repeats=3):
+    """K-step fold sweep (``Trainer.fold_steps``): time the SAME logical
+    training step at several fold widths K and assert the dispatch
+    contract — exactly one host dispatch per K logical steps (1/K per
+    logical step) and zero steady-state recompiles.  K=1 is the PR 15
+    single-step fold; larger K amortises the per-dispatch host cost over
+    the in-program ``lax.scan``.  Measurement is paired per round (one
+    window of each K back-to-back), score = median wall / K (per LOGICAL
+    step).  Returns the result dict."""
+    import gc
+
+    import numpy as np
+
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import gluon, profiler
+    from incubator_mxnet_tpu.gluon import step_fold
+
+    L2 = gluon.loss.L2Loss()
+    folds = {}
+    for k in ks:
+        net, tr, x, y = _build(42, True, layers, width, batch)
+        fold = tr.fold_steps(lambda a, b, n=net: L2(n(a), b), k=k,
+                             block=net)
+        if k == 1:
+            folds[k] = (fold, (x, y))
+        else:
+            # [K, batch, ...] stacked window, the stage_window layout
+            xw = mx.nd.array(np.repeat(np.asarray(x._data)[None],
+                                       k, axis=0))
+            yw = mx.nd.array(np.repeat(np.asarray(y._data)[None],
+                                       k, axis=0))
+            folds[k] = (fold, (xw, yw))
+
+    def one(k):
+        fold, nds = folds[k]
+        t0 = time.perf_counter()
+        fold(*nds)
+        mx.nd.waitall()
+        return (time.perf_counter() - t0) / k   # per LOGICAL step
+
+    for _ in range(max(1, warmup)):
+        for k in ks:
+            one(k)
+    for k in ks:
+        fold, _ = folds[k]
+        if not fold.folded:
+            print(f"K={k} FOLD FELL BACK: {fold.fallback_reason}",
+                  file=sys.stderr)
+            raise SystemExit(3)
+
+    # dispatch contract AFTER warmup: one window dispatch covers K logical
+    # steps, so dispatches / logical step must be exactly 1/K
+    c_base = profiler.counters()["recompile_steady_state"]
+    dispatch_ratio = {}
+    check_windows = 3
+    for k in ks:
+        c0 = profiler.counters()
+        for _ in range(check_windows):
+            one(k)
+        c1 = profiler.counters()
+        d = (step_fold.host_dispatch_total(c1)
+             - step_fold.host_dispatch_total(c0))
+        dispatch_ratio[k] = d / (check_windows * k)
+
+    rounds = max(1, iters * repeats)
+    times = {k: [] for k in ks}
+    gc.collect()
+    gc_was_on = gc.isenabled()
+    gc.disable()
+    try:
+        for _ in range(rounds):
+            for k in ks:
+                times[k].append(one(k))
+    finally:
+        if gc_was_on:
+            gc.enable()
+    recompiles = (profiler.counters()["recompile_steady_state"] - c_base)
+    medians = {k: _median(ts) for k, ts in times.items()}
+    kmax, kmin = max(ks), min(ks)
+    return {
+        "bench": "step_fold_k_sweep",
+        "backend": os.environ.get("JAX_PLATFORMS", "default"),
+        "layers": layers, "width": width, "batch": batch,
+        "rounds": rounds, "ks": list(ks),
+        "logical_steps_per_sec": {str(k): round(1.0 / m, 2)
+                                  for k, m in medians.items()},
+        "median_logical_step_s": {str(k): m for k, m in medians.items()},
+        "dispatches_per_logical_step": {str(k): round(r, 6)
+                                        for k, r in dispatch_ratio.items()},
+        "speedup_kmax_vs_k1": round(medians[kmin] / medians[kmax], 2),
+        "k_max": kmax,
         "recompiles_steady_state": recompiles,
     }
 
@@ -302,6 +408,12 @@ def main(argv=None):
     p.add_argument("--smoke", action="store_true",
                    help="tiny config; the steady-state assertions ARE the "
                         "regression guard (non-zero exit on any violation)")
+    p.add_argument("--k", dest="k_sweep", nargs="*", type=int, default=None,
+                   metavar="K",
+                   help="run the K-step fold sweep instead (default sweep "
+                        "1 4 16, or the listed K values): times the same "
+                        "logical step at each fold width and asserts "
+                        "dispatches/logical-step == 1/K after warmup")
     p.add_argument("--dist", action="store_true",
                    help="also run the 2-process overlap experiment")
     p.add_argument("--bucket-kb", type=int, default=64)
@@ -325,6 +437,39 @@ def main(argv=None):
         if getattr(args, k) is not None:
             defaults[k] = getattr(args, k)
         defaults.setdefault(k, None)
+
+    if args.k_sweep is not None:
+        ks = tuple(sorted(set(args.k_sweep))) or (
+            (1, 4) if args.smoke else (1, 4, 16))
+        result = run_k_sweep(ks=ks, **defaults)
+        print(json.dumps(result))
+        if args.json_path:
+            with open(args.json_path, "w") as f:
+                json.dump(result, f, indent=2)
+                f.write("\n")
+        rc = 0
+        for k_str, ratio in result["dispatches_per_logical_step"].items():
+            want = 1.0 / int(k_str)
+            if abs(ratio - want) > 1e-9:
+                print(f"FAIL: K={k_str}: {ratio} dispatches per logical "
+                      f"step (want exactly {want:.6f})", file=sys.stderr)
+                rc = 1
+        if result["recompiles_steady_state"]:
+            print(f"FAIL: {result['recompiles_steady_state']} steady-state "
+                  "recompiles during the sweep", file=sys.stderr)
+            rc = 1
+        # smoke asserts the dispatch contract only — paired-median timing
+        # on a 3-iter tiny config is noise, not signal
+        if not args.smoke and len(ks) > 1 \
+                and result["speedup_kmax_vs_k1"] < 1.3:
+            print(f"FAIL: K={result['k_max']} only "
+                  f"{result['speedup_kmax_vs_k1']}x the K={min(ks)} folded "
+                  "step (acceptance floor 1.3x)", file=sys.stderr)
+            rc = 1
+        if rc:
+            raise SystemExit(rc)
+        return result
+
     result = run(**defaults)
 
     if args.dist:

@@ -39,6 +39,21 @@ off at program granularity, not op granularity):
   group (forward/backward already ran eagerly by the time ``step()`` is
   called, so this is the part of the step ``Trainer.step`` can fold).
 
+* The K-step fold (``Trainer.fold_steps(loss_fn, k)``, K from
+  ``MXNET_STEP_FOLD_K``) wraps the SAME per-step body in a ``lax.scan``
+  over K pre-staged batches: params, optimizer state (and under error
+  feedback, compression residuals) ride the loop carry, per-step
+  lr/wd/t and PRNG keys ride as stacked ``[K]`` device arrays, and the
+  K per-step losses accumulate in-program — host dispatch cost drops to
+  1/K with numerics exactly equal to K unfolded steps.  The input side
+  folds too: ``pipeline.stage_window(k)`` hands the program a
+  device-resident ``[K, ...]`` stacked batch window the transfer thread
+  built ahead of the scan.  ``K=1`` IS the PR 15 program (same site,
+  same signature).  Checkpoints land on K boundaries only
+  (``save_states`` refuses mid-window; the window cursor rides the
+  snapshot payload).  Compile sites ``gluon.step_fold_k`` and (for
+  :class:`EvalProgram`, ``Trainer.fold_eval``) ``gluon.fold_eval``.
+
 Escape hatches (docs/step_fold.md): ``MXNET_STEP_FOLD=0`` disables both
 entries, a block opts out with ``block._step_fold_opt_out = True``, and
 any capture failure or unsupported optimizer falls back to the eager
@@ -64,8 +79,9 @@ from ..optimizer.optimizer import _swap
 from ..random import get_key
 from .block import trace_scope
 
-__all__ = ["StepProgram", "fold_update", "fold_enabled", "step_fast_path",
-           "host_dispatch_total", "DISPATCH_COUNTERS"]
+__all__ = ["StepProgram", "EvalProgram", "fold_update", "fold_enabled",
+           "step_fast_path", "fold_k", "host_dispatch_total",
+           "DISPATCH_COUNTERS", "FALLBACK_LABELS"]
 
 
 def fold_enabled():
@@ -73,6 +89,28 @@ def fold_enabled():
     ``MXNET_STEP_FOLD=0`` is the escape hatch — the returned StepProgram
     still works, running the eager record/backward/step path)."""
     return _os.environ.get("MXNET_STEP_FOLD", "1") != "0"
+
+
+def fold_k(default=1):
+    """The configured fold width K (``MXNET_STEP_FOLD_K``, default 1):
+    how many logical training steps ``Trainer.fold_steps`` /
+    ``Trainer.fold_eval`` fold into one compiled dispatch when the
+    caller does not pass ``k`` explicitly.  K=1 reduces exactly to the
+    single-step fold."""
+    try:
+        k = int(_os.environ.get("MXNET_STEP_FOLD_K", "") or default)
+    except ValueError:
+        k = default
+    return max(1, k)
+
+
+# Canonical per-reason labels for the ``step_fold_fallback`` counter
+# (``profiler.incr_labeled`` — surfaced in ``dumps()``, the metrics
+# snapshot and the Prometheus counters): one scrape says WHY a fold ran
+# eager, not just how often.
+FALLBACK_LABELS = ("env-off", "naive-engine", "block-opt-out",
+                   "grad-req-add", "unsupported-optimizer", "async-PS",
+                   "capture-failure", "deferred-init")
 
 
 def step_fast_path():
@@ -88,7 +126,7 @@ def step_fast_path():
 DISPATCH_COUNTERS = (
     "dispatch_cache_hit", "dispatch_cache_miss", "dispatch_cache_bypass",
     "dispatch_cache_fallback", "bulk_flush", "fused_step_call",
-    "allreduce_bucket", "step_fold_call",
+    "allreduce_bucket", "step_fold_call", "fold_eval_call",
 )
 
 
@@ -126,22 +164,34 @@ class StepProgram:
     hatches.
     """
 
-    def __init__(self, trainer, loss_fn, block=None, keep_grads=False):
+    def __init__(self, trainer, loss_fn, block=None, keep_grads=False,
+                 k=None, donate_window=False):
         self._trainer = trainer
         self._loss_fn = loss_fn
         self._block = block
         self._keep_grads = bool(keep_grads)
-        self._cache = {}            # (batch sig, group sig) -> entry dict
+        self._k = max(1, int(k if k is not None else fold_k()))
+        self._donate_window = bool(donate_window)
+        self._cache = {}            # (batch sig, group sig, ...) -> entry
         self._fallback_reason = None
+        self._fallback_label = None
         self._warned = False
         self._guard_armed = False
         self._dist = None           # _DistRegisters when folding over a mesh
+        self._logical_steps = 0     # logical training steps run (any path)
+        self._window_pos = 0        # steps since the last window boundary:
+                                    # always 0 for K=1 and after any whole-
+                                    # window dispatch; step_one moves it —
+                                    # save_states refuses while it is != 0
         if not fold_enabled():
             self._fallback_reason = "MXNET_STEP_FOLD=0"
+            self._fallback_label = "env-off"
         elif _engine.is_naive():
             self._fallback_reason = "NaiveEngine"
+            self._fallback_label = "naive-engine"
         elif _opted_out(block):
             self._fallback_reason = "block opt-out (_step_fold_opt_out)"
+            self._fallback_label = "block-opt-out"
 
     # -- public surface --------------------------------------------------
     @property
@@ -154,14 +204,41 @@ class StepProgram:
     def fallback_reason(self):
         return self._fallback_reason
 
+    @property
+    def k(self):
+        """Configured fold width: logical steps per full window."""
+        return self._k
+
+    @property
+    def logical_steps(self):
+        """Logical training steps this program has run (folded or eager)."""
+        return self._logical_steps
+
+    @property
+    def window_pos(self):
+        """Logical steps since the last window boundary (``0 <= pos < k``).
+        Whole-window dispatches — full or epoch-tail — always land back on
+        a boundary; only the ``step_one`` escape moves the cursor.  The
+        K-boundary checkpoint rule: ``Trainer.save_states`` refuses while
+        this is non-zero (docs/step_fold.md#multi-step-fold)."""
+        return self._window_pos
+
     def __call__(self, *batch, batch_size=None):
         tr = self._trainer
         if not tr._kv_initialized:
             tr._init_kvstore()
         nds = [b if isinstance(b, NDArray) else NDArray(jnp.asarray(b))
                for b in batch]
+        if self._k > 1:
+            return self._window_call(nds, batch_size)
         if batch_size is None:
             batch_size = nds[0].shape[0]
+        out = self._call_one(nds, batch_size)
+        self._logical_steps += 1
+        return out
+
+    def _call_one(self, nds, batch_size):
+        tr = self._trainer
         if self._fallback_reason is not None:
             return self._eager_step(nds, batch_size)
         # deferred-init params can only materialize through a real eager
@@ -180,6 +257,60 @@ class StepProgram:
         finally:
             _elastic.watchdog_disarm()
 
+    def _window_call(self, nds, batch_size):
+        """One K-window dispatch: ``nds`` are ``[k_window, batch, ...]``
+        stacked arrays (``pipeline.stage_window(k)`` hands them over
+        device-resident; an epoch tail may carry ``k_window < k``).  Any
+        whole-window dispatch lands the program back on a window
+        boundary.  Returns the ``[k_window, ...]`` per-step losses."""
+        tr = self._trainer
+        if nds[0].ndim < 2:
+            raise ValueError(
+                f"fold_steps(k={self._k}) expects stacked [k, batch, ...] "
+                "windows (pipeline.stage_window(k)); got shape "
+                f"{tuple(nds[0].shape)} — use step_one() for a single "
+                "unstacked batch")
+        kw = int(nds[0].shape[0])
+        if any(int(nd.shape[0]) != kw for nd in nds):
+            raise ValueError(
+                "window leading dims disagree: "
+                f"{[tuple(nd.shape) for nd in nds]}")
+        if batch_size is None:
+            batch_size = nds[0].shape[1]
+        if self._fallback_reason is not None or any(
+                p._deferred_init is not None or p._data is None
+                for p in tr._params):
+            out = self._eager_window(nds, batch_size)
+        else:
+            from ..parallel import elastic as _elastic
+            _elastic.watchdog_arm("step_fold.call")
+            try:
+                out = self._folded_step(nds, batch_size, k_window=kw)
+            finally:
+                _elastic.watchdog_disarm()
+        self._logical_steps += kw
+        self._window_pos = 0
+        return out
+
+    def step_one(self, *batch, batch_size=None):
+        """Single-logical-step escape on a K>1 program: runs ONE step as
+        a ``k_window=1`` window (its own compiled entry, registered as a
+        declared warmup — never a steady-state guard violation) and moves
+        the window cursor off the K boundary; ``Trainer.save_states``
+        refuses until further ``step_one`` calls complete a whole window.
+        On a K=1 program this is exactly ``__call__``."""
+        if self._k == 1:
+            return self(*batch, batch_size=batch_size)
+        nds = [b if isinstance(b, NDArray) else NDArray(jnp.asarray(b))
+               for b in batch]
+        if batch_size is None:
+            batch_size = nds[0].shape[0]
+        window = [NDArray(nd._data[None]) for nd in nds]
+        pos = self._window_pos
+        out = self(*window, batch_size=batch_size)
+        self._window_pos = (pos + 1) % self._k
+        return NDArray(out._data[0])
+
     def sync(self):
         """Write fold-held state back into the live Parameters/Trainer
         (no-op for the local fold, which swaps buffers every step; the
@@ -196,13 +327,14 @@ class StepProgram:
         self._dist = None
 
     # -- fallback path ---------------------------------------------------
-    def _note_fallback(self, reason):
+    def _note_fallback(self, reason, label="capture-failure"):
         if self._dist is not None:
             # the registers hold the live trajectory; the eager path reads
             # the Parameters — refresh them before switching over
             self._dist.sync_out()
             self._dist = None
         self._fallback_reason = reason
+        self._fallback_label = label
         if not self._warned:
             self._warned = True
             _warnings.warn(
@@ -210,23 +342,44 @@ class StepProgram:
                 "record/backward/step path instead — see docs/step_fold.md",
                 UserWarning, stacklevel=3)
 
+    def _run_eager(self, nds, batch_size):
+        """Route a fallback to the right eager shape: stacked windows for
+        a K>1 program, the single-batch path otherwise.  ``nds`` must
+        match the shape the caller was dispatched with."""
+        if self._k > 1 and nds and nds[0].ndim >= 2:
+            return self._eager_window(nds, batch_size)
+        return self._eager_step(nds, batch_size)
+
     def _eager_step(self, nds, batch_size):
         """The unfused reference path: record forward+loss, tape backward,
         ``Trainer.step`` (allreduce + fused optimizer groups).  EVERY
         eager execution through the program counts in
-        ``step_fold_fallback`` — the counter quantifies how much of a
-        run escaped the fold, not how many distinct reasons there were."""
-        _profiler.incr("step_fold_fallback")
+        ``step_fold_fallback`` (with a per-reason label) — the counter
+        quantifies how much of a run escaped the fold, not how many
+        distinct reasons there were."""
+        _profiler.incr_labeled("step_fold_fallback",
+                               self._fallback_label or "deferred-init")
         with autograd.record():
             loss = self._loss_fn(*nds)
         autograd.backward([loss])
         self._trainer.step(batch_size)
         return loss
 
+    def _eager_window(self, nds, batch_size):
+        """Eager reference for a stacked ``[k_window, ...]`` window: one
+        unfused step per row, losses restacked to the folded program's
+        ``[k_window, ...]`` output shape."""
+        losses = []
+        for j in range(int(nds[0].shape[0])):
+            row = [NDArray(nd._data[j]) for nd in nds]
+            losses.append(self._eager_step(row, batch_size))
+        return NDArray(jnp.stack([l._data for l in losses]))
+
     # -- the folded step -------------------------------------------------
-    def _folded_step(self, nds, batch_size):
+    def _folded_step(self, nds, batch_size, k_window=None):
         tr = self._trainer
         opt = tr._optimizer
+        kw = k_window if self._k > 1 else None
         tr._check_and_rescale_grad(tr._scale / batch_size)
         touched = []
         for i, p in enumerate(tr._params):
@@ -239,8 +392,9 @@ class StepProgram:
                 # grad_req='add' accumulates across backwards — a folded
                 # step would overwrite the running sum
                 self._note_fallback(f"{p.name} has grad_req="
-                                    f"{p.grad_req!r} (fold needs 'write')")
-                return self._eager_step(nds, batch_size)
+                                    f"{p.grad_req!r} (fold needs 'write')",
+                                    label="grad-req-add")
+                return self._run_eager(nds, batch_size)
             if i not in tr._states:
                 tr._states[i] = opt.create_state_multi_precision(i, p.data())
             touched.append((i, p))
@@ -251,8 +405,9 @@ class StepProgram:
             names = [tr._params[i].name for i, _, _ in rest][:3]
             self._note_fallback(
                 f"no fused kernels for {type(opt).__name__} on "
-                f"{names or 'these params'} (lazy/sparse or unsupported)")
-            return self._eager_step(nds, batch_size)
+                f"{names or 'these params'} (lazy/sparse or unsupported)",
+                label="unsupported-optimizer")
+            return self._run_eager(nds, batch_size)
 
         # kvstore routing: a dist store either folds in-program (SPMD
         # collectives available) or forces the eager path (async PS —
@@ -263,8 +418,8 @@ class StepProgram:
                          and kv.supports_grad_bucketing()):
             self._note_fallback(
                 f"kvstore {getattr(kv, 'type', kv)!r} cannot fold "
-                "(server-side optimizer / async tier)")
-            return self._eager_step(nds, batch_size)
+                "(server-side optimizer / async tier)", label="async-PS")
+            return self._run_eager(nds, batch_size)
 
         tpos_of = {i: t for t, (i, _) in enumerate(touched)}
         group_sig = tuple(
@@ -274,40 +429,62 @@ class StepProgram:
             for (step, dt, cx), members in groups.items())
         raws = [_raw(nd) for nd in nds]
         batch_sig = tuple((tuple(a.shape), str(a.dtype)) for a in raws)
-        key_sig = (batch_sig, group_sig, bool(dist))
+        key_sig = (batch_sig, group_sig, bool(dist), kw)
 
         entry = self._cache.get(key_sig)
         fresh = entry is None
         if fresh:
             try:
-                entry = self._build(raws, touched, groups, tpos_of, dist, kv)
+                entry = self._build(raws, touched, groups, tpos_of, dist,
+                                    kv, kw=kw)
             except Exception as e:  # capture failure: loud sticky fallback
                 self._note_fallback(f"capture failed: {e!r:.200}")
-                return self._eager_step(nds, batch_size)
+                return self._run_eager(nds, batch_size)
             self._cache[key_sig] = entry
 
         # per-step dynamic hypers: bump ALL counts first, then read lr/wd
         # (the fused_update discipline — synchronized params all see the
-        # same num_update)
-        for i, _ in touched:
-            opt._update_count(i)
-        lrs = jnp.asarray([opt._get_lr(i) for i, _ in touched], jnp.float32)
-        wds = jnp.asarray([opt._get_wd(i) for i, _ in touched], jnp.float32)
-        ts = jnp.asarray([opt._index_update_count[i] for i, _ in touched],
-                         jnp.float32)
+        # same num_update).  For a K-window, repeat the discipline once
+        # per LOGICAL step so the stacked [K, n] rows are exactly what K
+        # unfolded steps would have staged — and draw K keys from the
+        # ambient stream in step order so dropout parity is bit-exact.
+        if kw is None:
+            for i, _ in touched:
+                opt._update_count(i)
+            lrs = jnp.asarray([opt._get_lr(i) for i, _ in touched],
+                              jnp.float32)
+            wds = jnp.asarray([opt._get_wd(i) for i, _ in touched],
+                              jnp.float32)
+            ts = jnp.asarray([opt._index_update_count[i]
+                              for i, _ in touched], jnp.float32)
+            key = get_key()
+        else:
+            lr_rows, wd_rows, t_rows, keys = [], [], [], []
+            for _ in range(kw):
+                for i, _p in touched:
+                    opt._update_count(i)
+                lr_rows.append([opt._get_lr(i) for i, _p in touched])
+                wd_rows.append([opt._get_wd(i) for i, _p in touched])
+                t_rows.append([opt._index_update_count[i]
+                               for i, _p in touched])
+                keys.append(get_key())
+            lrs = jnp.asarray(lr_rows, jnp.float32)
+            wds = jnp.asarray(wd_rows, jnp.float32)
+            ts = jnp.asarray(t_rows, jnp.float32)
+            key = jnp.stack(keys)
         scalars = {k: jnp.asarray(v, jnp.float32)
                    for k, v in _fused._scalars(opt).items()}
-        key = get_key()
 
         return self._dispatch(entry, touched, key, lrs, wds, ts, scalars,
-                              raws, fresh)
+                              raws, fresh, kw)
 
     def _dispatch(self, entry, touched, key, lrs, wds, ts, scalars, raws,
-                  fresh):
+                  fresh, kw=None):
         tr = self._trainer
+        site = "gluon.step_fold" if kw is None else "gluon.step_fold_k"
         if self._dist is not None:
             call_args = self._dist.stage_call(key, lrs, wds, ts, scalars,
-                                              raws)
+                                              raws, window=kw is not None)
         else:
             param_arrs = [_raw(p._data) for p in entry["params"]]
             state_arrs = [tuple(_raw(s) for s in flat)
@@ -321,20 +498,32 @@ class StepProgram:
                 out = entry["fn"](*call_args)
             except Exception as e:
                 # the donated whole-step dispatch is an OOM choke point
-                _profiler.maybe_oom_postmortem(e, "gluon.step_fold")
+                _profiler.maybe_oom_postmortem(e, site)
                 raise
             loss_local = self._wire_outputs(entry, touched, out)
             if tc is not None:
                 # AFTER output wiring: a guard in raise mode must never
-                # leave Parameters pointing at donated-and-deleted buffers
-                _profiler.record_compile(
-                    "gluon.step_fold", self._compile_sig(entry, raws),
-                    (_perf() - tc) * 1e3)
+                # leave Parameters pointing at donated-and-deleted buffers.
+                # Tail-window / step_one entries (k_window != k) are a
+                # DECLARED warmup: each distinct window width is its own
+                # program, built once — register the compile but don't let
+                # an armed guard judge it (the serving re-warm convention).
+                if entry.get("declared_warmup"):
+                    with _profiler.compile_guard_paused():
+                        _profiler.record_compile(
+                            site, self._compile_sig(entry, raws),
+                            (_perf() - tc) * 1e3)
+                else:
+                    _profiler.record_compile(
+                        site, self._compile_sig(entry, raws),
+                        (_perf() - tc) * 1e3)
             if t0 is not None:
-                _profiler.record_span(
-                    "trainer.step_fold", "trainer", t0,
-                    args={"params": len(touched),
-                          "dist": self._dist is not None})
+                span_args = {"params": len(touched),
+                             "dist": self._dist is not None}
+                if kw is not None:
+                    span_args["k"] = int(kw)
+                _profiler.record_span("trainer.step_fold", "trainer", t0,
+                                      args=span_args)
             _profiler.incr("step_fold_call")
             # freshness snapshot (Trainer._update parity): only a future
             # backward/user write may flip a param back to fresh
@@ -344,12 +533,13 @@ class StepProgram:
             _profiler.step_boundary()
         if not self._guard_armed:
             self._guard_armed = True
-            _profiler.arm_compile_guard("gluon.step_fold")
+            _profiler.arm_compile_guard(site)
         return loss_local
 
     def _compile_sig(self, entry, raws):
-        sig = {"__program__": "step_fold" + (":dist" if entry["dist"]
-                                             else ""),
+        kw = entry.get("k")
+        program = "step_fold" if not kw else f"step_fold_k[{kw}]"
+        sig = {"__program__": program + (":dist" if entry["dist"] else ""),
                "params": _profiler.sig_static(len(entry["params"])),
                "groups": _profiler.sig_static(
                    [g[0] for g in entry["plan_names"]])}
@@ -391,11 +581,19 @@ class StepProgram:
         return NDArray(loss_data)
 
     # -- capture ---------------------------------------------------------
-    def _build(self, raws, touched, groups, tpos_of, dist, kv):
+    def _build(self, raws, touched, groups, tpos_of, dist, kv, kw=None):
         """Trace + jit the whole step.  Returns the cache entry dict.  The
         capture is validated with ``jax.eval_shape`` (no device work), so
         a loss_fn the tracer cannot swallow fails HERE — cleanly — and the
-        caller falls back to the eager path."""
+        caller falls back to the eager path.
+
+        With ``kw`` (the K-step fold), the SAME per-step body — forward,
+        backward, bucket collectives, optimizer tail, aux write-back —
+        becomes the body of a ``jax.lax.scan`` over the ``[kw, ...]``
+        stacked batch window: params and optimizer state (and, dist, EF
+        residuals) ride the loop carry; per-step lr/wd/t rows and PRNG
+        keys ride as stacked ``[kw, ...]`` scan inputs; the per-step
+        losses stack as the scan output."""
         tr = self._trainer
         params = [p for p in tr._params if p._data is not None]
         slot_of = {id(p): s for s, p in enumerate(params)}
@@ -468,21 +666,59 @@ class StepProgram:
             return self._build_dist(raws, touched, params, state_flats,
                                     plan, plan_names, trainable_slots,
                                     forward_loss, optimizer_tail, apply_aux,
-                                    aux_cell, loss_meta, kv)
+                                    aux_cell, loss_meta, kv, kw=kw)
 
-        def pure_step(key, lrs, wds, ts, scalars, param_arrs, state_arrs,
-                      *batch):
+        def one_step(key, lr, wd, t, scalars, param_arrs, state_arrs,
+                     batch):
+            """ONE logical step — shared verbatim by the K=1 program and
+            the scan body, so folded numerics cannot depend on K."""
             train_arrs = [param_arrs[s] for s in trainable_slots]
             (_, (aux_vals, loss_data)), grads = jax.value_and_grad(
-                forward_loss, has_aux=True)(train_arrs, param_arrs, key,
-                                            batch)
+                forward_loss, has_aux=True)(train_arrs, list(param_arrs),
+                                            key, batch)
             new_full, new_states = optimizer_tail(
-                param_arrs, state_arrs, grads, lrs, wds, ts, scalars)
+                param_arrs, state_arrs, grads, lr, wd, t, scalars)
             apply_aux(new_full, param_arrs, aux_vals)
-            out = (new_full, new_states, loss_data)
-            if keep_grads:
-                out += (list(grads),)
-            return out
+            return new_full, new_states, loss_data, grads
+
+        if kw is None:
+            def pure_step(key, lrs, wds, ts, scalars, param_arrs,
+                          state_arrs, *batch):
+                new_full, new_states, loss_data, grads = one_step(
+                    key, lrs, wds, ts, scalars, param_arrs, state_arrs,
+                    batch)
+                out = (new_full, new_states, loss_data)
+                if keep_grads:
+                    out += (list(grads),)
+                return out
+        else:
+            def pure_step(keys, lrs, wds, ts, scalars, param_arrs,
+                          state_arrs, *windows):
+                def body(carry, xs):
+                    p_arrs, s_arrs = carry[0], carry[1]
+                    key, lr, wd, t = xs[0], xs[1], xs[2], xs[3]
+                    batch = xs[4:]
+                    new_full, new_states, loss_data, grads = one_step(
+                        key, lr, wd, t, scalars, list(p_arrs),
+                        [tuple(s) for s in s_arrs], batch)
+                    new_carry = (tuple(new_full),
+                                 tuple(tuple(s) for s in new_states))
+                    if keep_grads:
+                        new_carry += (tuple(grads),)
+                    return new_carry, loss_data
+
+                init = (tuple(param_arrs),
+                        tuple(tuple(s) for s in state_arrs))
+                if keep_grads:
+                    init += (tuple(jnp.zeros_like(param_arrs[s])
+                                   for s in trainable_slots),)
+                xs = (keys, lrs, wds, ts) + tuple(windows)
+                carry, losses = jax.lax.scan(body, init, xs)
+                out = (list(carry[0]),
+                       [tuple(s) for s in carry[1]], losses)
+                if keep_grads:
+                    out += (list(carry[2]),)
+                return out
 
         # abstract validation pass — populates aux_cell/loss_meta and
         # surfaces capture failures without any device work.  The key aval
@@ -490,12 +726,13 @@ class StepProgram:
         # ambient stream at build time would desync fold-vs-unfused
         # dropout parity by one key.
         ex_key = jax.random.PRNGKey(0)
-        key_aval = jax.ShapeDtypeStruct(ex_key.shape, ex_key.dtype)
+        hyp = ((len(touched),) if kw is None else (kw, len(touched)))
+        key_shape = ex_key.shape if kw is None else (kw,) + ex_key.shape
         abstract = (
-            key_aval,
-            jax.ShapeDtypeStruct((len(touched),), jnp.float32),
-            jax.ShapeDtypeStruct((len(touched),), jnp.float32),
-            jax.ShapeDtypeStruct((len(touched),), jnp.float32),
+            jax.ShapeDtypeStruct(key_shape, ex_key.dtype),
+            jax.ShapeDtypeStruct(hyp, jnp.float32),
+            jax.ShapeDtypeStruct(hyp, jnp.float32),
+            jax.ShapeDtypeStruct(hyp, jnp.float32),
             {k: jax.ShapeDtypeStruct((), jnp.float32)
              for k in _fused._scalars(tr._optimizer)},
             [jax.ShapeDtypeStruct(p._data.shape, p._data.dtype)
@@ -507,14 +744,22 @@ class StepProgram:
         jax.eval_shape(pure_step, *abstract)
         self._warn_foreign_aux(aux_cell)
         donate = (5, 6) if _fused.donation_enabled() else ()
+        if kw is not None and self._donate_window and \
+                _fused.donation_enabled():
+            # the staged [K, ...] window is single-use — donating it back
+            # to the allocator covers the stacked copy's footprint
+            donate += tuple(range(7, 7 + len(raws)))
         fn = jax.jit(pure_step, donate_argnums=donate)
         return {"fn": fn, "params": params, "state_flats": state_flats,
-                "plan_names": plan_names, "dist": False}
+                "plan_names": plan_names, "dist": False, "k": kw,
+                "declared_warmup": kw is not None and kw != self._k,
+                "abstract": abstract}
 
     # -- the multi-process (in-fold collectives) build -------------------
     def _build_dist(self, raws, touched, params, state_flats, plan,
                     plan_names, trainable_slots, forward_loss,
-                    optimizer_tail, apply_aux, aux_cell, loss_meta, kv):
+                    optimizer_tail, apply_aux, aux_cell, loss_meta, kv,
+                    kw=None):
         """Fold the gradient exchange into the program: forward/backward
         per worker shard under ONE ``shard_map`` over the kvstore's worker
         mesh, with each size-capped gradient bucket an explicit allreduce
@@ -553,8 +798,12 @@ class StepProgram:
         smap = get_shard_map()
         P0 = P()
         PW = P("w")
-        batch_specs = tuple(P(*(("w",) + (None,) * (a.ndim - 1)))
-                            for a in raws)
+        # per-LOGICAL-step batch spec: inside a K-window the scan body
+        # sees one [global_batch, ...] slice per iteration (the stacked
+        # window itself is sharded on axis 1, its batch axis)
+        batch_specs = tuple(
+            P(*(("w",) + (None,) * ((a.ndim - (2 if kw else 1)))))
+            for a in raws)
 
         def shard_body(train_arrs, full_arrs, key, residuals, *batch):
             # distinct PRNG stream per worker — the documented dist-fold
@@ -588,23 +837,64 @@ class StepProgram:
             aux_vals = tuple(jax.lax.pmean(a, "w") for a in aux_vals)
             return (tuple(new_grads), tuple(new_resid), loss_out, aux_vals)
 
-        def pure_step(key, lrs, wds, ts, scalars, param_arrs, state_arrs,
-                      residuals, *batch):
+        mapped = smap(
+            shard_body, mesh=mesh,
+            in_specs=(P0, P0, P0, PW) + batch_specs,
+            out_specs=(P0, PW, PW, P0))
+
+        def dist_step(key, lr, wd, t, scalars, param_arrs, state_arrs,
+                      residuals, batch):
+            """ONE logical dist step (shard_map'd collectives inside) —
+            shared verbatim by the K=1 program and the scan body."""
             train_arrs = [param_arrs[s] for s in trainable_slots]
-            mapped = smap(
-                shard_body, mesh=mesh,
-                in_specs=(P0, P0, P0, PW) + batch_specs,
-                out_specs=(P0, PW, PW, P0))
             grads_t, new_resid, loss_out, aux_vals = mapped(
                 train_arrs, list(param_arrs), key, tuple(residuals), *batch)
             new_full, new_states = optimizer_tail(
-                param_arrs, state_arrs, list(grads_t), lrs, wds, ts,
-                scalars)
+                param_arrs, state_arrs, list(grads_t), lr, wd, t, scalars)
             apply_aux(new_full, param_arrs, aux_vals)
-            out = (new_full, new_states, list(new_resid), loss_out)
-            if keep_grads:
-                out += (list(grads_t),)
-            return out
+            return new_full, new_states, list(new_resid), loss_out, grads_t
+
+        if kw is None:
+            def pure_step(key, lrs, wds, ts, scalars, param_arrs,
+                          state_arrs, residuals, *batch):
+                new_full, new_states, new_resid, loss_out, grads_t = \
+                    dist_step(key, lrs, wds, ts, scalars, param_arrs,
+                              state_arrs, residuals, batch)
+                out = (new_full, new_states, new_resid, loss_out)
+                if keep_grads:
+                    out += (list(grads_t),)
+                return out
+        else:
+            def pure_step(keys, lrs, wds, ts, scalars, param_arrs,
+                          state_arrs, residuals, *windows):
+                def body(carry, xs):
+                    p_arrs, s_arrs, resid = carry
+                    key, lr, wd, t = xs[0], xs[1], xs[2], xs[3]
+                    batch = xs[4:]
+                    new_full, new_states, new_resid, loss_out, grads_t = \
+                        dist_step(key, lr, wd, t, scalars, list(p_arrs),
+                                  [tuple(s) for s in s_arrs], list(resid),
+                                  batch)
+                    new_carry = (tuple(new_full),
+                                 tuple(tuple(s) for s in new_states),
+                                 tuple(new_resid))
+                    ys = (loss_out,)
+                    if keep_grads:
+                        ys += (tuple(grads_t),)
+                    return new_carry, ys
+
+                init = (tuple(param_arrs),
+                        tuple(tuple(s) for s in state_arrs),
+                        tuple(residuals))
+                xs = (keys, lrs, wds, ts) + tuple(windows)
+                carry, ys = jax.lax.scan(body, init, xs)
+                out = (list(carry[0]), [tuple(s) for s in carry[1]],
+                       list(carry[2]), ys[0])
+                if keep_grads:
+                    # last logical step's grads — the window-boundary
+                    # grads, same contract as K=1's post-step grads
+                    out += ([g[-1] for g in ys[1]],)
+                return out
 
         if self._dist is not None:
             # a rebuild (new batch signature): the live Parameters are
@@ -614,17 +904,29 @@ class StepProgram:
                               buckets if ef else [], loss_meta)
         self._dist = regs
         donate = (5, 6, 7) if _fused.donation_enabled() else ()
+        if kw is not None and self._donate_window and \
+                _fused.donation_enabled():
+            donate += tuple(range(8, 8 + len(raws)))
         with mesh:
             fn = jax.jit(pure_step, donate_argnums=donate)
         # validation trace (abstract; global shapes)
         ex_key = jax.random.PRNGKey(0)
-        key_aval = jax.ShapeDtypeStruct(ex_key.shape, ex_key.dtype)
         nw = mesh.devices.size
+        hyp = ((n_train,) if kw is None else (kw, n_train))
+        key_shape = ex_key.shape if kw is None else (kw,) + ex_key.shape
+        if kw is None:
+            batch_avals = [jax.ShapeDtypeStruct(
+                (a.shape[0] * nw,) + tuple(a.shape[1:]), a.dtype)
+                for a in raws]
+        else:
+            batch_avals = [jax.ShapeDtypeStruct(
+                (a.shape[0], a.shape[1] * nw) + tuple(a.shape[2:]),
+                a.dtype) for a in raws]
         abstract = (
-            key_aval,
-            jax.ShapeDtypeStruct((n_train,), jnp.float32),
-            jax.ShapeDtypeStruct((n_train,), jnp.float32),
-            jax.ShapeDtypeStruct((n_train,), jnp.float32),
+            jax.ShapeDtypeStruct(key_shape, ex_key.dtype),
+            jax.ShapeDtypeStruct(hyp, jnp.float32),
+            jax.ShapeDtypeStruct(hyp, jnp.float32),
+            jax.ShapeDtypeStruct(hyp, jnp.float32),
             {k: jax.ShapeDtypeStruct((), jnp.float32)
              for k in _fused._scalars(tr._optimizer)},
             [jax.ShapeDtypeStruct(p._data.shape, p._data.dtype)
@@ -633,14 +935,15 @@ class StepProgram:
                    for s in flat) for flat in state_flats],
             [jax.ShapeDtypeStruct((nw, n), jnp.float32)
              for n in regs.resid_sizes],
-            *[jax.ShapeDtypeStruct((a.shape[0] * nw,) + tuple(a.shape[1:]),
-                                   a.dtype) for a in raws],
+            *batch_avals,
         )
         with mesh:
             jax.eval_shape(pure_step, *abstract)
         self._warn_foreign_aux(aux_cell)
         return {"fn": fn, "params": params, "state_flats": state_flats,
-                "plan_names": plan_names, "dist": True}
+                "plan_names": plan_names, "dist": True, "k": kw,
+                "declared_warmup": kw is not None and kw != self._k,
+                "abstract": abstract}
 
 
 class _DistRegisters:
@@ -707,21 +1010,26 @@ class _DistRegisters:
             return _jax.device_put(local, self._row)
         return _jax.make_array_from_process_local_data(self._row, local)
 
-    def _global_batch(self, arr):
+    def _global_batch(self, arr, window=False):
         import jax as _jax
         from jax.sharding import NamedSharding, PartitionSpec as P
 
-        spec = P(*(("w",) + (None,) * (arr.ndim - 1)))
+        # a [K, batch, ...] window shards on its BATCH axis (axis 1); a
+        # plain batch shards on axis 0
+        if window:
+            spec = P(*((None, "w") + (None,) * (arr.ndim - 2)))
+        else:
+            spec = P(*(("w",) + (None,) * (arr.ndim - 1)))
         sharding = NamedSharding(self._mesh, spec)
         return _jax.make_array_from_process_local_data(
             sharding, _np.asarray(arr))
 
-    def stage_call(self, key, lrs, wds, ts, scalars, raws):
+    def stage_call(self, key, lrs, wds, ts, scalars, raws, window=False):
         rep = self._replicate
         return (rep(key), rep(lrs), rep(wds), rep(ts),
                 {k: rep(v) for k, v in scalars.items()},
                 self.param_arrays, self.state_arrays, self.residuals,
-                *[self._global_batch(a) for a in raws])
+                *[self._global_batch(a, window=window) for a in raws])
 
     def wire(self, entry, touched, out, keep_grads):
         # everything stays DEVICE-RESIDENT: addressable_data(0) hands back
@@ -741,8 +1049,10 @@ class _DistRegisters:
                 p._data._grad._data = g.addressable_data(0)
                 p._data._grad._version += 1
         local = loss_out.addressable_data(0)
+        kw = entry.get("k")
         if self._loss_meta and self._loss_meta[0] == 0:
-            local = local.reshape(())
+            # scalar user loss: [1] per worker, or [K, 1] stacked
+            local = local.reshape((kw,) if kw else ())
         return NDArray(local)
 
     def sync_out(self):
@@ -771,6 +1081,235 @@ class _DistRegisters:
                 tr._grad_feedback.update(
                     self._resid_key(b, n),
                     _np.asarray(arr.addressable_data(0)))
+
+
+class EvalProgram:
+    """The folded evaluation pass (``Trainer.fold_eval(loss_fn, k)``).
+
+    Calling the program with a batch (K=1) or a ``[K, batch, ...]``
+    stacked window (``pipeline.stage_window(k)``) runs forward-only loss
+    under the SAME ``trace_scope`` ceremony as the training fold — but
+    with ``is_training=False``, so BatchNorm reads running stats and
+    dropout is identity — and accumulates the summed loss IN-PROGRAM
+    into a device-resident f32 register.  The host reads nothing until
+    :meth:`result`, once per eval pass: an N-batch eval is N/K dispatches
+    and ONE device->host transfer.
+
+    Compile site ``gluon.fold_eval``; every fresh build registers as a
+    declared warmup (eval programs are built once per batch signature,
+    usually after the train guard armed).  Escape hatches and fallback
+    accounting (``step_fold_fallback`` labels) match :class:`StepProgram`.
+    """
+
+    def __init__(self, trainer, loss_fn, block=None, k=None):
+        self._trainer = trainer
+        self._loss_fn = loss_fn
+        self._block = block
+        self._k = max(1, int(k if k is not None else fold_k()))
+        self._cache = {}          # (batch sig, kw) -> entry
+        self._fallback_reason = None
+        self._fallback_label = None
+        self._warned = False
+        self._guard_armed = False
+        self._acc = None          # device f32 scalar — summed loss so far
+        self._host_sum = 0.0      # eager-path contribution
+        self._count = 0           # loss elements accumulated
+        self._synced_at = -1      # train-fold progress at last register sync
+        if not fold_enabled():
+            self._fallback_reason = "MXNET_STEP_FOLD=0"
+            self._fallback_label = "env-off"
+        elif _engine.is_naive():
+            self._fallback_reason = "NaiveEngine"
+            self._fallback_label = "naive-engine"
+        elif _opted_out(block):
+            self._fallback_reason = "block opt-out (_step_fold_opt_out)"
+            self._fallback_label = "block-opt-out"
+
+    @property
+    def folded(self):
+        return self._fallback_reason is None
+
+    @property
+    def fallback_reason(self):
+        return self._fallback_reason
+
+    @property
+    def k(self):
+        return self._k
+
+    @property
+    def count(self):
+        """Loss elements accumulated since the last ``result(reset=True)``."""
+        return self._count
+
+    def _note_fallback(self, reason, label="capture-failure"):
+        self._fallback_reason = reason
+        self._fallback_label = label
+        if not self._warned:
+            self._warned = True
+            _warnings.warn(
+                f"eval fold disabled ({reason}); running the eager "
+                "forward path instead — see docs/step_fold.md",
+                UserWarning, stacklevel=3)
+
+    def _sync_train_fold(self):
+        """A multi-process TRAIN fold keeps the live trajectory in donated
+        registers — pull them back into the Parameters once per train
+        progress before evaluating against them."""
+        ref = getattr(self._trainer, "_fold", None)
+        fold = ref() if ref is not None else None
+        if fold is not None and fold._dist is not None and \
+                fold._logical_steps != self._synced_at:
+            fold.sync()
+            self._synced_at = fold._logical_steps
+
+    def __call__(self, *batch):
+        nds = [b if isinstance(b, NDArray) else NDArray(jnp.asarray(b))
+               for b in batch]
+        self._sync_train_fold()
+        tr = self._trainer
+        if self._fallback_reason is not None or any(
+                p._deferred_init is not None or p._data is None
+                for p in tr._params):
+            return self._eager_eval(nds)
+        return self._folded_eval(nds)
+
+    def _eager_eval(self, nds):
+        _profiler.incr_labeled("step_fold_fallback",
+                               self._fallback_label or "deferred-init")
+        rows = [nds]
+        if self._k > 1 and nds and nds[0].ndim >= 2:
+            kw = int(nds[0].shape[0])
+            rows = [[NDArray(nd._data[j]) for nd in nds]
+                    for j in range(kw)]
+        with autograd.pause():
+            for row in rows:
+                loss = self._loss_fn(*row)
+                self._host_sum += float(jnp.sum(
+                    loss._data.astype(jnp.float32)))
+                self._count += int(loss._data.size)
+
+    def _folded_eval(self, nds):
+        kw = None
+        if self._k > 1:
+            if nds[0].ndim < 2:
+                raise ValueError(
+                    f"fold_eval(k={self._k}) expects stacked [k, batch, "
+                    "...] windows (pipeline.stage_window(k)); got shape "
+                    f"{tuple(nds[0].shape)}")
+            kw = int(nds[0].shape[0])
+            if any(int(nd.shape[0]) != kw for nd in nds):
+                raise ValueError(
+                    "window leading dims disagree: "
+                    f"{[tuple(nd.shape) for nd in nds]}")
+        raws = [_raw(nd) for nd in nds]
+        batch_sig = tuple((tuple(a.shape), str(a.dtype)) for a in raws)
+        key_sig = (batch_sig, kw)
+        entry = self._cache.get(key_sig)
+        fresh = entry is None
+        if fresh:
+            try:
+                entry = self._build(raws, kw)
+            except Exception as e:
+                self._note_fallback(f"capture failed: {e!r:.200}")
+                return self._eager_eval(nds)
+            self._cache[key_sig] = entry
+        acc = self._acc
+        if acc is None:
+            acc = jnp.zeros((), jnp.float32)
+        param_arrs = [_raw(p._data) for p in entry["params"]]
+        tc = _perf() if fresh else None
+        t0 = _perf() if _profiler._active else None
+        try:
+            try:
+                new_acc = entry["fn"](acc, param_arrs, *raws)
+            except Exception as e:
+                _profiler.maybe_oom_postmortem(e, "gluon.fold_eval")
+                raise
+            self._acc = new_acc
+            self._count += entry["loss_size"] * (kw or 1)
+            if tc is not None:
+                # every eval build is a DECLARED warmup: one program per
+                # batch signature, typically compiled after the train
+                # guard armed — register it, don't judge it
+                with _profiler.compile_guard_paused():
+                    _profiler.record_compile(
+                        "gluon.fold_eval", self._compile_sig(entry, raws),
+                        (_perf() - tc) * 1e3)
+            if t0 is not None:
+                _profiler.record_span(
+                    "trainer.fold_eval", "trainer", t0,
+                    args={"params": len(entry["params"]),
+                          "k": int(kw or 1)})
+            _profiler.incr("fold_eval_call")
+        finally:
+            _profiler.step_boundary()
+        if not self._guard_armed:
+            self._guard_armed = True
+            _profiler.arm_compile_guard("gluon.fold_eval")
+
+    def result(self, reset=True):
+        """Mean loss over every element accumulated since the last reset —
+        THE one host read of an eval pass."""
+        total = self._host_sum
+        if self._acc is not None:
+            total += float(self._acc)
+        count = self._count
+        if reset:
+            self._acc = None
+            self._host_sum = 0.0
+            self._count = 0
+        return total / max(1, count)
+
+    def _build(self, raws, kw):
+        tr = self._trainer
+        params = [p for p in tr._params if p._data is not None]
+        loss_fn = self._loss_fn
+        loss_cell = []
+
+        def one_eval(param_arrs, batch):
+            # a fixed key: eval is deterministic (dropout is identity
+            # under is_training=False; the key only seeds the ceremony)
+            key = jax.random.PRNGKey(0)
+            with trace_scope(params, list(param_arrs), key, False):
+                loss = loss_fn(*[NDArray(b) for b in batch])
+            loss_data = loss._data
+            if not loss_cell:
+                loss_cell.append(int(_np.prod(loss_data.shape)))
+            return jnp.sum(loss_data.astype(jnp.float32))
+
+        if kw is None:
+            def pure_eval(acc, param_arrs, *batch):
+                return acc + one_eval(param_arrs, batch)
+        else:
+            def pure_eval(acc, param_arrs, *windows):
+                def body(carry, xs):
+                    return carry + one_eval(param_arrs, xs), None
+
+                acc2, _ = jax.lax.scan(body, acc, tuple(windows))
+                return acc2
+
+        abstract = (
+            jax.ShapeDtypeStruct((), jnp.float32),
+            [jax.ShapeDtypeStruct(p._data.shape, p._data.dtype)
+             for p in params],
+            *[jax.ShapeDtypeStruct(a.shape, a.dtype) for a in raws],
+        )
+        jax.eval_shape(pure_eval, *abstract)
+        # nothing donated: eval must never consume the live Parameters,
+        # and the tiny acc register isn't worth a donation aliasing rule
+        fn = jax.jit(pure_eval)
+        return {"fn": fn, "params": params, "k": kw,
+                "loss_size": loss_cell[0] if loss_cell else 1,
+                "abstract": abstract}
+
+    def _compile_sig(self, entry, raws):
+        sig = {"__program__": f"fold_eval[{entry.get('k') or 1}]",
+               "params": _profiler.sig_static(len(entry["params"]))}
+        for i, a in enumerate(raws):
+            sig[f"in{i}"] = {"k": "array", "shape": tuple(a.shape),
+                             "dtype": str(a.dtype)}
+        return sig
 
 
 # ---------------------------------------------------------------------------

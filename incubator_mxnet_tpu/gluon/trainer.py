@@ -349,6 +349,44 @@ class Trainer:
         self._fold = _weakref.ref(sp)
         return sp
 
+    def fold_steps(self, loss_fn, k=None, block=None, keep_grads=False,
+                   donate_window=False):
+        """The K-STEP fold: like :meth:`fold_step`, but the returned
+        :class:`~.step_fold.StepProgram` runs K logical training steps
+        per call as ONE compiled dispatch — a ``lax.scan`` over a
+        ``[K, batch, ...]`` stacked window (``pipeline.stage_window(k)``)
+        carrying params/optimizer state/EF residuals through the loop,
+        with per-step lr/wd/t and PRNG keys staged as stacked ``[K]``
+        device arrays.  Host dispatch cost drops to 1/K; numerics are
+        bit-exact vs K unfolded steps.  ``k`` defaults to
+        ``MXNET_STEP_FOLD_K`` (K=1 IS the :meth:`fold_step` program).
+
+        Checkpoints land on K boundaries only: ``save_states`` refuses
+        while ``program.window_pos != 0`` (only the ``step_one`` escape
+        moves the cursor).  ``donate_window=True`` additionally donates
+        the staged window buffers (docs/step_fold.md#multi-step-fold)."""
+        import weakref as _weakref
+
+        from . import step_fold as _sf
+
+        sp = _sf.StepProgram(self, loss_fn, block=block,
+                             keep_grads=keep_grads, k=k,
+                             donate_window=donate_window)
+        self._fold = _weakref.ref(sp)
+        return sp
+
+    def fold_eval(self, loss_fn, block=None, k=None):
+        """The folded evaluation pass: forward-only loss over a batch (or
+        a ``[K, batch, ...]`` window) as ONE compiled dispatch, with the
+        summed loss accumulated in-program — the host reads metrics once
+        per eval pass via ``program.result()``.  Shares the train fold's
+        ``trace_scope`` ceremony (``is_training=False``: BatchNorm reads
+        running stats, dropout is identity).  Returns a
+        :class:`~.step_fold.EvalProgram` (docs/step_fold.md)."""
+        from . import step_fold as _sf
+
+        return _sf.EvalProgram(self, loss_fn, block=block, k=k)
+
     def update(self, batch_size, ignore_stale_grad=False):
         """Optimizer update only (assumes grads already aggregated)."""
         if not self._kv_initialized:
@@ -466,6 +504,17 @@ class Trainer:
 
         fold = self._fold() if self._fold is not None else None
         if fold is not None:
+            if getattr(fold, "window_pos", 0) != 0:
+                # the K-boundary checkpoint rule: mid-window state is not
+                # a trajectory point K unfolded steps would ever visit —
+                # a restore from it could never be exact
+                raise RuntimeError(
+                    f"save_states refused mid-window: the K-step fold is "
+                    f"{fold.window_pos} step(s) past a K boundary "
+                    f"(k={fold.k}). Checkpoints land on K boundaries only "
+                    "— finish the window (further step_one calls) or "
+                    "save before stepping off the boundary "
+                    "(docs/step_fold.md#multi-step-fold).")
             # a multi-process fold holds params/states in donated global
             # registers; pull them into the live NDArrays first so the
             # snapshot sees the current trajectory (no-op for local folds)
@@ -478,6 +527,12 @@ class Trainer:
             "num_update": self._optimizer.num_update,
             "update_counts": dict(self._optimizer._index_update_count),
         }
+        if fold is not None and fold.k > 1:
+            # the fold window cursor rides the snapshot so elastic/exact
+            # resume can assert it restarts ON a K boundary
+            payload["fold_cursor"] = {"k": fold.k,
+                                      "logical_steps": fold.logical_steps,
+                                      "window_pos": 0}
         if self._grad_feedback is not None and len(self._grad_feedback):
             # gradient-compression residuals are optimizer-adjacent state:
             # dropping them at restore re-injects one step's quantization
@@ -509,6 +564,12 @@ class Trainer:
             # restored state lives in the Parameter/state NDArrays now; a
             # multi-process fold must re-stage its registers from them
             fold.invalidate()
+            cursor = payload.get("fold_cursor")
+            if cursor is not None:
+                # snapshots are taken on K boundaries only; restore the
+                # logical-step count and land the cursor back on one
+                fold._logical_steps = int(cursor.get("logical_steps", 0))
+                fold._window_pos = 0
         fb = payload.get("grad_feedback")
         if fb:
             from .. import comm

@@ -141,6 +141,13 @@ def _leaves(batch):
     return [_np.asarray(batch)], lambda new: new[0]
 
 
+def _rows_compatible(a, b):
+    """Whether two batches' leaf lists np.stack into one window."""
+    return (len(a) == len(b)
+            and all(x.shape == y.shape and x.dtype == y.dtype
+                    for x, y in zip(a, b)))
+
+
 class _Engine:
     """The threaded core of :class:`DataPipeline`.  Separated from the
     user-facing facade because the stage threads hold bound-method
@@ -289,6 +296,10 @@ class _Engine:
                                      # thread that outlived close()'s join
                                      # timeout (prep_fn stuck) can never
                                      # publish into a newer epoch's tables
+        self._window = 1             # K-step fold window: the transfer
+                                     # thread stacks this many source
+                                     # batches into ONE [K, ...] device
+                                     # item (stage_window / set_window)
 
         self._zombies = []
 
@@ -549,8 +560,65 @@ class _Engine:
 
     def _transfer(self, gen):
         """Order-restoring device stage: waits for the next seq, moves it
-        host→device, and parks it in the depth-bounded buffer."""
+        host→device, and parks it in the depth-bounded buffer.  With a
+        window K > 1 (``set_window``/``stage_window``) it first np.stacks
+        K consecutive prepped batches into ONE ``[K, ...]`` item and
+        ships that — the K-step fold's pre-staged batch window, built
+        entirely off the consumer thread.  An epoch tail (or the batches
+        before an in-stream error) still ships, as a short window."""
         next_seq = 0
+        window = max(1, int(self._window))
+        pend = []    # prepped (leaves, rebuild) rows awaiting a window
+
+        def emit(batch, err, nbytes, count):
+            # depth-bounded put that notices close(); False = stage died
+            with self._buf_cond:
+                while len(self._buf) >= self._depth \
+                        and not self._dead(gen):
+                    self._buf_cond.wait(timeout=0.05)
+                if self._dead(gen):
+                    return False
+                if nbytes:
+                    # alloc BEFORE the append becomes visible: a consumer
+                    # racing next() could otherwise pop-and-free first and
+                    # drive the tracker transiently negative
+                    self._mem.alloc(nbytes)
+                self._buf.append((batch, err, nbytes, count))
+                self._buf_cond.notify_all()
+            return True
+
+        def place_and_emit(item, count):
+            # item: a raw batch (window == 1) or the pending rows list
+            nbytes, err = 0, None
+            t0 = _perf() if _profiler._active else None
+            try:
+                if window == 1:
+                    batch, nbytes = self._place(item)
+                else:
+                    leaves = [_np.stack([r[0][i] for r in item])
+                              for i in range(len(item[0][0]))]
+                    batch, nbytes = self._place_leaves(leaves, item[0][1],
+                                                       window=True)
+            except BaseException as e:  # noqa: BLE001
+                batch, err, nbytes = None, e, 0
+            if t0 is not None:
+                args = {"bytes": nbytes}
+                if window > 1:
+                    args["window"] = count
+                _profiler.record_span("io.transfer", "io", t0, args=args)
+            if err is None:
+                _profiler.incr("io_pipeline_bytes", nbytes)
+                with self._lock:
+                    self._batch_bytes = nbytes or self._batch_bytes
+                    self._bytes_total += nbytes
+            return emit(batch, err, nbytes, count)
+
+        def flush_pend():
+            if not pend:
+                return True
+            rows, pend[:] = pend[:], []
+            return place_and_emit(rows, len(rows))
+
         while True:
             with self._ready_cond:
                 while next_seq not in self._ready and not self._dead(gen):
@@ -559,46 +627,46 @@ class _Engine:
                     return
                 batch, err = self._ready.pop(next_seq)
             next_seq += 1
-            nbytes = 0
             if err is None and batch is not _EOS:
-                t0 = _perf() if _profiler._active else None
-                try:
-                    batch, nbytes = self._place(batch)
-                except BaseException as e:  # noqa: BLE001
-                    batch, err = None, e
-                    nbytes = 0
-                if t0 is not None:
-                    _profiler.record_span("io.transfer", "io", t0,
-                                          args={"bytes": nbytes})
-                if err is None:
-                    _profiler.incr("io_pipeline_bytes", nbytes)
-                    with self._lock:
-                        self._batch_bytes = nbytes or self._batch_bytes
-                        self._bytes_total += nbytes
-            # depth-bounded put that notices close()
-            with self._buf_cond:
-                while len(self._buf) >= self._depth and not self._dead(gen):
-                    self._buf_cond.wait(timeout=0.05)
-                if self._dead(gen):
-                    return
-                if nbytes:
-                    # alloc BEFORE the append becomes visible: a consumer
-                    # racing next() could otherwise pop-and-free first and
-                    # drive the tracker transiently negative
-                    self._mem.alloc(nbytes)
-                self._buf.append((batch, err, nbytes))
-                self._buf_cond.notify_all()
+                if window == 1:
+                    if not place_and_emit(batch, 1):
+                        return
+                else:
+                    try:
+                        leaves, rebuild = _leaves(batch)
+                    except BaseException as e:  # noqa: BLE001
+                        if not flush_pend() or not emit(None, e, 0, 0):
+                            return
+                        continue
+                    # a row whose leaf shapes/dtypes disagree with the
+                    # pending ones cannot stack — ship them short first
+                    if pend and not _rows_compatible(pend[0][0], leaves):
+                        if not flush_pend():
+                            return
+                    pend.append((leaves, rebuild))
+                    if len(pend) >= window and not flush_pend():
+                        return
+                _profiler.maybe_sample_memory()  # pipeline tick: keep the
+                self._maybe_autotune()           # watermark/counter live
+                continue
+            # error or end-of-epoch: the partial window ships first, in
+            # order, then the terminator itself
+            if not flush_pend():
+                return
+            if not emit(batch, err, 0, 0):
+                return
             if batch is _EOS:
                 return
-            _profiler.maybe_sample_memory()  # pipeline tick: keep the
-            self._maybe_autotune()           # watermark/counter track live
 
     def _place(self, batch):
         """Move one prepped batch's leaves host→device with the mesh data
         sharding (or plain device placement when there is no mesh)."""
+        leaves, rebuild = _leaves(batch)
+        return self._place_leaves(leaves, rebuild)
+
+    def _place_leaves(self, leaves, rebuild, window=False):
         from ..parallel.sharding import batch_pspec, _fit_spec
 
-        leaves, rebuild = _leaves(batch)
         nbytes = 0
         placed = []
         multi = jax.process_count() > 1
@@ -611,9 +679,16 @@ class _Engine:
             # doesn't divide replicates instead of crashing the infeed; for
             # dividing batches (the perf path) the fitted spec is identical
             # to what SPMDTrainer.shard_batch builds, so its passthrough
-            # equality check holds
-            spec = (_fit_spec(batch_pspec(a.ndim, self._sp_axis), a.shape,
-                              self._mesh) if a.ndim else _P())
+            # equality check holds.  A stacked [K, batch, ...] window
+            # shards per LOGICAL batch: the K axis replicates, the spec
+            # shifts one axis right.
+            if window and a.ndim:
+                inner = _fit_spec(batch_pspec(a.ndim - 1, self._sp_axis),
+                                  a.shape[1:], self._mesh)
+                spec = _P(*((None,) + tuple(inner)))
+            else:
+                spec = (_fit_spec(batch_pspec(a.ndim, self._sp_axis),
+                                  a.shape, self._mesh) if a.ndim else _P())
             sharding = NamedSharding(self._mesh, spec)
             if multi:
                 placed.append(
@@ -625,6 +700,37 @@ class _Engine:
     # ------------------------------------------------------------------
     # consumer side
     # ------------------------------------------------------------------
+    def set_window(self, k):
+        """Configure the transfer stage to stack ``k`` consecutive source
+        batches into one ``[k, ...]`` device-resident window (the K-step
+        fold's pre-staged input).  ``k=1`` restores per-batch delivery.
+        Must be set on a window boundary of the pipeline's own stream:
+        before iteration starts, or right after ``reset()`` — changing it
+        after batches were delivered this epoch raises."""
+        k = max(1, int(k))
+        with self._lock:
+            if k == self._window:
+                return
+            if self._started and self._epoch_batches > 0:
+                raise RuntimeError(
+                    "set_window mid-epoch: batches were already delivered "
+                    "this epoch — set the window before iterating (or "
+                    "after reset())")
+            was_started = self._started
+            self._window = k
+        if was_started:
+            # the transfer thread snapshots the window per run: restart
+            # the stages so the new width takes effect (the source epoch
+            # is re-opened; nothing was delivered, so nothing is lost)
+            self.close()
+            with self._lock:
+                self._closed = False
+            self.start()
+
+    @property
+    def window(self):
+        return self._window
+
     def ensure_epoch(self):
         """Facade ``__iter__`` hook: re-entering iteration after
         exhaustion re-opens the source (python-iterable ergonomics —
@@ -660,7 +766,7 @@ class _Engine:
                 stalled_t0 = t0
             else:
                 stalled_t0 = None
-            batch, err, nbytes = self._buf.pop(0)
+            batch, err, nbytes, count = self._buf.pop(0)
             self._buf_cond.notify_all()
         if nbytes:
             self._mem.free(nbytes)   # the consumer owns the batch now
@@ -674,9 +780,11 @@ class _Engine:
             with self._lock:
                 self._finished = True
             raise StopIteration
-        self._n_batches += 1
-        self._epoch_batches += 1
-        _profiler.incr("io_pipeline_batches")
+        # a stacked window counts every LOGICAL batch it carries — the
+        # delivered-cursor (state_dict) stays window-width agnostic
+        self._n_batches += count
+        self._epoch_batches += count
+        _profiler.incr("io_pipeline_batches", count)
         return batch
 
     # ------------------------------------------------------------------
@@ -756,7 +864,8 @@ class _Engine:
                 "depth": self._depth,
                 "max_depth": self._max_depth,
                 "buffer_occupancy": len(self._buf),
-                "buffer_bytes": sum(n for _, _, n in self._buf),
+                "buffer_bytes": sum(n for _, _, n, _ in self._buf),
+                "window": self._window,
                 "batch_bytes": self._batch_bytes,
                 "bytes_total": self._bytes_total,
                 "batches": self._n_batches,
@@ -820,6 +929,41 @@ class DataPipeline:
     def start(self):
         self._eng.start()
         return self
+
+    def set_window(self, k):
+        """Stack ``k`` consecutive source batches into one ``[k, ...]``
+        device-resident window per delivery (see
+        :meth:`_Engine.set_window`)."""
+        self._eng.set_window(k)
+        return self
+
+    @property
+    def window(self):
+        """Current stacking width (1 = per-batch delivery)."""
+        return self._eng.window
+
+    def stage_window(self, k=None):
+        """Hand the K-step fold its next pre-staged batch window: one
+        device-resident item whose leaves are ``[k, batch, ...]`` stacked
+        arrays, built by the transfer thread ahead of the scan (an epoch
+        tail may be shorter).  ``k`` (optional after the first call)
+        configures the width via :meth:`set_window`.  Raises
+        ``StopIteration`` at end of epoch; iteration restarts the next
+        epoch like ``__iter__`` does::
+
+            pipe = DataPipeline(source)
+            program = trainer.fold_steps(loss_fn, k=8)
+            while True:
+                try:
+                    window = pipe.stage_window(8)
+                except StopIteration:
+                    break
+                loss = program(window.data[0], window.label[0])
+        """
+        if k is not None:
+            self._eng.set_window(k)
+        self._eng.ensure_epoch()
+        return self._eng.next()
 
     def close(self):
         self._eng.close()

@@ -481,6 +481,21 @@ class RunCheckpoint:
             except OSError:
                 pass
 
+    def _fold_cursor(self):
+        """Window cursor of an attached K-step fold, for snapshot metadata.
+
+        ``None`` unless the trainer has a live fold with k > 1.  Because
+        ``save_states`` refuses mid-window, a snapshot that exists always
+        recorded ``window_pos == 0`` — this field makes that auditable
+        without unpickling ``trainer_states``."""
+        ref = getattr(self._trainer, "_fold", None)
+        fold = ref() if callable(ref) else None
+        if fold is None or getattr(fold, "k", 1) <= 1:
+            return None
+        return {"k": int(fold.k),
+                "logical_steps": int(fold.logical_steps),
+                "window_pos": int(fold.window_pos)}
+
     def _params_numpy(self):
         if self._net is None:
             return None
@@ -543,8 +558,14 @@ class RunCheckpoint:
         # trainer states FIRST: for a folded trainer save_states syncs the
         # donated step-fold registers back into the live Parameters, which
         # _params_numpy then reads — the other order snapshots stale params.
+        # A K-step fold (fold_steps with k>1) refuses save_states mid-window,
+        # so elastic snapshots inherit the K-boundary rule: the raise below
+        # propagates and no shard is written between K boundaries.  The fold
+        # window cursor rides inside trainer_states and is restored by
+        # load_states in _apply, so exact resume lands on a K boundary.
         states = self._trainer_states_bytes()
         payload = {
+            "fold_cursor": self._fold_cursor(),
             "step": int(step),
             "epoch": int(epoch),
             "rank": self._rank,

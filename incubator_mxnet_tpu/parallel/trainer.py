@@ -228,6 +228,9 @@ class SPMDTrainer:
 
         self._t = self._optimizer.begin_num_update
         self._step_cache = {}
+        self._window_k = None       # step_window's steady width (first
+                                    # width seen; shorter tails are
+                                    # declared-warmup programs)
         self._guard_armed = False   # steady-state compile guard armed after
                                     # the first compiled step completes
         # device-memory ledger: the trainer owns its donated param/state
@@ -834,6 +837,169 @@ class SPMDTrainer:
             return pa, os, losses[-1], extras
 
         return self._jit_wrapped(bulk_step)
+
+    # ------------------------------------------------------------------
+    def shard_window(self, *arrays):
+        """``shard_batch`` for ``[K, batch, ...]`` stacked windows: the K
+        axis replicates, the per-step batch axis (axis 1) shards over
+        (dp, fsdp) — byte-identical to what ``io.DataPipeline``'s
+        ``stage_window`` builds, so windows arriving device-resident pass
+        through with zero host work."""
+        out = []
+        for a in arrays:
+            if isinstance(a, NDArray):
+                a = a._data
+            a = _np.asarray(a) if not isinstance(a, jax.Array) else a
+            inner = batch_pspec(max(0, a.ndim - 1), self._sp_axis)
+            spec = P(*((None,) + tuple(inner)))
+            sharding = NamedSharding(self._mesh, spec)
+            if isinstance(a, jax.Array) and a.sharding == sharding:
+                out.append(a)
+                continue
+            t0 = _perf() if _profiler._active else None
+            if jax.process_count() > 1:
+                out.append(jax.make_array_from_process_local_data(sharding, a))
+            else:
+                out.append(jax.device_put(a, sharding))
+            if t0 is not None:
+                _profiler.record_span("spmd.shard_batch", "trainer", t0,
+                                      args={"bytes": int(a.nbytes)})
+        return tuple(out)
+
+    def step_window(self, data, label, batch_size=None):
+        """Run K fused optimizer steps over K DIFFERENT pre-staged batches
+        in ONE device dispatch — ``step_bulk``'s real-data twin and the
+        SPMD analog of ``gluon.Trainer.fold_steps``: the per-step program
+        (collectives, codec buckets and all) becomes a ``lax.scan`` body,
+        consuming one row of the ``[K, batch, ...]`` stacked window
+        (``io.DataPipeline.stage_window(k)``) per iteration.  Numerically
+        identical to K successive ``step()`` calls on the K rows (same
+        num_update/lr/PRNG-key schedule); returns the LAST step's mean
+        loss.  K rides the window's leading axis — an epoch tail simply
+        dispatches a shorter program (registered as a declared warmup,
+        not a steady-state recompile)."""
+        inputs = data if isinstance(data, (list, tuple)) else (data,)
+        arrays = self.shard_window(*inputs, label)
+        if arrays[0].ndim < 2:
+            raise ValueError(
+                "step_window expects stacked [k, batch, ...] windows "
+                f"(pipeline.stage_window(k)); got {tuple(arrays[0].shape)}")
+        k = int(arrays[0].shape[0])
+        if batch_size is None:
+            batch_size = arrays[0].shape[1]
+        if self._window_k is None:
+            self._window_k = k     # first width seen = the steady width
+        sig = (tuple((a.shape, str(a.dtype)) for a in arrays), "window")
+        fn = self._step_cache.get(sig)
+        fresh = fn is None
+        if fresh:
+            fn = self._build_window(arrays)
+            self._step_cache[sig] = fn
+        ts, lrs, keys = [], [], []
+        for _ in range(k):
+            self._t += 1
+            self._optimizer.num_update = self._t
+            ts.append(float(self._t))
+            lrs.append(self.learning_rate())
+            keys.append(get_key())
+        rescale = self._optimizer.rescale_grad / batch_size
+        comm = self._comm_state is not None
+        call_args = (jnp.stack(keys), jnp.asarray(ts, jnp.float32),
+                     jnp.asarray(lrs, jnp.float32), jnp.float32(rescale),
+                     self._param_arrays, self._opt_states,
+                     *((self._comm_state,) if comm else ()), *arrays)
+        lowered = None
+        if fresh and _profiler.compile_cost_enabled():
+            try:
+                lowered = fn.lower(*call_args)
+            except Exception:
+                lowered = None
+        tc = _perf() if fresh else None
+        tw = _perf()
+        t0 = tw if _profiler._active else None
+        _elastic.watchdog_arm("spmd.step_window")
+        try:
+            try:
+                if comm:
+                    (new_params, new_states, new_comm,
+                     loss, extras) = fn(*call_args)
+                    self._comm_state = new_comm
+                else:
+                    new_params, new_states, loss, extras = fn(*call_args)
+            except Exception as e:
+                _profiler.maybe_oom_postmortem(e, "spmd.step_window")
+                raise
+            self._param_arrays = new_params
+            self._opt_states = new_states
+            if tc is not None:
+                if k != self._window_k:
+                    # a tail width is its own program, built once — a
+                    # declared warmup, never a steady-state violation
+                    with _profiler.compile_guard_paused():
+                        _profiler.record_compile(
+                            "spmd.step",
+                            self._compile_sig(arrays, f"step_window[{k}]"),
+                            (_perf() - tc) * 1e3, lowered=lowered)
+                else:
+                    _profiler.record_compile(
+                        "spmd.step",
+                        self._compile_sig(arrays, f"step_window[{k}]"),
+                        (_perf() - tc) * 1e3, lowered=lowered)
+            if t0 is not None:
+                args = {"k": int(k)}
+                if self._comm_span_args:
+                    args.update(self._comm_span_args,
+                                bytes_raw=(self._comm_span_args["bytes_raw"]
+                                           * int(k)),
+                                bytes_wire=(self._comm_span_args["bytes_wire"]
+                                            * int(k)))
+                _profiler.record_span("spmd.step_window", "trainer", t0,
+                                      args=args)
+            self._record_step_obs(extras, tw, k=int(k))
+        finally:
+            _elastic.watchdog_disarm()
+            _profiler.step_boundary()  # one boundary per dispatch
+        self._post_step()
+        return NDArray(loss)
+
+    def _build_window(self, example_arrays):
+        # the per-step body traces against one window ROW's avals
+        per_step = [jax.ShapeDtypeStruct(tuple(a.shape[1:]), a.dtype)
+                    for a in example_arrays]
+        pure_step = self._build_pure(per_step)
+        if self._comm_state is not None:
+            def window_step(keys, ts, lrs, rescale, param_arrs, opt_states,
+                            comm_state, *windows):
+                def body(carry, xs):
+                    pa, os, cs = carry
+                    key, t, lr = xs[0], xs[1], xs[2]
+                    pa, os, cs, loss, extras = pure_step(
+                        key, t, lr, rescale, pa, os, cs, *xs[3:])
+                    return (pa, os, cs), (loss, extras)
+
+                (pa, os, cs), (losses, extras) = jax.lax.scan(
+                    body, (param_arrs, opt_states, comm_state),
+                    (keys, ts, lrs) + tuple(windows))
+                return pa, os, cs, losses[-1], extras
+
+            return self._jit_wrapped(window_step)
+
+        def window_step(keys, ts, lrs, rescale, param_arrs, opt_states,
+                        *windows):
+            def body(carry, xs):
+                pa, os = carry
+                key, t, lr = xs[0], xs[1], xs[2]
+                pa, os, loss, extras = pure_step(
+                    key, t, lr, rescale, pa, os, *xs[3:])
+                return (pa, os), (loss, extras)
+
+            (pa, os), (losses, extras) = jax.lax.scan(
+                body, (param_arrs, opt_states), (keys, ts, lrs)
+                + tuple(windows))
+            # extras leaves arrive stacked [k]; _record_step_obs reduces
+            return pa, os, losses[-1], extras
+
+        return self._jit_wrapped(window_step)
 
     # ------------------------------------------------------------------
     def _build_step(self, example_arrays):

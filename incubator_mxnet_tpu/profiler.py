@@ -86,7 +86,8 @@ import jax
 
 __all__ = ["set_config", "start", "stop", "dump", "dumps", "pause", "resume",
            "scope", "span", "Marker", "state", "counters", "reset_counters",
-           "incr", "declare_counter", "record_span", "step_boundary",
+           "incr", "incr_labeled", "counter_labels", "declare_counter",
+           "record_span", "step_boundary",
            "current_step", "step_stats", "memory_watermark", "recorder_stats",
            "recording_enabled", "process_info", "set_process_info",
            "update_clock_offset", "sample_clock_offset", "metrics_snapshot",
@@ -194,6 +195,8 @@ _counters = {
     "fused_step_fallback_params": 0,  # params that took the per-tensor loop
     "step_fold_call": 0,              # folded-step single-program dispatches
     "step_fold_fallback": 0,          # fold entries that ran the eager path
+                                      # (per-reason split: counter_labels())
+    "fold_eval_call": 0,              # folded-eval single-program dispatches
     "allreduce_overlap_launched": 0,  # buckets pushed from the grad-readiness
                                       # hook DURING backward (overlap path)
     "allreduce_bucket": 0,            # bucketed gradient pushpulls
@@ -249,6 +252,14 @@ _counters = {
 }
 _counter_lock = _threading.Lock()
 
+# Optional per-reason breakdowns hanging off a declared counter
+# (``incr_labeled``): {name: {label: n}}.  The flat counter stays the
+# aggregate the dashboards alert on; the labels say WHY — e.g.
+# ``step_fold_fallback`` splits by env-off / capture-failure /
+# unsupported-optimizer / async-PS / grad-req-add so a silently-eager
+# fold is diagnosable from one scrape (docs/observability.md).
+_counter_labels = {}
+
 
 def declare_counter(name, initial=0):
     """Register an extension counter so ``incr(name)`` is legal.  In-tree
@@ -274,6 +285,36 @@ def incr(name, n=1):
             ) from None
 
 
+def incr_labeled(name, label, n=1):
+    """Increment a declared counter AND its per-reason label breakdown
+    (see ``counter_labels``).  Same strictness as :func:`incr` on the
+    counter name; labels are free-form strings, created on first use —
+    they classify events within a declared counter, they are not
+    counters themselves (and stay out of the lint_counters doc table)."""
+    label = str(label)
+    with _counter_lock:
+        try:
+            _counters[name] += n
+        except KeyError:
+            raise KeyError(
+                f"undeclared profiler counter {name!r}; add it to "
+                f"profiler._counters or call declare_counter() first"
+            ) from None
+        lab = _counter_labels.setdefault(name, {})
+        lab[label] = lab.get(label, 0) + n
+
+
+def counter_labels(name=None):
+    """Per-reason breakdowns recorded via :func:`incr_labeled`:
+    ``{counter: {label: n}}`` (or one counter's ``{label: n}`` when
+    ``name`` is given).  A label's sum never exceeds its flat counter —
+    plain ``incr`` calls on the same counter carry no label."""
+    with _counter_lock:
+        if name is not None:
+            return dict(_counter_labels.get(name, {}))
+        return {k: dict(v) for k, v in _counter_labels.items()}
+
+
 def counters():
     """Snapshot of the dispatch/bulking counters (parity-adjacent to the
     reference's engine op counters; see docs/observability.md)."""
@@ -285,6 +326,7 @@ def reset_counters():
     with _counter_lock:
         for k in _counters:
             _counters[k] = 0
+        _counter_labels.clear()
 
 
 # ---------------------------------------------------------------------------
@@ -933,6 +975,7 @@ def metrics_snapshot():
         "time_unix": time.time(),
         "clock_offset_s": _proc["clock_offset_s"],
         "counters": counters(),
+        "counter_labels": counter_labels(),
         "last_step": dict(steps[-1]) if steps else None,
         "window": {
             "n": len(steps),
@@ -1025,6 +1068,13 @@ def render_prometheus():
             lab = ",".join(f'{k}="{_prom_escape(v2)}"' for k, v2 in
                            (("counter", cname),) + base)
             out.append(f"mxnet_profiler_counter_total{{{lab}}} {v}")
+        for cname, labs in sorted((snap.get("counter_labels")
+                                   or {}).items()):
+            for reason, v in sorted((labs or {}).items()):
+                lab = ",".join(
+                    f'{k}="{_prom_escape(v2)}"' for k, v2 in
+                    (("counter", cname), ("reason", reason)) + base)
+                out.append(f"mxnet_profiler_counter_total{{{lab}}} {v}")
         ls = snap.get("last_step") or {}
         gauge("mxnet_step_last_id", "id of the last closed step",
               base, ls.get("step"))
@@ -2540,11 +2590,15 @@ def dumps(reset=False):
     for name, cnt, tot in agg_rows:
         lines.append(f"{name:<40}{cnt:>8}{tot * 1e3:>12.3f}{tot / cnt * 1e3:>12.3f}")
     snap = counters()
+    labels = counter_labels()
     if any(snap.values()):
         lines.append("")
         lines.append("Dispatch counters:")
         for name, v in sorted(snap.items()):
             lines.append(f"{name:<40}{v:>8}")
+            for lab, n in sorted(labels.get(name, {}).items()):
+                row = f'  {name}{{reason="{lab}"}}'
+                lines.append(f"{row:<40}{n:>8}")
     steps = step_stats()
     if steps:
         lines.append("")

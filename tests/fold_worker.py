@@ -103,6 +103,54 @@ def main():
     losses3 = [float(program(x2, y2).mean().asscalar()) for _ in range(2)]
     assert all(np.isfinite(v) for v in losses3)
 
+    # --- K-step window through the dist fold (ISSUE 17) -----------------
+    # k=2 windows with the int8-codec bucket nodes inside EACH scan
+    # iteration: BIT-exact trajectory vs the same codec run per-step, in
+    # half the dispatches (EF residuals ride the loop carry).
+    # The IN-FOLD codec rides the env policy (MXNET_GRAD_COMPRESS), not
+    # per-key store compression — that path keeps one key per param and
+    # refuses bucketing.
+    os.environ["MXNET_GRAD_COMPRESS"] = "int8"
+
+    def codec_pair(k):
+        kvn = mx.kv.create("dist_sync")
+        netn, xn, yn = build(5)
+        trn = gluon.Trainer(netn.collect_params(), "sgd",
+                            {"learning_rate": 0.05}, kvstore=kvn)
+        fold = trn.fold_steps(lambda a, b, n=netn: L2(n(a), b), k=k,
+                              block=netn)
+        return netn, fold, xn, yn
+
+    net5, ref5, x5, y5 = codec_pair(1)
+    mx.random.seed(9)
+    losses5 = [float(ref5(x5, y5).mean().asscalar()) for _ in range(4)]
+    assert ref5.folded, ref5.fallback_reason
+
+    net6, fold6, x6, y6 = codec_pair(2)
+    xw = mx.nd.array(np.repeat(x6.asnumpy()[None], 2, axis=0))
+    yw = mx.nd.array(np.repeat(y6.asnumpy()[None], 2, axis=0))
+    c0 = profiler.counters()
+    mx.random.seed(9)
+    losses6 = []
+    for _ in range(2):                       # 2 windows == 4 logical steps
+        out = np.asarray(fold6(xw, yw).asnumpy(), np.float64)
+        losses6.extend(out.reshape(out.shape[0], -1).mean(axis=1))
+    c1 = profiler.counters()
+    assert fold6.folded, fold6.fallback_reason
+    assert fold6.logical_steps == 4
+    assert c1["step_fold_call"] - c0["step_fold_call"] == 2, \
+        "k=2 window must be ONE dispatch per 2 logical steps"
+    np.testing.assert_allclose(losses5, losses6, rtol=1e-6, atol=1e-8)
+    ref5.sync()
+    fold6.sync()
+    # pair positionally: by this phase the gluon auto-name counters are
+    # past dense9, and lexical name sort ("dense10" < "dense9") scrambles
+    # cross-net pairing; collect_params() insertion order is stable
+    for pa, pb in zip(list(net5.collect_params().values()),
+                      list(net6.collect_params().values())):
+        assert np.array_equal(pa.data().asnumpy(), pb.data().asnumpy()), \
+            f"{pa.name} vs {pb.name} diverged"
+
     kv.barrier()
     print(f"fold_worker rank {rank}/{nw}: all assertions passed",
           flush=True)

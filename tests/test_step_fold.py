@@ -61,7 +61,12 @@ def _mlp(seed, dropout=0.3, bn=True, dtype="float32"):
 
 
 def _params_of(net):
-    return sorted(net.collect_params().values(), key=lambda p: p.name)
+    # insertion order, NOT a lexical name sort: identically-built nets
+    # pair positionally, while name sorting scrambles the cross-net
+    # pairing once the gluon auto-name counters pass dense9
+    # ("dense10" < "dense9") — which depends on what ran earlier in the
+    # process.
+    return list(net.collect_params().values())
 
 
 def _assert_params_equal(a, b, **tol):
@@ -221,9 +226,12 @@ def test_fold_interleaved_foreign_aux_frozen_with_warning():
     for k in foreign2:   # frozen, not silently corrupted
         np.testing.assert_array_equal(frozen[k], all2[k].data().asnumpy(),
                                       err_msg=k)
-    # OWNED params (incl. both owned BNs' stats) match the unfused run
-    for pa, pb in zip(sorted(owned1, key=lambda p: p.name),
-                      sorted(owned2, key=lambda p: p.name)):
+    # OWNED params (incl. both owned BNs' stats) match the unfused run.
+    # Pair positionally: both lists come from the same insertion-ordered
+    # collect_params() walk, while a lexical name sort scrambles the
+    # pairing once earlier tests push the auto-name counters past
+    # dense9 ("dense10" < "dense9").
+    for pa, pb in zip(owned1, owned2):
         np.testing.assert_allclose(
             pa.data().asnumpy(), pb.data().asnumpy(),
             err_msg=f"{pa.name} vs {pb.name}", **TOL)
@@ -512,6 +520,344 @@ def test_grad_ready_hook_order_and_parity():
 
 # ---------------------------------------------------------------------------
 # 2-process tiers (launch_local, like tests/test_dist.py)
+# ---------------------------------------------------------------------------
+# the K-step fold (ISSUE 17): one dispatch per K logical steps
+# ---------------------------------------------------------------------------
+
+
+def _window(nd, k):
+    """[K, batch, ...] stacked window of the same batch — the
+    stage_window layout."""
+    return mx.nd.array(np.repeat(nd.asnumpy()[None], k, axis=0),
+                       dtype=str(nd.dtype))
+
+
+def _states_np(tr):
+    from incubator_mxnet_tpu.gluon.trainer import _states_to_numpy
+    return {i: _states_to_numpy(st) for i, st in sorted(tr._states.items())}
+
+
+def _assert_states_bit_exact(a, b):
+    import jax
+
+    la, lb = jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)
+    assert len(la) == len(lb)
+    for va, vb in zip(la, lb):
+        assert np.array_equal(np.asarray(va), np.asarray(vb)), \
+            "optimizer state diverged between folded widths"
+
+
+def _window_losses(fold, xw, yw):
+    """Per-logical-step mean losses from one [K, ...] window dispatch."""
+    out = np.asarray(fold(xw, yw).asnumpy(), dtype=np.float64)
+    return list(out.reshape(out.shape[0], -1).mean(axis=1))
+
+
+@pytest.mark.parametrize("k", [1, 2, 4])
+def test_fold_k_parity_bit_exact(k):
+    """K-window fold == K unfolded (single-step folded) steps, BIT-exact:
+    per-step losses, params incl. BN aux, dropout PRNG streams, and Adam
+    opt-state.  The scan body IS the K=1 program — same key/hyper staging
+    order, so np.array_equal, not allclose."""
+    steps = 4
+    net1, x, y = _mlp(61)
+    tr1 = gluon.Trainer(net1.collect_params(), "adam",
+                        {"learning_rate": 0.01}, kvstore=None)
+    ref = tr1.fold_step(lambda a, b: L2(net1(a), b), block=net1)
+    mx.random.seed(55)
+    l1 = [float(np.asarray(ref(x, y).asnumpy(), np.float64).mean())
+          for _ in range(steps)]
+    assert ref.folded, ref.fallback_reason
+
+    net2, x2, y2 = _mlp(61)
+    tr2 = gluon.Trainer(net2.collect_params(), "adam",
+                        {"learning_rate": 0.01}, kvstore=None)
+    fold = tr2.fold_steps(lambda a, b: L2(net2(a), b), k=k, block=net2)
+    assert fold.k == k
+    mx.random.seed(55)
+    if k == 1:
+        l2 = [float(np.asarray(fold(x2, y2).asnumpy(), np.float64).mean())
+              for _ in range(steps)]
+    else:
+        xw, yw = _window(x2, k), _window(y2, k)
+        l2 = []
+        for _ in range(steps // k):
+            l2.extend(_window_losses(fold, xw, yw))
+    assert fold.folded, fold.fallback_reason
+    assert fold.logical_steps == steps
+
+    np.testing.assert_array_equal(np.asarray(l1), np.asarray(l2))
+    for pa, pb in zip(_params_of(net1), _params_of(net2)):
+        assert np.array_equal(pa.data().asnumpy(), pb.data().asnumpy()), \
+            f"{pa.name} vs {pb.name}"
+    _assert_states_bit_exact(_states_np(tr1), _states_np(tr2))
+
+
+@pytest.mark.parametrize("k", [2, 4])
+def test_fold_k_parity_mixed_groups(k):
+    """Mixed fp32 + bf16 fused groups through the K-step scan: bit-exact
+    vs the K=1 folded program (both run identical group adapters)."""
+    def build():
+        mx.random.seed(11)
+        np.random.seed(11)
+        net = gluon.nn.HybridSequential()
+        net.add(gluon.nn.Dense(8, activation="relu"), gluon.nn.Dense(4))
+        net.initialize()
+        net.hybridize()
+        x = mx.nd.array(np.random.RandomState(0).rand(4, 6).astype("float32"))
+        y = mx.nd.array(np.random.RandomState(1).rand(4, 4).astype("float32"))
+        net(x)
+        for p in net[1].collect_params().values():
+            p.cast("bfloat16")
+        return net, x, y
+
+    net1, x, y = build()
+    tr1 = gluon.Trainer(net1.collect_params(), "sgd",
+                        {"learning_rate": 0.05, "momentum": 0.9},
+                        kvstore=None)
+    ref = tr1.fold_step(lambda a, b: L2(net1(a), b), block=net1)
+    mx.random.seed(5)
+    _run_folded(ref, x, y, k)
+    assert ref.folded, ref.fallback_reason
+
+    net2, x2, y2 = build()
+    tr2 = gluon.Trainer(net2.collect_params(), "sgd",
+                        {"learning_rate": 0.05, "momentum": 0.9},
+                        kvstore=None)
+    fold = tr2.fold_steps(lambda a, b: L2(net2(a), b), k=k, block=net2)
+    mx.random.seed(5)
+    fold(_window(x2, k), _window(y2, k))
+    assert fold.folded, fold.fallback_reason
+    for pa, pb in zip(_params_of(net1), _params_of(net2)):
+        assert np.array_equal(pa.data().asnumpy(), pb.data().asnumpy()), \
+            f"{pa.name} vs {pb.name}"
+    _assert_states_bit_exact(_states_np(tr1), _states_np(tr2))
+
+
+def test_fold_k_dispatch_count_ceil():
+    """N logical steps through a K=4 fold land in EXACTLY ceil(N/K)
+    dispatches — full windows plus one shorter tail window."""
+    net, x, y = _mlp(67, dropout=0.0)
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.05}, kvstore=None)
+    fold = tr.fold_steps(lambda a, b: L2(net(a), b), k=4, block=net)
+    xw, yw = _window(x, 4), _window(y, 4)
+    xt, yt = _window(x, 2), _window(y, 2)
+    mx.random.seed(9)
+    fold(xw, yw)   # warmup: build the full-window program
+    fold(xt, yt)   # ... and the tail-window program
+    assert fold.folded, fold.fallback_reason
+    c0 = profiler.counters()
+    # N=10 logical steps at K=4: two full windows + one 2-step tail
+    fold(xw, yw)
+    fold(xw, yw)
+    fold(xt, yt)
+    c1 = profiler.counters()
+    assert c1["step_fold_call"] - c0["step_fold_call"] == 3  # == ceil(10/4)
+    assert (step_fold.host_dispatch_total(c1)
+            - step_fold.host_dispatch_total(c0)) == 3
+    assert c1["recompile_steady_state"] == c0["recompile_steady_state"]
+    assert fold.logical_steps == 16  # 6 warmup + 10 measured
+
+
+def test_fold_k_zero_recompiles_under_guard_raise(monkeypatch):
+    """Steady-state K-windows, a shorter tail window, and the step_one
+    escape hatch all stay silent under MXNET_COMPILE_GUARD=raise (tail
+    and step_one programs register as declared warmups)."""
+    monkeypatch.setenv("MXNET_COMPILE_GUARD", "raise")
+    net, x, y = _mlp(71, dropout=0.0)
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.05}, kvstore=None)
+    fold = tr.fold_steps(lambda a, b: L2(net(a), b), k=4, block=net)
+    xw, yw = _window(x, 4), _window(y, 4)
+    mx.random.seed(10)
+    fold(xw, yw)                    # builds, then arms the guard
+    for _ in range(3):
+        fold(xw, yw)                # must not raise CompileGuardError
+    fold(_window(x, 3), _window(y, 3))   # tail: declared warmup
+    for _ in range(4):
+        fold.step_one(x, y)         # escape hatch: declared warmup
+    assert fold.folded, fold.fallback_reason
+
+
+def test_fold_k_mid_window_save_refusal_and_cursor():
+    """save_states refuses between K boundaries with a clear error; at a
+    boundary the payload carries the fold cursor and load_states restores
+    the logical-step count (PR 16 exact resume through RunCheckpoint)."""
+    import tempfile
+
+    net, x, y = _mlp(73, dropout=0.0)
+    tr = gluon.Trainer(net.collect_params(), "adam",
+                       {"learning_rate": 0.01}, kvstore=None)
+    fold = tr.fold_steps(lambda a, b: L2(net(a), b), k=3, block=net)
+    mx.random.seed(21)
+    for _ in range(3):
+        fold.step_one(x, y)         # one full window -> back on boundary
+    assert fold.window_pos == 0 and fold.logical_steps == 3
+    with tempfile.TemporaryDirectory() as d:
+        fname = os.path.join(d, "trainer.states")
+        tr.save_states(fname)       # boundary: allowed
+        fold.step_one(x, y)         # 1 past the boundary
+        assert fold.window_pos == 1
+        with pytest.raises(RuntimeError, match="K boundar"):
+            tr.save_states(fname)
+        fold.step_one(x, y)
+        fold.step_one(x, y)         # back on a boundary
+        assert fold.window_pos == 0 and fold.logical_steps == 6
+        tr.save_states(fname)
+        for _ in range(3):
+            fold.step_one(x, y)     # advance past the snapshot...
+        assert fold.logical_steps == 9
+        tr.load_states(fname)       # ...and restore the cursor
+    assert fold.logical_steps == 6 and fold.window_pos == 0
+
+
+def test_fold_k_one_reduces_to_single_step_program():
+    """K=1 (the MXNET_STEP_FOLD_K default) must BE the PR 15 program:
+    same compile site, one dispatch per step, no window ceremony."""
+    net, x, y = _mlp(79, dropout=0.0)
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.05}, kvstore=None)
+    fold = tr.fold_steps(lambda a, b: L2(net(a), b), k=1, block=net)
+    mx.random.seed(31)
+    fold(x, y)
+    c0 = profiler.counters()
+    fold(x, y)
+    c1 = profiler.counters()
+    assert fold.k == 1 and fold.folded
+    assert c1["step_fold_call"] - c0["step_fold_call"] == 1
+    assert (step_fold.host_dispatch_total(c1)
+            - step_fold.host_dispatch_total(c0)) == 1
+
+
+def test_fold_k_env_default(monkeypatch):
+    """MXNET_STEP_FOLD_K configures the default fold width."""
+    monkeypatch.setenv("MXNET_STEP_FOLD_K", "4")
+    net, x, y = _mlp(83, dropout=0.0)
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.05}, kvstore=None)
+    fold = tr.fold_steps(lambda a, b: L2(net(a), b), block=net)
+    assert fold.k == 4
+
+
+def test_fold_fallback_reason_labels(monkeypatch):
+    """step_fold_fallback carries a per-reason label, surfaced through
+    counter_labels() and the metrics provider (docs/observability.md)."""
+    monkeypatch.setenv("MXNET_STEP_FOLD", "0")
+    net, x, y = _mlp(89, dropout=0.0, bn=False)
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.05}, kvstore=None)
+    program = tr.fold_step(lambda a, b: L2(net(a), b), block=net)
+    base = dict(profiler.counter_labels("step_fold_fallback") or {})
+    program(x, y)
+    labels = profiler.counter_labels("step_fold_fallback")
+    assert labels.get("env-off", 0) == base.get("env-off", 0) + 1
+    monkeypatch.delenv("MXNET_STEP_FOLD")
+
+    net2, x2, y2 = _mlp(89, dropout=0.0, bn=False)
+    tr2 = gluon.Trainer(net2.collect_params(), "ftrl",
+                        {"learning_rate": 0.05}, kvstore=None)
+    program2 = tr2.fold_step(lambda a, b: L2(net2(a), b), block=net2)
+    with pytest.warns(UserWarning, match="step fold disabled"):
+        program2(x2, y2)
+    labels = profiler.counter_labels("step_fold_fallback")
+    assert labels.get("unsupported-optimizer", 0) \
+        == base.get("unsupported-optimizer", 0) + 1
+    # every label the fold can emit is a known, documented reason
+    for lbl in labels:
+        assert lbl in step_fold.FALLBACK_LABELS, lbl
+    snap = profiler.metrics_snapshot()
+    assert "step_fold_fallback" in snap.get("counter_labels", {})
+
+
+def test_stage_window_feeds_fold():
+    """io.DataPipeline.stage_window(k) hands the fold [K, batch, ...]
+    stacked windows (epoch tail shorter), and N source batches land in
+    exactly ceil(N/K) fold dispatches."""
+    from incubator_mxnet_tpu.io import DataPipeline, NDArrayIter
+
+    rs = np.random.RandomState(3)
+    xs = rs.rand(40, 6).astype("float32")      # 10 batches of 4
+    ys = rs.rand(40, 4).astype("float32")
+    net, _, _ = _mlp(97, dropout=0.0)
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.05}, kvstore=None)
+    fold = tr.fold_steps(lambda a, b: L2(net(a), b), k=4, block=net)
+    pipe = DataPipeline(NDArrayIter(xs, ys, batch_size=4))
+    try:
+        mx.random.seed(12)
+        widths, calls = [], 0
+        while True:
+            try:
+                window = pipe.stage_window(4)
+            except StopIteration:
+                break
+            data, label = window.data[0], window.label[0]
+            widths.append(int(data.shape[0]))
+            fold(data, label)
+            calls += 1
+        assert widths == [4, 4, 2]             # epoch tail is shorter
+        assert calls == 3                      # == ceil(10/4)
+        assert fold.logical_steps == 10
+        assert fold.folded, fold.fallback_reason
+        assert pipe.window == 4
+        assert pipe.stats()["batches"] >= 10   # logical-batch accounting
+    finally:
+        pipe.close()
+
+
+def test_fold_eval_parity_and_single_read():
+    """fold_eval accumulates in-program (eval mode: BN running stats,
+    dropout identity) and reads the host ONCE per pass; the mean matches
+    the eager eval-mode loss, and a [K, ...] window run matches K
+    per-batch calls exactly."""
+    net, x, y = _mlp(101, dropout=0.5)
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.05}, kvstore=None)
+
+    with autograd.pause():
+        ref = float(np.asarray(L2(net(x), y).asnumpy(),
+                               np.float64).mean())
+
+    ev = tr.fold_eval(lambda a, b: L2(net(a), b), block=net)
+    c0 = profiler.counters()
+    for _ in range(3):
+        ev(x, y)
+    c1 = profiler.counters()
+    got = ev.result()
+    assert c1["fold_eval_call"] - c0["fold_eval_call"] == 3
+    np.testing.assert_allclose(got, ref, rtol=1e-6, atol=1e-8)
+
+    # K=4 windowed eval: one dispatch per window, same accumulator math
+    ev4 = tr.fold_eval(lambda a, b: L2(net(a), b), block=net, k=4)
+    xw, yw = _window(x, 4), _window(y, 4)
+    c0 = profiler.counters()
+    ev4(xw, yw)
+    c1 = profiler.counters()
+    assert c1["fold_eval_call"] - c0["fold_eval_call"] == 1
+    np.testing.assert_allclose(ev4.result(), ref, rtol=1e-6, atol=1e-8)
+    assert ev.folded and ev4.folded
+
+
+def test_fold_eval_no_recompile_under_guard_raise(monkeypatch):
+    """Eval builds are declared warmups: creating/running fold_eval after
+    the TRAIN guard armed must not raise in raise mode."""
+    monkeypatch.setenv("MXNET_COMPILE_GUARD", "raise")
+    net, x, y = _mlp(103, dropout=0.0)
+    tr = gluon.Trainer(net.collect_params(), "adam",
+                       {"learning_rate": 0.01}, kvstore=None)
+    fold = tr.fold_steps(lambda a, b: L2(net(a), b), k=2, block=net)
+    xw, yw = _window(x, 2), _window(y, 2)
+    mx.random.seed(41)
+    fold(xw, yw)                 # builds + arms gluon.step_fold_k
+    fold(xw, yw)
+    ev = tr.fold_eval(lambda a, b: L2(net(a), b), block=net)
+    ev(x, y)                     # fresh eval build: declared warmup
+    ev(x, y)                     # steady state: cached, no compile
+    assert np.isfinite(ev.result())
+    assert fold.folded and ev.folded
+
+
 # ---------------------------------------------------------------------------
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))

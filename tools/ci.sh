@@ -243,6 +243,7 @@ for tier in "${TIERS[@]}"; do
             run_tier fold "${CPU_ENV[@]}" bash -c '
                 set -e
                 python benchmark/opperf/step_fold.py --smoke >/dev/null
+                python benchmark/opperf/step_fold.py --k --smoke >/dev/null
                 python -m pytest tests/test_step_fold.py -q -m "not slow" '"${CI_PYTEST_ARGS:-}"
             ;;
         tpu)

@@ -27,8 +27,11 @@ Sections:
 * ``--xplane DIR`` — the device HLO-op table parsed from an xprof capture
   via the shared ``profiler.iter_xplane_ops`` reader (same stream
   ``tools/parse_xplane.py`` and ``dumps()`` present);
-* ``--analytic`` — the bench-config analytic FLOPs/MFU table that used to
-  live in ``tools/flops_report.py`` (kept there as a deprecated shim).
+* ``--analytic`` — with no dump, the bench-config analytic FLOPs/MFU
+  table that used to live in ``tools/flops_report.py`` (kept there as a
+  deprecated shim); with a dump, the K-fold scan-body attribution table
+  (whole-program cost / K iterations for ``gluon.step_fold_k`` /
+  ``gluon.fold_eval`` compiles — see docs/step_fold.md).
 
 Exit codes: 0 on success, 2 on an unreadable/empty registry.
 """
@@ -173,16 +176,23 @@ def report(reg, top=15, out=sys.stdout):
           f"{summ['steady_state_by_site'].get(site, 0):>8}{gflop:>10}"
           f"{mb:>10}\n")
 
-    # step-fold callout (docs/step_fold.md): the fold site compiles once
-    # per (batch signature, optimizer-group-set); ANY steady-state compile
-    # here means the single-program-per-step contract broke
-    fold_records = [r for r in records if r.get("site") == "gluon.step_fold"]
+    # step-fold callout (docs/step_fold.md): the fold sites compile once
+    # per (batch signature, optimizer-group-set[, K]); ANY steady-state
+    # compile here means the single-program-per-(K-)step contract broke.
+    # gluon.step_fold_k is the K-step scan program, gluon.fold_eval the
+    # folded eval program — distinct program names per K are expected,
+    # steady-state recompiles of an already-seen one are not.
+    _FOLD_SITES = ("gluon.step_fold", "gluon.step_fold_k", "gluon.fold_eval")
+    fold_records = [r for r in records if r.get("site") in _FOLD_SITES]
     if fold_records:
         progs = defaultdict(int)
         for r in fold_records:
             progs[str(r.get("program") or "step_fold")] += 1
-        steady_fold = summ["steady_state_by_site"].get("gluon.step_fold", 0)
-        w("\nStep fold (gluon.step_fold): "
+        steady_fold = sum(summ["steady_state_by_site"].get(s, 0)
+                          for s in _FOLD_SITES)
+        w("\nStep fold (" + "/".join(
+            s for s in _FOLD_SITES
+            if any(r.get("site") == s for r in fold_records)) + "): "
           + ", ".join(f"{p} x{n}" for p, n in sorted(progs.items()))
           + (f" — {steady_fold} STEADY-STATE recompile(s): the one-"
              "dispatch-per-step contract broke" if steady_fold
@@ -233,6 +243,45 @@ def xplane_report(trace_dir, top=20, out=sys.stdout):
                                   key=lambda kv: -kv[1][1])[:top]:
         w(f"{inst[:44]:<44}{cnt:>8}{ps / 1e9:>12.3f}"
           f"{100 * ps / grand:>6.1f}%\n")
+
+
+def fold_analytic_report(reg, out=sys.stdout):
+    """Per-iteration cost attribution for K-step fold scan bodies.
+
+    A ``gluon.step_fold_k`` compile covers K scan iterations in ONE
+    program, so the XLA cost analysis captured under
+    ``MXNET_COMPILE_COST=1`` reports K iterations' worth of flops and
+    bytes.  The honest per-logical-step number is whole-program cost / K;
+    K is parsed from the program name (``step_fold_k[4]``,
+    ``fold_eval[8]``).  Comparing GFLOP/iter across K values is the quick
+    check that the scan body really is the K=1 step and the fold is pure
+    dispatch amortisation, not a different program."""
+    import re
+    rows = []
+    for r in reg.get("records") or []:
+        site = r.get("site")
+        if site not in ("gluon.step_fold", "gluon.step_fold_k",
+                        "gluon.fold_eval"):
+            continue
+        prog = str(r.get("program") or "step_fold")
+        m = re.search(r"\[(\d+)\]", prog)
+        k = int(m.group(1)) if m else 1
+        c = r.get("cost") or {}
+        rows.append((site, prog, k, c.get("flops"),
+                     c.get("bytes_accessed"), r.get("wall_ms", 0.0)))
+    w = out.write
+    if not rows:
+        w("\n(no step-fold compiles in the registry — nothing to "
+          "attribute per scan iteration)\n")
+        return
+    w("\nK-fold scan-body attribution (whole-program cost / K iterations; "
+      "needs MXNET_COMPILE_COST=1 for flops/bytes):\n")
+    w(f"{'site':<22}{'program':<22}{'K':>4}{'GFLOP/iter':>12}"
+      f"{'MB/iter':>10}{'compile(ms)':>13}\n")
+    for site, prog, k, fl, by, ms in sorted(rows, key=lambda r: (r[0], r[2])):
+        g = f"{fl / k / 1e9:.3f}" if fl else "-"
+        mb = f"{by / k / 1e6:.2f}" if by else "-"
+        w(f"{site:<22}{prog[:22]:<22}{k:>4}{g:>12}{mb:>10}{ms:>13.1f}\n")
 
 
 # -- analytic bench-config FLOPs (absorbed from tools/flops_report.py) -------
@@ -340,13 +389,14 @@ def main(argv=None):
     p.add_argument("--xplane", default=None,
                    help="xprof trace dir: append the device HLO-op table")
     p.add_argument("--analytic", action="store_true",
-                   help="bench-config analytic FLOPs table "
-                        "(ex tools/flops_report.py)")
+                   help="no dump: bench-config analytic FLOPs table (ex "
+                        "tools/flops_report.py); with a dump: per-iteration "
+                        "K-fold scan-body cost attribution")
     p.add_argument("--configs", nargs="*", default=None,
                    help="--analytic: subset of bench configs")
     args = p.parse_args(argv)
 
-    if args.analytic:
+    if args.analytic and not args.dump:
         return analytic_report(args.configs)
     if not args.dump:
         p.error("give at least one dump file (or --analytic)")
@@ -365,6 +415,10 @@ def main(argv=None):
             sys.stdout.write("\n")
         else:
             report(reg, top=args.top)
+        if args.analytic:
+            # with a dump: per-iteration scan-body attribution instead of
+            # (in addition to --configs would be ambiguous) the bench table
+            fold_analytic_report(reg)
         if args.xplane:
             xplane_report(args.xplane, top=args.top)
     except BrokenPipeError:

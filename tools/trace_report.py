@@ -150,7 +150,8 @@ def report(path, spans, other, top=15, bins=10, xplane=None,
             buckets_by_pid[pid].append((ts, ts + dur))
     per_step = defaultdict(int)
     fold_steps = set()
-    for name, _cat, ts, _, step, _, pid in spans:
+    fold_k = {}                      # step -> K logical steps in that window
+    for name, _cat, ts, _, step, args, pid in spans:
         if step is None or name not in _DISPATCH_SPANS:
             continue
         if name in _WIRE_CHILDREN and any(
@@ -159,6 +160,9 @@ def report(path, spans, other, top=15, bins=10, xplane=None,
         per_step[step] += 1
         if name == "trainer.step_fold":
             fold_steps.add(step)
+            k = int((args or {}).get("k") or 1)
+            if k > fold_k.get(step, 1):
+                fold_k[step] = k
     if per_step:
         counts = sorted(per_step.values())
         med = counts[len(counts) // 2]
@@ -170,6 +174,16 @@ def report(path, spans, other, top=15, bins=10, xplane=None,
             w(f"; folded steps: {len(fold_steps)} "
               f"(median {fold_counts[len(fold_counts) // 2]} dispatch/step)")
         w("\n")
+        # K-step fold (Trainer.fold_steps, k > 1): one trainer.step_fold
+        # span covers K logical training steps (span arg "k"), so the
+        # honest dispatch-amortisation number is per LOGICAL step — it
+        # reads 1/K when the fold held and snaps back to ~1 on fallback.
+        logical = sum(fold_k.get(s, 1) for s in per_step)
+        if logical > len(per_step):
+            disp = sum(per_step.values())
+            w(f"Host dispatches per LOGICAL step (K-fold): {disp} "
+              f"dispatches / {logical} logical steps = "
+              f"{disp / logical:.3f}\n")
 
     # gradient-exchange payloads (docs/gradient_compression.md): the
     # bucketed-pushpull and spmd-step spans carry bytes_raw/bytes_wire
