@@ -39,6 +39,7 @@ def _emit_error(exc):
     mode = os.environ.get("MXNET_TPU_BENCH") or "bert_base"
     print(json.dumps({
         "metric": mode, "value": None, "unit": None, "vs_baseline": None,
+        "status": "backend_unavailable",
         "error": f"{type(exc).__name__}: {exc}"[:800],
     }))
 
@@ -56,16 +57,22 @@ def _probe_backend(deadline_s):
             "print('BACKEND_OK', jax.default_backend())")
     t0 = time.monotonic()
     delay, last = 5.0, "never probed"
+    # per-attempt cap: a wedged tunnel hangs the child until this expires,
+    # so a 180 s default burns most of the overall deadline on ONE attempt
+    # (the round-18 run spent 748 s to report an unavailable backend);
+    # tunable so CI can fail fast
+    probe_s = float(os.environ.get("MXNET_TPU_BENCH_PROBE_TIMEOUT", "180"))
     while True:
         try:
             r = subprocess.run([sys.executable, "-c", code],
-                               capture_output=True, text=True, timeout=180)
+                               capture_output=True, text=True,
+                               timeout=probe_s)
             if r.returncode == 0 and "BACKEND_OK" in r.stdout:
                 print(r.stdout.strip(), file=sys.stderr)
                 return
             last = (r.stderr or r.stdout).strip()[-500:]
         except subprocess.TimeoutExpired:
-            last = "probe timed out after 180s (tunnel hang)"
+            last = f"probe timed out after {probe_s:.0f}s (tunnel hang)"
         waited = time.monotonic() - t0
         if waited > deadline_s:
             raise RuntimeError(

@@ -17,9 +17,14 @@ runs one step of every tier back-to-back, median round wins, GC paused.
 
 Acceptance (ISSUE 14): the int8 tier moves >= 3.5x fewer gradient bytes
 than fp32 on BOTH paths (counters), with the opt-out groups still
-travelling exact.
+travelling exact.  ``--algo ring|psum|both`` (ISSUE 19) A/Bs the SPMD
+exchange algorithm over the SAME buckets — for the ring, the evidence is
+per-HOP: every ppermute payload is one encoded chunk, and the harness
+exits non-zero if the int8 per-hop byte ratio falls below 3.5x fp32 or
+any tier recompiles after warmup.
 
     python benchmark/opperf/collectives.py [--json PATH] [--smoke]
+                                           [--algo ring|psum|both]
 """
 from __future__ import annotations
 
@@ -40,6 +45,10 @@ if "xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", "")
                                + " --xla_force_host_platform_device_count=8")
 
 TIERS = ("fp32", "bf16", "int8")
+# the SPMD half also runs the int4 packed tier: its nibble wire rides the
+# ring hops / int4 psum grid, while the host bucket path rejects it (no
+# linear sum for packed nibbles) — so it never joins the pushpull tiers
+SPMD_TIERS = TIERS + ("int4",)
 
 
 @contextlib.contextmanager
@@ -76,13 +85,22 @@ def _median(xs):
     return xs[n // 2] if n % 2 else 0.5 * (xs[n // 2 - 1] + xs[n // 2])
 
 
-def _policy(tier):
+def _policy(tier, algo=None):
     from incubator_mxnet_tpu import comm
 
     # "off", not None: None re-resolves MXNET_GRAD_COMPRESS downstream,
     # and an exported tier in the caller's env would silently compress
     # the fp32 BASELINE, making every ratio in the evidence meaningless
-    return "off" if tier == "fp32" else comm.resolve_policy(tier)
+    if tier == "fp32":
+        return "off"
+    pol = comm.resolve_policy(tier)
+    if algo is not None:
+        # pin the exchange algorithm for the A/B regardless of the
+        # caller's MXNET_GRAD_COMPRESS_ALGO
+        pol = comm.CompressionPolicy(pol.codec,
+                                     error_feedback=pol.error_feedback,
+                                     algo=algo)
+    return pol
 
 
 def _counter_delta(fn):
@@ -161,9 +179,12 @@ def run_pushpull(n_params=64, shape=(64, 32), iters=10, warmup=2, repeats=3):
 
 @_guarded
 def run_spmd(batch=32, features=64, hidden=256, classes=8, iters=10,
-             warmup=2, repeats=3):
+             warmup=2, repeats=3, algo="psum"):
     """Paired SPMD-step timing, one trainer per tier, under the
-    steady-state compile guard."""
+    steady-state compile guard.  ``algo`` picks the exchange form for the
+    compressed tiers: ``psum`` (quantize -> integer psum -> dequantize)
+    or ``ring`` (explicit encoded ppermute hops, comm/ring.py) — same
+    buckets either way, so the A/B isolates the algorithm."""
     import gc
 
     import numpy as np
@@ -197,37 +218,52 @@ def run_spmd(batch=32, features=64, hidden=256, classes=8, iters=10,
         return time.perf_counter() - t0
 
     with profiler.compile_guard_paused():
-        for tier in TIERS:
+        for tier in SPMD_TIERS:
             trainers[tier] = SPMDTrainer(
                 build(), loss_fn, "sgd", {"learning_rate": 0.05},
                 mesh=make_mesh(),
-                compression=_policy(tier))
+                compression=_policy(tier, algo=algo))
         for _ in range(max(1, warmup)):
-            for t in TIERS:
+            for t in SPMD_TIERS:
                 one(t)
     base_recompiles = profiler.counters()["recompile_steady_state"]
 
     byte_ratio = {}
-    for tier in TIERS:
+    for tier in SPMD_TIERS:
         _, raw, wire = _counter_delta(lambda: one(tier))
         if tier == "fp32":
             # the fp32 trainer has no comm accounting: its dp exchange IS
             # the raw payload — derive it from the int8 trainer's layout
             continue
-        byte_ratio[tier] = {"bytes_raw": raw, "bytes_wire": wire,
-                            "ratio": round(raw / wire, 3) if wire else 0.0}
+        entry = {"bytes_raw": raw, "bytes_wire": wire,
+                 "ratio": round(raw / wire, 3) if wire else 0.0}
+        cfg_t = trainers[tier]._comm_cfg
+        if algo == "ring" and cfg_t["hops"]:
+            # per-HOP wire accounting (the acceptance evidence is
+            # hop-granular for the ring: every ppermute payload is the
+            # encoded chunk, so the per-hop ratio IS the codec's)
+            from incubator_mxnet_tpu.comm import ring as ring_mod
+
+            chunk = ring_mod._ring_chunk(cfg_t["codec"], cfg_t["n"],
+                                         cfg_t["shards"])
+            entry.update(
+                hops=cfg_t["hops"], bytes_per_hop=cfg_t["bytes_hop"],
+                fp32_bytes_per_hop=4 * chunk,
+                hop_ratio_vs_fp32=round(4 * chunk / cfg_t["bytes_hop"], 3)
+                if cfg_t["bytes_hop"] else 0.0)
+        byte_ratio[tier] = entry
     cfg = trainers["int8"]._comm_cfg
     byte_ratio["fp32"] = {"bytes_raw": cfg["bytes_raw"],
                           "bytes_wire": cfg["bytes_raw"], "ratio": 1.0}
 
     rounds = max(1, iters * repeats)
-    times = {t: [] for t in TIERS}
+    times = {t: [] for t in SPMD_TIERS}
     gc.collect()
     gc_was_on = gc.isenabled()
     gc.disable()
     try:
         for _ in range(rounds):
-            for t in TIERS:
+            for t in SPMD_TIERS:
                 times[t].append(one(t))
     finally:
         if gc_was_on:
@@ -235,6 +271,7 @@ def run_spmd(batch=32, features=64, hidden=256, classes=8, iters=10,
     recompiles = profiler.counters()["recompile_steady_state"] - base_recompiles
     medians = {t: _median(v) for t, v in times.items()}
     return {
+        "algo": algo,
         "rounds": rounds,
         "median_s": medians,
         "steps_per_sec": {t: round(1.0 / v, 2) for t, v in medians.items()},
@@ -244,16 +281,32 @@ def run_spmd(batch=32, features=64, hidden=256, classes=8, iters=10,
 
 
 def run(n_params=64, shape=(64, 32), batch=32, hidden=256, iters=10,
-        warmup=2, repeats=3):
+        warmup=2, repeats=3, algo="both"):
     pushpull = run_pushpull(n_params=n_params, shape=shape, iters=iters,
                             warmup=warmup, repeats=repeats)
-    spmd = run_spmd(batch=batch, hidden=hidden, iters=iters, warmup=warmup,
-                    repeats=repeats)
+    algos = ("psum", "ring") if algo == "both" else (algo,)
+    spmd_ab = {}
+    for a in algos:
+        spmd_ab[a] = run_spmd(batch=batch, hidden=hidden, iters=iters,
+                              warmup=warmup, repeats=repeats, algo=a)
+    primary = "ring" if "ring" in spmd_ab else algos[0]
+    spmd = spmd_ab[primary]
     ratios = {
         "pushpull_int8": pushpull["bytes"]["int8"]["ratio"],
         "spmd_int8": spmd["bytes"]["int8"]["ratio"],
     }
     ok = all(v >= 3.5 for v in ratios.values())
+    if "ring" in spmd_ab:
+        # hop-granular acceptance: the ring's per-ppermute payload must
+        # be >= 3.5x narrower than the fp32 chunk it replaces (>= 6x for
+        # the packed int4 nibbles)
+        ratios["spmd_ring_int8_per_hop"] = \
+            spmd_ab["ring"]["bytes"]["int8"]["hop_ratio_vs_fp32"]
+        ratios["spmd_ring_int4_per_hop"] = \
+            spmd_ab["ring"]["bytes"]["int4"]["hop_ratio_vs_fp32"]
+        ok = (ok and ratios["spmd_ring_int8_per_hop"] >= 3.5
+              and ratios["spmd_ring_int4_per_hop"] >= 6.0)
+    recompiles = sum(r["post_warmup_recompiles"] for r in spmd_ab.values())
     return {
         "bench": "collectives",
         "backend": os.environ.get("JAX_PLATFORMS", "default"),
@@ -261,11 +314,13 @@ def run(n_params=64, shape=(64, 32), batch=32, hidden=256, iters=10,
         "shape": list(shape),
         "batch": batch,
         "hidden": hidden,
+        "algo": algo,
         "pushpull": pushpull,
         "spmd": spmd,
+        "spmd_ab": spmd_ab,
         "int8_byte_ratio": ratios,
         "bytes_acceptance": bool(ok),   # int8 >= 3.5x on BOTH paths
-        "post_warmup_recompiles": spmd["post_warmup_recompiles"],
+        "post_warmup_recompiles": int(recompiles),
     }
 
 
@@ -278,15 +333,22 @@ def main(argv=None):
     p.add_argument("--iters", type=int, default=10)
     p.add_argument("--warmup", type=int, default=2)
     p.add_argument("--repeats", type=int, default=3)
+    p.add_argument("--algo", choices=("psum", "ring", "both"),
+                   default="both",
+                   help="gradient-exchange algorithm for the SPMD half: "
+                        "the quantized psum sandwich, the explicit "
+                        "encoded-ppermute ring, or an A/B of both over "
+                        "the same buckets (default)")
     p.add_argument("--smoke", action="store_true",
                    help="tiny config + 1 round: the CI regression guard "
                         "(non-zero exit on post-warmup recompiles or an "
-                        "int8 byte-ratio below 3.5x on either path)")
+                        "int8 byte-ratio below 3.5x on either path, "
+                        "per-hop for the ring)")
     p.add_argument("--json", dest="json_path", default=None, metavar="PATH")
     args = p.parse_args(argv)
     kw = dict(n_params=args.n_params, shape=(args.side, 32),
               batch=args.batch, hidden=args.hidden, iters=args.iters,
-              warmup=args.warmup, repeats=args.repeats)
+              warmup=args.warmup, repeats=args.repeats, algo=args.algo)
     if args.smoke:
         kw.update(n_params=16, iters=1, repeats=1, warmup=1, hidden=128)
     line = run(**kw)

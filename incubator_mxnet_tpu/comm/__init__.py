@@ -3,32 +3,54 @@
 Codecs are first-class objects shared by BOTH cross-host gradient paths:
 ``kvstore.bucketed_pushpull``'s flat buckets (gluon Trainer against a
 dist store) and SPMDTrainer's in-program dp-axis gradient reduction.
-One policy surface (``MXNET_GRAD_COMPRESS=off|bf16|int8``) drives both.
+One policy surface (``MXNET_GRAD_COMPRESS=off|bf16|int8|int4`` plus
+``MXNET_GRAD_COMPRESS_ALGO=psum|ring``) drives both; the explicit
+ring-hop exchange lives in ``comm/ring.py``.
 """
 from .compression import (
     Bf16Codec,
     CompressionPolicy,
     ErrorFeedback,
+    Int4PackedCodec,
     Int8BlockCodec,
     account,
     bucket_allreduce,
     codec_from_id,
     codec_from_params,
     decode_np,
+    encode_np,
     resolve_policy,
     traced_allreduce,
+)
+from .ring import (
+    hop_plan,
+    ring_all_gather,
+    ring_allreduce,
+    ring_allreduce_sharded,
+    ring_reduce_scatter,
+    ring_rs_ag_sharded,
+    rs_ag_hop_plan,
 )
 
 __all__ = [
     "Bf16Codec",
     "CompressionPolicy",
     "ErrorFeedback",
+    "Int4PackedCodec",
     "Int8BlockCodec",
     "account",
     "bucket_allreduce",
     "codec_from_id",
     "codec_from_params",
     "decode_np",
+    "encode_np",
+    "hop_plan",
     "resolve_policy",
+    "ring_all_gather",
+    "ring_allreduce",
+    "ring_allreduce_sharded",
+    "ring_reduce_scatter",
+    "ring_rs_ag_sharded",
+    "rs_ag_hop_plan",
     "traced_allreduce",
 ]

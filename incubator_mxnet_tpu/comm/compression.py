@@ -53,9 +53,10 @@ import numpy as _np
 from .. import profiler as _profiler
 
 __all__ = [
-    "Bf16Codec", "CompressionPolicy", "ErrorFeedback", "Int8BlockCodec",
-    "account", "bucket_allreduce", "codec_from_id", "codec_from_params",
-    "decode_np", "resolve_policy", "traced_allreduce",
+    "Bf16Codec", "CompressionPolicy", "ErrorFeedback", "Int4PackedCodec",
+    "Int8BlockCodec", "PULL_ENC_WIRE_VERSION", "account",
+    "bucket_allreduce", "codec_from_id", "codec_from_params", "decode_np",
+    "encode_np", "resolve_policy", "traced_allreduce",
 ]
 
 
@@ -98,6 +99,88 @@ def _dequantize(vals, safe, n, block, xp):
     or blocked) × per-block scales → the first ``n`` fp32 values."""
     b = vals.reshape(-1, block).astype(xp.float32)
     return (b * safe[:, None]).reshape(-1)[:n]
+
+
+# THE int4 grid: 4-bit codes in [-7, 7] packed two-per-int8-lane, with a
+# two-level scale hierarchy — per-block absmax scales are themselves
+# quantized to uint8 codes against ONE per-tensor fp32 scale, so the
+# wire carries n/2 bytes of packed codes + 1 byte/block of scale codes
+# + a single fp32, ~7.9x narrower than fp32 at block 256.  Encode
+# quantizes against the DEQUANTIZED block scale (the grid the receiver
+# reconstructs), so pack→unpack is exact by construction.
+
+def _block_scales4(b, xp):
+    return xp.max(xp.abs(b), axis=1) / 7.0
+
+
+def _int4_scale_codes(s, xp):
+    """(uint8 scale codes, fp32 per-tensor scale) for per-block scales."""
+    tmax = xp.max(s) if s.size else xp.float32(0.0)
+    tsafe = xp.where(tmax > 0, tmax, 1.0)
+    scodes = xp.clip(xp.round(s / tsafe * 255.0), 0.0, 255.0)
+    return scodes.astype(xp.uint8), xp.asarray(tmax, xp.float32)
+
+
+def _int4_safe_scales(scodes, tmax, xp):
+    s_hat = scodes.astype(xp.float32) / 255.0 * tmax
+    return _safe_scales(s_hat, xp)
+
+
+def _int4_pack(codes, xp):
+    """int4 codes [-7, 7] (nb, block) -> packed uint8 (nb, block//2)."""
+    u = (codes + 8.0).astype(xp.uint8)
+    return u[:, 0::2] | (u[:, 1::2] << 4)
+
+
+def _int4_unpack(packed, xp):
+    """packed uint8 (nb, block//2) -> fp32 codes [-7, 7] (nb, block)."""
+    lo = (packed & 0xF).astype(xp.float32) - 8.0
+    hi = (packed >> 4).astype(xp.float32) - 8.0
+    nb, half = packed.shape
+    return xp.stack([lo, hi], axis=-1).reshape(nb, 2 * half)
+
+
+def _int4_encode_arrays(flat, block, xp):
+    """flat fp32 -> (packed uint8, scale codes uint8, tmax fp32, resid)."""
+    n = flat.shape[0]
+    b = _pad_blocks(flat, block) if xp is not _np else _pad_blocks_np(flat,
+                                                                      block)
+    scodes, tmax = _int4_scale_codes(_block_scales4(b, xp), xp)
+    safe = _int4_safe_scales(scodes, tmax, xp)
+    codes = xp.clip(xp.round(b / safe[:, None]), -7.0, 7.0)
+    packed = _int4_pack(codes, xp)
+    deq = _dequantize(codes, safe, n, block, xp)
+    return packed, scodes, tmax, flat - deq
+
+
+def _int4_decode_arrays(packed, scodes, tmax, n, block, xp):
+    safe = _int4_safe_scales(scodes, tmax, xp)
+    codes = _int4_unpack(packed, xp)
+    return _dequantize(codes, safe, n, block, xp)
+
+
+def _pad_blocks_np(flat, block):
+    n = flat.shape[0]
+    pad = (-n) % block
+    if pad:
+        flat = _np.concatenate([flat, _np.zeros((pad,), flat.dtype)])
+    return flat.reshape(-1, block)
+
+
+@_lru_cache(maxsize=None)
+def _int4_fns(block):
+    """(encode, decode) jitted kernels for one int4 block size."""
+    import jax
+    import jax.numpy as jnp
+
+    def encode(flat):
+        packed, scodes, tmax, resid = _int4_encode_arrays(flat, block, jnp)
+        return packed, scodes, tmax, resid
+
+    def decode(packed, scodes, tmax, n):
+        return _int4_decode_arrays(packed, scodes, tmax, n, block, jnp)
+
+    return jax.jit(encode), jax.jit(decode, static_argnums=(3,))
 
 
 @_lru_cache(maxsize=None)
@@ -219,6 +302,43 @@ class Int8BlockCodec:
         return self.decode_with_scales(payload["codes"], payload["scales"], n)
 
 
+class Int4PackedCodec:
+    """Packed 4-bit quantization: codes in [-7, 7], TWO values per int8
+    lane, with a two-level scale hierarchy — per-block scales quantized
+    to uint8 codes against one per-tensor fp32 scale (~7.9x fewer bytes
+    at block 256).  Coarser than int8, so it is gated to the explicit
+    ring exchange (``comm/ring.py``) and the async-PS wire, where the
+    packed lanes are what physically moves; the host ``bucket_allreduce``
+    wire has no linear sum for packed nibbles and keeps rejecting it.
+    ``id`` embeds the block size like :class:`Int8BlockCodec`."""
+
+    error_feedback_default = True
+
+    def __init__(self, block=256):
+        block = int(block)
+        if block < 2 or block % 2:
+            raise ValueError(
+                f"int4 block size must be an even value >= 2, got {block}")
+        self.block = block
+        self.id = f"int4b{block}"
+
+    def n_blocks(self, n):
+        return -(-n // self.block)
+
+    def wire_nbytes(self, n):
+        nb = self.n_blocks(n)
+        # packed nibble lanes + uint8 scale codes + one fp32 tensor scale
+        return nb * self.block // 2 + nb + 4
+
+    def encode(self, flat):
+        packed, scodes, tmax, resid = _int4_fns(self.block)[0](flat)
+        return {"packed": packed, "scodes": scodes, "tmax": tmax}, resid
+
+    def decode(self, payload, n):
+        return _int4_fns(self.block)[1](
+            payload["packed"], payload["scodes"], payload["tmax"], int(n))
+
+
 def codec_from_id(codec_id):
     """Inverse of ``codec.id`` — the wire envelope names codecs by id."""
     if codec_id == "bf16":
@@ -226,17 +346,23 @@ def codec_from_id(codec_id):
     m = _re.fullmatch(r"int8b(\d+)", codec_id)
     if m:
         return Int8BlockCodec(int(m.group(1)))
+    m = _re.fullmatch(r"int4b(\d+)", codec_id)
+    if m:
+        return Int4PackedCodec(int(m.group(1)))
     raise ValueError(f"unknown gradient-compression codec id {codec_id!r}")
 
 
 def codec_from_params(params):
     """Codec for a ``set_gradient_compression`` dict with ``type`` in
-    ('bf16', 'int8'); the legacy '2bit' scheme stays in kvstore.py."""
+    ('bf16', 'int8', 'int4'); the legacy '2bit' scheme stays in
+    kvstore.py."""
     ctype = params.get("type")
     if ctype == "bf16":
         return Bf16Codec()
     if ctype == "int8":
         return Int8BlockCodec(params.get("block", _default_block()))
+    if ctype == "int4":
+        return Int4PackedCodec(params.get("block", _default_block()))
     raise ValueError(f"no codec for gradient compression type {ctype!r}")
 
 
@@ -247,9 +373,48 @@ def decode_np(codec_id, payload, n):
     if codec_id == "bf16":
         return _np.asarray(payload["enc"], _np.float32)[:n]
     codec = codec_from_id(codec_id)
+    if isinstance(codec, Int4PackedCodec):
+        return _int4_decode_arrays(
+            _np.asarray(payload["packed"], _np.uint8),
+            _np.asarray(payload["scodes"], _np.uint8),
+            _np.float32(payload["tmax"]), n, codec.block,
+            _np).astype(_np.float32)
     codes = _np.asarray(payload["codes"], _np.float32)
     safe = _safe_scales(_np.asarray(payload["scales"], _np.float32), _np)
     return _dequantize(codes, safe, n, codec.block, _np).astype(_np.float32)
+
+
+# Wire version of the encoded async-PS PULL envelope ("pull_enc").  The
+# push leg's envelope is the request tuple itself (codec id + payload
+# arrays, versioned implicitly by the codec id grammar); the pull leg
+# carries an explicit version because the REPLY is produced by the server
+# — a client must be able to tell "old server that echoed something
+# else" from "current envelope", and a server must reject a future
+# client's envelope loudly instead of guessing.
+PULL_ENC_WIRE_VERSION = 1
+
+
+def encode_np(codec_id, flat):
+    """Pure-numpy encode — the :func:`decode_np` inverse the async-PS
+    server uses for the ENCODED PULL leg: aggregated fp32 values leave
+    the server in the bucket codec's wire form with no device round-trip.
+    Returns the payload dict only (the server keeps no residual: pull is
+    a read, the quantization error does not feed back)."""
+    flat = _np.asarray(flat, _np.float32).reshape(-1)
+    if codec_id == "bf16":
+        import ml_dtypes as _mld
+
+        return {"enc": flat.astype(_mld.bfloat16)}
+    codec = codec_from_id(codec_id)
+    if isinstance(codec, Int4PackedCodec):
+        packed, scodes, tmax, _ = _int4_encode_arrays(
+            flat, codec.block, _np)
+        return {"packed": packed, "scodes": scodes, "tmax": tmax}
+    b = _pad_blocks_np(flat, codec.block)
+    s = _block_scales(b, _np)
+    safe = _safe_scales(s, _np)
+    codes = _quantize_codes(b, safe, _np).astype(_np.int8)
+    return {"codes": codes.reshape(-1), "scales": s.astype(_np.float32)}
 
 
 # ---------------------------------------------------------------------------
@@ -321,6 +486,19 @@ def _default_block():
     return _profiler._env_int("MXNET_GRAD_COMPRESS_BLOCK", 256)
 
 
+_ALGOS = ("psum", "ring")
+
+
+def _default_algo():
+    algo = _os.environ.get("MXNET_GRAD_COMPRESS_ALGO", "psum") or "psum"
+    algo = algo.lower()
+    if algo not in _ALGOS:
+        raise ValueError(
+            f"unknown gradient-compression algorithm {algo!r} "
+            "(MXNET_GRAD_COMPRESS_ALGO=psum|ring)")
+    return algo
+
+
 class CompressionPolicy:
     """Which codec a parameter's gradient travels under, if any.
 
@@ -330,13 +508,28 @@ class CompressionPolicy:
     biases, embeddings — the groups whose few large-magnitude gradients
     a shared block scale would crush); a string replaces it with a
     custom regex; ``False`` disables the opt-out; a callable is used
-    as-is."""
+    as-is.
 
-    def __init__(self, codec, error_feedback=None, skip=None):
+    ``algo`` picks the in-program exchange form: ``'psum'`` (the
+    quantize → integer psum → dequantize sandwich, wire width up to
+    XLA) or ``'ring'`` (explicit per-hop ``ppermute`` exchange of the
+    ENCODED payload, ``comm/ring.py`` — wire bytes narrow by
+    construction); ``None`` reads ``MXNET_GRAD_COMPRESS_ALGO``
+    (default psum).  fsdp/tp-sharded parameter groups always travel
+    the hop form (quantized reduce-scatter + all-gather) — psum cannot
+    express a sharded exchange."""
+
+    def __init__(self, codec, error_feedback=None, skip=None, algo=None):
         self.codec = codec
         self.error_feedback = (codec.error_feedback_default
                                if error_feedback is None
                                else bool(error_feedback))
+        algo = _default_algo() if algo is None else str(algo).lower()
+        if algo not in _ALGOS:
+            raise ValueError(
+                f"unknown gradient-compression algorithm {algo!r} "
+                "(expected one of {})".format("|".join(_ALGOS)))
+        self.algo = algo
         if skip is None:
             from ..optimizer.fused import quantization_sensitive
             self._skip = quantization_sensitive
@@ -363,9 +556,10 @@ class CompressionPolicy:
 
 def resolve_policy(spec=None):
     """THE policy entry both tiers resolve through.  ``spec``: None reads
-    ``MXNET_GRAD_COMPRESS`` (off|bf16|int8, default off); a string names
-    a codec; a :class:`CompressionPolicy` passes through.  Returns the
-    policy or None (compression off)."""
+    ``MXNET_GRAD_COMPRESS`` (off|bf16|int8|int4, default off); a string
+    names a codec; a :class:`CompressionPolicy` passes through.  Returns
+    the policy or None (compression off).  The exchange algorithm rides
+    ``MXNET_GRAD_COMPRESS_ALGO=psum|ring`` (default psum)."""
     if isinstance(spec, CompressionPolicy):
         _ensure_provider()
         return spec
@@ -379,10 +573,13 @@ def resolve_policy(spec=None):
     elif spec.startswith("int8"):
         codec = (codec_from_id(spec) if spec != "int8"
                  else Int8BlockCodec(_default_block()))
+    elif spec.startswith("int4"):
+        codec = (codec_from_id(spec) if spec != "int4"
+                 else Int4PackedCodec(_default_block()))
     else:
         raise ValueError(
             f"unknown gradient-compression tier {spec!r} "
-            "(MXNET_GRAD_COMPRESS=off|bf16|int8)")
+            "(MXNET_GRAD_COMPRESS=off|bf16|int8|int4)")
     ef_env = _os.environ.get("MXNET_GRAD_COMPRESS_EF")
     skip_env = _os.environ.get("MXNET_GRAD_COMPRESS_SKIP") or None
     _ensure_provider()
@@ -447,18 +644,30 @@ def bucket_allreduce(codec, flat, wire_allreduce, residual=None):
 # in-program compressed allreduce (the SPMD dp axis)
 # ---------------------------------------------------------------------------
 
-def traced_allreduce(codec, flat, residual, axis_names):
+def traced_allreduce(codec, flat, residual, axis_names, algo="psum"):
     """Inside-trace quantized allreduce for the SPMD step (call from a
-    ``shard_map`` body): quantize -> integer psum with a per-block scale
-    max-reduction -> dequantize, so the whole exchange fuses into the
-    donated-buffer compiled step.  ``flat`` is this shard's local
-    partial-gradient bucket; returns ``(reduced, new_residual)`` where
-    the residual is the shard-local quantization error (pass
-    ``residual=None`` to disable compensation; a zero residual is still
-    returned so the caller's output structure stays fixed)."""
+    ``shard_map`` body).  ``algo='psum'`` (default): quantize -> integer
+    psum with a per-block scale max-reduction -> dequantize, so the whole
+    exchange fuses into the donated-buffer compiled step — the physical
+    psum width is up to XLA.  ``algo='ring'``: the explicit per-hop
+    ``ppermute`` ring (``comm/ring.py``) whose inter-chip payload is the
+    codec's ENCODED form, wire bytes narrow by construction.  ``flat``
+    is this shard's local partial-gradient bucket; returns ``(reduced,
+    new_residual)`` where the residual is the shard-local quantization
+    error (pass ``residual=None`` to disable compensation; a zero
+    residual is still returned so the caller's output structure stays
+    fixed)."""
     import jax.numpy as jnp
     from jax import lax
 
+    if algo == "ring":
+        from . import ring as _ring
+
+        return _ring.ring_allreduce(codec, flat, residual, axis_names)
+    if algo != "psum":
+        raise ValueError(
+            f"unknown traced_allreduce algorithm {algo!r} "
+            "(expected 'psum' or 'ring')")
     comp = flat if residual is None else flat + residual
     n = comp.shape[0]
     if isinstance(codec, Bf16Codec):
@@ -466,6 +675,20 @@ def traced_allreduce(codec, flat, residual, axis_names):
         reduced = lax.psum(enc, axis_names).astype(jnp.float32)
         resid = comp - enc.astype(jnp.float32)
         return reduced, resid
+    if isinstance(codec, Int4PackedCodec):
+        # the psum form has no packed-lane sum: codes travel as the
+        # integers XLA chooses, only the GRID is 4-bit.  Narrow-wire
+        # int4 is the ring's job; this form exists so ring-vs-psum A/B
+        # runs the same grid at both ends.
+        b = _pad_blocks(comp, codec.block)
+        scodes, tmax = _int4_scale_codes(
+            lax.pmax(_block_scales4(b, jnp), axis_names), jnp)
+        safe = _int4_safe_scales(scodes, tmax, jnp)
+        codes = jnp.clip(jnp.round(b / safe[:, None]), -7.0, 7.0)
+        summed = lax.psum(codes.astype(jnp.int32), axis_names)
+        reduced = _dequantize(summed, safe, n, codec.block, jnp)
+        deq = _dequantize(codes, safe, n, codec.block, jnp)
+        return reduced, comp - deq
     if not isinstance(codec, Int8BlockCodec):
         raise TypeError(
             f"traced_allreduce has no in-program exchange for "

@@ -517,11 +517,23 @@ class StepProgram:
                     _profiler.record_compile(
                         site, self._compile_sig(entry, raws),
                         (_perf() - tc) * 1e3)
+            ca = entry.get("comm_args")
+            kk = int(kw) if kw is not None else 1
+            if ca is not None:
+                from ..comm import compression as _comp
+
+                _comp.account(ca["bytes_raw"] * kk, ca["bytes_wire"] * kk)
+                if ca["hops"]:
+                    _profiler.incr("comms_ring_hops", ca["hops"] * kk)
             if t0 is not None:
                 span_args = {"params": len(touched),
                              "dist": self._dist is not None}
                 if kw is not None:
                     span_args["k"] = int(kw)
+                if ca is not None:
+                    span_args.update(ca,
+                                     bytes_raw=ca["bytes_raw"] * kk,
+                                     bytes_wire=ca["bytes_wire"] * kk)
                 _profiler.record_span("trainer.step_fold", "trainer", t0,
                                       args=span_args)
             _profiler.incr("step_fold_call")
@@ -539,7 +551,15 @@ class StepProgram:
     def _compile_sig(self, entry, raws):
         kw = entry.get("k")
         program = "step_fold" if not kw else f"step_fold_k[{kw}]"
-        sig = {"__program__": program + (":dist" if entry["dist"] else ""),
+        if entry["dist"]:
+            program += ":dist"
+            ca = entry.get("comm_args")
+            if ca:
+                # a wire-policy change (codec tier or exchange algorithm)
+                # is a DISTINCT program, not a recompile of the old one —
+                # the same reason bucket keys are codec-namespaced
+                program += f":{ca.get('codec')}:{ca.get('algo')}"
+        sig = {"__program__": program,
                "params": _profiler.sig_static(len(entry["params"])),
                "groups": _profiler.sig_static(
                    [g[0] for g in entry["plan_names"]])}
@@ -795,7 +815,10 @@ class StepProgram:
                 off += int(a.size)
             buckets.append((bk["codec"], tuple(rows)))
         n_train = len(touched)
-        smap = get_shard_map()
+        # ring outputs are replicated by explicit ppermute relay, which
+        # the static replication checker cannot infer through
+        algo = policy.algo if policy is not None else "psum"
+        smap = get_shard_map(check_rep=(algo != "ring"))
         P0 = P()
         PW = P("w")
         # per-LOGICAL-step batch spec: inside a K-window the scan body
@@ -823,7 +846,7 @@ class StepProgram:
                 else:
                     red, resid = comp_mod.traced_allreduce(
                         codec, flat, residuals[ri][0] if ef else None,
-                        ("w",))
+                        ("w",), algo=algo)
                     if ef:
                         new_resid.append(resid[None, :])
                         ri += 1
@@ -940,10 +963,40 @@ class StepProgram:
         with mesh:
             jax.eval_shape(pure_step, *abstract)
         self._warn_foreign_aux(aux_cell)
+        # per-dispatch comms accounting for the in-fold exchange (the
+        # trace_report comms table + counters): logical payload sizes per
+        # LOGICAL step, plus hop-level detail when the ring algorithm
+        # carries the buckets over explicit ppermute
+        from ..comm import ring as ring_mod
+
+        b_raw = b_wire = hops = hop_wire = hop_fp32 = 0
+        codec_ids = []
+        for codec, rows in buckets:
+            n = sum(r[2] for r in rows)
+            b_raw += 4 * n
+            if codec is None:
+                b_wire += 4 * n
+            else:
+                b_wire += int(codec.wire_nbytes(n))
+                codec_ids.append(codec.id)
+                if algo == "ring":
+                    h, bb = ring_mod.hop_plan(codec, n, nw)
+                    hops += h
+                    hop_wire += h * bb
+                    # what a fp32 ring would move per hop: one raw chunk
+                    hop_fp32 += h * 4 * ring_mod._ring_chunk(codec, n, nw)
+        comm_args = None
+        if codec_ids:
+            comm_args = {"bytes_raw": int(b_raw), "bytes_wire": int(b_wire),
+                         "codec": ",".join(sorted(set(codec_ids))),
+                         "algo": algo, "hops": int(hops),
+                         "bytes_hop": int(hop_wire // hops) if hops else 0,
+                         "bytes_hop_fp32":
+                             int(hop_fp32 // hops) if hops else 0}
         return {"fn": fn, "params": params, "state_flats": state_flats,
                 "plan_names": plan_names, "dist": True, "k": kw,
                 "declared_warmup": kw is not None and kw != self._k,
-                "abstract": abstract}
+                "comm_args": comm_args, "abstract": abstract}
 
 
 class _DistRegisters:

@@ -243,8 +243,8 @@ class _DedupEntry:
 
 # messages exempt from the dedup window: pure reads (safe to re-execute)
 # and heartbeats (idempotent by definition, highest frequency)
-_NO_DEDUP = frozenset(("pull", "counts", "members", "heartbeat", "clock",
-                       "metrics"))
+_NO_DEDUP = frozenset(("pull", "pull_enc", "counts", "members", "heartbeat",
+                       "clock", "metrics"))
 
 
 class ParameterServer:
@@ -493,6 +493,38 @@ class ParameterServer:
                 if key not in self._store:
                     return ("err", "key", f"unknown key {key!r}")
                 return ("val", np.array(self._store[key], copy=True))
+        if kind == "pull_enc":
+            # encoded PULL leg, the push_enc mirror: the client names the
+            # bucket codec + envelope version, the server ships the
+            # aggregated fp32 value in the codec's wire form (no device
+            # round-trip, no residual — the server keeps the fp32 master,
+            # so pull quantization error never accumulates).  Version or
+            # codec-id the server cannot speak fails LOUDLY (protocol
+            # error) instead of silently answering fp32: a silent
+            # fallback would hide a 4x wire regression behind a version
+            # skew.
+            from ..comm.compression import PULL_ENC_WIRE_VERSION, encode_np
+
+            _, key, codec_id, ver = msg
+            if int(ver) != PULL_ENC_WIRE_VERSION:
+                raise ValueError(
+                    f"pull_enc envelope v{int(ver)} from client, server "
+                    f"speaks v{PULL_ENC_WIRE_VERSION}: mixed old/new "
+                    "deployment — upgrade the older side")
+            with self._lock:
+                if key not in self._store:
+                    return ("err", "key", f"unknown key {key!r}")
+                val = np.asarray(self._store[key], np.float32)
+            try:
+                payload = encode_np(codec_id, val.reshape(-1))
+            except ValueError as e:
+                raise ValueError(
+                    f"pull_enc codec-id mismatch: client asked for "
+                    f"{codec_id!r}, which this server cannot encode "
+                    f"({e}) — mixed old-server/new-client deployment")
+            return ("val", {"v": PULL_ENC_WIRE_VERSION, "codec": codec_id,
+                            "payload": payload, "n": int(val.size),
+                            "shape": list(val.shape)})
         if kind == "set_optimizer":
             _, blob = msg
             from ..optimizer import get_updater

@@ -875,7 +875,8 @@ class KVStoreDistAsync(KVStore):
         codec_s = _perf() - t0
         wire = sum(int(a.nbytes) for a in np_payload.values())
         self._last_wire_dtype = str(
-            np_payload.get("codes", np_payload.get("enc")).dtype)
+            np_payload.get("codes", np_payload.get(
+                "enc", np_payload.get("packed"))).dtype)
         _comp.account(int(flat.nbytes), wire, codec_s)
         self._client.request("push_enc", key, codec.id, np_payload,
                              int(flat.size), list(agg.shape), self._rank)
@@ -886,12 +887,53 @@ class KVStoreDistAsync(KVStore):
                 self.pull(k, o, priority)
             return
         t0 = _perf() if _profiler._active else None
-        value = self._client.request("pull", key)
+        if self._compression is not None and \
+                self._compression.get("type", "2bit") != "2bit":
+            value = self._pull_encoded(key)
+        else:
+            value = self._client.request("pull", key)
         outs = out if isinstance(out, (list, tuple)) else [out]
         for o in outs:
             array(value, ctx=o.context).copyto(o)
         if t0 is not None:
             _profiler.record_span("kvstore.pull", "comms", t0)
+
+    def _pull_encoded(self, key):
+        """Codec-tier pull (the ENCODED pull leg, push_enc's mirror): the
+        versioned request names the bucket codec, the server encodes the
+        aggregated fp32 value server-side, this client decodes.  No error
+        feedback — pull is a read against the server's fp32 master, so
+        the quantization error is per-read, never accumulated.  Envelope
+        checks fail loudly (PSProtocolError): a silent fp32 fallback or a
+        misdecoded payload would be invisible until convergence drifted."""
+        from ..comm import compression as _comp
+        from .async_ps import PSProtocolError
+
+        codec = _comp.codec_from_params(self._compression)
+        env = self._client.request("pull_enc", key, codec.id,
+                                   _comp.PULL_ENC_WIRE_VERSION)
+        if not isinstance(env, dict) or \
+                env.get("v") != _comp.PULL_ENC_WIRE_VERSION:
+            raise PSProtocolError(
+                f"pull_enc reply for {key!r} is not a "
+                f"v{_comp.PULL_ENC_WIRE_VERSION} envelope (got "
+                f"{type(env).__name__}): mixed old-server/new-client "
+                "deployment — upgrade the server")
+        if env.get("codec") != codec.id:
+            raise PSProtocolError(
+                f"pull_enc codec-id mismatch for {key!r}: asked "
+                f"{codec.id!r}, server answered {env.get('codec')!r}")
+        t0 = _perf()
+        flat = _comp.decode_np(codec.id, env["payload"], int(env["n"]))
+        codec_s = _perf() - t0
+        wire = sum(int(_np.asarray(a).nbytes)
+                   for a in env["payload"].values())
+        self._last_wire_dtype = str(
+            env["payload"].get(
+                "codes", env["payload"].get(
+                    "enc", env["payload"].get("packed"))).dtype)
+        _comp.account(4 * int(env["n"]), wire, codec_s)
+        return flat.reshape(env["shape"])
 
     def pushpull(self, key, value, out=None, priority=0):
         self.push(key, value, priority)

@@ -44,17 +44,32 @@ __all__ = [
 ]
 
 
-def get_shard_map():
+def get_shard_map(check_rep=True):
     """THE ``shard_map`` entry for the whole repo.  The stable location has
     moved across jax releases (``jax.shard_map`` → only some versions;
     ``jax.experimental.shard_map.shard_map`` → everywhere this repo
     supports), and resolving it per call site already produced one broken
-    tier (TestRingAttention at HEAD) — so every user goes through here."""
+    tier (TestRingAttention at HEAD) — so every user goes through here.
+
+    ``check_rep=False`` disables shard_map's static replication check —
+    required by bodies whose replicated outputs are built from explicit
+    ``ppermute`` exchange (the quantized ring collectives in
+    ``comm/ring.py``: every device decodes the SAME relayed codes, so the
+    result is replicated by construction, but the checker cannot infer
+    replication through ppermute).  The keyword's name moved across jax
+    releases (``check_rep`` → ``check_vma``); the wrapper tries both."""
     sm = getattr(jax, "shard_map", None)
-    if sm is not None:
+    if sm is None:
+        from jax.experimental.shard_map import shard_map as sm
+    if check_rep:
         return sm
-    from jax.experimental.shard_map import shard_map as sm
-    return sm
+
+    def unchecked(*args, **kwargs):
+        try:
+            return sm(*args, check_rep=False, **kwargs)
+        except TypeError:
+            return sm(*args, check_vma=False, **kwargs)
+    return unchecked
 
 # Outermost → innermost.  jax.devices() enumerates in topology order on TPU
 # and the last axes step fastest through it, so the bandwidth-hungriest

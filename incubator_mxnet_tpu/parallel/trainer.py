@@ -397,6 +397,8 @@ class SPMDTrainer:
 
         from ..comm import compression as comp_mod
 
+        from ..comm import ring as ring_mod
+
         self._comm_cfg = None
         self._comm_state = None
         self._comm_sharding = None
@@ -414,46 +416,129 @@ class SPMDTrainer:
         for ax in ("pp", "ep", "sp", "tp"):
             if int(mesh.shape.get(ax, 1)) > 1:
                 reasons.append(f"mesh axis {ax!r} > 1")
-        if any(any(n is not None for n in s.spec)
-               for s in self._param_shardings):
-            reasons.append("sharded parameters (fsdp/tp rules)")
+        # sharded parameters compress through the hop machinery (quantized
+        # reduce-scatter of grads + quantized all-gather of updated shards,
+        # comm/ring.py) — supported for the fsdp layout this repo's rules
+        # produce: axis 0 sharded over 'fsdp' alone.  Anything fancier
+        # (non-0 dims, multi-axis specs) still falls back with a reason.
+        shard_mode = False
+        for s in self._param_shardings:
+            for i, names in enumerate(s.spec):
+                if names is None:
+                    continue
+                nt = (names,) if isinstance(names, str) else tuple(names)
+                if i != 0 or nt != ("fsdp",):
+                    reasons.append(
+                        "unsupported sharded-parameter layout (compression "
+                        "handles axis-0 sharding over 'fsdp')")
+                    break
+                shard_mode = True
+            else:
+                continue
+            break
         if reasons:
             _warnings.warn(
                 "gradient compression requested but unsupported for this "
                 f"build ({', '.join(reasons)}); running uncompressed. The "
                 "quantized dp-allreduce needs a pure data-parallel step "
-                "(replicated parameters, no pipeline/sp).", UserWarning)
+                "(replicated or fsdp-sharded parameters, no pipeline/sp).",
+                UserWarning)
             return
         if shards <= 1:
             return  # no shard boundary: nothing crosses a wire
-        comp_slots, exact_slots, spans = [], [], []
-        off = 0
-        for slot, j in enumerate(self._trainable_idx):
-            a = self._param_arrays[j]
-            codec = (policy.codec_for(self._params[j].name)
-                     if str(a.dtype) == "float32" else None)
-            if codec is None:
-                exact_slots.append(slot)
-            else:
-                spans.append((off, int(a.size), tuple(a.shape)))
-                off += int(a.size)
-                comp_slots.append(slot)
-        if not comp_slots:
-            return  # every group opted out: the plain build IS the exact one
         codec = policy.codec
-        n_exact = sum(int(self._param_arrays[self._trainable_idx[s]].size)
-                      for s in exact_slots)
-        bytes_raw = 4 * (off + n_exact)
-        bytes_wire = int(codec.wire_nbytes(off)) + 4 * n_exact
-        self._comm_cfg = {
-            "policy": policy, "codec": codec, "ef": policy.error_feedback,
-            "comp_slots": comp_slots, "exact_slots": exact_slots,
-            "spans": spans, "n": off, "shards": shards,
-            "bytes_raw": int(bytes_raw), "bytes_wire": int(bytes_wire),
-        }
+        algo = policy.algo
+        dp_size = int(mesh.shape["dp"])
+        fsdp_size = int(mesh.shape["fsdp"])
+        if shard_mode:
+            # the fsdp form: compressed slots are the fp32, non-opted-out
+            # trainables whose axis 0 is ACTUALLY sharded; everything else
+            # (opt-outs, non-fp32, replicated-because-indivisible) travels
+            # exact.  The bucket is laid out in RING-CHUNK order — segment
+            # i is the concatenation of every compressed slot's shard i —
+            # so the reduce-scatter hands each device exactly its shards.
+            comp_slots, exact_slots, spans = [], [], []
+            seg_off = 0
+            for slot, j in enumerate(self._trainable_idx):
+                a = self._param_arrays[j]
+                spec = self._param_shardings[j].spec
+                sharded = len(spec) > 0 and spec[0] is not None
+                cdc = (policy.codec_for(self._params[j].name)
+                       if str(a.dtype) == "float32" and sharded else None)
+                if cdc is None:
+                    exact_slots.append(slot)
+                else:
+                    shard_sz = int(a.size) // fsdp_size
+                    spans.append((seg_off, shard_sz, tuple(a.shape)))
+                    seg_off += shard_sz
+                    comp_slots.append(slot)
+            if not comp_slots:
+                return  # nothing sharded compresses: plain build is exact
+            seg = seg_off                  # per-device segment length
+            off = seg * fsdp_size          # full bucket (ring-chunk order)
+            n_exact = sum(
+                int(self._param_arrays[self._trainable_idx[s]].size)
+                for s in exact_slots)
+            # logical payload accounting: the grad reduce-scatter and the
+            # updated-shard all-gather each move one encoded bucket where
+            # fp32 fsdp would have moved the raw one
+            bytes_raw = 4 * (2 * off + n_exact)
+            bytes_wire = 2 * int(codec.wire_nbytes(off)) + 4 * n_exact
+            hops, bytes_hop = ring_mod.rs_ag_hop_plan(codec, off, fsdp_size)
+            if dp_size > 1:
+                h2, b2 = ring_mod.hop_plan(codec, off, dp_size)
+                bytes_hop = ((hops * bytes_hop + h2 * b2) // (hops + h2)
+                             if hops + h2 else 0)
+                hops += h2
+            self._comm_cfg = {
+                "policy": policy, "codec": codec,
+                "ef": policy.error_feedback, "algo": algo, "sharded": True,
+                "shard_ax": "fsdp", "F": fsdp_size, "S": seg,
+                "comp_slots": comp_slots, "exact_slots": exact_slots,
+                "spans": spans, "n": off, "shards": shards,
+                "bytes_raw": int(bytes_raw), "bytes_wire": int(bytes_wire),
+                "hops": int(hops), "bytes_hop": int(bytes_hop),
+            }
+        else:
+            comp_slots, exact_slots, spans = [], [], []
+            off = 0
+            for slot, j in enumerate(self._trainable_idx):
+                a = self._param_arrays[j]
+                cdc = (policy.codec_for(self._params[j].name)
+                       if str(a.dtype) == "float32" else None)
+                if cdc is None:
+                    exact_slots.append(slot)
+                else:
+                    spans.append((off, int(a.size), tuple(a.shape)))
+                    off += int(a.size)
+                    comp_slots.append(slot)
+            if not comp_slots:
+                return  # every group opted out: plain build IS the exact one
+            n_exact = sum(
+                int(self._param_arrays[self._trainable_idx[s]].size)
+                for s in exact_slots)
+            bytes_raw = 4 * (off + n_exact)
+            bytes_wire = int(codec.wire_nbytes(off)) + 4 * n_exact
+            if algo == "ring":
+                hops, bytes_hop = ring_mod.hop_plan_axes(
+                    codec, off, [d for d in (dp_size, fsdp_size) if d > 1])
+            else:
+                hops, bytes_hop = 0, 0  # psum: one fused exchange, no hops
+            self._comm_cfg = {
+                "policy": policy, "codec": codec,
+                "ef": policy.error_feedback, "algo": algo, "sharded": False,
+                "comp_slots": comp_slots, "exact_slots": exact_slots,
+                "spans": spans, "n": off, "shards": shards,
+                "bytes_raw": int(bytes_raw), "bytes_wire": int(bytes_wire),
+                "hops": int(hops), "bytes_hop": int(bytes_hop),
+            }
         self._comm_span_args = {"bytes_raw": int(bytes_raw),
                                 "bytes_wire": int(bytes_wire),
-                                "codec": codec.id}
+                                "codec": codec.id,
+                                "algo": ("ring" if self._comm_cfg["sharded"]
+                                         else algo),
+                                "hops": self._comm_cfg["hops"],
+                                "bytes_hop": self._comm_cfg["bytes_hop"]}
         if policy.error_feedback:
             import weakref as _weakref
 
@@ -550,6 +635,8 @@ class SPMDTrainer:
 
             comp_mod.account(self._comm_cfg["bytes_raw"] * k,
                              self._comm_cfg["bytes_wire"] * k)
+            if self._comm_cfg["hops"]:
+                _profiler.incr("comms_ring_hops", self._comm_cfg["hops"] * k)
         if self._stages is not None:
             sim = self._pipe_sim
             _profiler.incr("pipeline_step", k)
@@ -1034,6 +1121,8 @@ class SPMDTrainer:
         if self._stages is not None:
             return self._build_pure_pipeline(example_arrays)
         if self._comm_cfg is not None:
+            if self._comm_cfg.get("sharded"):
+                return self._build_pure_compressed_sharded(example_arrays)
             return self._build_pure_compressed(example_arrays)
         trainable_idx = self._trainable_idx
         n_inputs = len(example_arrays) - 1
@@ -1123,6 +1212,7 @@ class SPMDTrainer:
 
         cfg = self._comm_cfg
         codec, ef = cfg["codec"], cfg["ef"]
+        algo = cfg["algo"]
         comp_slots, exact_slots = cfg["comp_slots"], cfg["exact_slots"]
         spans = cfg["spans"]
         trainable_idx = self._trainable_idx
@@ -1132,7 +1222,9 @@ class SPMDTrainer:
         mesh = self._mesh
         AX = ("dp", "fsdp")
         fsdp = int(mesh.shape["fsdp"])
-        smap = get_shard_map()
+        # ring outputs are replicated by explicit relay, which the static
+        # replication checker cannot see through ppermute
+        smap = get_shard_map(check_rep=(algo != "ring"))
         P0 = P()
         batch_specs = tuple(batch_pspec(a.ndim) for a in example_arrays)
 
@@ -1149,7 +1241,7 @@ class SPMDTrainer:
                 new_grads[s] = jax.lax.psum(grads[s], AX)
             flat = jnp.concatenate([grads[s].reshape(-1) for s in comp_slots])
             reduced, resid_out = comp_mod.traced_allreduce(
-                codec, flat, residual[0] if ef else None, AX)
+                codec, flat, residual[0] if ef else None, AX, algo=algo)
             for (off, n, shape), s in zip(spans, comp_slots):
                 new_grads[s] = reduced[off:off + n].reshape(shape)
             # host-facing scalars reduce across shards, so every export
@@ -1194,6 +1286,160 @@ class SPMDTrainer:
             else:
                 grads_t, loss_mean, aux_vals, extras = mapped(
                     train_arrs, list(param_arrs), key, *batch)
+            new_full, new_states = self._traced_optimizer_apply(
+                t, lr, rescale, param_arrs, opt_states, list(grads_t))
+            for k, v in zip(aux_idx_cell[0] if aux_idx_cell else [], aux_vals):
+                new_full[k] = v.astype(new_full[k].dtype)
+            if ef:
+                return new_full, new_states, new_comm, loss_mean, extras
+            return new_full, new_states, loss_mean, extras
+
+        return pure_step
+
+    # ------------------------------------------------------------------
+    def _build_pure_compressed_sharded(self, example_arrays):
+        """The fsdp twin of ``_build_pure_compressed`` — the ZeRO++-style
+        form from docs/gradient_compression.md: parameters live sharded on
+        axis 0 over 'fsdp'; inside ONE ``shard_map`` over the batch axes
+        the compressed trainables are materialized for the forward by a
+        QUANTIZED ring all-gather of the updated shards (one bucket in
+        ring-chunk order: segment i = every slot's shard i concatenated),
+        and their gradients leave via quantized ring allreduce over 'dp'
+        followed by quantized ring reduce-scatter over 'fsdp' — so every
+        inter-chip payload on both legs is the codec's encoded form.
+        Exact slots (opt-outs, non-fp32, replicated-because-indivisible)
+        ride fp32 ``all_gather``/``psum``/``psum_scatter``.  Error
+        feedback accumulates r_dp + r_rs/|dp| per device in the full
+        ring-chunk bucket, riding the same donated ``_comm_state`` rows.
+        Gradients return with the parameter shardings, so the optimizer
+        tail outside the shard_map partitions elementwise with zero
+        comms."""
+        from .mesh import get_shard_map
+        from ..comm import ring as ring_mod
+
+        cfg = self._comm_cfg
+        codec, ef = cfg["codec"], cfg["ef"]
+        comp_slots, exact_slots = cfg["comp_slots"], cfg["exact_slots"]
+        spans = cfg["spans"]
+        shard_ax, F, S = cfg["shard_ax"], cfg["F"], cfg["S"]
+        trainable_idx = self._trainable_idx
+        n_slots = len(trainable_idx)
+        n_inputs = len(example_arrays) - 1
+        forward_loss, aux_idx_cell = self._forward_loss_builder(n_inputs)
+        mesh = self._mesh
+        AX = ("dp", "fsdp")
+        dp_size = int(mesh.shape["dp"])
+        fsdp = int(mesh.shape["fsdp"])
+        dp_axes = tuple(a for a in AX if a != shard_ax)
+        param_specs = [s.spec for s in self._param_shardings]
+        train_specs = [param_specs[j] for j in trainable_idx]
+
+        def is_sharded(spec):
+            return len(spec) > 0 and spec[0] is not None
+
+        smap = get_shard_map(check_rep=False)
+        P0 = P()
+        batch_specs = tuple(batch_pspec(a.ndim) for a in example_arrays)
+
+        def gather_fp(x):
+            return jax.lax.all_gather(x, shard_ax, axis=0, tiled=True)
+
+        def core(train_arrs, full_arrs, key, residual, batch):
+            d = jax.lax.axis_index("dp") * fsdp + jax.lax.axis_index("fsdp")
+            key = jax.random.fold_in(key, d)
+            # quantized all-gather of the updated shards: the bucket's
+            # ring-chunk layout means one AG delivers every slot's full
+            # parameter as F contiguous row-slices
+            shard_bucket = jnp.concatenate(
+                [train_arrs[s].reshape(-1) for s in comp_slots])
+            full_bucket = ring_mod.ring_all_gather(
+                codec, shard_bucket, shard_ax)
+            seg2d = full_bucket.reshape(F, S)
+            gathered = list(train_arrs)
+            for (off, ssz, shape), s in zip(spans, comp_slots):
+                gathered[s] = seg2d[:, off:off + ssz].reshape(shape)
+            for s in exact_slots:
+                if is_sharded(train_specs[s]):
+                    gathered[s] = gather_fp(train_arrs[s])
+            full = list(full_arrs)
+            tset = set(trainable_idx)
+            for j in range(len(full)):
+                if j not in tset and is_sharded(param_specs[j]):
+                    full[j] = gather_fp(full[j])
+            (_, (aux_vals, loss_mean, extras)), grads = jax.value_and_grad(
+                forward_loss, has_aux=True
+            )(gathered, full, key, batch)
+            new_grads = [None] * n_slots
+            for s in exact_slots:
+                if is_sharded(train_specs[s]):
+                    g = grads[s]
+                    if dp_axes:
+                        g = jax.lax.psum(g, dp_axes)
+                    new_grads[s] = jax.lax.psum_scatter(
+                        g, shard_ax, scatter_dimension=0, tiled=True)
+                else:
+                    new_grads[s] = jax.lax.psum(grads[s], AX)
+            # gradient bucket in the same ring-chunk order: row i of each
+            # slot's (F, shard) view lands in segment i
+            flat = jnp.concatenate(
+                [grads[s].reshape(F, -1) for s in comp_slots],
+                axis=1).reshape(-1)
+            comp = flat + residual[0] if ef else flat
+            if dp_size > 1:
+                x, r_dp = ring_mod.ring_allreduce(codec, comp, None, dp_axes)
+            else:
+                x, r_dp = comp, None
+            shard_red, r_rs = ring_mod.ring_reduce_scatter(
+                codec, x, None, shard_ax)
+            resid = r_rs if r_dp is None else r_dp + r_rs / dp_size
+            for (off, ssz, shape), s in zip(spans, comp_slots):
+                new_grads[s] = shard_red[off:off + ssz].reshape(
+                    (shape[0] // F,) + tuple(shape[1:]))
+            loss_mean = jax.lax.pmean(loss_mean, AX)
+            aux_vals = tuple(jax.lax.pmean(a, AX) for a in aux_vals)
+            if extras:
+                extras = {
+                    "moe_tokens_dropped":
+                        jax.lax.psum(extras["moe_tokens_dropped"], AX),
+                    "moe_expert_load_min":
+                        jax.lax.pmin(extras["moe_expert_load_min"], AX),
+                    "moe_expert_load_max":
+                        jax.lax.pmax(extras["moe_expert_load_max"], AX),
+                }
+            new_resid = resid[None, :] if ef else None
+            return tuple(new_grads), new_resid, loss_mean, aux_vals, extras
+
+        grad_specs = tuple(
+            train_specs[s] if is_sharded(train_specs[s]) else P0
+            for s in range(n_slots))
+        tr_in = tuple(train_specs)
+        full_in = tuple(param_specs)
+        if ef:
+            def shard_body(train_arrs, full_arrs, key, residual, *batch):
+                return core(train_arrs, full_arrs, key, residual, batch)
+            in_specs = (tr_in, full_in, P0, P(AX)) + batch_specs
+            out_specs = (grad_specs, P(AX), P0, P0, P0)
+        else:
+            def shard_body(train_arrs, full_arrs, key, *batch):
+                g, _, l, a, e = core(train_arrs, full_arrs, key, None, batch)
+                return g, l, a, e
+            in_specs = (tr_in, full_in, P0) + batch_specs
+            out_specs = (grad_specs, P0, P0, P0)
+
+        def pure_step(key, t, lr, rescale, param_arrs, opt_states, *rest):
+            if ef:
+                comm_state, batch = rest[0], rest[1:]
+            else:
+                comm_state, batch = None, rest
+            train_arrs = tuple(param_arrs[j] for j in trainable_idx)
+            mapped = smap(shard_body, mesh=mesh,
+                          in_specs=in_specs, out_specs=out_specs)
+            if ef:
+                grads_t, new_comm, loss_mean, aux_vals, extras = mapped(
+                    train_arrs, tuple(param_arrs), key, comm_state, *batch)
+            else:
+                grads_t, loss_mean, aux_vals, extras = mapped(
+                    train_arrs, tuple(param_arrs), key, *batch)
             new_full, new_states = self._traced_optimizer_apply(
                 t, lr, rescale, param_arrs, opt_states, list(grads_t))
             for k, v in zip(aux_idx_cell[0] if aux_idx_cell else [], aux_vals):

@@ -204,6 +204,9 @@ _counters = {
     "comms_bytes_raw": 0,             # gradient bytes before compression
     "comms_bytes_wire": 0,            # encoded gradient bytes on the wire
     "comms_compress_ms": 0,           # host-side codec encode/decode wall ms
+    "comms_ring_hops": 0,             # encoded ppermute hops issued by the
+                                      # quantized ring collectives (per step:
+                                      # 2(D-1) per active ring stage)
     "profiler_trace_error": 0,        # jax.profiler start/stop failures
     "slow_step_detected": 0,          # slow-step detector firings
     "io_prefetch_batches": 0,         # batches produced by prefetch workers

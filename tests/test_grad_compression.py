@@ -11,6 +11,14 @@ steady-state recompiles under ``MXNET_COMPILE_GUARD=raise``,
 ``step``/``step_bulk`` equivalence, residual persistence through
 ``save_states``/``load_states``), the comms byte counters + ``comm``
 metrics provider, and a CI smoke of ``benchmark/opperf/collectives.py``.
+
+ISSUE 19 adds the quantized ring collectives: the int4 packed codec
+(wire bytes + host-path rejection), the explicit-hop ring allreduce
+(numerics, the aggregate error-feedback invariant, D=1 bit-exactness
+with the psum sandwich, zero steady-state recompiles), the fsdp-sharded
+quantized reduce-scatter/all-gather build (convergence parity), and the
+async-PS encoded pull leg (versioned envelope, loud codec-id/version
+mismatch).
 """
 import os
 import socket
@@ -193,8 +201,20 @@ def test_policy_env_resolution(monkeypatch):
     monkeypatch.setenv("MXNET_GRAD_COMPRESS_EF", "0")
     assert comm.resolve_policy().error_feedback is False
     monkeypatch.setenv("MXNET_GRAD_COMPRESS", "int4")
-    with pytest.raises(ValueError):
+    monkeypatch.delenv("MXNET_GRAD_COMPRESS_EF", raising=False)
+    pol = comm.resolve_policy()
+    assert pol.id == "int4b128" and pol.error_feedback is True
+    assert isinstance(pol.codec, comm.Int4PackedCodec)
+    # the exchange algorithm rides its own knob (default psum)
+    assert pol.algo == "psum"
+    monkeypatch.setenv("MXNET_GRAD_COMPRESS_ALGO", "ring")
+    assert comm.resolve_policy().algo == "ring"
+    monkeypatch.setenv("MXNET_GRAD_COMPRESS_ALGO", "butterfly")
+    with pytest.raises(ValueError, match="butterfly"):
         comm.resolve_policy()
+    monkeypatch.delenv("MXNET_GRAD_COMPRESS_ALGO", raising=False)
+    with pytest.raises(ValueError, match="tree"):
+        comm.CompressionPolicy(comm.Int8BlockCodec(), algo="tree")
 
 
 def test_quantization_sensitive_groups(monkeypatch):
@@ -395,9 +415,11 @@ def test_async_push_enc_int8_with_error_feedback(async_store):
         out = nd.zeros((6,))
         kv.pull("w", out=out)
         # server accumulates DECODED fp32; with error feedback the
-        # running sum stays within one quantization step of k*g
+        # running sum stays within one quantization step of k*g — plus
+        # one more step for the encoded pull leg (the server's fp32
+        # master re-quantizes per read, never accumulated)
         scale = 3.0 / 127.0  # the largest block's grid
-        assert np.abs(out.asnumpy() - k * g).max() <= scale + 1e-6
+        assert np.abs(out.asnumpy() - k * g).max() <= 2 * scale + 1e-6
     assert kv._last_wire_dtype == "int8"
     assert _c()["comms_bytes_raw"] > _c()["comms_bytes_wire"] > 0
 
@@ -528,15 +550,29 @@ def test_spmd_all_optout_falls_back_to_plain_build():
 
 
 def test_spmd_unsupported_builds_warn_and_fall_back():
-    from incubator_mxnet_tpu.parallel import fsdp_rules
-
+    # tp > 1 is still outside the compressed build's supported shape
     with pytest.warns(UserWarning, match="running uncompressed"):
         tr = SPMDTrainer(_build_net(3), _LOSS, "sgd", {"learning_rate": 0.1},
-                         mesh=make_mesh(fsdp=2), rules=fsdp_rules(),
-                         compression="int8")
+                         mesh=make_mesh(dp=4, tp=2), compression="int8")
     assert tr._comm_cfg is None
     x, y = _batch()
     tr.step(nd.array(x), nd.array(y))  # the fallback build still trains
+
+
+def test_spmd_fsdp_sharded_builds_compressed():
+    """fsdp-sharded parameters now COMPRESS (quantized reduce-scatter of
+    grads + quantized all-gather of updated shards) instead of falling
+    back — the PR 14 refusal is lifted for axis-0 'fsdp' shards."""
+    from incubator_mxnet_tpu.parallel import fsdp_rules
+
+    tr = SPMDTrainer(_build_net(3), _LOSS, "sgd", {"learning_rate": 0.1},
+                     mesh=make_mesh(fsdp=2), rules=fsdp_rules(),
+                     compression="int8")
+    cfg = tr._comm_cfg
+    assert cfg is not None and cfg["sharded"] and cfg["shard_ax"] == "fsdp"
+    assert cfg["F"] == 2 and cfg["n"] == cfg["S"] * cfg["F"]
+    assert cfg["comp_slots"] and cfg["hops"] > 0
+    assert cfg["bytes_wire"] < cfg["bytes_raw"]
 
 
 def test_spmd_zero_steady_state_recompiles(monkeypatch):
@@ -640,6 +676,197 @@ def test_spmd_span_carries_payload_args(tmp_path):
     args = spans[-1]["args"]
     assert args["bytes_raw"] > args["bytes_wire"] > 0
     assert args["codec"].startswith("int8b")
+
+
+# ---------------------------------------------------------------------------
+# quantized ring collectives + the int4 tier (ISSUE 19)
+# ---------------------------------------------------------------------------
+
+
+def test_int4_roundtrip_wire_and_host_rejection():
+    import jax.numpy as jnp
+
+    x = np.random.RandomState(7).randn(500).astype(np.float32) * 2.0
+    codec = comm.Int4PackedCodec(block=64)
+    assert codec.id == "int4b64"
+    assert comm.codec_from_id("int4b64").block == 64
+    payload, resid = codec.encode(jnp.asarray(x))
+    assert np.asarray(payload["packed"]).dtype == np.uint8
+    dec = np.asarray(codec.decode(payload, 500))
+    # 4-bit grid: error bounded by half a step of the DECODED block scale
+    scodes = np.asarray(payload["scodes"]).reshape(-1)
+    scales = scodes.astype(np.float32) / 255.0 * float(payload["tmax"])
+    bound = np.repeat(np.where(scales > 0, scales, 1.0), 64)[:500]
+    assert (np.abs(dec - x) <= bound / 2 + 1e-6).all()
+    np.testing.assert_allclose(np.asarray(resid), x - dec,
+                               rtol=1e-4, atol=1e-5)
+    # numpy decode (the async-PS server) matches the jitted decode
+    np_payload = {k: np.asarray(v) for k, v in payload.items()}
+    np.testing.assert_allclose(comm.decode_np("int4b64", np_payload, 500),
+                               dec, atol=1e-6)
+    # wire accounting: packed nibbles + uint8 scale codes + one fp32 tmax
+    nb = -(-500 // 64)
+    assert codec.wire_nbytes(500) == nb * 32 + nb + 4
+    assert 4 * 500 / codec.wire_nbytes(500) > 6.0
+    # the host bucket wire has no linear sum for packed nibbles: rejected
+    with pytest.raises(TypeError, match="no wire protocol"):
+        comm.bucket_allreduce(codec, jnp.asarray(x), lambda a, op: a)
+
+
+@pytest.mark.parametrize("tier", ["int8b64", "int4b64"])
+def test_ring_allreduce_numerics_and_ef_invariant(tier):
+    """The explicit-hop ring allreduce sums the per-device buckets, and
+    the per-device residuals sum EXACTLY to the dropped error
+    (exact − delivered) — the aggregate EF invariant."""
+    from incubator_mxnet_tpu.comm import ring
+
+    codec = comm.codec_from_id(tier)
+    n = 640
+    x = np.random.RandomState(11).randn(n).astype(np.float32)
+    out, resid = ring.ring_allreduce_sharded(
+        codec, np.asarray(x), make_mesh(), axis_names=("dp",), algo="ring")
+    out, resid = np.asarray(out), np.asarray(resid)
+    exact = 8.0 * x  # replicated input: every device contributes x
+    step = 127.0 if tier.startswith("int8") else 7.0
+    assert np.abs(out - exact).max() <= 16 * np.abs(exact).max() / step
+    np.testing.assert_allclose(resid.reshape(8, n).sum(axis=0),
+                               exact - out, rtol=2e-4, atol=2e-4)
+    # static plan matches what the trace layers report: 2(D-1) hops of
+    # one encoded chunk each
+    hops, bytes_hop = ring.hop_plan(codec, n, 8)
+    assert hops == 14
+    assert bytes_hop == codec.wire_nbytes(ring._ring_chunk(codec, n, 8))
+
+
+def test_ring_psum_bitexact_at_world_one():
+    """D=1 degenerate form: the ring is a local encode/decode roundtrip,
+    bit-exact with the psum sandwich (same grid helpers at both ends)."""
+    import jax
+
+    from incubator_mxnet_tpu.comm import ring
+
+    mesh1 = make_mesh(devices=jax.devices()[:1])
+    x = np.random.RandomState(13).randn(300).astype(np.float32)
+    for codec in (comm.Int8BlockCodec(64), comm.Int4PackedCodec(64)):
+        a, ra = ring.ring_allreduce_sharded(codec, np.asarray(x), mesh1,
+                                            axis_names=("dp",), algo="ring")
+        b, rb = ring.ring_allreduce_sharded(codec, np.asarray(x), mesh1,
+                                            axis_names=("dp",), algo="psum")
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        np.testing.assert_array_equal(np.asarray(ra), np.asarray(rb))
+    assert ring.hop_plan(comm.Int8BlockCodec(64), 300, 1) == (0, 0)
+
+
+def test_ring_rs_ag_roundtrip():
+    """Sharded-group exchange: quantized reduce-scatter then quantized
+    all-gather of the reduced shards recovers the cross-device sum within
+    the codec grid."""
+    from incubator_mxnet_tpu.comm import ring
+
+    codec = comm.Int8BlockCodec(32)
+    n = 512  # divisible by the axis size
+    x = np.random.RandomState(17).randn(n).astype(np.float32)
+    gathered, resid = ring.ring_rs_ag_sharded(
+        codec, np.asarray(x), make_mesh(fsdp=8), axis_name="fsdp")
+    gathered = np.asarray(gathered)
+    exact = 8.0 * x
+    assert np.abs(gathered - exact).max() <= np.abs(exact).max() / 10
+    assert np.asarray(resid).shape == (8 * n,)
+    hops, bytes_hop = ring.rs_ag_hop_plan(codec, n, 8)
+    assert hops == 14 and bytes_hop == codec.wire_nbytes(n // 8)
+
+
+def test_spmd_ring_matches_fp32_losses_and_counts_hops():
+    pol = comm.CompressionPolicy(comm.Int8BlockCodec(), algo="ring")
+    ref, cmp_tr = _spmd_pair(pol)
+    cfg = cmp_tr._comm_cfg
+    assert cfg["algo"] == "ring" and cfg["hops"] == 14 > 0
+    assert cfg["bytes_hop"] > 0
+    x, y = _batch()
+    for _ in range(5):
+        l0 = float(ref.step(nd.array(x), nd.array(y)).asnumpy())
+        l1 = float(cmp_tr.step(nd.array(x), nd.array(y)).asnumpy())
+        assert abs(l0 - l1) < 5e-3 * max(1.0, abs(l0))
+    # 2(D-1) encoded ppermute hops per step ride the counter
+    assert _c()["comms_ring_hops"] == 5 * cfg["hops"]
+    assert _c()["comms_bytes_raw"] > _c()["comms_bytes_wire"] > 0
+
+
+def test_spmd_ring_zero_steady_state_recompiles(monkeypatch):
+    monkeypatch.setenv("MXNET_COMPILE_GUARD", "raise")
+    profiler.reset_compiles()
+    profiler.disarm_compile_guard()
+    try:
+        pol = comm.CompressionPolicy(comm.Int8BlockCodec(), algo="ring")
+        _, cmp_tr = _spmd_pair(pol)
+        x, y = _batch(2)
+        cmp_tr.step(nd.array(x), nd.array(y))   # compile + arm
+        base = _c()["recompile_steady_state"]
+        for _ in range(3):
+            cmp_tr.step(nd.array(x), nd.array(y))
+        assert _c()["recompile_steady_state"] == base
+    finally:
+        profiler.disarm_compile_guard()
+        profiler.reset_compiles()
+
+
+def test_spmd_fsdp_int8_convergence_parity():
+    """The sharded compressed build (quantized RS of grads + quantized AG
+    of updated shards, int8 + error feedback) converges to the fp32
+    fsdp run's loss within the PR 14 tolerance."""
+    from incubator_mxnet_tpu.parallel import fsdp_rules
+
+    def mk(compression):
+        return SPMDTrainer(_build_net(3), _LOSS, "sgd",
+                           {"learning_rate": 0.2}, mesh=make_mesh(fsdp=2),
+                           rules=fsdp_rules(), compression=compression)
+
+    ref, cmp_tr = mk(None), mk("int8")
+    assert cmp_tr._comm_cfg["sharded"]
+    x, y = _batch(1)
+    l0 = None
+    for _ in range(40):
+        lf = float(ref.step(nd.array(x), nd.array(y)).asnumpy())
+        lc = float(cmp_tr.step(nd.array(x), nd.array(y)).asnumpy())
+        l0 = lf if l0 is None else l0
+    assert lc < 0.5 * l0       # actually trained
+    assert abs(lc - lf) < 0.05 * max(lf, 0.1) + 0.02
+    assert _c()["comms_ring_hops"] > 0
+    assert _c()["comms_bytes_raw"] > _c()["comms_bytes_wire"] > 0
+
+
+def test_async_pull_enc_int4(async_store):
+    kv = async_store
+    kv.set_gradient_compression({"type": "int4", "block": 4})
+    kv.init("w", nd.zeros((6,)))
+    g = np.array([0.7, -0.9, 0.2, 0.0, 3.0, -0.1], np.float32)
+    kv.push("w", nd.array(g))
+    out = nd.zeros((6,))
+    kv.pull("w", out=out)
+    # one 4-bit push quantization + one 4-bit pull quantization
+    assert np.abs(out.asnumpy() - g).max() <= 2 * 3.0 / 7 + 1e-5
+    assert kv._last_wire_dtype == "uint8"  # packed nibbles on the wire
+
+
+def test_async_pull_enc_mismatches_fail_loudly(async_store):
+    from incubator_mxnet_tpu.kvstore.async_ps import PSProtocolError
+
+    kv = async_store
+    kv.set_gradient_compression({"type": "int8", "block": 4})
+    kv.init("w", nd.zeros((4,)))
+    kv.push("w", nd.array(np.ones(4, np.float32)))
+    # codec id the server cannot encode: named protocol error, not a
+    # silent mis-decode (mixed old-server/new-client deployment)
+    with pytest.raises(PSProtocolError, match="codec-id mismatch"):
+        kv._client.request("pull_enc", "w", "nosuchcodec99", 1)
+    # envelope version drift: the versioned pull leg rejects loudly too
+    with pytest.raises(PSProtocolError, match="v99"):
+        kv._client.request("pull_enc", "w", "int8b4",
+                           comp_mod.PULL_ENC_WIRE_VERSION + 98)
+    # the store itself still works after the rejected probes
+    out = nd.zeros((4,))
+    kv.pull("w", out=out)
+    assert np.abs(out.asnumpy() - 1.0).max() <= 2 * 1.0 / 127 + 1e-6
 
 
 # ---------------------------------------------------------------------------

@@ -229,7 +229,8 @@ for tier in "${TIERS[@]}"; do
             # mode IS the regression guard (non-zero exit on any
             # post-warmup recompile in the compressed SPMD step, or an
             # int8 bytes-on-wire ratio below the 3.5x acceptance floor on
-            # either gradient path), then the compression tests
+            # either gradient path — per-HOP for the ring half of the
+            # default psum/ring A/B), then the compression tests
             run_tier comm "${CPU_ENV[@]}" bash -c '
                 set -e
                 python benchmark/opperf/collectives.py --smoke >/dev/null

@@ -190,12 +190,27 @@ def report(path, spans, other, top=15, bins=10, xplane=None,
     # args; per-pid aggregation = per-RANK in a merged trace, so
     # straggler attribution can tell "slow network" from "big payload"
     payload = defaultdict(lambda: [0, 0, 0])   # pid -> [spans, raw, wire]
+    # (pid, algo) -> [spans, raw, wire, hops, hop_bytes]: spans from the
+    # quantized exchange also carry the ALGORITHM ("psum" = one fused
+    # exchange, "ring" = explicit encoded ppermute hops) plus the
+    # per-LOGICAL-step hop count and per-hop wire bytes, so the report
+    # can show bytes per hop per algorithm — the ring acceptance is
+    # hop-granular (ISSUE 19)
+    by_algo = defaultdict(lambda: [0, 0, 0, 0, 0])
     for name, _cat, _, _, _, args, pid in spans:
         if args and "bytes_wire" in args and "bytes_raw" in args:
             row = payload[pid]
             row[0] += 1
             row[1] += int(args.get("bytes_raw") or 0)
             row[2] += int(args.get("bytes_wire") or 0)
+            if args.get("algo"):
+                k = int(args.get("k") or 1)
+                arow = by_algo[(pid, str(args["algo"]))]
+                arow[0] += 1
+                arow[1] += int(args.get("bytes_raw") or 0)
+                arow[2] += int(args.get("bytes_wire") or 0)
+                arow[3] += int(args.get("hops") or 0) * k
+                arow[4] = int(args.get("bytes_hop") or 0) or arow[4]
     if payload:
         w("\nComms payload per rank (raw = fp32 bytes the gradient "
           "exchange replaces, wire = encoded payload):\n")
@@ -204,6 +219,17 @@ def report(path, spans, other, top=15, bins=10, xplane=None,
         for pid, (cnt, raw, wire) in sorted(payload.items(),
                                             key=lambda kv: str(kv[0])):
             w(f"{pid!s:>9}{cnt:>7}{raw / 1e6:>11.3f}{wire / 1e6:>11.3f}"
+              f"{(raw / wire if wire else 0.0):>8.2f}\n")
+    if by_algo:
+        w("\nComms per algorithm (hops = encoded ppermute exchanges; "
+          "psum is one fused exchange, hops n/a):\n")
+        w(f"{'rank/pid':>9}{'algo':>6}{'spans':>7}{'wire(MB)':>11}"
+          f"{'hops':>7}{'bytes/hop':>11}{'ratio':>8}\n")
+        for (pid, algo), (cnt, raw, wire, hops, bh) in sorted(
+                by_algo.items(), key=lambda kv: (str(kv[0][0]), kv[0][1])):
+            w(f"{pid!s:>9}{algo:>6}{cnt:>7}{wire / 1e6:>11.3f}"
+              f"{(hops if hops else '-'):>7}"
+              f"{(bh if bh else '-'):>11}"
               f"{(raw / wire if wire else 0.0):>8.2f}\n")
 
     step_walls = [dur / 1e3 for name, cat, _, dur, _, _, _ in spans
