@@ -31,16 +31,57 @@ BASELINE_SAMPLES_PER_SEC = 100.0
 BASELINE_RESNET50_IMG_PER_SEC = 1400.0
 
 
+def _cpu_smoke_goodput(budget_s=120.0):
+    """Bounded CPU-smoke goodput breakdown for outage rounds (ISSUE 20):
+    run the scaling harness's child (2 virtual CPU devices, a handful of
+    steps) in a subprocess with JAX_PLATFORMS=cpu and return its goodput
+    snapshot.  A backend_unavailable round then still carries SOME
+    evidence — proof the software stack trains and where its wall-clock
+    goes — instead of a bare error string.  Never raises; returns None
+    if even the CPU smoke can't run (that in itself is reported by the
+    caller as smoke=None, i.e. the outage is not tunnel-only)."""
+    import subprocess
+
+    try:
+        script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "benchmark", "opperf", "scaling.py")
+        env = dict(os.environ)
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env.pop("MXNET_FAULT_SPEC", None)
+        env.update(JAX_PLATFORMS="cpu",
+                   XLA_FLAGS="--xla_force_host_platform_device_count=2")
+        r = subprocess.run(
+            [sys.executable, script, "--child", "--devices", "2",
+             "--config", "dp", "--mode", "weak", "--steps", "5",
+             "--warmup", "2", "--per-device-batch", "8",
+             "--global-batch", "16"],
+            env=env, capture_output=True, text=True, timeout=budget_s)
+        for line in r.stdout.splitlines():
+            if line.startswith("SCALING_RESULT "):
+                res = json.loads(line[len("SCALING_RESULT "):])
+                snap = res.get("goodput") or {}
+                return {"samples_per_sec": res.get("samples_per_sec"),
+                        "goodput": snap.get("goodput"),
+                        "wall_s": snap.get("wall_s"),
+                        "top_overhead": snap.get("top_overhead")}
+    except Exception as e:  # the smoke is best-effort evidence, never fatal
+        print(f"bench: cpu smoke failed: {e}", file=sys.stderr)
+    return None
+
+
 def _emit_error(exc):
     """Structured one-line error JSON: a transient tunnel wedge must degrade
     to a parseable record, not an rc=1 traceback (the round-4 bench evidence
     died exactly that way — at backend init, through no fault of the
-    workload)."""
+    workload).  Since ISSUE 20 the record carries a ``cpu_smoke`` goodput
+    breakdown so an outage round still shows the stack trains on CPU and
+    where its seconds went."""
     mode = os.environ.get("MXNET_TPU_BENCH") or "bert_base"
     print(json.dumps({
         "metric": mode, "value": None, "unit": None, "vs_baseline": None,
         "status": "backend_unavailable",
         "error": f"{type(exc).__name__}: {exc}"[:800],
+        "cpu_smoke": _cpu_smoke_goodput(),
     }))
 
 

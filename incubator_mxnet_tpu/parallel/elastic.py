@@ -385,6 +385,24 @@ def watchdog_disarm():
         wd.disarm()
 
 
+_downtime_recorded = False
+
+
+def _record_supervisor_downtime():
+    """Fold the supervisor-measured restart gap (death of the previous
+    generation → this generation's spawn, ``MXNET_ELASTIC_DOWNTIME_S``
+    from the tools/supervise.py run manifest) into the goodput ledger's
+    downtime bucket — once per process; every generation is a fresh
+    process carrying the cumulative figure."""
+    global _downtime_recorded
+    if _downtime_recorded:
+        return
+    _downtime_recorded = True
+    downtime_s = _env_float("MXNET_ELASTIC_DOWNTIME_S", 0.0)
+    if downtime_s > 0:
+        _profiler.record_downtime(downtime_s, "elastic_restart")
+
+
 def init(watchdog=True, heartbeat=True):
     """Wire this worker into an ambient supervisor.  No-op (returns None)
     when ``MXNET_ELASTIC_SOCKET`` is unset, so training scripts can call
@@ -392,6 +410,7 @@ def init(watchdog=True, heartbeat=True):
     global _client
     _profiler.register_metrics_provider(
         "elastic", lambda: {"restarts": restart_generation()})
+    _record_supervisor_downtime()
     if not enabled():
         return None
     if _client is None:

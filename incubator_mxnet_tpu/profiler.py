@@ -96,6 +96,9 @@ __all__ = ["set_config", "start", "stop", "dump", "dumps", "pause", "resume",
            "render_prometheus",
            "start_metrics", "stop_metrics", "metrics_server_port",
            "straggler_report",
+           # -- goodput ledger (ISSUE 20) --
+           "goodput_snapshot", "cluster_goodput", "record_downtime",
+           "reset_goodput",
            # -- compilation observability (ISSUE 10) --
            "record_compile", "compile_site", "compile_registry",
            "compile_stats", "reset_compiles", "sig_array", "sig_static",
@@ -252,6 +255,8 @@ _counters = {
     "recompile_steady_state": 0,      # compiles after the guard armed
     "memory_oom_postmortem": 0,       # OOM/budget-breach postmortems emitted
     "memory_budget_refusal": 0,       # admissions deferred by a MemoryBudget
+    "goodput_snapshot": 0,            # goodput_snapshot() captures taken
+    "goodput_downtime_ms": 0,         # downtime ms recorded into the ledger
 }
 _counter_lock = _threading.Lock()
 
@@ -442,6 +447,38 @@ _BUCKET_OF = {
     "kvstore.pull": "comms",
 }
 
+# run-level goodput attribution (ISSUE 20): the same ROOT-span discipline
+# as _BUCKET_OF, but folding spans into the RUN ledger's exclusive
+# overhead buckets instead of the per-step host/comms split.  Precedence
+# rules for overlapping spans (documented in docs/observability.md):
+#
+# * ``dispatch.jit_compile`` is deliberately ABSENT — its wall is covered
+#   by the ``compile.jit`` span ``record_compile`` emits for every site
+#   (kvstore-tier AND spmd/fold), so compile time lands in "compile"
+#   exactly once instead of once in "host" and again in "compile";
+# * ``kvstore.bucketed_pushpull`` is absent for the same reason its
+#   children carry the _BUCKET_OF billing: the per-bucket
+#   ``kvstore.pushpull`` leaves inside it would double-bill the parent;
+# * only spans from the step-driving thread bill (a background prefetch
+#   worker's dispatch overlaps the run on the wall clock — billing it
+#   would break the buckets-sum-to-wall invariant the ledger exists for).
+_GOODPUT_BUCKET_OF = {
+    "dispatch.cache_hit": "host",
+    "dispatch.fallback": "host",
+    "dispatch.raw": "host",
+    "dispatch.backward": "host",
+    "bulk.flush": "host",
+    "fused.group_apply": "host",
+    "spmd.shard_batch": "host",
+    "io.wait": "data_wait",
+    "kvstore.pushpull": "comm",
+    "kvstore.push": "comm",
+    "kvstore.pull": "comm",
+    "compile.jit": "compile",
+    "elastic.snapshot": "checkpoint",
+    "elastic.restore": "checkpoint",
+}
+
 
 _ring_uid = 0  # unique chrome-trace tid per ring: OS thread idents are
                # recycled, and reusing one would merge distinct (dead)
@@ -575,13 +612,19 @@ def record_span(name, category, t0, t1=None, args=None, step=None):
         if t1 < t0:
             t1 = t0
     bucket = _BUCKET_OF.get(name)
-    if bucket is not None and _threading.get_ident() == _step_thread:
+    gbucket = _GOODPUT_BUCKET_OF.get(name)
+    if ((bucket is not None or gbucket is not None)
+            and _threading.get_ident() == _step_thread):
         # only the step-owning thread bills the step buckets: a background
         # io-prefetch worker's dispatch spans overlap the step on the wall
         # clock and would inflate host_ms past what the step critically
         # paid (its spans still land in the trace below)
         with _counter_lock:
-            _step_acc[bucket] = _step_acc.get(bucket, 0.0) + (t1 - t0)
+            if bucket is not None:
+                _step_acc[bucket] = _step_acc.get(bucket, 0.0) + (t1 - t0)
+            if gbucket is not None:
+                _goodput_acc[gbucket] = (
+                    _goodput_acc.get(gbucket, 0.0) + (t1 - t0))
     if _recording:
         # t1 stored raw (not as a duration): serialization derives begin
         # and end timestamps through the SAME float pipeline, so spans
@@ -1321,6 +1364,203 @@ def straggler_report():
             "comms_ms": worst.get("comms_ms", 0.0),
             "device_ms": worst.get("device_ms", 0.0),
             "ranks_compared": len(rows)}
+
+
+# ---------------------------------------------------------------------------
+# Goodput ledger (ISSUE 20): run-level wall-clock decomposition
+# ---------------------------------------------------------------------------
+
+# Where did the run's seconds go?  The per-step telemetry above answers
+# that for ONE step; the goodput ledger answers it for the RUN: every
+# armed second lands in exactly one bucket — compute (the residual),
+# host dispatch, data wait, comm, compile, checkpoint, pipeline bubble,
+# or elastic-restart downtime — accumulated from the spans/counters the
+# repo already records (no new per-step probes).
+#
+# Scope: the ledger is RUN-scoped (process generation), not recording-
+# session-scoped.  ``start()``/``stop()``/``pause()``/``resume()`` only
+# open/close the wall-clock window it integrates over; only an explicit
+# ``reset_goodput()`` zeroes it.  Downtime recorded by ``record_downtime``
+# (the supervisor's restart gap, fed through ``MXNET_ELASTIC_DOWNTIME_S``)
+# is added to BOTH its bucket and the wall — it happened while no
+# profiler in this process could observe anything.
+#
+# Invariant: buckets sum to wall_s by construction (compute is the
+# clamped residual), so ``goodput = compute / wall`` is a true fraction.
+
+_goodput_acc = {"host": 0.0, "data_wait": 0.0, "comm": 0.0,
+                "compile": 0.0, "checkpoint": 0.0, "downtime": 0.0}
+_goodput_downtime = {}        # reason -> seconds (record_downtime detail)
+_goodput_wall_s = 0.0         # closed armed windows, summed
+_goodput_win_t0 = _perf() if _active else None  # open window start
+_goodput_bubble_base_ms = 0   # pipeline_bubble_ms at the last reset
+
+_GOODPUT_BUCKETS = ("compute", "host", "data_wait", "comm", "compile",
+                    "checkpoint", "bubble", "downtime")
+
+
+def _goodput_open(now=None):
+    """Open the armed wall-clock window (idempotent)."""
+    global _goodput_win_t0
+    with _counter_lock:
+        if _goodput_win_t0 is None:
+            _goodput_win_t0 = _perf() if now is None else now
+
+
+def _goodput_close(now=None):
+    """Close the armed window, folding it into the wall total
+    (idempotent)."""
+    global _goodput_wall_s, _goodput_win_t0
+    with _counter_lock:
+        if _goodput_win_t0 is not None:
+            _goodput_wall_s += (_perf() if now is None else now) \
+                - _goodput_win_t0
+            _goodput_win_t0 = None
+
+
+def reset_goodput():
+    """Zero the run ledger (tests; an explicit fresh measurement window).
+    Re-baselines the bubble counter and reopens the wall window when the
+    profiler is armed."""
+    global _goodput_wall_s, _goodput_win_t0, _goodput_bubble_base_ms
+    with _counter_lock:
+        for k in _goodput_acc:
+            _goodput_acc[k] = 0.0
+        _goodput_downtime.clear()
+        _goodput_wall_s = 0.0
+        _goodput_win_t0 = _perf() if _active else None
+        _goodput_bubble_base_ms = _counters.get("pipeline_bubble_ms", 0)
+
+
+def record_downtime(seconds, reason="downtime"):
+    """Account seconds this process generation did NOT exist (or could
+    not train) into the ledger's downtime bucket — the supervisor's
+    death→respawn gap, fed via ``MXNET_ELASTIC_DOWNTIME_S`` and consumed
+    once by ``parallel.elastic.init()``.  Adds to both the bucket and the
+    wall (the invariant: buckets sum to wall)."""
+    seconds = float(seconds)
+    if seconds <= 0:
+        return
+    reason = str(reason)
+    with _counter_lock:
+        _goodput_acc["downtime"] += seconds
+        _goodput_downtime[reason] = (
+            _goodput_downtime.get(reason, 0.0) + seconds)
+    incr("goodput_downtime_ms", int(round(seconds * 1e3)))
+
+
+def goodput_snapshot():
+    """The run's wall-clock decomposition::
+
+        {"schema", "rank", "host", "time_unix", "active", "wall_s",
+         "goodput", "buckets_s": {compute, host, data_wait, comm,
+         compile, checkpoint, bubble, downtime}, "overhead_s",
+         "top_overhead", "downtime_detail"}
+
+    ``wall_s`` integrates armed (``_active``) time plus recorded
+    downtime; every bucket is exclusive (see docs/observability.md for
+    the overlap-precedence rules) and ``compute`` is the clamped
+    residual, so the buckets sum to ``wall_s`` by construction.
+    ``goodput`` is compute/wall (None until any wall exists).  Schema-
+    versioned like ``metrics_snapshot``; embedded in ``dump()``'s
+    otherData and exported by the "goodput" metrics provider."""
+    incr("goodput_snapshot")
+    now = _perf()
+    with _counter_lock:
+        acc = dict(_goodput_acc)
+        wall = _goodput_wall_s
+        if _goodput_win_t0 is not None:
+            wall += now - _goodput_win_t0
+        bubble_ms = max(0, _counters.get("pipeline_bubble_ms", 0)
+                        - _goodput_bubble_base_ms)
+        detail = dict(_goodput_downtime)
+        rank, host = _proc["rank"], _proc["host"]
+        armed = _goodput_win_t0 is not None
+    wall += acc["downtime"]  # the process did not exist: wall grows too
+    buckets = {
+        "host": acc["host"],
+        "data_wait": acc["data_wait"],
+        "comm": acc["comm"],
+        "compile": acc["compile"],
+        "checkpoint": acc["checkpoint"],
+        "bubble": bubble_ms / 1e3,
+        "downtime": acc["downtime"],
+    }
+    overhead = sum(buckets.values())
+    buckets["compute"] = max(0.0, wall - overhead)
+    buckets = {k: round(buckets[k], 6) for k in _GOODPUT_BUCKETS}
+    top = sorted(((k, v) for k, v in buckets.items()
+                  if k != "compute" and v > 0),
+                 key=lambda kv: -kv[1])
+    return {
+        "schema": 1,
+        "rank": rank,
+        "host": host,
+        "time_unix": time.time(),
+        "active": armed,
+        "wall_s": round(wall, 6),
+        "goodput": round(buckets["compute"] / wall, 6) if wall > 0 else None,
+        "buckets_s": buckets,
+        "overhead_s": round(min(overhead, wall), 6),
+        "top_overhead": [[k, v] for k, v in top[:3]],
+        "downtime_detail": {k: round(v, 6) for k, v in detail.items()},
+    }
+
+
+def _goodput_provider():
+    """Built-in "goodput" metrics provider: the ledger as flat gauges —
+    rides every export surface (JSONL, /metrics as ``mxnet_goodput_*``,
+    heartbeat piggyback) and is what ``cluster_goodput`` aggregates."""
+    snap = goodput_snapshot()
+    out = {"wall_s": snap["wall_s"], "goodput": snap["goodput"]}
+    for k, v in snap["buckets_s"].items():
+        out[f"{k}_s"] = v
+    return out
+
+
+register_metrics_provider("goodput", _goodput_provider)
+
+
+def cluster_goodput():
+    """Whole-job goodput over every known rank (local ledger + the peer
+    snapshots the PR 6 heartbeat piggyback delivered to rank 0)::
+
+        {"schema", "ranks", "wall_s", "goodput",
+         "worst": {"rank", "host", "goodput", "bucket", "bucket_s"}}
+
+    Job goodput is wall-weighted (sum of compute over sum of wall), the
+    worst rank is the lowest-goodput one, and ``bucket`` names where its
+    time went (its largest overhead bucket).  Returns None when no rank
+    has any wall yet."""
+    rows = []
+    for snap in _cluster_snapshots():
+        g = (snap.get("providers") or {}).get("goodput")
+        if not isinstance(g, dict):
+            continue
+        wall = g.get("wall_s")
+        if not isinstance(wall, (int, float)) or wall <= 0:
+            continue
+        rows.append((snap.get("rank", -1), snap.get("host", "?"), g))
+    if not rows:
+        return None
+    tot_wall = sum(g["wall_s"] for _, _, g in rows)
+    tot_compute = sum(g.get("compute_s") or 0.0 for _, _, g in rows)
+    worst_rank, worst_host, worst = min(
+        rows, key=lambda r: (r[2].get("goodput") is None,
+                             r[2].get("goodput") or 0.0))
+    over = [(k[:-2], v) for k, v in worst.items()
+            if k.endswith("_s") and k not in ("wall_s", "compute_s")
+            and isinstance(v, (int, float)) and v > 0]
+    top = max(over, key=lambda kv: kv[1]) if over else (None, 0.0)
+    return {
+        "schema": 1,
+        "ranks": len(rows),
+        "wall_s": round(tot_wall, 6),
+        "goodput": round(tot_compute / tot_wall, 6) if tot_wall > 0 else None,
+        "worst": {"rank": worst_rank, "host": worst_host,
+                  "goodput": worst.get("goodput"),
+                  "bucket": top[0], "bucket_s": round(top[1], 6)},
+    }
 
 
 # ---------------------------------------------------------------------------
@@ -2307,6 +2547,9 @@ def set_config(**kwargs):
             # would bill the whole gap to the next step (stop() resets it
             # for the same reason)
             _step_t0 = None
+            _goodput_open()
+        elif was_active and not _active:
+            _goodput_close()
 
 
 def state():
@@ -2365,6 +2608,10 @@ def _arm(fresh):
     _step_thread = _threading.get_ident()
     _recording = True
     _active = True
+    # the RUN-scoped goodput ledger only opens its wall window here —
+    # start() discards spans but never the run's ledger (reset_goodput()
+    # is the explicit reset)
+    _goodput_open(_armed_at)
     _state.update(running=True, dir=trace_dir, t0=time.perf_counter())
 
 
@@ -2405,6 +2652,10 @@ def stop():
     # a later telemetry-only step_boundary must anchor fresh, not measure
     # the wall-clock gap since this session's last boundary
     _step_t0 = None
+    if not _active:
+        # goodput wall stops integrating while nothing observes: a paused
+        # profiler billing the pause to "compute" would inflate goodput
+        _goodput_close()
     _state["running"] = False
 
 
@@ -2499,6 +2750,7 @@ def dump(finished=True, profile_process="worker"):
                            else None),
             },
             "recorder": recorder_stats(),
+            "goodput": goodput_snapshot(),
             "compiles": compile_registry(),
             "compile_guard": compile_guard_state(),
             "xprof_dir": _state["dir"],
@@ -2629,6 +2881,13 @@ def dumps(reset=False):
             lines.append(f"{o:<36}{i['category']:<18}{i['bytes']:>14}"
                          f"{i['peak']:>14}")
         lines.append(f"{'TOTAL':<36}{'':<18}{led['total_bytes']:>14}")
+    gp = goodput_snapshot()
+    if gp["wall_s"] > 0:
+        lines.append("")
+        lines.append(f"Goodput ledger: wall {gp['wall_s']:.3f} s, "
+                     f"goodput {gp['goodput'] * 100:.1f}%"
+                     + ("".join(f", {k} {v:.3f} s"
+                                for k, v in gp["top_overhead"])))
     csites = compile_stats()
     if csites:
         lines.append("")

@@ -52,7 +52,16 @@
 #                  (exits non-zero if a steady-state folded step is ever
 #                  more than ONE host dispatch or recompiles after
 #                  warmup) plus the fast fold/overlap tests
-#  13. tpu       — (opt-in: CI_TPU=1) on-chip correctness tier, needs a chip
+#  13. scaling   — goodput/scaling tier: the scaling-curve harness in
+#                  --smoke mode (samples/sec-vs-N over the CPU mesh with
+#                  per-point goodput ledgers; exits non-zero on a
+#                  post-warmup recompile, an efficiency-floor miss, or a
+#                  live-vs-merged-trace attribution mismatch), the fast
+#                  goodput-ledger tests, then tools/perf_history.py
+#                  gating the bench trajectory + the fresh evidence
+#                  against the committed baseline (outage rounds are
+#                  classified backend_unavailable, never regressions)
+#  14. tpu       — (opt-in: CI_TPU=1) on-chip correctness tier, needs a chip
 #
 # The unit tier is split in two so each invocation fits a ~10 min shell on
 # a 1-core box (the full suite exceeds one 600 s window there); `unit` is
@@ -93,7 +102,7 @@ TIERS=()
 for t in "$@"; do
     if [ "$t" = unit ]; then TIERS+=(unit1 unit2); else TIERS+=("$t"); fi
 done
-[ ${#TIERS[@]} -eq 0 ] && TIERS=(unit1 unit2 zoo dist examples bench profiler chaos serving io parallel comm fold)
+[ ${#TIERS[@]} -eq 0 ] && TIERS=(unit1 unit2 zoo dist examples bench profiler chaos serving io parallel comm fold scaling)
 [ "${CI_TPU:-0}" = "1" ] && TIERS+=(tpu)
 
 declare -A RESULT
@@ -246,6 +255,23 @@ for tier in "${TIERS[@]}"; do
                 python benchmark/opperf/step_fold.py --smoke >/dev/null
                 python benchmark/opperf/step_fold.py --k --smoke >/dev/null
                 python -m pytest tests/test_step_fold.py -q -m "not slow" '"${CI_PYTEST_ARGS:-}"
+            ;;
+        scaling)
+            # goodput/scaling tier: the harness in --smoke mode IS the
+            # regression guard (each curve point is a fresh subprocess
+            # under MXNET_COMPILE_GUARD=raise; non-zero exit on a
+            # post-warmup recompile, an efficiency-floor miss, or if the
+            # live numbers stop matching the merged per-rank trace
+            # ledgers), the fast goodput-ledger tests, then perf_history
+            # gates the BENCH trajectory + this evidence against the
+            # committed baseline
+            run_tier scaling "${CPU_ENV[@]}" bash -c '
+                set -e
+                ev="/tmp/ci_scaling_evidence_$$.json"
+                trap "rm -f \"$ev\"" EXIT
+                python benchmark/opperf/scaling.py --smoke --json "$ev" >/dev/null
+                python -m pytest tests/test_goodput.py -q -m "not slow" '"${CI_PYTEST_ARGS:-}"'
+                python tools/perf_history.py --scaling "$ev"'
             ;;
         tpu)
             # on-chip tier: runs under the ambient axon env (NOT cpu-cleaned)

@@ -21,10 +21,19 @@ Per generation ``g`` the workers additionally see:
 * ``MXNET_ELASTIC_SOCKET``  — this supervisor's control address
 * ``MXNET_ELASTIC_RESTART`` — ``g`` (0 on the first launch), so fault
   gating (``gen=``) and the restart metrics gauge see the generation
+* ``MXNET_ELASTIC_DOWNTIME_S`` — cumulative supervisor-observed downtime
+  (previous generation's end → this spawn, including backoff) which
+  ``parallel.elastic.init()`` folds into the goodput ledger's downtime
+  bucket (ISSUE 20)
 
 Reports exactly ONE ``ELASTIC_RESTART {json}`` line per re-formation
 (and one ``ELASTIC_GIVEUP`` line if the budget runs out) — chaos tests
-count these lines.
+count these lines.  With ``--manifest PATH`` (or
+``MXNET_ELASTIC_MANIFEST``) the same story is kept machine-readable: a
+JSON run manifest (schema-versioned; per-generation start/end
+timestamps, exit causes, downtime seconds, restart totals) atomically
+rewritten at every transition, so tooling reads the run's fault history
+from ONE file instead of scraping log lines.
 
 Env defaults: ``MXNET_ELASTIC_MAX_RESTARTS`` (3),
 ``MXNET_ELASTIC_BACKOFF_S`` (1.0, doubled per restart, capped at 30),
@@ -129,7 +138,7 @@ class ControlServer(threading.Thread):
             return [r for r, t in self._beats.items() if now - t > lease_s]
 
 
-def spawn_ranks(args, ctrl_port, gen):
+def spawn_ranks(args, ctrl_port, gen, downtime_s=0.0):
     holder, port = reserve_port()
     ps_holder, ps_port = reserve_port()
     procs = []
@@ -144,6 +153,7 @@ def spawn_ranks(args, ctrl_port, gen):
             DMLC_WORKER_ID=str(rank),
             MXNET_ELASTIC_SOCKET=f"127.0.0.1:{ctrl_port}",
             MXNET_ELASTIC_RESTART=str(gen),
+            MXNET_ELASTIC_DOWNTIME_S=f"{downtime_s:.3f}",
         )
         env["MXNET_ASYNC_PS_PORT"] = str(ps_port)
         env.setdefault("JAX_PLATFORMS", "cpu")
@@ -169,11 +179,26 @@ def kill_all(procs):
             pass
 
 
-def run_generation(args, ctrl, gen):
+def write_manifest(path, manifest):
+    """Atomically (tmp + rename) rewrite the run manifest — a crashed
+    supervisor leaves the last complete transition, never a torn file."""
+    if not path:
+        return
+    tmp = path + ".tmp"
+    try:
+        with open(tmp, "w") as f:
+            json.dump(manifest, f, indent=1)
+        os.replace(tmp, path)
+    except OSError as e:
+        print(f"[supervise] manifest write failed: {e}",
+              file=sys.stderr, flush=True)
+
+
+def run_generation(args, ctrl, gen, downtime_s=0.0):
     """Run one cohort to completion.  Returns ``(rc, failure)`` —
     ``(0, None)`` when every rank exits cleanly."""
     ctrl.new_generation()
-    procs = spawn_ranks(args, ctrl.port, gen)
+    procs = spawn_ranks(args, ctrl.port, gen, downtime_s)
     try:
         while True:
             live = [p for p in procs if p.poll() is None]
@@ -211,6 +236,10 @@ def main(argv=None):
     ap.add_argument("--lease-s", type=float,
                     default=float(os.environ.get(
                         "MXNET_ELASTIC_LEASE_S", "15")))
+    ap.add_argument("--manifest",
+                    default=os.environ.get("MXNET_ELASTIC_MANIFEST") or None,
+                    help="path for the machine-readable JSON run manifest"
+                         " (generations, exit causes, downtime)")
     ap.add_argument("command", nargs=argparse.REMAINDER)
     args = ap.parse_args(argv)
     if not args.command:
@@ -225,12 +254,34 @@ def main(argv=None):
     signal.signal(signal.SIGTERM, on_term)
 
     gen = 0
+    total_downtime = 0.0
+    manifest = {
+        "schema": 1,
+        "started_unix": time.time(),
+        "num_workers": args.num_workers,
+        "command": list(args.command),
+        "generations": [],
+        "restarts": 0,
+        "total_downtime_s": 0.0,
+        "final": None,
+        "ended_unix": None,
+    }
+    write_manifest(args.manifest, manifest)
     while True:
-        rc, failure = run_generation(args, ctrl, gen)
+        gen_start = time.time()
+        rc, failure = run_generation(args, ctrl, gen, total_downtime)
+        gen_end = time.time()
+        gen_rec = {"generation": gen, "start_unix": gen_start,
+                   "end_unix": gen_end,
+                   "exit_cause": failure or {"reason": "clean"},
+                   "downtime_s": 0.0}
+        manifest["generations"].append(gen_rec)
         if rc == 0:
             if gen:
                 print(f"[supervise] run complete after {gen} restart(s)",
                       file=sys.stderr, flush=True)
+            manifest.update(final="complete", ended_unix=time.time())
+            write_manifest(args.manifest, manifest)
             return 0
         report = dict(failure or {}, event="elastic_restart", generation=gen,
                       restarts_left=args.max_restarts - gen)
@@ -238,6 +289,8 @@ def main(argv=None):
             report["event"] = "elastic_giveup"
             print("ELASTIC_GIVEUP " + json.dumps(report),
                   file=sys.stderr, flush=True)
+            manifest.update(final="giveup", ended_unix=time.time())
+            write_manifest(args.manifest, manifest)
             return rc if rc > 0 else 1
         # exactly ONE restart report line per re-formation (chaos tests
         # count these)
@@ -249,6 +302,15 @@ def main(argv=None):
         except Exception:
             pass
         time.sleep(min(args.backoff * (2 ** gen), 30.0))
+        # supervisor-observed downtime for THIS re-formation: generation
+        # end (death detected + survivors killed) → the instant the next
+        # cohort spawns.  The cumulative figure rides
+        # MXNET_ELASTIC_DOWNTIME_S into the relaunched workers' ledgers.
+        gen_rec["downtime_s"] = round(time.time() - gen_end, 3)
+        total_downtime = round(total_downtime + gen_rec["downtime_s"], 3)
+        manifest["restarts"] = gen + 1
+        manifest["total_downtime_s"] = total_downtime
+        write_manifest(args.manifest, manifest)
         gen += 1
 
 

@@ -114,6 +114,7 @@ def merge_traces(paths):
             "steps": od.get("steps"),
             "memory_watermark_bytes": od.get("memory_watermark_bytes"),
             "memory": od.get("memory"),   # ledger/postmortems (ISSUE 12)
+            "goodput": od.get("goodput"),  # run ledger (ISSUE 20)
         }
     # stable ts sort keeps each file's intra-instant B/E ordering (pairing
     # is per (pid, tid), so cross-rank interleaving at equal ts is inert)
@@ -192,6 +193,67 @@ def check_merged(doc, expect_ranks=None):
             "steps_per_rank": {p: len(v) for p, v in step_ids.items()}}
 
 
+def goodput_summary(doc):
+    """Cluster goodput from a merged trace's per-rank ledger snapshots
+    (``otherData.ranks.*.goodput``, ISSUE 20)::
+
+        {"ranks", "wall_s", "goodput", "buckets_s", "per_rank",
+         "worst": {"rank", "goodput", "bucket", "bucket_s"}}
+
+    Whole-job goodput is wall-weighted (sum compute / sum wall) — the
+    same aggregation ``profiler.cluster_goodput()`` computes live over
+    the heartbeat piggyback, recomputed offline from the dumps.  Returns
+    None when no rank carried a ledger."""
+    rank_snaps = []
+    for rank, entry in sorted(((doc.get("otherData") or {}).get("ranks")
+                               or {}).items(), key=lambda kv: int(kv[0])):
+        gp = (entry or {}).get("goodput")
+        if isinstance(gp, dict) and (gp.get("wall_s") or 0) > 0:
+            rank_snaps.append((int(rank), gp))
+    if not rank_snaps:
+        return None
+    tot_wall = sum(gp["wall_s"] for _, gp in rank_snaps)
+    buckets = {}
+    per_rank = {}
+    for rank, gp in rank_snaps:
+        for k, v in (gp.get("buckets_s") or {}).items():
+            buckets[k] = buckets.get(k, 0.0) + (v or 0.0)
+        per_rank[rank] = {"wall_s": gp["wall_s"],
+                          "goodput": gp.get("goodput"),
+                          "top_overhead": gp.get("top_overhead") or []}
+    worst_rank, worst = min(rank_snaps,
+                            key=lambda r: r[1].get("goodput") or 0.0)
+    wtop = (worst.get("top_overhead") or [[None, 0.0]])[0]
+    return {
+        "ranks": len(rank_snaps),
+        "wall_s": round(tot_wall, 6),
+        "goodput": (round(buckets.get("compute", 0.0) / tot_wall, 6)
+                    if tot_wall > 0 else None),
+        "buckets_s": {k: round(v, 6) for k, v in buckets.items()},
+        "per_rank": per_rank,
+        "worst": {"rank": worst_rank, "goodput": worst.get("goodput"),
+                  "bucket": wtop[0], "bucket_s": wtop[1]},
+    }
+
+
+def format_goodput(summary):
+    """Human-readable ``--goodput`` section lines."""
+    lines = [f"goodput: {summary['ranks']} rank(s), wall "
+             f"{summary['wall_s']:.3f} s, job goodput "
+             f"{(summary['goodput'] or 0) * 100:.1f}%"]
+    for rank, row in sorted(summary["per_rank"].items()):
+        top = ", ".join(f"{k} {v:.3f}s" for k, v in row["top_overhead"])
+        lines.append(f"  rank {rank}: wall {row['wall_s']:.3f} s, goodput "
+                     f"{(row['goodput'] or 0) * 100:.1f}%"
+                     + (f" ({top})" if top else ""))
+    w = summary["worst"]
+    if w["bucket"]:
+        lines.append(f"  worst: rank {w['rank']} "
+                     f"({(w['goodput'] or 0) * 100:.1f}%) — top overhead "
+                     f"{w['bucket']} {w['bucket_s']:.3f} s")
+    return lines
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("traces", nargs="+",
@@ -203,6 +265,9 @@ def main(argv=None):
                         "monotonicity) and fail loudly when broken")
     p.add_argument("--expect-ranks", type=int, default=None,
                    help="with --check: require exactly ranks 0..N-1")
+    p.add_argument("--goodput", action="store_true",
+                   help="print the cluster goodput section (per-rank "
+                        "ledgers + wall-weighted job goodput)")
     args = p.parse_args(argv)
     try:
         merged = merge_traces(args.traces)
@@ -220,6 +285,13 @@ def main(argv=None):
               f"{summary['spans']} spans, "
               f"{summary['counter_events']} counter events, steps/rank "
               f"{summary['steps_per_rank']}")
+    if args.goodput:
+        gp = goodput_summary(merged)
+        if gp is None:
+            print("goodput: no per-rank ledger in these traces "
+                  "(pre-ISSUE-20 dumps?)")
+        else:
+            print("\n".join(format_goodput(gp)))
     with open_trace(args.out, "wt") as f:
         json.dump(merged, f)
     print(f"merged {len(args.traces)} trace(s) -> {args.out}")

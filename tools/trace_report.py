@@ -95,10 +95,67 @@ def histogram(values, bins):
             for i, c in enumerate(counts)]
 
 
+# span-name -> goodput bucket for the header's trace-derived fallback
+# (pre-ISSUE-20 dumps carry no otherData.goodput); mirrors
+# profiler._GOODPUT_BUCKET_OF
+_GOODPUT_SPAN_BUCKET = {
+    "dispatch.cache_hit": "host", "dispatch.fallback": "host",
+    "dispatch.raw": "host", "dispatch.backward": "host",
+    "bulk.flush": "host", "fused.group_apply": "host",
+    "spmd.shard_batch": "host", "io.wait": "data_wait",
+    "kvstore.pushpull": "comm", "kvstore.push": "comm",
+    "kvstore.pull": "comm", "compile.jit": "compile",
+    "elastic.snapshot": "checkpoint", "elastic.restore": "checkpoint",
+}
+
+
+def run_summary(other, spans):
+    """The numbers behind the run-summary header: ``(wall_s, goodput,
+    top_overhead, source)``.  Prefers the embedded ledger
+    (``otherData.goodput`` of a single-rank dump, or the per-rank
+    ledgers of a merged trace aggregated the same way ``trace_merge
+    --goodput`` does); falls back to approximating from the spans
+    themselves (span extent as wall, bucket-mapped span sums as
+    overhead) so pre-ledger traces still get a header."""
+    gp = (other or {}).get("goodput")
+    if isinstance(gp, dict) and (gp.get("wall_s") or 0) > 0:
+        return (gp["wall_s"], gp.get("goodput"),
+                gp.get("top_overhead") or [], "ledger")
+    if (other or {}).get("ranks"):
+        summ = trace_merge.goodput_summary({"otherData": other})
+        if summ is not None:
+            top3 = sorted(((k, v) for k, v in summ["buckets_s"].items()
+                           if k != "compute" and v > 0),
+                          key=lambda kv: -kv[1])[:3]
+            return summ["wall_s"], summ["goodput"], top3, "ledger(merged)"
+    if not spans:
+        return None
+    t0 = min(s[2] for s in spans)
+    t1 = max(s[2] + s[3] for s in spans)
+    wall_s = max(0.0, (t1 - t0) / 1e6)
+    buckets = defaultdict(float)
+    for name, _, _, dur, _, _, _ in spans:
+        b = _GOODPUT_SPAN_BUCKET.get(name)
+        if b is not None:
+            buckets[b] += dur / 1e6
+    overhead = sum(buckets.values())
+    goodput = (max(0.0, wall_s - overhead) / wall_s) if wall_s > 0 else None
+    top3 = sorted(buckets.items(), key=lambda kv: -kv[1])[:3]
+    return wall_s, goodput, [[k, round(v, 6)] for k, v in top3], "spans"
+
+
 def report(path, spans, other, top=15, bins=10, xplane=None,
            out=sys.stdout):
     w = out.write
 
+    # the first line answers "where did the time go" (ISSUE 20)
+    summ = run_summary(other, spans)
+    if summ is not None:
+        wall_s, goodput, top3, source = summ
+        over = ", ".join(f"{k} {v:.3f}s" for k, v in top3) or "none"
+        w(f"run: wall {wall_s:.3f} s, goodput "
+          f"{(goodput or 0) * 100:.1f}% [{source}] — top overhead: "
+          f"{over}\n")
     w(f"trace: {path} — {len(spans)} spans\n\n")
 
     by_cat = defaultdict(lambda: [0, 0.0])
